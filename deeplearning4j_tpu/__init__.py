@@ -1,0 +1,43 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of the
+deeplearning4j stack (reference: lhfde/deeplearning4j).  Shipping modules:
+
+- ``ops``       — op catalog (libnd4j declarable-op parity) as namespaced
+                  functions over jnp/lax (Nd4j.math()/nn()/cnn()/... façades).
+- ``nn``        — config-first neural-network API: layer catalog,
+                  MultiLayerNetwork / ComputationGraph with JSON round-trip
+                  (DL4J deeplearning4j-nn parity).
+- ``train``     — training loop, updaters (optax), schedules, listeners
+                  (DL4J optimize/ + SameDiff TrainingConfig parity).
+- ``evaluation``— Evaluation / RegressionEvaluation / ROC / calibration
+                  parity (org.nd4j.evaluation).
+- ``data``      — DataVec-parity ETL: DataSet/iterators, normalizers,
+                  datasets (MNIST/CIFAR/HAR/Iris with offline fallbacks).
+- ``io``        — checkpointing (ModelSerializer parity: config JSON + params
+                  + updater state), CheckpointListener.
+- ``obs``       — observability: listener bus, jsonl metrics, profiler,
+                  NaN panic (ND4J OpProfiler / DL4J listeners parity).
+- ``utils``     — flat-param-vector views and pytree helpers.
+
+The build plan (SURVEY.md §7) adds, in later milestones: ``autodiff``
+(StableHLO export, grad-check harness), ``parallel`` (mesh/DP/TP/CP over
+ICI collectives, gradient-compression codec), ``models`` (zoo: LeNet,
+ResNet-50, LSTM, BERT), ``importers`` (Keras-H5, TF-checkpoint), and
+Pallas kernels under ``ops/pallas``.
+
+Reference citations use repo-relative paths of lhfde/deeplearning4j, e.g.
+``nd4j/.../org/nd4j/autodiff/samediff/SameDiff.java``.
+"""
+
+from deeplearning4j_tpu.config import get_config, set_config, dtype_policy, set_dtype_policy
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "get_config",
+    "set_config",
+    "dtype_policy",
+    "set_dtype_policy",
+    "__version__",
+]

@@ -1,0 +1,110 @@
+"""Global configuration and dtype policy.
+
+Replaces the reference's three overlapping config surfaces
+(``ND4JSystemProperties`` / ``Nd4jEnvironmentVars`` /
+``Nd4j.getEnvironment()`` — see nd4j-api ``org/nd4j/config/`` and
+``sd::Environment`` in libnd4j ``include/system/Environment.h``) with ONE
+dataclass-based config overridable by ``DL4J_TPU_*`` environment variables.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Any
+
+import jax.numpy as jnp
+
+_ENV_PREFIX = "DL4J_TPU_"
+
+
+@dataclasses.dataclass
+class DTypePolicy:
+    """Mixed-precision policy: params stored in ``param_dtype``, matmuls/convs
+    computed in ``compute_dtype``, outputs (losses, metrics) in
+    ``output_dtype``.  On TPU the MXU wants bfloat16 inputs; float32 params
+    keep optimizer numerics intact (the reference is float32-everywhere —
+    libnd4j ``DataType`` enum — so ``float32`` policy gives bit-parity while
+    ``bfloat16`` policy gives speed)."""
+
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.float32
+    output_dtype: Any = jnp.float32
+
+    @classmethod
+    def bf16(cls) -> "DTypePolicy":
+        return cls(param_dtype=jnp.float32, compute_dtype=jnp.bfloat16, output_dtype=jnp.float32)
+
+    @classmethod
+    def f32(cls) -> "DTypePolicy":
+        return cls()
+
+
+@dataclasses.dataclass
+class Config:
+    """Runtime knobs (``Nd4j.getEnvironment()`` parity).
+
+    - ``debug`` / ``verbose``: mirrors sd::Environment toggles.
+    - ``nan_panic`` / ``inf_panic``: OpProfiler NAN_PANIC/INF_PANIC modes
+      (nd4j-api ``org/nd4j/linalg/profiler/OpProfiler``): scan step outputs
+      and raise on the first non-finite value.
+    - ``default_seed``: global RNG seed used when nets don't specify one.
+    - ``metrics_dir``: where jsonl metric streams are written.
+    - ``prefetch_size``: AsyncDataSetIterator-parity prefetch queue depth.
+    """
+
+    debug: bool = False
+    verbose: bool = False
+    nan_panic: bool = False
+    inf_panic: bool = False
+    default_seed: int = 0
+    metrics_dir: str = "runs"
+    prefetch_size: int = 2
+    profiling: bool = False
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        cfg = cls()
+        for f in dataclasses.fields(cls):
+            raw = os.environ.get(_ENV_PREFIX + f.name.upper())
+            if raw is None:
+                continue
+            if f.type in ("bool", bool):
+                setattr(cfg, f.name, raw.lower() in ("1", "true", "yes"))
+            elif f.type in ("int", int):
+                setattr(cfg, f.name, int(raw))
+            else:
+                setattr(cfg, f.name, raw)
+        return cfg
+
+
+_lock = threading.Lock()
+_config: Config | None = None
+_policy = DTypePolicy()
+
+
+def get_config() -> Config:
+    global _config
+    with _lock:
+        if _config is None:
+            _config = Config.from_env()
+        return _config
+
+
+def set_config(**kwargs: Any) -> Config:
+    cfg = get_config()
+    for k, v in kwargs.items():
+        if not hasattr(cfg, k):
+            raise AttributeError(f"unknown config key: {k}")
+        setattr(cfg, k, v)
+    return cfg
+
+
+def dtype_policy() -> DTypePolicy:
+    return _policy
+
+
+def set_dtype_policy(policy: DTypePolicy) -> None:
+    global _policy
+    _policy = policy

@@ -1,0 +1,20 @@
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.data.iterators import (
+    DataSetIterator,
+    ListDataSetIterator,
+    ArrayDataSetIterator,
+    AsyncDataSetIterator,
+    EarlyTerminationIterator,
+)
+from deeplearning4j_tpu.data.normalizers import (
+    NormalizerStandardize,
+    NormalizerMinMaxScaler,
+    ImagePreProcessingScaler,
+)
+
+__all__ = [
+    "DataSet", "MultiDataSet",
+    "DataSetIterator", "ListDataSetIterator", "ArrayDataSetIterator",
+    "AsyncDataSetIterator", "EarlyTerminationIterator",
+    "NormalizerStandardize", "NormalizerMinMaxScaler", "ImagePreProcessingScaler",
+]

@@ -1,0 +1,71 @@
+"""DataSet / MultiDataSet — the batch containers.
+
+Parity with ND4J ``org/nd4j/linalg/dataset/DataSet.java`` (features,
+labels, featuresMask, labelsMask) and ``MultiDataSet`` (lists of each).
+Registered as jax pytrees so a batch can cross the jit boundary directly
+and be donated/sharded.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class DataSet:
+    features: Any = None
+    labels: Any = None
+    features_mask: Optional[Any] = None
+    labels_mask: Optional[Any] = None
+
+    def num_examples(self) -> int:
+        return 0 if self.features is None else int(self.features.shape[0])
+
+    def split_test_and_train(self, n_train: int) -> tuple["DataSet", "DataSet"]:
+        def take(arr, lo, hi):
+            return None if arr is None else arr[lo:hi]
+        n = self.num_examples()
+        train = DataSet(*[take(a, 0, n_train) for a in
+                          (self.features, self.labels, self.features_mask, self.labels_mask)])
+        test = DataSet(*[take(a, n_train, n) for a in
+                         (self.features, self.labels, self.features_mask, self.labels_mask)])
+        return train, test
+
+    def shuffle(self, seed: int = 0) -> "DataSet":
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(self.num_examples())
+        def pick(arr):
+            return None if arr is None else np.asarray(arr)[idx]
+        return DataSet(pick(self.features), pick(self.labels),
+                       pick(self.features_mask), pick(self.labels_mask))
+
+    def batch_by(self, batch_size: int) -> list["DataSet"]:
+        n = self.num_examples()
+        out = []
+        for lo in range(0, n, batch_size):
+            hi = min(lo + batch_size, n)
+            out.append(DataSet(
+                self.features[lo:hi], self.labels[lo:hi],
+                None if self.features_mask is None else self.features_mask[lo:hi],
+                None if self.labels_mask is None else self.labels_mask[lo:hi]))
+        return out
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class MultiDataSet:
+    """N features arrays + M labels arrays (``MultiDataSet.java``) — the
+    ComputationGraph batch type."""
+
+    features: Sequence[Any] = dataclasses.field(default_factory=list)
+    labels: Sequence[Any] = dataclasses.field(default_factory=list)
+    features_masks: Optional[Sequence[Any]] = None
+    labels_masks: Optional[Sequence[Any]] = None
+
+    def num_examples(self) -> int:
+        return 0 if not self.features else int(self.features[0].shape[0])

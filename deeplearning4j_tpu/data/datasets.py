@@ -1,0 +1,177 @@
+"""Canned datasets — MnistDataSetIterator / Cifar10 / UCI-HAR parity.
+
+Parity with DL4J ``deeplearning4j-datasets``
+(``org/deeplearning4j/datasets/iterator/impl/MnistDataSetIterator.java``,
+``Cifar10DataSetIterator``, fetchers in ``datasets/fetchers/``).  The
+reference downloads+caches; this environment has NO network, so each
+loader (a) reads the real on-disk format from ``root`` if present
+(idx/ubyte for MNIST, python pickle-free binary batches for CIFAR-10,
+txt for UCI HAR), and (b) otherwise falls back to a DETERMINISTIC
+synthetic dataset with the same shapes — clearly flagged via
+``synthetic=True`` on the returned iterators — so tests and benches run
+hermetically.
+
+Synthetic data is class-template + noise, hard enough that learning is
+measurable (accuracy ≫ chance requires real training) but easy enough
+that small models converge in a few epochs.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+
+DEFAULT_ROOT = os.environ.get("DL4J_TPU_DATA_DIR", os.path.expanduser("~/.dl4j_tpu/data"))
+
+
+def _one_hot(y: np.ndarray, n: int) -> np.ndarray:
+    out = np.zeros((y.shape[0], n), dtype=np.float32)
+    out[np.arange(y.shape[0]), y] = 1.0
+    return out
+
+
+# ------------------------------------------------------------------ MNIST
+def _read_idx(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        shape = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(shape)
+
+
+def _find(root: str, names: list[str]) -> Optional[str]:
+    for name in names:
+        for candidate in (os.path.join(root, name), os.path.join(root, name + ".gz")):
+            if os.path.exists(candidate):
+                return candidate
+    return None
+
+
+def _synthetic_images(n: int, classes: int, shape: tuple, seed: int, noise_seed: int):
+    """Deterministic class-template images + noise.  Templates depend only
+    on ``seed`` so train/test splits share the same class structure; only
+    the noise (and label draw) differs via ``noise_seed``."""
+    template_rng = np.random.default_rng(seed)
+    templates = template_rng.uniform(0.0, 1.0, size=(classes,) + shape).astype(np.float32)
+    rng = np.random.default_rng(noise_seed)
+    y = rng.integers(0, classes, size=n)
+    x = templates[y] + rng.normal(0, 0.35, size=(n,) + shape).astype(np.float32)
+    x = np.clip(x, 0.0, 1.0)
+    return x, y.astype(np.int64)
+
+
+def mnist(batch_size: int = 128, train: bool = True, root: str = DEFAULT_ROOT,
+          flatten: bool = True, n_synthetic: int = 12000, seed: int = 123,
+          shuffle: Optional[bool] = None) -> ArrayDataSetIterator:
+    """MnistDataSetIterator parity: 28x28 grayscale, 10 classes, pixels
+    scaled to [0,1]; ``flatten`` yields [N, 784] (DL4J default feeds
+    DenseLayer directly)."""
+    mroot = os.path.join(root, "mnist")
+    prefix = "train" if train else "t10k"
+    img_path = _find(mroot, [f"{prefix}-images-idx3-ubyte", f"{prefix}-images.idx3-ubyte"])
+    lbl_path = _find(mroot, [f"{prefix}-labels-idx1-ubyte", f"{prefix}-labels.idx1-ubyte"])
+    if img_path and lbl_path:
+        x = _read_idx(img_path).astype(np.float32) / 255.0
+        y = _read_idx(lbl_path).astype(np.int64)
+        synthetic = False
+    else:
+        n = n_synthetic if train else max(n_synthetic // 6, 500)
+        x, y = _synthetic_images(n, 10, (28, 28), seed, seed if train else seed + 1)
+        synthetic = True
+    if flatten:
+        x = x.reshape(x.shape[0], -1)
+    else:
+        x = x[..., None]  # NHWC single channel
+    it = ArrayDataSetIterator(x, _one_hot(y, 10), batch_size,
+                              shuffle=train if shuffle is None else shuffle, seed=seed)
+    it.synthetic = synthetic
+    return it
+
+
+# ------------------------------------------------------------------ CIFAR-10
+def cifar10(batch_size: int = 128, train: bool = True, root: str = DEFAULT_ROOT,
+            n_synthetic: int = 8000, seed: int = 321,
+            shuffle: Optional[bool] = None) -> ArrayDataSetIterator:
+    """Cifar10DataSetIterator parity: 32x32x3, 10 classes, NHWC in [0,1]."""
+    croot = os.path.join(root, "cifar-10-batches-bin")
+    files = ([f"data_batch_{i}.bin" for i in range(1, 6)] if train else ["test_batch.bin"])
+    paths = [os.path.join(croot, f) for f in files]
+    if all(os.path.exists(p) for p in paths):
+        xs, ys = [], []
+        for p in paths:
+            raw = np.fromfile(p, dtype=np.uint8).reshape(-1, 3073)
+            ys.append(raw[:, 0].astype(np.int64))
+            xs.append(raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+        x = np.concatenate(xs).astype(np.float32) / 255.0
+        y = np.concatenate(ys)
+        synthetic = False
+    else:
+        n = n_synthetic if train else max(n_synthetic // 8, 500)
+        x, y = _synthetic_images(n, 10, (32, 32, 3), seed, seed if train else seed + 1)
+        synthetic = True
+    it = ArrayDataSetIterator(x, _one_hot(y, 10), batch_size,
+                              shuffle=train if shuffle is None else shuffle, seed=seed)
+    it.synthetic = synthetic
+    return it
+
+
+# ------------------------------------------------------------------ UCI HAR
+def uci_har(batch_size: int = 64, train: bool = True, root: str = DEFAULT_ROOT,
+            n_synthetic: int = 4000, seed: int = 777,
+            timesteps: int = 128, channels: int = 9,
+            shuffle: Optional[bool] = None) -> ArrayDataSetIterator:
+    """UCI Human Activity Recognition (the reference's LSTM sequence
+    classification workload, BASELINE config #3): sequences [N, 128, 9],
+    6 classes.  Real data: 'UCI HAR Dataset' directory layout (Inertial
+    Signals txt files).  Synthetic: per-class frequency-modulated sines —
+    an LSTM must use temporal structure to classify them."""
+    split = "train" if train else "test"
+    har_root = os.path.join(root, "UCI HAR Dataset", split)
+    signals_dir = os.path.join(har_root, "Inertial Signals")
+    y_path = os.path.join(har_root, f"y_{split}.txt")
+    if os.path.isdir(signals_dir) and os.path.exists(y_path):
+        sigs = sorted(os.listdir(signals_dir))
+        x = np.stack([np.loadtxt(os.path.join(signals_dir, s)) for s in sigs], axis=-1)
+        y = np.loadtxt(y_path).astype(np.int64) - 1
+        synthetic = False
+    else:
+        n = n_synthetic if train else max(n_synthetic // 8, 400)
+        rng = np.random.default_rng(seed if train else seed + 1)
+        y = rng.integers(0, 6, size=n)
+        t = np.linspace(0, 4 * np.pi, timesteps, dtype=np.float32)
+        freq = 0.5 + y[:, None].astype(np.float32) * 0.6    # class-dependent frequency
+        phase = rng.uniform(0, 2 * np.pi, size=(n, 1)).astype(np.float32)
+        base = np.sin(freq * t[None, :] + phase)            # [N, T]
+        x = (base[:, :, None] * rng.uniform(0.5, 1.5, size=(n, 1, channels)).astype(np.float32)
+             + rng.normal(0, 0.25, size=(n, timesteps, channels)).astype(np.float32))
+        synthetic = True
+    it = ArrayDataSetIterator(x.astype(np.float32), _one_hot(y, 6), batch_size,
+                              shuffle=train if shuffle is None else shuffle, seed=seed)
+    it.synthetic = synthetic
+    return it
+
+
+# ------------------------------------------------------------------ IRIS
+def iris(batch_size: int = 150, seed: int = 42) -> ArrayDataSetIterator:
+    """IrisDataSetIterator parity.  The 150-sample table is generated from
+    the canonical summary statistics (no network) — deterministic."""
+    rng = np.random.default_rng(seed)
+    means = np.array([[5.01, 3.43, 1.46, 0.25],
+                      [5.94, 2.77, 4.26, 1.33],
+                      [6.59, 2.97, 5.55, 2.03]], dtype=np.float32)
+    stds = np.array([[0.35, 0.38, 0.17, 0.11],
+                     [0.52, 0.31, 0.47, 0.20],
+                     [0.64, 0.32, 0.55, 0.27]], dtype=np.float32)
+    x = np.concatenate([rng.normal(means[c], stds[c], size=(50, 4)).astype(np.float32)
+                        for c in range(3)])
+    y = np.repeat(np.arange(3), 50)
+    idx = rng.permutation(150)
+    return ArrayDataSetIterator(x[idx], _one_hot(y[idx], 3), batch_size, shuffle=False)

@@ -1,0 +1,179 @@
+"""DataSetIterator protocol + adapters.
+
+Parity with ND4J ``DataSetIterator`` SPI (nd4j-api
+``org/nd4j/linalg/dataset/api/iterator/``) and DL4J's wrappers
+(``AsyncDataSetIterator`` prefetch thread,
+``EarlyTerminationDataSetIterator``, ``ListDataSetIterator``).
+
+An iterator here is any iterable of :class:`DataSet` with optional
+``reset()``; ``AsyncDataSetIterator`` prefetches on a background thread so
+host-side ETL overlaps the device step (the reference's dedicated prefetch
+thread — SURVEY.md stack 3.1).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+
+
+class DataSetIterator:
+    """Base: iterable + reset."""
+
+    def __iter__(self) -> Iterator[DataSet]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate a list of pre-built DataSets (``ListDataSetIterator.java``)."""
+
+    def __init__(self, datasets: list[DataSet], batch_size: Optional[int] = None):
+        if batch_size is not None:
+            merged = datasets
+            self.datasets = []
+            for ds in merged:
+                self.datasets.extend(ds.batch_by(batch_size))
+        else:
+            self.datasets = list(datasets)
+
+    def __iter__(self):
+        return iter(self.datasets)
+
+    def __len__(self):
+        return len(self.datasets)
+
+
+class ArrayDataSetIterator(DataSetIterator):
+    """Batch one big (features, labels) array pair, with optional
+    per-epoch shuffling (RecordReaderDataSetIterator-style usage)."""
+
+    def __init__(self, features, labels, batch_size: int = 32,
+                 shuffle: bool = False, seed: int = 0,
+                 features_mask=None, labels_mask=None, drop_last: bool = False):
+        self.features = np.asarray(features)
+        self.labels = np.asarray(labels)
+        self.features_mask = None if features_mask is None else np.asarray(features_mask)
+        self.labels_mask = None if labels_mask is None else np.asarray(labels_mask)
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self._rng = np.random.default_rng(seed)
+
+    def __iter__(self):
+        n = self.features.shape[0]
+        idx = self._rng.permutation(n) if self.shuffle else np.arange(n)
+        stop = n - (n % self.batch_size) if self.drop_last else n
+        for lo in range(0, stop, self.batch_size):
+            sel = idx[lo: lo + self.batch_size]
+            yield DataSet(
+                self.features[sel], self.labels[sel],
+                None if self.features_mask is None else self.features_mask[sel],
+                None if self.labels_mask is None else self.labels_mask[sel])
+
+    def __len__(self):
+        n = self.features.shape[0]
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+
+class GeneratorDataSetIterator(DataSetIterator):
+    """Wrap a factory of generators (re-invoked on each epoch)."""
+
+    def __init__(self, factory: Callable[[], Iterable[DataSet]]):
+        self.factory = factory
+
+    def __iter__(self):
+        return iter(self.factory())
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch (``AsyncDataSetIterator.java``): keeps a
+    bounded queue of ready batches so the accelerator never waits on ETL."""
+
+    _DONE = object()
+
+    def __init__(self, underlying: DataSetIterator, queue_size: int = 2):
+        self.underlying = underlying
+        self.queue_size = max(1, queue_size)
+        self.etl_wait_s = 0.0  # PerformanceListener ETL-starvation metric
+
+    def reset(self):
+        if hasattr(self.underlying, "reset"):
+            self.underlying.reset()
+
+    def __iter__(self):
+        q: queue.Queue = queue.Queue(maxsize=self.queue_size)
+        error: list[BaseException] = []
+        stop = threading.Event()
+
+        def producer():
+            try:
+                for item in self.underlying:
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            except BaseException as e:  # surfaced on the consumer side
+                error.append(e)
+            finally:
+                # the sentinel must arrive even when the queue is full —
+                # block-with-retry like item puts, bailing only if the
+                # consumer already abandoned the epoch
+                while not stop.is_set():
+                    try:
+                        q.put(self._DONE, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        thread = threading.Thread(target=producer, daemon=True)
+        thread.start()
+        import time
+        try:
+            while True:
+                t0 = time.perf_counter()
+                item = q.get()
+                self.etl_wait_s += time.perf_counter() - t0
+                if item is self._DONE:
+                    if error:
+                        raise error[0]
+                    return
+                yield item
+        finally:
+            # consumer abandoned the epoch (break / EarlyTermination):
+            # release the producer so it doesn't block on the full queue
+            stop.set()
+            while not q.empty():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+
+
+class EarlyTerminationIterator(DataSetIterator):
+    """Caps the number of batches per epoch
+    (``EarlyTerminationDataSetIterator.java``)."""
+
+    def __init__(self, underlying: DataSetIterator, max_batches: int):
+        self.underlying = underlying
+        self.max_batches = max_batches
+
+    def reset(self):
+        if hasattr(self.underlying, "reset"):
+            self.underlying.reset()
+
+    def __iter__(self):
+        for i, batch in enumerate(self.underlying):
+            if i >= self.max_batches:
+                return
+            yield batch

@@ -1,0 +1,181 @@
+"""Data normalizers — fit/transform/revert scalers.
+
+Parity with ND4J ``org/nd4j/linalg/dataset/api/preprocessor/``
+(NormalizerStandardize, NormalizerMinMaxScaler, ImagePreProcessingScaler,
+and their serialization via NormalizerSerializer — here plain npz).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+
+
+class Normalizer:
+    def fit(self, iterator) -> "Normalizer":
+        raise NotImplementedError
+
+    def transform(self, ds: DataSet) -> DataSet:
+        raise NotImplementedError
+
+    def revert(self, ds: DataSet) -> DataSet:
+        raise NotImplementedError
+
+    def preprocess(self, iterator):
+        for ds in iterator:
+            yield self.transform(ds)
+
+    def save(self, path: str) -> None:
+        np.savez(path, _type=type(self).__name__, **self._state())
+
+    @staticmethod
+    def load(path: str) -> "Normalizer":
+        data = np.load(path, allow_pickle=False)
+        kind = str(data["_type"])
+        cls = {"NormalizerStandardize": NormalizerStandardize,
+               "NormalizerMinMaxScaler": NormalizerMinMaxScaler,
+               "ImagePreProcessingScaler": ImagePreProcessingScaler}[kind]
+        obj = cls.__new__(cls)
+        obj._load_state(data)
+        return obj
+
+
+class NormalizerStandardize(Normalizer):
+    """(x - mean) / std per feature column."""
+
+    def __init__(self, fit_labels: bool = False):
+        self.fit_labels = fit_labels
+        self.mean = self.std = None
+        self.label_mean = self.label_std = None
+
+    def fit(self, iterator):
+        count, total, total_sq = 0, 0.0, 0.0
+        l_total, l_total_sq = 0.0, 0.0
+        for ds in iterator:
+            f = np.asarray(ds.features, dtype=np.float64)
+            f2 = f.reshape(f.shape[0], -1)
+            total = total + f2.sum(axis=0)
+            total_sq = total_sq + (f2 ** 2).sum(axis=0)
+            count += f2.shape[0]
+            if self.fit_labels:
+                l = np.asarray(ds.labels, dtype=np.float64).reshape(f.shape[0], -1)
+                l_total = l_total + l.sum(axis=0)
+                l_total_sq = l_total_sq + (l ** 2).sum(axis=0)
+        self.mean = (total / count).astype(np.float32)
+        var = total_sq / count - (total / count) ** 2
+        self.std = np.sqrt(np.maximum(var, 1e-12)).astype(np.float32)
+        if self.fit_labels:
+            self.label_mean = (l_total / count).astype(np.float32)
+            l_var = l_total_sq / count - (l_total / count) ** 2
+            self.label_std = np.sqrt(np.maximum(l_var, 1e-12)).astype(np.float32)
+        return self
+
+    def transform(self, ds: DataSet) -> DataSet:
+        f = np.asarray(ds.features)
+        shape = f.shape
+        f = (f.reshape(shape[0], -1) - self.mean) / self.std
+        labels = ds.labels
+        if self.fit_labels and labels is not None:
+            l = np.asarray(labels)
+            labels = ((l.reshape(shape[0], -1) - self.label_mean) / self.label_std).reshape(l.shape)
+        return DataSet(f.reshape(shape).astype(np.float32), labels,
+                       ds.features_mask, ds.labels_mask)
+
+    def revert(self, ds: DataSet) -> DataSet:
+        f = np.asarray(ds.features)
+        shape = f.shape
+        f = f.reshape(shape[0], -1) * self.std + self.mean
+        return DataSet(f.reshape(shape), ds.labels, ds.features_mask, ds.labels_mask)
+
+    def _state(self):
+        state = {"mean": self.mean, "std": self.std,
+                 "fit_labels": np.asarray(self.fit_labels)}
+        if self.label_mean is not None:
+            state.update(label_mean=self.label_mean, label_std=self.label_std)
+        return state
+
+    def _load_state(self, data):
+        self.mean, self.std = data["mean"], data["std"]
+        self.fit_labels = bool(data["fit_labels"])
+        self.label_mean = data["label_mean"] if "label_mean" in data else None
+        self.label_std = data["label_std"] if "label_std" in data else None
+
+
+class NormalizerMinMaxScaler(Normalizer):
+    """Scale to [min, max] (default [0,1]) per feature column."""
+
+    def __init__(self, feature_min: float = 0.0, feature_max: float = 1.0):
+        self.feature_min = feature_min
+        self.feature_max = feature_max
+        self.data_min = self.data_max = None
+
+    def fit(self, iterator):
+        lo, hi = None, None
+        for ds in iterator:
+            f = np.asarray(ds.features).reshape(ds.features.shape[0], -1)
+            bmin, bmax = f.min(axis=0), f.max(axis=0)
+            lo = bmin if lo is None else np.minimum(lo, bmin)
+            hi = bmax if hi is None else np.maximum(hi, bmax)
+        self.data_min, self.data_max = lo.astype(np.float32), hi.astype(np.float32)
+        return self
+
+    def transform(self, ds: DataSet) -> DataSet:
+        f = np.asarray(ds.features)
+        shape = f.shape
+        span = np.maximum(self.data_max - self.data_min, 1e-12)
+        scaled = (f.reshape(shape[0], -1) - self.data_min) / span
+        scaled = scaled * (self.feature_max - self.feature_min) + self.feature_min
+        return DataSet(scaled.reshape(shape).astype(np.float32), ds.labels,
+                       ds.features_mask, ds.labels_mask)
+
+    def revert(self, ds: DataSet) -> DataSet:
+        f = np.asarray(ds.features)
+        shape = f.shape
+        span = self.data_max - self.data_min
+        raw = (f.reshape(shape[0], -1) - self.feature_min) / (self.feature_max - self.feature_min)
+        raw = raw * span + self.data_min
+        return DataSet(raw.reshape(shape), ds.labels, ds.features_mask, ds.labels_mask)
+
+    def _state(self):
+        return {"data_min": self.data_min, "data_max": self.data_max,
+                "feature_min": np.asarray(self.feature_min),
+                "feature_max": np.asarray(self.feature_max)}
+
+    def _load_state(self, data):
+        self.data_min, self.data_max = data["data_min"], data["data_max"]
+        self.feature_min = float(data["feature_min"])
+        self.feature_max = float(data["feature_max"])
+
+
+class ImagePreProcessingScaler(Normalizer):
+    """Pixel scaler: [0, maxPixel] → [min, max] with no fit stats
+    (``ImagePreProcessingScaler.java``)."""
+
+    def __init__(self, min_range: float = 0.0, max_range: float = 1.0,
+                 max_pixel: float = 255.0):
+        self.min_range = min_range
+        self.max_range = max_range
+        self.max_pixel = max_pixel
+
+    def fit(self, iterator):
+        return self
+
+    def transform(self, ds: DataSet) -> DataSet:
+        f = np.asarray(ds.features, dtype=np.float32) / self.max_pixel
+        f = f * (self.max_range - self.min_range) + self.min_range
+        return DataSet(f, ds.labels, ds.features_mask, ds.labels_mask)
+
+    def revert(self, ds: DataSet) -> DataSet:
+        f = (np.asarray(ds.features) - self.min_range) / (self.max_range - self.min_range)
+        return DataSet(f * self.max_pixel, ds.labels, ds.features_mask, ds.labels_mask)
+
+    def _state(self):
+        return {"min_range": np.asarray(self.min_range),
+                "max_range": np.asarray(self.max_range),
+                "max_pixel": np.asarray(self.max_pixel)}
+
+    def _load_state(self, data):
+        self.min_range = float(data["min_range"])
+        self.max_range = float(data["max_range"])
+        self.max_pixel = float(data["max_pixel"])

@@ -1,0 +1,12 @@
+from deeplearning4j_tpu.io.model_serializer import (
+    write_model,
+    restore_multi_layer_network,
+    save_params,
+    load_params,
+)
+from deeplearning4j_tpu.io.checkpoint import CheckpointListener
+
+__all__ = [
+    "write_model", "restore_multi_layer_network", "save_params", "load_params",
+    "CheckpointListener",
+]

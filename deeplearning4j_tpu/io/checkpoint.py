@@ -1,0 +1,73 @@
+"""CheckpointListener — periodic checkpoints with keep-last-K.
+
+Parity with DL4J ``org/deeplearning4j/optimize/listeners/CheckpointListener.java``:
+save every N iterations / epochs / seconds, keep last K (or all),
+``last_checkpoint()`` lookup for resume.  Saves run on the listener thread
+AFTER the step's host sync — the device is already past the step, so this
+is effectively the async-checkpoint pattern (device never blocked on disk).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from deeplearning4j_tpu.obs.listeners import TrainingListener
+
+
+class CheckpointListener(TrainingListener):
+    def __init__(self, directory: str,
+                 save_every_n_iterations: Optional[int] = None,
+                 save_every_n_epochs: Optional[int] = None,
+                 save_every_seconds: Optional[float] = None,
+                 keep_last: Optional[int] = 3,
+                 keep_all: bool = False):
+        self.directory = directory
+        self.every_iter = save_every_n_iterations
+        self.every_epoch = save_every_n_epochs
+        self.every_seconds = save_every_seconds
+        self.keep_last = None if keep_all else (keep_last or 3)
+        self._last_save_time = time.time()
+        self._saved: list[str] = []
+        os.makedirs(directory, exist_ok=True)
+
+    def _save(self, model, iteration: int, epoch: int) -> str:
+        name = f"checkpoint_iter{iteration}_epoch{epoch}.zip"
+        path = os.path.join(self.directory, name)
+        model.save(path)
+        self._saved.append(path)
+        with open(os.path.join(self.directory, "checkpoints.json"), "w") as f:
+            json.dump({"checkpoints": self._saved}, f)
+        if self.keep_last is not None:
+            while len(self._saved) > self.keep_last:
+                old = self._saved.pop(0)
+                if os.path.exists(old):
+                    os.remove(old)
+        self._last_save_time = time.time()
+        return path
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if self.every_iter and iteration > 0 and iteration % self.every_iter == 0:
+            self._save(model, iteration, epoch)
+        elif self.every_seconds and time.time() - self._last_save_time >= self.every_seconds:
+            self._save(model, iteration, epoch)
+
+    def on_epoch_end(self, model, epoch, info):
+        if self.every_epoch and (epoch + 1) % self.every_epoch == 0:
+            self._save(model, model.iteration, epoch)
+
+    def last_checkpoint(self) -> Optional[str]:
+        return self._saved[-1] if self._saved else None
+
+    @staticmethod
+    def last_checkpoint_in(directory: str) -> Optional[str]:
+        index = os.path.join(directory, "checkpoints.json")
+        if os.path.exists(index):
+            with open(index) as f:
+                saved = json.load(f).get("checkpoints", [])
+            for path in reversed(saved):
+                if os.path.exists(path):
+                    return path
+        return None

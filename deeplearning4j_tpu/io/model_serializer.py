@@ -1,0 +1,126 @@
+"""Model serialization — ModelSerializer parity.
+
+Parity with DL4J ``org/deeplearning4j/util/ModelSerializer.java``: a model
+file is a ZIP containing
+- ``configuration.json``   — full network conf (JSON round-trip, §2.5)
+- ``coefficients.npz``     — parameters; the reference stores ONE flat
+  float vector (``coefficients.bin``); we store the pytree leaves named by
+  path AND byte-compatible ordering so the flat view matches
+- ``state.npz``            — non-trainable state (BN running stats)
+- ``updater.npz``          — optax updater state pytree (``updaterState.bin``)
+- ``meta.json``            — iteration/epoch counters, format version
+- optional ``normalizer.npz`` (``NormalizerSerializer`` parity)
+
+Arrays transfer device→host on save and host→device lazily on load (jax
+moves them at first use).
+"""
+
+from __future__ import annotations
+
+import io as _io
+import json
+import os
+import zipfile
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def _tree_to_npz_bytes(tree: Any) -> bytes:
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    buf = _io.BytesIO()
+    np.savez(buf, treedef=np.frombuffer(str(treedef).encode(), dtype=np.uint8),
+             **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)})
+    return buf.getvalue()
+
+
+def _npz_bytes_to_leaves(data: bytes) -> list[np.ndarray]:
+    archive = np.load(_io.BytesIO(data), allow_pickle=False)
+    leaves = []
+    i = 0
+    while f"leaf_{i}" in archive:
+        leaves.append(archive[f"leaf_{i}"])
+        i += 1
+    return leaves
+
+
+def _rebuild_like(template: Any, leaves: list[np.ndarray]) -> Any:
+    _, treedef = jax.tree_util.tree_flatten(template)
+    if treedef.num_leaves != len(leaves):
+        raise ValueError(
+            f"checkpoint has {len(leaves)} arrays but model expects {treedef.num_leaves}")
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def write_model(net, path: str, save_updater: bool = True,
+                normalizer=None) -> None:
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("configuration.json", net.conf.to_json())
+        zf.writestr("coefficients.npz", _tree_to_npz_bytes(net.params_))
+        zf.writestr("state.npz", _tree_to_npz_bytes(net.state_))
+        if save_updater and net.opt_state is not None:
+            zf.writestr("updater.npz", _tree_to_npz_bytes(net.opt_state))
+        zf.writestr("meta.json", json.dumps({
+            "format_version": FORMAT_VERSION,
+            "iteration": net.iteration,
+            "epoch": net.epoch,
+            "model_type": type(net).__name__,
+        }))
+        if normalizer is not None:
+            buf = _io.BytesIO()
+            np.savez(buf, _type=type(normalizer).__name__, **normalizer._state())
+            zf.writestr("normalizer.npz", buf.getvalue())
+
+
+def _restore(path: str, conf_cls, net_cls, load_updater: bool):
+    with zipfile.ZipFile(path, "r") as zf:
+        conf = conf_cls.from_json(zf.read("configuration.json").decode())
+        net = net_cls(conf)
+        net.init()  # build template pytrees for exact re-inflation
+        net.params_ = _rebuild_like(net.params_, _npz_bytes_to_leaves(zf.read("coefficients.npz")))
+        net.state_ = _rebuild_like(net.state_, _npz_bytes_to_leaves(zf.read("state.npz")))
+        meta = json.loads(zf.read("meta.json").decode())
+        net.iteration = meta.get("iteration", 0)
+        net.epoch = meta.get("epoch", 0)
+        if load_updater and "updater.npz" in zf.namelist():
+            from deeplearning4j_tpu.train.trainer import Trainer
+            trainer = Trainer(net)
+            template = trainer.tx.init(net.params_)
+            net.opt_state = _rebuild_like(template, _npz_bytes_to_leaves(zf.read("updater.npz")))
+    return net
+
+
+def restore_multi_layer_network(path: str, load_updater: bool = True):
+    from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    return _restore(path, MultiLayerConfiguration, MultiLayerNetwork, load_updater)
+
+
+def restore_computation_graph(path: str, load_updater: bool = True):
+    from deeplearning4j_tpu.nn.graph import ComputationGraphConfiguration, ComputationGraph
+    return _restore(path, ComputationGraphConfiguration, ComputationGraph, load_updater)
+
+
+def restore_model(path: str, load_updater: bool = True):
+    """ModelGuesser parity: dispatch on the saved model_type."""
+    with zipfile.ZipFile(path, "r") as zf:
+        meta = json.loads(zf.read("meta.json").decode())
+    if meta.get("model_type") == "ComputationGraph":
+        return restore_computation_graph(path, load_updater)
+    return restore_multi_layer_network(path, load_updater)
+
+
+def save_params(params: Any, path: str) -> None:
+    """Bare parameter pytree → npz (zoo weight files)."""
+    with open(path, "wb") as f:
+        f.write(_tree_to_npz_bytes(params))
+
+
+def load_params(path: str, template: Any) -> Any:
+    with open(path, "rb") as f:
+        return _rebuild_like(template, _npz_bytes_to_leaves(f.read()))

@@ -1,0 +1,94 @@
+"""Activation catalog.
+
+Parity with ND4J's ``IActivation`` implementations
+(nd4j-api ``org/nd4j/linalg/activations/impl/``: ActivationCube, ELU,
+HardSigmoid, HardTanh, Identity, LReLU, PReLU, RationalTanh, ReLU, ReLU6,
+RReLU, Sigmoid, Softmax, SoftPlus, SoftSign, TanH, RectifiedTanh, SELU,
+Swish, ThresholdedReLU, GELU, Mish).  Backward passes are free via jax.grad;
+each entry here is just the forward fn — XLA fuses it into the surrounding
+matmul on TPU.
+
+Names are matched case-insensitively to the DL4J ``Activation`` enum.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+ActivationFn = Callable[[jnp.ndarray], jnp.ndarray]
+
+_REGISTRY: dict[str, ActivationFn] = {}
+
+
+def register(name: str) -> Callable[[ActivationFn], ActivationFn]:
+    def deco(fn: ActivationFn) -> ActivationFn:
+        _REGISTRY[name.lower()] = fn
+        return fn
+    return deco
+
+
+def get(name) -> ActivationFn:
+    """Look up an activation by DL4J enum name (case-insensitive).  A
+    callable is passed through (custom-activation SPI parity)."""
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in _REGISTRY:
+        raise KeyError(f"unknown activation '{name}'; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]
+
+
+def names() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+register("identity")(lambda x: x)
+register("relu")(jax.nn.relu)
+register("relu6")(jax.nn.relu6)
+register("sigmoid")(jax.nn.sigmoid)
+register("hardsigmoid")(jax.nn.hard_sigmoid)
+register("tanh")(jnp.tanh)
+register("hardtanh")(jax.nn.hard_tanh)
+register("softplus")(jax.nn.softplus)
+register("softsign")(jax.nn.soft_sign)
+register("elu")(jax.nn.elu)
+register("selu")(jax.nn.selu)
+register("gelu")(jax.nn.gelu)
+register("swish")(jax.nn.silu)
+register("silu")(jax.nn.silu)
+register("mish")(jax.nn.mish)
+register("cube")(lambda x: x ** 3)
+register("softmax")(lambda x: jax.nn.softmax(x, axis=-1))
+register("logsoftmax")(lambda x: jax.nn.log_softmax(x, axis=-1))
+
+
+@register("leakyrelu")
+def leaky_relu(x: jnp.ndarray) -> jnp.ndarray:
+    # DL4J ActivationLReLU default alpha = 0.01
+    return jax.nn.leaky_relu(x, negative_slope=0.01)
+
+
+@register("rationaltanh")
+def rational_tanh(x: jnp.ndarray) -> jnp.ndarray:
+    # ActivationRationalTanh: 1.7159 * tanh_approx(2x/3), clipped rational
+    # approximation (f(x) = 1.7159 * sgn(x) * (1 - 1/(1 + |a| + a^2 + 1.41645 a^4)), a = 2x/3)
+    a = jnp.abs(2.0 * x / 3.0)
+    approx = 1.0 - 1.0 / (1.0 + a + a * a + 1.41645 * a ** 4)
+    return 1.7159 * jnp.sign(x) * approx
+
+
+@register("rectifiedtanh")
+def rectified_tanh(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+@register("thresholdedrelu")
+def thresholded_relu(x: jnp.ndarray, theta: float = 1.0) -> jnp.ndarray:
+    return jnp.where(x > theta, x, 0.0)
+
+
+def leaky_relu_with(alpha: float) -> ActivationFn:
+    return lambda x: jax.nn.leaky_relu(x, negative_slope=alpha)

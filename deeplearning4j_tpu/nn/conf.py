@@ -1,0 +1,225 @@
+"""NeuralNetConfiguration — config-first network spec with JSON round-trip.
+
+Parity with DL4J's builder cascade
+(deeplearning4j-nn ``org/deeplearning4j/nn/conf/NeuralNetConfiguration.java``
+→ ``MultiLayerConfiguration``): network-level defaults (activation,
+weight init, updater, l1/l2, dropout, gradient normalization) cascade into
+layers that don't override them; ``.list()`` builds a layer stack;
+``setInputType`` drives shape inference through each layer's
+``getOutputType``.  The JSON form round-trips — it is the checkpoint
+``configuration.json`` (``ModelSerializer`` parity in
+``deeplearning4j_tpu.io``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Optional
+
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, layer_from_dict
+from deeplearning4j_tpu.train import updaters as updater_mod
+
+_CASCADE_FIELDS = ("activation", "weight_init", "bias_init", "dropout",
+                   "l1", "l2", "l1_bias", "l2_bias")
+
+
+@dataclasses.dataclass
+class MultiLayerConfiguration:
+    """The built, serializable network spec (``MultiLayerConfiguration.java``)."""
+
+    layers: list = dataclasses.field(default_factory=list)
+    input_type: Optional[InputType] = None
+    seed: int = 0
+    updater: Any = None                      # updater config object
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: float = 1.0
+    mini_batch: bool = True                  # divide gradients by minibatch size
+    backprop_type: str = "standard"          # "standard" | "tbptt"
+    tbptt_fwd_length: int = 20
+    tbptt_back_length: int = 20
+    dtype: str = "float32"
+
+    def input_types(self) -> list[InputType]:
+        """Per-layer input InputType chain (shape inference, with automatic
+        InputPreProcessor insertion — ``setInputType`` parity)."""
+        from deeplearning4j_tpu.nn import preprocessors
+        if self.input_type is None:
+            raise ValueError("input_type not set — call set_input_type(...) on the builder")
+        types = []
+        current = self.input_type
+        for layer in self.layers:
+            current = preprocessors.adapt_type(current, layer)
+            types.append(current)
+            current = layer.get_output_type(current)
+        return types
+
+    def output_type(self) -> InputType:
+        from deeplearning4j_tpu.nn import preprocessors
+        current = self.input_type
+        for layer in self.layers:
+            current = preprocessors.adapt_type(current, layer)
+            current = layer.get_output_type(current)
+        return current
+
+    # ---- serde ------------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "layers": [l.to_dict() for l in self.layers],
+            "input_type": self.input_type.to_dict() if self.input_type else None,
+            "seed": self.seed,
+            "updater": updater_mod.to_dict(self.updater) if self.updater else None,
+            "gradient_normalization": self.gradient_normalization,
+            "gradient_normalization_threshold": self.gradient_normalization_threshold,
+            "mini_batch": self.mini_batch,
+            "backprop_type": self.backprop_type,
+            "tbptt_fwd_length": self.tbptt_fwd_length,
+            "tbptt_back_length": self.tbptt_back_length,
+            "dtype": self.dtype,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    @staticmethod
+    def from_dict(d: dict) -> "MultiLayerConfiguration":
+        conf = MultiLayerConfiguration(
+            layers=[layer_from_dict(ld) for ld in d["layers"]],
+            input_type=InputType.from_dict(d["input_type"]) if d.get("input_type") else None,
+            seed=d.get("seed", 0),
+            updater=updater_mod.from_dict(d["updater"]) if d.get("updater") else None,
+            gradient_normalization=d.get("gradient_normalization"),
+            gradient_normalization_threshold=d.get("gradient_normalization_threshold", 1.0),
+            mini_batch=d.get("mini_batch", True),
+            backprop_type=d.get("backprop_type", "standard"),
+            tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
+            tbptt_back_length=d.get("tbptt_back_length", 20),
+            dtype=d.get("dtype", "float32"),
+        )
+        return conf
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        return MultiLayerConfiguration.from_dict(json.loads(s))
+
+
+class NeuralNetConfiguration:
+    """Entry point: ``NeuralNetConfiguration.builder()``."""
+
+    @staticmethod
+    def builder() -> "Builder":
+        return Builder()
+
+
+class Builder:
+    def __init__(self):
+        self._seed = 0
+        self._updater = None
+        self._defaults: dict[str, Any] = {}
+        self._grad_norm: Optional[str] = None
+        self._grad_norm_threshold = 1.0
+        self._mini_batch = True
+        self._dtype = "float32"
+
+    def seed(self, seed: int) -> "Builder":
+        self._seed = int(seed)
+        return self
+
+    def updater(self, updater) -> "Builder":
+        self._updater = updater
+        return self
+
+    def activation(self, act) -> "Builder":
+        self._defaults["activation"] = act
+        return self
+
+    def weight_init(self, wi) -> "Builder":
+        self._defaults["weight_init"] = wi
+        return self
+
+    def bias_init(self, b: float) -> "Builder":
+        self._defaults["bias_init"] = b
+        return self
+
+    def dropout(self, retain_prob: float) -> "Builder":
+        self._defaults["dropout"] = retain_prob
+        return self
+
+    def l1(self, v: float) -> "Builder":
+        self._defaults["l1"] = v
+        return self
+
+    def l2(self, v: float) -> "Builder":
+        self._defaults["l2"] = v
+        return self
+
+    def l1_bias(self, v: float) -> "Builder":
+        self._defaults["l1_bias"] = v
+        return self
+
+    def l2_bias(self, v: float) -> "Builder":
+        self._defaults["l2_bias"] = v
+        return self
+
+    def gradient_normalization(self, gn: str, threshold: float = 1.0) -> "Builder":
+        self._grad_norm = gn
+        self._grad_norm_threshold = threshold
+        return self
+
+    def mini_batch(self, v: bool) -> "Builder":
+        self._mini_batch = v
+        return self
+
+    def dtype(self, dt: str) -> "Builder":
+        self._dtype = dt
+        return self
+
+    def list(self) -> "ListBuilder":
+        return ListBuilder(self)
+
+    def graph(self):
+        from deeplearning4j_tpu.nn.graph import GraphBuilder  # noqa: F401
+        return GraphBuilder(self)
+
+
+class ListBuilder:
+    def __init__(self, parent: Builder):
+        self.parent = parent
+        self._layers: list[Layer] = []
+        self._input_type: Optional[InputType] = None
+        self._backprop_type = "standard"
+        self._tbptt_fwd = 20
+        self._tbptt_back = 20
+
+    def layer(self, layer: Layer) -> "ListBuilder":
+        self._layers.append(layer)
+        return self
+
+    def set_input_type(self, input_type: InputType) -> "ListBuilder":
+        self._input_type = input_type
+        return self
+
+    def backprop_type(self, kind: str, fwd_length: int = 20, back_length: int = 20) -> "ListBuilder":
+        self._backprop_type = kind
+        self._tbptt_fwd = fwd_length
+        self._tbptt_back = back_length
+        return self
+
+    def build(self) -> MultiLayerConfiguration:
+        p = self.parent
+        for layer in self._layers:
+            layer.inherit_defaults(p._defaults)
+        return MultiLayerConfiguration(
+            layers=self._layers,
+            input_type=self._input_type,
+            seed=p._seed,
+            updater=p._updater,
+            gradient_normalization=p._grad_norm,
+            gradient_normalization_threshold=p._grad_norm_threshold,
+            mini_batch=p._mini_batch,
+            backprop_type=self._backprop_type,
+            tbptt_fwd_length=self._tbptt_fwd,
+            tbptt_back_length=self._tbptt_back,
+            dtype=p._dtype,
+        )

@@ -1,0 +1,109 @@
+"""Attention layers.
+
+Parity targets: DL4J ``conf/layers/SelfAttentionLayer.java`` and
+``LearnedSelfAttentionLayer.java``, backed in the reference by libnd4j
+``multi_head_dot_product_attention`` (materialized O(T²) scores).  Here the
+inner product is one fused XLA einsum chain via
+``deeplearning4j_tpu.ops.attention``; this layer is the API-parity wrapper.
+Long-sequence blockwise/ring attention lands with the parallelism milestone.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import activations
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+from deeplearning4j_tpu.ops.attention import multi_head_attention
+
+
+@register_layer("self_attention")
+@dataclasses.dataclass
+class SelfAttentionLayer(Layer):
+    """Multi-head self attention over NTC input; ``project_input`` adds
+    learned Q/K/V/O projections (required when n_heads > 1)."""
+
+    n_heads: int = 1
+    head_size: int = 0
+    project_input: bool = True
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        if self.project_input:
+            out = self.n_heads * (self.head_size or input_type.size // self.n_heads)
+        else:
+            out = input_type.size
+        return InputType.recurrent(out, input_type.timesteps)
+
+    def init_params(self, key, input_type):
+        if not self.project_input:
+            return {}
+        d = input_type.size
+        hs = self.head_size or d // self.n_heads
+        proj = self.n_heads * hs
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        return {
+            "Wq": self._init_weight(k1, (d, proj), d, proj),
+            "Wk": self._init_weight(k2, (d, proj), d, proj),
+            "Wv": self._init_weight(k3, (d, proj), d, proj),
+            "Wo": self._init_weight(k4, (proj, proj), proj, proj),
+        }
+
+    def has_params(self) -> bool:
+        return self.project_input
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        if self.project_input:
+            q = jnp.einsum("btc,cd->btd", x, params["Wq"])
+            k = jnp.einsum("btc,cd->btd", x, params["Wk"])
+            v = jnp.einsum("btc,cd->btd", x, params["Wv"])
+        else:
+            q = k = v = x
+        n_heads = self.n_heads if self.project_input else 1
+        y = multi_head_attention(q, k, v, n_heads=n_heads, mask=mask)
+        if self.project_input:
+            y = jnp.einsum("btd,de->bte", y, params["Wo"])
+        return y, state
+
+
+@register_layer("learned_self_attention")
+@dataclasses.dataclass
+class LearnedSelfAttentionLayer(SelfAttentionLayer):
+    """Attention with N learned query vectors → fixed-length [B, nQueries, D]
+    output regardless of input length (``LearnedSelfAttentionLayer.java``)."""
+
+    n_queries: int = 1
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        out = self.n_heads * (self.head_size or input_type.size // self.n_heads) \
+            if self.project_input else input_type.size
+        return InputType.recurrent(out, self.n_queries)
+
+    def has_params(self) -> bool:
+        return True  # the learned queries are params even without projections
+
+    def init_params(self, key, input_type):
+        params = super().init_params(key, input_type)
+        d = input_type.size
+        kq = jax.random.fold_in(key, 17)
+        params["Q"] = self._init_weight(kq, (self.n_queries, d), d, d)
+        return params
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        b = x.shape[0]
+        queries = jnp.broadcast_to(params["Q"], (b,) + params["Q"].shape)
+        if self.project_input:
+            q = jnp.einsum("btc,cd->btd", queries, params["Wq"])
+            k = jnp.einsum("btc,cd->btd", x, params["Wk"])
+            v = jnp.einsum("btc,cd->btd", x, params["Wv"])
+        else:
+            q, k, v = queries, x, x
+        n_heads = self.n_heads if self.project_input else 1
+        y = multi_head_attention(q, k, v, n_heads=n_heads, kv_mask=mask)
+        if self.project_input:
+            y = jnp.einsum("btd,de->bte", y, params["Wo"])
+        return y, state

@@ -1,0 +1,625 @@
+"""Convolutional / pooling / spatial layers — NHWC, MXU-targeted.
+
+Parity targets (deeplearning4j-nn ``conf/layers/`` + libnd4j declarable ops):
+- ConvolutionLayer (libnd4j ``conv2d``: im2col+gemm / cuDNN → here one
+  ``lax.conv_general_dilated`` that XLA tiles onto the MXU)
+- Convolution1DLayer, Convolution3DLayer, Deconvolution2D (``deconv2d``),
+  SeparableConvolution2D (``sconv2d``), DepthwiseConvolution2D
+- SubsamplingLayer 1D/2D/3D (``maxpool2d``/``avgpool2d``/``pnormpool2d``)
+- Upsampling1D/2D/3D, ZeroPaddingLayer, CroppingLayer, SpaceToDepthLayer
+- GlobalPoolingLayer (``conf/layers/GlobalPoolingLayer.java``) with masking
+- LocalResponseNormalization (``lrn`` op)
+
+Layout: NHWC / NWC / NDHWC (channels-last; the reference is NCHW — layout is
+converted at import boundaries).  Weights: HWIO (kh, kw, in, out).
+
+ConvolutionMode parity (``org/deeplearning4j/nn/conf/ConvolutionMode.java``):
+- "truncate"/"strict" → VALID with explicit padding (DL4J default)
+- "same" → SAME (padding field ignored)
+- "causal" (1-D only) → left-pad (k-1)*dilation
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.config import dtype_policy
+from deeplearning4j_tpu.nn import activations
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+def _pair(v) -> tuple:
+    if isinstance(v, (tuple, list)):
+        return tuple(v)
+    return (v, v)
+
+
+def _out_dim(size: int, k: int, s: int, p: int, d: int, mode: str) -> int:
+    eff_k = (k - 1) * d + 1
+    if mode == "same":
+        return -(-size // s)
+    return (size + 2 * p - eff_k) // s + 1
+
+
+@register_layer("conv2d")
+@dataclasses.dataclass
+class ConvolutionLayer(Layer):
+    """2-D convolution.  One XLA conv op replaces the reference's
+    im2col+gemm helper (libnd4j ``ops/declarable/generic/nn/convo/conv2d.cpp``)
+    and its cuDNN platform engine."""
+
+    n_out: int = 0
+    kernel_size: Any = (3, 3)
+    stride: Any = (1, 1)
+    padding: Any = (0, 0)
+    dilation: Any = (1, 1)
+    convolution_mode: str = "truncate"
+    has_bias: bool = True
+
+    def _dims(self):
+        return _pair(self.kernel_size), _pair(self.stride), _pair(self.padding), _pair(self.dilation)
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        (kh, kw), (sh, sw), (ph, pw), (dh, dw) = self._dims()
+        h = _out_dim(input_type.height, kh, sh, ph, dh, self.convolution_mode)
+        w = _out_dim(input_type.width, kw, sw, pw, dw, self.convolution_mode)
+        return InputType.convolutional(h, w, self.n_out)
+
+    def init_params(self, key, input_type):
+        (kh, kw), _, _, _ = self._dims()
+        c_in = input_type.channels
+        fan_in = kh * kw * c_in
+        fan_out = kh * kw * self.n_out
+        params = {"W": self._init_weight(key, (kh, kw, c_in, self.n_out), fan_in, fan_out)}
+        if self.has_bias:
+            params["b"] = self._init_bias((self.n_out,))
+        return params
+
+    def _conv(self, x, w, stride, padding, dilation, groups=1):
+        policy = dtype_policy()
+        y = lax.conv_general_dilated(
+            x.astype(policy.compute_dtype), w.astype(policy.compute_dtype),
+            window_strides=stride,
+            padding=padding,
+            rhs_dilation=dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=groups,
+        )
+        return y.astype(policy.output_dtype)
+
+    def _padding_arg(self, pad_pairs):
+        if self.convolution_mode == "same":
+            return "SAME"
+        return [(p, p) for p in pad_pairs]
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        _, stride, pad, dilation = self._dims()
+        x = self._maybe_dropout(x, train, rng)
+        y = self._conv(x, params["W"], stride, self._padding_arg(pad), dilation)
+        if self.has_bias:
+            y = y + params["b"]
+        return activations.get(self.activation or "identity")(y), state
+
+
+@register_layer("conv1d")
+@dataclasses.dataclass
+class Convolution1DLayer(ConvolutionLayer):
+    """1-D convolution over NWC (``conv1d`` op); supports causal mode."""
+
+    kernel_size: Any = 3
+    stride: Any = 1
+    padding: Any = 0
+    dilation: Any = 1
+
+    def _dims1(self):
+        k = self.kernel_size if not isinstance(self.kernel_size, (tuple, list)) else self.kernel_size[0]
+        s = self.stride if not isinstance(self.stride, (tuple, list)) else self.stride[0]
+        p = self.padding if not isinstance(self.padding, (tuple, list)) else self.padding[0]
+        d = self.dilation if not isinstance(self.dilation, (tuple, list)) else self.dilation[0]
+        return k, s, p, d
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        k, s, p, d = self._dims1()
+        t = input_type.timesteps
+        if t is not None:
+            if self.convolution_mode == "causal":
+                t = -(-t // s)
+            else:
+                t = _out_dim(t, k, s, p, d, self.convolution_mode)
+        return InputType.recurrent(self.n_out, t)
+
+    def init_params(self, key, input_type):
+        k, _, _, _ = self._dims1()
+        c_in = input_type.size
+        fan_in, fan_out = k * c_in, k * self.n_out
+        params = {"W": self._init_weight(key, (k, c_in, self.n_out), fan_in, fan_out)}
+        if self.has_bias:
+            params["b"] = self._init_bias((self.n_out,))
+        return params
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        k, s, p, d = self._dims1()
+        x = self._maybe_dropout(x, train, rng)
+        if self.convolution_mode == "same":
+            padding = "SAME"
+        elif self.convolution_mode == "causal":
+            padding = [((k - 1) * d, 0)]
+        else:
+            padding = [(p, p)]
+        policy = dtype_policy()
+        y = lax.conv_general_dilated(
+            x.astype(policy.compute_dtype), params["W"].astype(policy.compute_dtype),
+            window_strides=(s,), padding=padding, rhs_dilation=(d,),
+            dimension_numbers=("NWC", "WIO", "NWC"),
+        ).astype(policy.output_dtype)
+        if self.has_bias:
+            y = y + params["b"]
+        return activations.get(self.activation or "identity")(y), state
+
+
+@register_layer("conv3d")
+@dataclasses.dataclass
+class Convolution3DLayer(ConvolutionLayer):
+    """3-D convolution over NDHWC (``conv3dnew`` op)."""
+
+    kernel_size: Any = (3, 3, 3)
+    stride: Any = (1, 1, 1)
+    padding: Any = (0, 0, 0)
+    dilation: Any = (1, 1, 1)
+
+    def _triple(self, v):
+        return tuple(v) if isinstance(v, (tuple, list)) else (v, v, v)
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        k, s, p, d = (self._triple(self.kernel_size), self._triple(self.stride),
+                      self._triple(self.padding), self._triple(self.dilation))
+        dd = _out_dim(input_type.depth, k[0], s[0], p[0], d[0], self.convolution_mode)
+        h = _out_dim(input_type.height, k[1], s[1], p[1], d[1], self.convolution_mode)
+        w = _out_dim(input_type.width, k[2], s[2], p[2], d[2], self.convolution_mode)
+        return InputType.convolutional3d(dd, h, w, self.n_out)
+
+    def init_params(self, key, input_type):
+        k = self._triple(self.kernel_size)
+        c_in = input_type.channels
+        fan_in = math.prod(k) * c_in
+        fan_out = math.prod(k) * self.n_out
+        params = {"W": self._init_weight(key, k + (c_in, self.n_out), fan_in, fan_out)}
+        if self.has_bias:
+            params["b"] = self._init_bias((self.n_out,))
+        return params
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        k, s, p, d = (self._triple(self.kernel_size), self._triple(self.stride),
+                      self._triple(self.padding), self._triple(self.dilation))
+        x = self._maybe_dropout(x, train, rng)
+        padding = "SAME" if self.convolution_mode == "same" else [(pp, pp) for pp in p]
+        policy = dtype_policy()
+        y = lax.conv_general_dilated(
+            x.astype(policy.compute_dtype), params["W"].astype(policy.compute_dtype),
+            window_strides=s, padding=padding, rhs_dilation=d,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+        ).astype(policy.output_dtype)
+        if self.has_bias:
+            y = y + params["b"]
+        return activations.get(self.activation or "identity")(y), state
+
+
+@register_layer("deconv2d")
+@dataclasses.dataclass
+class Deconvolution2D(ConvolutionLayer):
+    """Transposed convolution (``deconv2d`` op)."""
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        (kh, kw), (sh, sw), (ph, pw), (dh, dw) = self._dims()
+        if self.convolution_mode == "same":
+            h, w = input_type.height * sh, input_type.width * sw
+        else:
+            h = sh * (input_type.height - 1) + (kh - 1) * dh + 1 - 2 * ph
+            w = sw * (input_type.width - 1) + (kw - 1) * dw + 1 - 2 * pw
+        return InputType.convolutional(h, w, self.n_out)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        (kh, kw), stride, (ph, pw), dilation = self._dims()
+        x = self._maybe_dropout(x, train, rng)
+        policy = dtype_policy()
+        if self.convolution_mode == "same":
+            padding = "SAME"
+        else:
+            # conv_transpose VALID then crop explicit padding
+            padding = [((kh - 1) * dilation[0] - ph, (kh - 1) * dilation[0] - ph),
+                       ((kw - 1) * dilation[1] - pw, (kw - 1) * dilation[1] - pw)]
+        y = lax.conv_transpose(
+            x.astype(policy.compute_dtype), params["W"].astype(policy.compute_dtype),
+            strides=stride, padding=padding, rhs_dilation=dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ).astype(policy.output_dtype)
+        if self.has_bias:
+            y = y + params["b"]
+        return activations.get(self.activation or "identity")(y), state
+
+
+@register_layer("depthwise_conv2d")
+@dataclasses.dataclass
+class DepthwiseConvolution2D(ConvolutionLayer):
+    """Depthwise conv (``depthwise_conv2d`` op): n_out = c_in * depth_multiplier."""
+
+    depth_multiplier: int = 1
+    n_out: int = 0  # derived: c_in * depth_multiplier
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        base = dataclasses.replace(self, n_out=input_type.channels * self.depth_multiplier)
+        return ConvolutionLayer.get_output_type(base, input_type)
+
+    def init_params(self, key, input_type):
+        (kh, kw), _, _, _ = self._dims()
+        c_in = input_type.channels
+        out = c_in * self.depth_multiplier
+        fan_in, fan_out = kh * kw, kh * kw * self.depth_multiplier
+        params = {"W": self._init_weight(key, (kh, kw, 1, out), fan_in, fan_out)}
+        if self.has_bias:
+            params["b"] = self._init_bias((out,))
+        return params
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        _, stride, pad, dilation = self._dims()
+        x = self._maybe_dropout(x, train, rng)
+        y = self._conv(x, params["W"], stride, self._padding_arg(pad), dilation,
+                       groups=x.shape[-1])
+        if self.has_bias:
+            y = y + params["b"]
+        return activations.get(self.activation or "identity")(y), state
+
+
+@register_layer("separable_conv2d")
+@dataclasses.dataclass
+class SeparableConvolution2D(ConvolutionLayer):
+    """Depthwise-separable conv (``sconv2d`` op): depthwise then 1x1 pointwise."""
+
+    depth_multiplier: int = 1
+
+    def init_params(self, key, input_type):
+        (kh, kw), _, _, _ = self._dims()
+        c_in = input_type.channels
+        mid = c_in * self.depth_multiplier
+        k1, k2 = jax.random.split(key)
+        params = {
+            "depthW": self._init_weight(k1, (kh, kw, 1, mid), kh * kw, kh * kw * self.depth_multiplier),
+            "pointW": self._init_weight(k2, (1, 1, mid, self.n_out), mid, self.n_out),
+        }
+        if self.has_bias:
+            params["b"] = self._init_bias((self.n_out,))
+        return params
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        _, stride, pad, dilation = self._dims()
+        x = self._maybe_dropout(x, train, rng)
+        y = self._conv(x, params["depthW"], stride, self._padding_arg(pad), dilation,
+                       groups=x.shape[-1])
+        y = self._conv(y, params["pointW"], (1, 1), "VALID", (1, 1))
+        if self.has_bias:
+            y = y + params["b"]
+        return activations.get(self.activation or "identity")(y), state
+
+
+@register_layer("subsampling")
+@dataclasses.dataclass
+class SubsamplingLayer(Layer):
+    """Pooling (``conf/layers/SubsamplingLayer.java``; libnd4j
+    maxpool2d/avgpool2d/pnormpool2d) via ``lax.reduce_window``."""
+
+    pooling_type: str = "max"  # max | avg | sum | pnorm
+    kernel_size: Any = (2, 2)
+    stride: Any = (2, 2)
+    padding: Any = (0, 0)
+    convolution_mode: str = "truncate"
+    pnorm: int = 2
+    avg_pool_include_pad: bool = False
+
+    def has_params(self) -> bool:
+        return False
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        (kh, kw), (sh, sw), (ph, pw) = _pair(self.kernel_size), _pair(self.stride), _pair(self.padding)
+        h = _out_dim(input_type.height, kh, sh, ph, 1, self.convolution_mode)
+        w = _out_dim(input_type.width, kw, sw, pw, 1, self.convolution_mode)
+        return InputType.convolutional(h, w, input_type.channels)
+
+    def _window(self, ndim):
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        return (1, kh, kw, 1), (1, sh, sw, 1)
+
+    def _padding_arg(self):
+        if self.convolution_mode == "same":
+            return "SAME"
+        ph, pw = _pair(self.padding)
+        return [(0, 0), (ph, ph), (pw, pw), (0, 0)]
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        window, strides = self._window(x.ndim)
+        padding = self._padding_arg()
+        pt = self.pooling_type.lower()
+        if pt == "max":
+            init = -jnp.inf
+            y = lax.reduce_window(x, init, lax.max, window, strides, padding)
+        elif pt in ("avg", "sum"):
+            y = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+            if pt == "avg":
+                if self.avg_pool_include_pad:
+                    y = y / math.prod(window)
+                else:
+                    # exclude-pad semantics (DL4J default): divide by the
+                    # count of real (non-pad) elements in each window
+                    count = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add,
+                                              window, strides, padding)
+                    y = y / jnp.maximum(count, 1.0)
+        elif pt == "pnorm":
+            p = float(self.pnorm)
+            y = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window, strides, padding) ** (1.0 / p)
+        else:
+            raise ValueError(f"unknown pooling type {self.pooling_type}")
+        return y, state
+
+
+@register_layer("subsampling1d")
+@dataclasses.dataclass
+class Subsampling1DLayer(SubsamplingLayer):
+    """1-D pooling over NWC (``Subsampling1DLayer.java``)."""
+
+    kernel_size: Any = 2
+    stride: Any = 2
+    padding: Any = 0
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        k = self.kernel_size if not isinstance(self.kernel_size, (tuple, list)) else self.kernel_size[0]
+        s = self.stride if not isinstance(self.stride, (tuple, list)) else self.stride[0]
+        p = self.padding if not isinstance(self.padding, (tuple, list)) else self.padding[0]
+        t = input_type.timesteps
+        if t is not None:
+            t = _out_dim(t, k, s, p, 1, self.convolution_mode)
+        return InputType.recurrent(input_type.size, t)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        # lift NWC → NHWC with H=1, pool, drop H
+        x4 = x[:, None, :, :]
+        saved = (self.kernel_size, self.stride, self.padding)
+        k = saved[0] if not isinstance(saved[0], (tuple, list)) else saved[0][0]
+        s = saved[1] if not isinstance(saved[1], (tuple, list)) else saved[1][0]
+        p = saved[2] if not isinstance(saved[2], (tuple, list)) else saved[2][0]
+        layer2d = dataclasses.replace(self, kernel_size=(1, k), stride=(1, s), padding=(0, p))
+        y, state = SubsamplingLayer.apply(layer2d, params, state, x4, train=train, rng=rng)
+        return y[:, 0, :, :], state
+
+
+@register_layer("subsampling3d")
+@dataclasses.dataclass
+class Subsampling3DLayer(SubsamplingLayer):
+    """3-D pooling over NDHWC (``Subsampling3DLayer.java``)."""
+
+    kernel_size: Any = (2, 2, 2)
+    stride: Any = (2, 2, 2)
+    padding: Any = (0, 0, 0)
+
+    def _t3(self, v):
+        return tuple(v) if isinstance(v, (tuple, list)) else (v, v, v)
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        k, s, p = self._t3(self.kernel_size), self._t3(self.stride), self._t3(self.padding)
+        d = _out_dim(input_type.depth, k[0], s[0], p[0], 1, self.convolution_mode)
+        h = _out_dim(input_type.height, k[1], s[1], p[1], 1, self.convolution_mode)
+        w = _out_dim(input_type.width, k[2], s[2], p[2], 1, self.convolution_mode)
+        return InputType.convolutional3d(d, h, w, input_type.channels)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        k, s, p = self._t3(self.kernel_size), self._t3(self.stride), self._t3(self.padding)
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        padding = "SAME" if self.convolution_mode == "same" else [(0, 0)] + [(pp, pp) for pp in p] + [(0, 0)]
+        pt = self.pooling_type.lower()
+        if pt == "max":
+            y = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, padding)
+        elif pt == "pnorm":
+            p = float(self.pnorm)
+            y = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window, strides,
+                                  padding) ** (1.0 / p)
+        else:
+            y = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+            if pt == "avg":
+                if self.avg_pool_include_pad:
+                    y = y / math.prod(k)
+                else:
+                    count = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add,
+                                              window, strides, padding)
+                    y = y / jnp.maximum(count, 1.0)
+        return y, state
+
+
+@register_layer("upsampling2d")
+@dataclasses.dataclass
+class UpsamplingLayer(Layer):
+    """Nearest-neighbor upsampling (``Upsampling2D.java``; ``upsampling2d`` op)."""
+
+    size: Any = 2
+
+    def has_params(self) -> bool:
+        return False
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        sh, sw = _pair(self.size)
+        return InputType.convolutional(input_type.height * sh, input_type.width * sw,
+                                       input_type.channels)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        sh, sw = _pair(self.size)
+        y = jnp.repeat(jnp.repeat(x, sh, axis=1), sw, axis=2)
+        return y, state
+
+
+@register_layer("zero_padding")
+@dataclasses.dataclass
+class ZeroPaddingLayer(Layer):
+    """(``ZeroPaddingLayer.java``).  padding: (top, bottom, left, right) or
+    (h, w) symmetric."""
+
+    padding: Any = (1, 1, 1, 1)
+
+    def has_params(self) -> bool:
+        return False
+
+    def _pads(self):
+        p = self.padding
+        if isinstance(p, int):
+            return (p, p, p, p)
+        if len(p) == 2:
+            return (p[0], p[0], p[1], p[1])
+        return tuple(p)
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        t, b, l, r = self._pads()
+        return InputType.convolutional(input_type.height + t + b, input_type.width + l + r,
+                                       input_type.channels)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        t, b, l, r = self._pads()
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0))), state
+
+
+@register_layer("cropping2d")
+@dataclasses.dataclass
+class CroppingLayer(Layer):
+    """(``Cropping2D.java``).  cropping: (top, bottom, left, right) or (h, w)."""
+
+    cropping: Any = (0, 0, 0, 0)
+
+    def has_params(self) -> bool:
+        return False
+
+    def _crops(self):
+        c = self.cropping
+        if isinstance(c, int):
+            return (c, c, c, c)
+        if len(c) == 2:
+            return (c[0], c[0], c[1], c[1])
+        return tuple(c)
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        t, b, l, r = self._crops()
+        return InputType.convolutional(input_type.height - t - b, input_type.width - l - r,
+                                       input_type.channels)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        t, b, l, r = self._crops()
+        h, w = x.shape[1], x.shape[2]
+        return x[:, t:h - b if b else h, l:w - r if r else w, :], state
+
+
+@register_layer("space_to_depth")
+@dataclasses.dataclass
+class SpaceToDepthLayer(Layer):
+    """(``SpaceToDepthLayer.java``; libnd4j ``space_to_depth``)."""
+
+    block_size: int = 2
+
+    def has_params(self) -> bool:
+        return False
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        s = self.block_size
+        return InputType.convolutional(input_type.height // s, input_type.width // s,
+                                       input_type.channels * s * s)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        n, h, w, c = x.shape
+        s = self.block_size
+        y = x.reshape(n, h // s, s, w // s, s, c)
+        y = y.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // s, w // s, s * s * c)
+        return y, state
+
+
+@register_layer("global_pooling")
+@dataclasses.dataclass
+class GlobalPoolingLayer(Layer):
+    """Global pooling over spatial (CNN) or time (RNN) dims with mask
+    support (``conf/layers/GlobalPoolingLayer.java``)."""
+
+    pooling_type: str = "max"  # max | avg | sum | pnorm
+    pnorm: int = 2
+    collapse_dimensions: bool = True
+
+    def has_params(self) -> bool:
+        return False
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind == "cnn":
+            return InputType.feed_forward(input_type.channels)
+        if input_type.kind == "cnn3d":
+            return InputType.feed_forward(input_type.channels)
+        if input_type.kind == "rnn":
+            return InputType.feed_forward(input_type.size)
+        return input_type
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        if x.ndim == 4:
+            axes = (1, 2)
+        elif x.ndim == 5:
+            axes = (1, 2, 3)
+        else:
+            axes = (1,)  # NTC: pool over time
+        pt = self.pooling_type.lower()
+        if mask is not None and x.ndim == 3:
+            m = mask[..., None]
+            if pt == "max":
+                y = jnp.max(jnp.where(m > 0, x, -jnp.inf), axis=axes)
+            elif pt == "sum":
+                y = jnp.sum(x * m, axis=axes)
+            elif pt == "avg":
+                y = jnp.sum(x * m, axis=axes) / jnp.clip(jnp.sum(m, axis=axes), 1.0)
+            else:
+                p = float(self.pnorm)
+                y = jnp.sum(jnp.abs(x * m) ** p, axis=axes) ** (1.0 / p)
+            return y, state
+        if pt == "max":
+            y = jnp.max(x, axis=axes)
+        elif pt == "avg":
+            y = jnp.mean(x, axis=axes)
+        elif pt == "sum":
+            y = jnp.sum(x, axis=axes)
+        elif pt == "pnorm":
+            p = float(self.pnorm)
+            y = jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1.0 / p)
+        else:
+            raise ValueError(self.pooling_type)
+        return y, state
+
+
+@register_layer("lrn")
+@dataclasses.dataclass
+class LocalResponseNormalization(Layer):
+    """Local response normalization across channels (``lrn`` op;
+    ``conf/layers/LocalResponseNormalization.java``).  DL4J defaults:
+    k=2, n=5, alpha=1e-4, beta=0.75."""
+
+    k: float = 2.0
+    n: int = 5
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def has_params(self) -> bool:
+        return False
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        half = self.n // 2
+        sq = x * x
+        # sum over a sliding window of channels (last axis)
+        window = (1, 1, 1, self.n)
+        padded = jnp.pad(sq, ((0, 0), (0, 0), (0, 0), (half, half)))
+        summed = lax.reduce_window(padded, 0.0, lax.add, window, (1, 1, 1, 1), "VALID")
+        denom = (self.k + self.alpha * summed) ** self.beta
+        return x / denom, state

@@ -1,0 +1,248 @@
+"""Core feed-forward layers.
+
+Parity targets (deeplearning4j-nn):
+- ``conf/layers/DenseLayer.java`` + ``layers/feedforward/dense/DenseLayer.java``
+- ``conf/layers/OutputLayer.java`` + ``layers/OutputLayer.java``
+- ``conf/layers/LossLayer.java``, ``ActivationLayer.java``, ``DropoutLayer.java``
+- ``conf/layers/EmbeddingLayer.java``, ``EmbeddingSequenceLayer.java``
+- ``conf/layers/BatchNormalization.java`` + ``layers/normalization/BatchNormalization.java``
+
+The matmul is ``x @ W + b`` on the MXU via ``jnp.dot`` in the compute dtype
+(bf16 under the bf16 policy); params stay float32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.config import dtype_policy
+from deeplearning4j_tpu.nn import activations, losses
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+@register_layer("dense")
+@dataclasses.dataclass
+class DenseLayer(Layer):
+    """Fully connected: y = act(x @ W + b).  W: [nIn, nOut]."""
+
+    n_out: int = 0
+    has_bias: bool = True
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind == "rnn":
+            # DL4J auto-inserts RnnToFeedForward/FeedForwardToRnn
+            # preprocessor pairs around a DenseLayer fed by an RNN layer —
+            # net effect: time-distributed dense, [B,T,nIn] → [B,T,nOut].
+            return InputType.recurrent(self.n_out, input_type.timesteps)
+        return InputType.feed_forward(self.n_out)
+
+    def init_params(self, key, input_type):
+        n_in = input_type.size if input_type.kind == "rnn" else input_type.flat_size()
+        params = {"W": self._init_weight(key, (n_in, self.n_out), n_in, self.n_out)}
+        if self.has_bias:
+            params["b"] = self._init_bias((self.n_out,))
+        return params
+
+    def pre_output(self, params, state, x, *, train=False, rng=None):
+        policy = dtype_policy()
+        x = self._maybe_dropout(x, train, rng)
+        if x.ndim > 2 and x.shape[-1] == params["W"].shape[0]:
+            pass  # [B,T,C] time-distributed path: contract the last axis
+        elif x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)  # CNN→FF flatten
+        y = jnp.dot(x.astype(policy.compute_dtype), params["W"].astype(policy.compute_dtype))
+        y = y.astype(policy.output_dtype)
+        if self.has_bias:
+            y = y + params["b"]
+        return y
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        z = self.pre_output(params, state, x, train=train, rng=rng)
+        return activations.get(self.activation or "identity")(z), state
+
+
+@register_layer("output")
+@dataclasses.dataclass
+class OutputLayer(DenseLayer):
+    """Dense + loss head (``conf/layers/OutputLayer.java``).  ``apply``
+    returns the activated output; ``compute_score_array`` pairs the
+    pre-activation with the loss (stable fused softmax/sigmoid paths)."""
+
+    loss: Any = "mcxent"
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind == "rnn":
+            raise ValueError(
+                "OutputLayer cannot follow a recurrent layer — use "
+                "RnnOutputLayer for per-timestep output, or wrap the RNN in "
+                "LastTimeStep/GlobalPoolingLayer (DL4J config-validation parity)")
+        return InputType.feed_forward(self.n_out)
+
+    def compute_score_array(self, params, state, x, labels, *, train=False,
+                            rng=None, mask=None):
+        z = self.pre_output(params, state, x, train=train, rng=rng)
+        loss_fn = losses.get(self.loss)
+        score = loss_fn(labels, z, self.activation or "identity", mask)
+        return score
+
+    def labels_required(self) -> bool:
+        return True
+
+
+@register_layer("loss")
+@dataclasses.dataclass
+class LossLayer(Layer):
+    """Loss without params (``conf/layers/LossLayer.java``): applies
+    activation + loss to its input directly."""
+
+    loss: Any = "mcxent"
+
+    def has_params(self) -> bool:
+        return False
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return activations.get(self.activation or "identity")(x), state
+
+    def compute_score_array(self, params, state, x, labels, *, train=False,
+                            rng=None, mask=None):
+        loss_fn = losses.get(self.loss)
+        return loss_fn(labels, x, self.activation or "identity", mask)
+
+    def labels_required(self) -> bool:
+        return True
+
+
+@register_layer("activation")
+@dataclasses.dataclass
+class ActivationLayer(Layer):
+    """Standalone activation (``conf/layers/ActivationLayer.java``)."""
+
+    def has_params(self) -> bool:
+        return False
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return activations.get(self.activation or "identity")(x), state
+
+
+@register_layer("dropout")
+@dataclasses.dataclass
+class DropoutLayer(Layer):
+    """Standalone dropout (``conf/layers/DropoutLayer.java``); ``dropout``
+    field is the retain probability per DL4J convention."""
+
+    def has_params(self) -> bool:
+        return False
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return self._maybe_dropout(x, train, rng), state
+
+
+@register_layer("embedding")
+@dataclasses.dataclass
+class EmbeddingLayer(Layer):
+    """Index → vector lookup (``conf/layers/EmbeddingLayer.java``): input is
+    one int index per example; equivalent to a Dense over one-hot but
+    executed as a gather (libnd4j ``gather`` declarable op → jnp.take)."""
+
+    n_in: int = 0   # vocab size
+    n_out: int = 0
+    has_bias: bool = True
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def init_params(self, key, input_type):
+        n_in = self.n_in or input_type.flat_size()
+        params = {"W": self._init_weight(key, (n_in, self.n_out), n_in, self.n_out)}
+        if self.has_bias:
+            params["b"] = self._init_bias((self.n_out,))
+        return params
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 2 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        y = jnp.take(params["W"], idx, axis=0)
+        if self.has_bias:
+            y = y + params["b"]
+        return activations.get(self.activation or "identity")(y), state
+
+
+@register_layer("embedding_sequence")
+@dataclasses.dataclass
+class EmbeddingSequenceLayer(EmbeddingLayer):
+    """Sequence of indices → [B, T, nOut] (``EmbeddingSequenceLayer.java``).
+    Output is time-major-free NTC (batch, time, channels)."""
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, input_type.timesteps)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 3 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        y = jnp.take(params["W"], idx, axis=0)  # [B, T, nOut]
+        if self.has_bias:
+            y = y + params["b"]
+        return activations.get(self.activation or "identity")(y), state
+
+
+@register_layer("batch_norm")
+@dataclasses.dataclass
+class BatchNormalization(Layer):
+    """Batch normalization over the channel (last) axis
+    (``conf/layers/BatchNormalization.java``; libnd4j ``batchnorm`` op and
+    its cuDNN platform engine — here a fused XLA pattern).
+
+    ``decay`` is the running-average decay (DL4J default 0.9):
+    running = decay * running + (1-decay) * batch_stat.
+    """
+
+    decay: float = 0.9
+    eps: float = 1e-5
+    lock_gamma_beta: bool = False
+    use_gamma_beta: bool = True
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def _num_features(self, input_type: InputType) -> int:
+        if input_type.kind == "cnn":
+            return input_type.channels
+        if input_type.kind == "cnn3d":
+            return input_type.channels
+        return input_type.flat_size() if input_type.kind != "rnn" else input_type.size
+
+    def init_params(self, key, input_type):
+        n = self._num_features(input_type)
+        if not self.use_gamma_beta or self.lock_gamma_beta:
+            return {}
+        return {"gamma": jnp.ones((n,)), "beta": jnp.zeros((n,))}
+
+    def init_state(self, input_type):
+        n = self._num_features(input_type)
+        return {"mean": jnp.zeros((n,)), "var": jnp.ones((n,))}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        axes = tuple(range(x.ndim - 1))  # all but channel axis (NHWC/NC/NTC)
+        if train:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1.0 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1.0 - self.decay) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = jax.lax.rsqrt(var + self.eps)
+        y = (x - mean) * inv
+        if params:
+            y = y * params["gamma"] + params["beta"]
+        y = activations.get(self.activation or "identity")(y)
+        return y, new_state

@@ -1,0 +1,65 @@
+"""Normalization / parametric activation layers.
+
+- LayerNormalization: libnd4j ``layer_norm`` declarable op parity (used by
+  the BERT path; the reference exposes it as an op + SameDiff layer).
+- PReLULayer: DL4J ``conf/layers/PReLULayer.java`` (learned per-channel
+  negative slope).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+@register_layer("layer_norm")
+@dataclasses.dataclass
+class LayerNormalization(Layer):
+    """Normalize over the channel (last) axis with learned gain/bias."""
+
+    eps: float = 1e-5
+    use_bias: bool = True
+
+    def _n(self, input_type: InputType) -> int:
+        if input_type.kind == "cnn":
+            return input_type.channels
+        if input_type.kind == "rnn":
+            return input_type.size
+        return input_type.flat_size()
+
+    def init_params(self, key, input_type):
+        n = self._n(input_type)
+        params = {"gamma": jnp.ones((n,))}
+        if self.use_bias:
+            params["beta"] = jnp.zeros((n,))
+        return params
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + self.eps) * params["gamma"]
+        if self.use_bias:
+            y = y + params["beta"]
+        return y, state
+
+
+@register_layer("prelu")
+@dataclasses.dataclass
+class PReLULayer(Layer):
+    """Parametric ReLU with learned alpha of the input's channel shape."""
+
+    def init_params(self, key, input_type):
+        if input_type.kind == "cnn":
+            shape = (input_type.channels,)
+        else:
+            shape = (input_type.flat_size(),)
+        return {"alpha": jnp.zeros(shape)}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return jnp.where(x >= 0, x, params["alpha"] * x), state

@@ -1,0 +1,419 @@
+"""Recurrent layers — `lax.scan` cells (XLA fuses the per-step matmuls onto
+the MXU; this replaces libnd4j ``lstmLayer``/``lstmBlock``/``gruCell`` ops
+and their cuDNN platform engines).
+
+Parity targets (deeplearning4j-nn ``conf/layers/`` + ``layers/recurrent/``):
+- LSTM (``conf/layers/LSTM.java``, impl ``layers/recurrent/LSTM.java`` via
+  ``LSTMHelpers``): gate order **IFOG** (input, forget, output, cell-gate)
+  in the packed [*, 4H] weights — kept so imported DL4J weights bit-match;
+  ``forget_gate_bias_init`` default 1.0.
+- GravesLSTM (``GravesLSTM.java``): adds peephole connections (cell→i,f,o).
+- SimpleRnn, GRU, Bidirectional (CONCAT/ADD/MUL/AVERAGE modes),
+  LastTimeStep, TimeDistributed, RnnOutputLayer, RnnLossLayer.
+
+Data layout NTC (batch, time, channels) — DL4J's NCW is converted at import.
+Masking: mask [B, T] ∈ {0,1}; masked steps carry the previous hidden state
+through unchanged and output zeros (DL4J semantics for variable-length
+sequences).  Streaming inference (``rnnTimeStep`` parity) uses
+``init_carry``/``step`` directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.config import dtype_policy
+from deeplearning4j_tpu.nn import activations
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+@dataclasses.dataclass
+class BaseRecurrentLayer(Layer):
+    n_out: int = 0
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, input_type.timesteps)
+
+    def init_carry(self, batch: int, dtype=jnp.float32):
+        raise NotImplementedError
+
+    def step(self, params, carry, x_t):
+        """One timestep: (carry, x_t[B,C]) -> (new_carry, y_t[B,H])."""
+        raise NotImplementedError
+
+    def _scan(self, params, x, mask, carry):
+        """Scan the cell over time with masking."""
+        xs = jnp.swapaxes(x, 0, 1)  # [T, B, C]
+        if mask is not None:
+            ms = jnp.swapaxes(mask.astype(x.dtype), 0, 1)  # [T, B]
+        else:
+            ms = None
+
+        def body(carry, inputs):
+            if ms is None:
+                x_t = inputs
+                new_carry, y_t = self.step(params, carry, x_t)
+                return new_carry, y_t
+            x_t, m_t = inputs
+            new_carry, y_t = self.step(params, carry, x_t)
+            m = m_t[:, None]
+            merged = jax.tree_util.tree_map(
+                lambda new, old: m * new + (1.0 - m) * old, new_carry, carry)
+            return merged, y_t * m
+
+        inputs = xs if ms is None else (xs, ms)
+        carry, ys = lax.scan(body, carry, inputs)
+        return jnp.swapaxes(ys, 0, 1), carry  # [B, T, H]
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self._maybe_dropout(x, train, rng)
+        carry = self.init_carry(x.shape[0], x.dtype)
+        y, _ = self._scan(params, x, mask, carry)
+        return y, state
+
+
+@register_layer("lstm")
+@dataclasses.dataclass
+class LSTM(BaseRecurrentLayer):
+    """Standard LSTM, IFOG packed weights:
+    W [nIn, 4H] input weights, U [nOut, 4H] recurrent weights, b [4H].
+    gate activation sigmoid (configurable), cell activation ``activation``
+    (default tanh)."""
+
+    gate_activation: Any = "sigmoid"
+    forget_gate_bias_init: float = 1.0
+
+    def init_params(self, key, input_type):
+        n_in, h = input_type.size, self.n_out
+        k1, k2 = jax.random.split(key)
+        w = self._init_weight(k1, (n_in, 4 * h), n_in, h)
+        u = self._init_weight(k2, (h, 4 * h), h, h)
+        b = jnp.zeros((4 * h,))
+        # IFOG order: forget block is [h:2h]
+        b = b.at[h:2 * h].set(self.forget_gate_bias_init)
+        return {"W": w, "U": u, "b": b}
+
+    def init_carry(self, batch, dtype=jnp.float32):
+        h = self.n_out
+        return (jnp.zeros((batch, h), dtype), jnp.zeros((batch, h), dtype))
+
+    def step(self, params, carry, x_t):
+        h_prev, c_prev = carry
+        policy = dtype_policy()
+        hsz = self.n_out
+        z = (jnp.dot(x_t.astype(policy.compute_dtype), params["W"].astype(policy.compute_dtype))
+             + jnp.dot(h_prev.astype(policy.compute_dtype), params["U"].astype(policy.compute_dtype))
+             ).astype(policy.output_dtype) + params["b"]
+        gate = activations.get(self.gate_activation)
+        cell_act = activations.get(self.activation or "tanh")
+        i = gate(z[:, 0 * hsz:1 * hsz])
+        f = gate(z[:, 1 * hsz:2 * hsz])
+        o = gate(z[:, 2 * hsz:3 * hsz])
+        g = cell_act(z[:, 3 * hsz:4 * hsz])
+        c = f * c_prev + i * g
+        h = o * cell_act(c)
+        return (h, c), h
+
+
+@register_layer("graves_lstm")
+@dataclasses.dataclass
+class GravesLSTM(LSTM):
+    """LSTM with peephole connections (Graves 2013 formulation;
+    ``conf/layers/GravesLSTM.java``): cell state feeds i/f (previous cell)
+    and o (current cell) gates via diagonal peephole weights wP [3H]."""
+
+    def init_params(self, key, input_type):
+        params = super().init_params(key, input_type)
+        params["wP"] = jnp.zeros((3 * self.n_out,))
+        return params
+
+    def step(self, params, carry, x_t):
+        h_prev, c_prev = carry
+        policy = dtype_policy()
+        hsz = self.n_out
+        z = (jnp.dot(x_t.astype(policy.compute_dtype), params["W"].astype(policy.compute_dtype))
+             + jnp.dot(h_prev.astype(policy.compute_dtype), params["U"].astype(policy.compute_dtype))
+             ).astype(policy.output_dtype) + params["b"]
+        gate = activations.get(self.gate_activation)
+        cell_act = activations.get(self.activation or "tanh")
+        p_i = params["wP"][0 * hsz:1 * hsz]
+        p_f = params["wP"][1 * hsz:2 * hsz]
+        p_o = params["wP"][2 * hsz:3 * hsz]
+        i = gate(z[:, 0 * hsz:1 * hsz] + p_i * c_prev)
+        f = gate(z[:, 1 * hsz:2 * hsz] + p_f * c_prev)
+        g = cell_act(z[:, 3 * hsz:4 * hsz])
+        c = f * c_prev + i * g
+        o = gate(z[:, 2 * hsz:3 * hsz] + p_o * c)
+        h = o * cell_act(c)
+        return (h, c), h
+
+
+@register_layer("simple_rnn")
+@dataclasses.dataclass
+class SimpleRnn(BaseRecurrentLayer):
+    """Vanilla RNN: h_t = act(x_t W + h_{t-1} U + b)
+    (``conf/layers/recurrent/SimpleRnn.java``)."""
+
+    def init_params(self, key, input_type):
+        n_in, h = input_type.size, self.n_out
+        k1, k2 = jax.random.split(key)
+        return {
+            "W": self._init_weight(k1, (n_in, h), n_in, h),
+            "U": self._init_weight(k2, (h, h), h, h),
+            "b": self._init_bias((h,)),
+        }
+
+    def init_carry(self, batch, dtype=jnp.float32):
+        return jnp.zeros((batch, self.n_out), dtype)
+
+    def step(self, params, carry, x_t):
+        act = activations.get(self.activation or "tanh")
+        h = act(jnp.dot(x_t, params["W"]) + jnp.dot(carry, params["U"]) + params["b"])
+        return h, h
+
+
+@register_layer("gru")
+@dataclasses.dataclass
+class GRU(BaseRecurrentLayer):
+    """GRU cell (libnd4j ``gruCell`` parity): packed [*, 3H] weights in
+    r, u(z), c order."""
+
+    gate_activation: Any = "sigmoid"
+
+    def init_params(self, key, input_type):
+        n_in, h = input_type.size, self.n_out
+        k1, k2 = jax.random.split(key)
+        return {
+            "W": self._init_weight(k1, (n_in, 3 * h), n_in, h),
+            "U": self._init_weight(k2, (h, 3 * h), h, h),
+            "b": self._init_bias((3 * h,)),
+        }
+
+    def init_carry(self, batch, dtype=jnp.float32):
+        return jnp.zeros((batch, self.n_out), dtype)
+
+    def step(self, params, carry, x_t):
+        h = self.n_out
+        gate = activations.get(self.gate_activation)
+        act = activations.get(self.activation or "tanh")
+        zx = jnp.dot(x_t, params["W"]) + params["b"]
+        zh = jnp.dot(carry, params["U"])
+        r = gate(zx[:, 0:h] + zh[:, 0:h])
+        u = gate(zx[:, h:2 * h] + zh[:, h:2 * h])
+        c = act(zx[:, 2 * h:3 * h] + r * zh[:, 2 * h:3 * h])
+        new_h = u * carry + (1.0 - u) * c
+        return new_h, new_h
+
+
+@register_layer("bidirectional")
+@dataclasses.dataclass
+class Bidirectional(Layer):
+    """Wraps any recurrent layer, runs fwd + bwd passes and merges
+    (``conf/layers/recurrent/Bidirectional.java``; modes ADD, MUL,
+    AVERAGE, CONCAT)."""
+
+    fwd: Any = None   # layer config (dict or Layer)
+    mode: str = "concat"
+
+    def __post_init__(self):
+        if isinstance(self.fwd, dict):
+            from deeplearning4j_tpu.nn.layers.base import layer_from_dict
+            self.fwd = layer_from_dict(self.fwd)
+
+    def inherit_defaults(self, defaults):
+        super().inherit_defaults(defaults)
+        if self.fwd is not None:
+            self.fwd.inherit_defaults(defaults)
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        inner = self.fwd.get_output_type(input_type)
+        size = inner.size * 2 if self.mode == "concat" else inner.size
+        return InputType.recurrent(size, inner.timesteps)
+
+    def init_params(self, key, input_type):
+        k1, k2 = jax.random.split(key)
+        return {"fwd": self.fwd.init_params(k1, input_type),
+                "bwd": self.fwd.init_params(k2, input_type)}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        y_f, _ = self.fwd.apply(params["fwd"], {}, x, train=train, rng=rng, mask=mask)
+        x_rev = jnp.flip(x, axis=1)
+        mask_rev = jnp.flip(mask, axis=1) if mask is not None else None
+        y_b, _ = self.fwd.apply(params["bwd"], {}, x_rev, train=train, rng=rng, mask=mask_rev)
+        y_b = jnp.flip(y_b, axis=1)
+        m = self.mode.lower()
+        if m == "concat":
+            y = jnp.concatenate([y_f, y_b], axis=-1)
+        elif m == "add":
+            y = y_f + y_b
+        elif m == "mul":
+            y = y_f * y_b
+        elif m == "average":
+            y = 0.5 * (y_f + y_b)
+        else:
+            raise ValueError(self.mode)
+        return y, state
+
+    def to_dict(self):
+        d = super().to_dict()
+        d["fwd"] = self.fwd.to_dict()
+        return d
+
+
+@register_layer("last_time_step")
+@dataclasses.dataclass
+class LastTimeStep(Layer):
+    """Wraps a recurrent layer; outputs the LAST (unmasked) timestep as a
+    feed-forward vector (``conf/layers/recurrent/LastTimeStep.java``)."""
+
+    underlying: Any = None
+
+    def __post_init__(self):
+        if isinstance(self.underlying, dict):
+            from deeplearning4j_tpu.nn.layers.base import layer_from_dict
+            self.underlying = layer_from_dict(self.underlying)
+
+    def inherit_defaults(self, defaults):
+        super().inherit_defaults(defaults)
+        if self.underlying is not None:
+            self.underlying.inherit_defaults(defaults)
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        inner = self.underlying.get_output_type(input_type)
+        return InputType.feed_forward(inner.size)
+
+    def init_params(self, key, input_type):
+        return self.underlying.init_params(key, input_type)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        y, state = self.underlying.apply(params, state, x, train=train, rng=rng, mask=mask)
+        if mask is None:
+            return y[:, -1, :], state
+        # last unmasked index per example
+        idx = jnp.maximum(jnp.sum(mask, axis=1).astype(jnp.int32) - 1, 0)
+        out = jnp.take_along_axis(y, idx[:, None, None], axis=1)[:, 0, :]
+        return out, state
+
+    def to_dict(self):
+        d = super().to_dict()
+        d["underlying"] = self.underlying.to_dict()
+        return d
+
+
+@register_layer("time_distributed")
+@dataclasses.dataclass
+class TimeDistributed(Layer):
+    """Applies a feed-forward layer independently at every timestep
+    (``conf/layers/recurrent/TimeDistributed.java``): [B,T,C] flattened to
+    [B*T,C], inner layer applied, reshaped back."""
+
+    underlying: Any = None
+
+    def __post_init__(self):
+        if isinstance(self.underlying, dict):
+            from deeplearning4j_tpu.nn.layers.base import layer_from_dict
+            self.underlying = layer_from_dict(self.underlying)
+
+    def inherit_defaults(self, defaults):
+        super().inherit_defaults(defaults)
+        if self.underlying is not None:
+            self.underlying.inherit_defaults(defaults)
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        inner_in = InputType.feed_forward(input_type.size)
+        inner_out = self.underlying.get_output_type(inner_in)
+        return InputType.recurrent(inner_out.size, input_type.timesteps)
+
+    def init_params(self, key, input_type):
+        return self.underlying.init_params(key, InputType.feed_forward(input_type.size))
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        b, t, c = x.shape
+        y, state = self.underlying.apply(params, state, x.reshape(b * t, c),
+                                         train=train, rng=rng)
+        return y.reshape(b, t, -1), state
+
+    def to_dict(self):
+        d = super().to_dict()
+        d["underlying"] = self.underlying.to_dict()
+        return d
+
+
+@register_layer("rnn_output")
+@dataclasses.dataclass
+class RnnOutputLayer(Layer):
+    """Per-timestep dense + loss (``conf/layers/RnnOutputLayer.java``):
+    input [B,T,C] → output [B,T,nOut]; score averaged over unmasked steps."""
+
+    n_out: int = 0
+    loss: Any = "mcxent"
+    has_bias: bool = True
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, input_type.timesteps)
+
+    def init_params(self, key, input_type):
+        n_in = input_type.size
+        params = {"W": self._init_weight(key, (n_in, self.n_out), n_in, self.n_out)}
+        if self.has_bias:
+            params["b"] = self._init_bias((self.n_out,))
+        return params
+
+    def pre_output(self, params, state, x, *, train=False, rng=None):
+        x = self._maybe_dropout(x, train, rng)
+        policy = dtype_policy()
+        z = jnp.dot(x.astype(policy.compute_dtype),
+                    params["W"].astype(policy.compute_dtype)).astype(policy.output_dtype)
+        if self.has_bias:
+            z = z + params["b"]
+        return z
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        z = self.pre_output(params, state, x, train=train, rng=rng)
+        return activations.get(self.activation or "identity")(z), state
+
+    def compute_score_array(self, params, state, x, labels, *, train=False,
+                            rng=None, mask=None):
+        from deeplearning4j_tpu.nn import losses as _losses
+        z = self.pre_output(params, state, x, train=train, rng=rng)
+        loss_fn = _losses.get(self.loss)
+        # flatten time into batch: [B*T, n_out]
+        b, t = z.shape[0], z.shape[1]
+        score = loss_fn(labels.reshape(b * t, -1), z.reshape(b * t, -1),
+                        self.activation or "identity", None)
+        return score.reshape(b, t)
+
+    def labels_required(self) -> bool:
+        return True
+
+
+@register_layer("rnn_loss")
+@dataclasses.dataclass
+class RnnLossLayer(Layer):
+    """Per-timestep loss without params (``conf/layers/RnnLossLayer.java``)."""
+
+    loss: Any = "mcxent"
+
+    def has_params(self) -> bool:
+        return False
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return activations.get(self.activation or "identity")(x), state
+
+    def compute_score_array(self, params, state, x, labels, *, train=False,
+                            rng=None, mask=None):
+        from deeplearning4j_tpu.nn import losses as _losses
+        loss_fn = _losses.get(self.loss)
+        b, t = x.shape[0], x.shape[1]
+        score = loss_fn(labels.reshape(b * t, -1), x.reshape(b * t, -1),
+                        self.activation or "identity", None)
+        return score.reshape(b, t)
+
+    def labels_required(self) -> bool:
+        return True

@@ -1,0 +1,82 @@
+"""InputPreProcessors — shape adapters auto-inserted between layer kinds.
+
+Parity with DL4J ``org/deeplearning4j/nn/conf/preprocessor/``
+(CnnToFeedForwardPreProcessor, FeedForwardToCnnPreProcessor,
+RnnToFeedForwardPreProcessor, FeedForwardToRnnPreProcessor,
+CnnToRnnPreProcessor, RnnToCnnPreProcessor) and the auto-insertion
+``MultiLayerConfiguration`` performs in ``setInputType``.
+
+All are pure reshapes/transposes (free under XLA).  Layouts: NHWC for CNN
+activations, NTC for RNN activations (reference uses NCHW/NCW — converted
+at import boundaries only).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.input_type import InputType
+
+
+def expected_kind(layer) -> Optional[str]:
+    """What input kind a layer wants, judged from its class; None = any."""
+    from deeplearning4j_tpu.nn.layers import conv as conv_mod
+    from deeplearning4j_tpu.nn.layers import recurrent as rnn_mod
+    from deeplearning4j_tpu.nn.layers import attention as attn_mod
+    if isinstance(layer, (conv_mod.Convolution1DLayer, conv_mod.Subsampling1DLayer)):
+        return "rnn"
+    if isinstance(layer, attn_mod.SelfAttentionLayer):
+        return "rnn"
+    if isinstance(layer, conv_mod.Convolution3DLayer):
+        return "cnn3d"
+    if isinstance(layer, (conv_mod.ConvolutionLayer, conv_mod.SubsamplingLayer,
+                          conv_mod.UpsamplingLayer, conv_mod.ZeroPaddingLayer,
+                          conv_mod.CroppingLayer, conv_mod.SpaceToDepthLayer,
+                          conv_mod.LocalResponseNormalization)):
+        return "cnn"
+    if isinstance(layer, (rnn_mod.BaseRecurrentLayer, rnn_mod.Bidirectional,
+                          rnn_mod.LastTimeStep, rnn_mod.TimeDistributed,
+                          rnn_mod.RnnOutputLayer, rnn_mod.RnnLossLayer)):
+        return "rnn"
+    return None
+
+
+def adapt_type(current: InputType, layer) -> InputType:
+    """Convert ``current`` to the kind ``layer`` expects (conf-time)."""
+    want = expected_kind(layer)
+    if want is None or current.kind == want:
+        return current
+    if want == "cnn" and current.kind == "cnn_flat":
+        return InputType.convolutional(current.height, current.width, current.channels)
+    if want == "cnn" and current.kind == "ff":
+        raise ValueError(
+            "cannot infer CNN dims from flat feed-forward input — use "
+            "InputType.convolutional_flat(h, w, c) as the network input type")
+    if want == "ff":
+        return InputType.feed_forward(current.flat_size())
+    if want == "rnn" and current.kind == "ff":
+        return InputType.recurrent(current.size, 1)
+    if want == "rnn" and current.kind == "cnn":
+        # CnnToRnn: H becomes time, W*C features (DL4J collapses to depth*h*w
+        # per step along W — we use rows as steps)
+        return InputType.recurrent(current.width * current.channels, current.height)
+    raise ValueError(f"no preprocessor from {current.kind} to {want}")
+
+
+def adapt_array(x: jnp.ndarray, current: InputType, layer) -> jnp.ndarray:
+    """Runtime twin of :func:`adapt_type`."""
+    want = expected_kind(layer)
+    if want is None or current.kind == want:
+        return x
+    if want == "cnn" and current.kind == "cnn_flat":
+        return x.reshape(x.shape[0], current.height, current.width, current.channels)
+    if want == "ff":
+        return x.reshape(x.shape[0], -1)
+    if want == "rnn" and current.kind == "ff":
+        return x[:, None, :]
+    if want == "rnn" and current.kind == "cnn":
+        b, h, w, c = x.shape
+        return x.reshape(b, h, w * c)
+    raise ValueError(f"no preprocessor from {current.kind} to {want}")

@@ -1,0 +1,156 @@
+"""Weight initialization schemes.
+
+Parity with DL4J ``WeightInit`` enum + ``IWeightInit`` impls
+(deeplearning4j-nn ``org/deeplearning4j/nn/weights/``): ZERO, ONES, NORMAL,
+UNIFORM, XAVIER, XAVIER_UNIFORM, XAVIER_FAN_IN, LECUN_NORMAL, LECUN_UNIFORM,
+RELU (He normal), RELU_UNIFORM (He uniform), SIGMOID_UNIFORM, IDENTITY,
+VAR_SCALING_* and DISTRIBUTION.
+
+DL4J's fan conventions: for a dense weight of shape [nIn, nOut],
+fanIn = nIn, fanOut = nOut; for convs fan includes the receptive field.
+All initializers take (key, shape, fan_in, fan_out, dtype).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+InitFn = Callable[[jax.Array, tuple, float, float, jnp.dtype], jnp.ndarray]
+
+_REGISTRY: dict[str, InitFn] = {}
+
+
+def register(name: str):
+    def deco(fn: InitFn) -> InitFn:
+        _REGISTRY[name.lower()] = fn
+        return fn
+    return deco
+
+
+def get(name) -> InitFn:
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in _REGISTRY:
+        raise KeyError(f"unknown weight init '{name}'; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]
+
+
+def names() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+register("zero")(lambda key, shape, fi, fo, dtype: jnp.zeros(shape, dtype))
+register("ones")(lambda key, shape, fi, fo, dtype: jnp.ones(shape, dtype))
+register("normal")(  # DL4J NORMAL: N(0, 1/sqrt(fanIn))
+    lambda key, shape, fi, fo, dtype: jax.random.normal(key, shape, dtype) / math.sqrt(max(fi, 1.0))
+)
+register("uniform")(  # DL4J UNIFORM: U(-a, a), a = sqrt(3/fanIn)
+    lambda key, shape, fi, fo, dtype: jax.random.uniform(
+        key, shape, dtype, -math.sqrt(3.0 / max(fi, 1.0)), math.sqrt(3.0 / max(fi, 1.0)))
+)
+register("xavier")(  # N(0, sqrt(2/(fanIn+fanOut)))
+    lambda key, shape, fi, fo, dtype: jax.random.normal(key, shape, dtype)
+    * math.sqrt(2.0 / max(fi + fo, 1.0))
+)
+register("xavier_uniform")(  # U(-a, a), a = sqrt(6/(fanIn+fanOut))
+    lambda key, shape, fi, fo, dtype: jax.random.uniform(
+        key, shape, dtype, -math.sqrt(6.0 / max(fi + fo, 1.0)), math.sqrt(6.0 / max(fi + fo, 1.0)))
+)
+register("xavier_fan_in")(  # N(0, sqrt(1/fanIn))
+    lambda key, shape, fi, fo, dtype: jax.random.normal(key, shape, dtype) / math.sqrt(max(fi, 1.0))
+)
+register("relu")(  # He normal: N(0, sqrt(2/fanIn))
+    lambda key, shape, fi, fo, dtype: jax.random.normal(key, shape, dtype)
+    * math.sqrt(2.0 / max(fi, 1.0))
+)
+register("relu_uniform")(  # He uniform: U(-a, a), a = sqrt(6/fanIn)
+    lambda key, shape, fi, fo, dtype: jax.random.uniform(
+        key, shape, dtype, -math.sqrt(6.0 / max(fi, 1.0)), math.sqrt(6.0 / max(fi, 1.0)))
+)
+register("lecun_normal")(
+    lambda key, shape, fi, fo, dtype: jax.random.normal(key, shape, dtype)
+    * math.sqrt(1.0 / max(fi, 1.0))
+)
+register("lecun_uniform")(  # U(-a, a), a = sqrt(3/fanIn)
+    lambda key, shape, fi, fo, dtype: jax.random.uniform(
+        key, shape, dtype, -math.sqrt(3.0 / max(fi, 1.0)), math.sqrt(3.0 / max(fi, 1.0)))
+)
+register("sigmoid_uniform")(  # U(-a, a), a = 4*sqrt(6/(fanIn+fanOut))
+    lambda key, shape, fi, fo, dtype: jax.random.uniform(
+        key, shape, dtype,
+        -4.0 * math.sqrt(6.0 / max(fi + fo, 1.0)), 4.0 * math.sqrt(6.0 / max(fi + fo, 1.0)))
+)
+
+
+@register("identity")
+def identity_init(key, shape, fi, fo, dtype):
+    if len(shape) == 2 and shape[0] == shape[1]:
+        return jnp.eye(shape[0], dtype=dtype)
+    raise ValueError("IDENTITY weight init requires a square 2-D weight")
+
+
+@register("var_scaling_normal_fan_in")
+def vs_normal_fan_in(key, shape, fi, fo, dtype):
+    return jax.random.normal(key, shape, dtype) * math.sqrt(1.0 / max(fi, 1.0))
+
+
+@register("var_scaling_normal_fan_out")
+def vs_normal_fan_out(key, shape, fi, fo, dtype):
+    return jax.random.normal(key, shape, dtype) * math.sqrt(1.0 / max(fo, 1.0))
+
+
+@register("var_scaling_normal_fan_avg")
+def vs_normal_fan_avg(key, shape, fi, fo, dtype):
+    return jax.random.normal(key, shape, dtype) * math.sqrt(2.0 / max(fi + fo, 1.0))
+
+
+@register("var_scaling_uniform_fan_in")
+def vs_uniform_fan_in(key, shape, fi, fo, dtype):
+    a = math.sqrt(3.0 / max(fi, 1.0))
+    return jax.random.uniform(key, shape, dtype, -a, a)
+
+
+@register("var_scaling_uniform_fan_out")
+def vs_uniform_fan_out(key, shape, fi, fo, dtype):
+    a = math.sqrt(3.0 / max(fo, 1.0))
+    return jax.random.uniform(key, shape, dtype, -a, a)
+
+
+@register("var_scaling_uniform_fan_avg")
+def vs_uniform_fan_avg(key, shape, fi, fo, dtype):
+    a = math.sqrt(6.0 / max(fi + fo, 1.0))
+    return jax.random.uniform(key, shape, dtype, -a, a)
+
+
+def distribution(dist: str, **kw) -> InitFn:
+    """WeightInit.DISTRIBUTION parity: explicit distribution objects
+    (``org/deeplearning4j/nn/conf/distribution/``)."""
+    dist = dist.lower()
+    if dist == "normal" or dist == "gaussian":
+        mean, std = kw.get("mean", 0.0), kw.get("std", 1.0)
+        return lambda key, shape, fi, fo, dtype: mean + std * jax.random.normal(key, shape, dtype)
+    if dist == "uniform":
+        lo, hi = kw.get("lower", -1.0), kw.get("upper", 1.0)
+        return lambda key, shape, fi, fo, dtype: jax.random.uniform(key, shape, dtype, lo, hi)
+    if dist == "truncated_normal":
+        mean, std = kw.get("mean", 0.0), kw.get("std", 1.0)
+        return lambda key, shape, fi, fo, dtype: mean + std * jax.random.truncated_normal(
+            key, -2.0, 2.0, shape, dtype)
+    if dist == "constant":
+        value = kw.get("value", 0.0)
+        return lambda key, shape, fi, fo, dtype: jnp.full(shape, value, dtype)
+    if dist == "orthogonal":
+        gain = kw.get("gain", 1.0)
+        return lambda key, shape, fi, fo, dtype: gain * jax.nn.initializers.orthogonal()(key, shape, dtype)
+    if dist == "binomial":
+        n, p = kw.get("n", 1), kw.get("p", 0.5)
+        return lambda key, shape, fi, fo, dtype: jax.random.binomial(key, n, p, shape).astype(dtype)
+    if dist == "log_normal":
+        mean, std = kw.get("mean", 0.0), kw.get("std", 1.0)
+        return lambda key, shape, fi, fo, dtype: jnp.exp(mean + std * jax.random.normal(key, shape, dtype))
+    raise KeyError(f"unknown distribution '{dist}'")

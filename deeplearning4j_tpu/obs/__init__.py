@@ -1,0 +1,24 @@
+from deeplearning4j_tpu.obs.listeners import (
+    TrainingListener,
+    ListenerBus,
+    ScoreIterationListener,
+    PerformanceListener,
+    CollectScoresListener,
+    TimeIterationListener,
+    EvaluativeListener,
+)
+from deeplearning4j_tpu.obs.metrics import MetricsWriter
+from deeplearning4j_tpu.obs.profiler import check_finite, StepTimer
+
+__all__ = [
+    "TrainingListener",
+    "ListenerBus",
+    "ScoreIterationListener",
+    "PerformanceListener",
+    "CollectScoresListener",
+    "TimeIterationListener",
+    "EvaluativeListener",
+    "MetricsWriter",
+    "check_finite",
+    "StepTimer",
+]

@@ -1,0 +1,83 @@
+"""Structured jsonl metrics — the StatsListener/StatsStorage replacement.
+
+The reference streams per-iteration stats (score, histograms, memory, GC,
+timings) through ``StatsListener`` → ``StatsStorage`` → Vert.x web UI
+(deeplearning4j-ui-parent).  TPU-native plan (SURVEY.md §2.8/§5.5): emit the
+same records as append-only jsonl that any notebook/dashboard can read.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Optional
+
+from deeplearning4j_tpu.obs.listeners import TrainingListener
+
+
+class MetricsWriter:
+    """Append-only jsonl writer; one file per run."""
+
+    def __init__(self, path: str):
+        self.path = path
+        directory = os.path.dirname(path)
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+        self._fh = open(path, "a", buffering=1)
+
+    def write(self, record: dict[str, Any]) -> None:
+        record = {"ts": time.time(), **record}
+        self._fh.write(json.dumps(record, default=_to_jsonable) + "\n")
+
+    def close(self) -> None:
+        self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _to_jsonable(obj: Any) -> Any:
+    if hasattr(obj, "item"):
+        try:
+            return obj.item()
+        except Exception:
+            pass
+    if hasattr(obj, "tolist"):
+        return obj.tolist()
+    return str(obj)
+
+
+class StatsListener(TrainingListener):
+    """StatsListener parity: writes score (+optional param/grad norms) per
+    iteration to jsonl."""
+
+    def __init__(self, writer: MetricsWriter, frequency: int = 1,
+                 with_norms: bool = False):
+        self.writer = writer
+        self.frequency = max(1, frequency)
+        self.with_norms = with_norms
+        self._norms: Optional[dict] = None
+
+    def on_gradient_calculation(self, model, gradients):
+        if self.with_norms:
+            import jax.numpy as jnp
+            from deeplearning4j_tpu.utils.pytree import param_table
+            self._norms = {
+                k: float(jnp.linalg.norm(v)) for k, v in param_table(gradients).items()
+            }
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if iteration % self.frequency:
+            return
+        record = {"event": "iteration", "iteration": iteration, "epoch": epoch, "score": float(score)}
+        if self._norms:
+            record["grad_norms"] = self._norms
+            self._norms = None
+        self.writer.write(record)
+
+    def on_epoch_end(self, model, epoch, info):
+        self.writer.write({"event": "epoch_end", "epoch": epoch, **info})

@@ -1,0 +1,96 @@
+"""Profiling hooks: NaN/Inf panic and step timing.
+
+Parity with ND4J ``OpProfiler`` NAN_PANIC / INF_PANIC modes
+(nd4j-api ``org/nd4j/linalg/profiler/OpProfiler.java``) and the per-op
+timing the C++ graph executor records (libnd4j
+``include/graph/profiling/GraphProfilingHelper``).  On TPU, per-op hooks
+don't exist inside a jit region — XLA fuses everything — so the equivalents
+are (a) post-step finite checks on outputs (host-side, only when enabled),
+(b) ``jax.config.jax_debug_nans`` for trap-at-op granularity in debug runs,
+(c) ``jax.profiler`` traces for HLO-level cost breakdowns.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.config import get_config
+
+
+class NonFiniteError(RuntimeError):
+    pass
+
+
+def check_finite(tree: Any, label: str = "output") -> None:
+    """NAN_PANIC/INF_PANIC parity: raise on the first non-finite leaf.
+    Only called by the trainer when ``config.nan_panic``/``inf_panic`` is
+    set — it forces a device sync, so it's off by default."""
+    cfg = get_config()
+    if not (cfg.nan_panic or cfg.inf_panic):
+        return
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if not hasattr(leaf, "dtype") or not jnp.issubdtype(leaf.dtype, jnp.inexact):
+            continue
+        if cfg.nan_panic and bool(jnp.any(jnp.isnan(leaf))):
+            raise NonFiniteError(f"NaN detected in {label} at {path}")
+        if cfg.inf_panic and bool(jnp.any(jnp.isinf(leaf))):
+            raise NonFiniteError(f"Inf detected in {label} at {path}")
+
+
+def enable_debug_nans(enable: bool = True) -> None:
+    """Trap NaNs at op granularity (recompiles without fusion-hiding)."""
+    jax.config.update("jax_debug_nans", enable)
+
+
+class StepTimer:
+    """Wall-clock timing of jit'd steps, with compile-step detection: the
+    first call through a jit boundary includes trace+compile time, so it is
+    recorded separately (``compile_s``) and excluded from the step stats."""
+
+    def __init__(self):
+        self.compile_s: float | None = None
+        self.steps = 0
+        self.total_s = 0.0
+        self.min_s = float("inf")
+        self.max_s = 0.0
+
+    @contextmanager
+    def step(self):
+        t0 = time.perf_counter()
+        yield
+        dt = time.perf_counter() - t0
+        if self.compile_s is None:
+            self.compile_s = dt
+        else:
+            self.steps += 1
+            self.total_s += dt
+            self.min_s = min(self.min_s, dt)
+            self.max_s = max(self.max_s, dt)
+
+    @property
+    def mean_s(self) -> float:
+        return self.total_s / self.steps if self.steps else 0.0
+
+    def summary(self) -> dict:
+        return {
+            "compile_s": self.compile_s,
+            "steps": self.steps,
+            "mean_step_s": self.mean_s,
+            "min_step_s": self.min_s if self.steps else None,
+            "max_step_s": self.max_s if self.steps else None,
+        }
+
+
+@contextmanager
+def trace(logdir: str):
+    """``jax.profiler`` trace context (TensorBoard/Perfetto viewable)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

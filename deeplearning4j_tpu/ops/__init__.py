@@ -1,0 +1,18 @@
+"""Op catalog — libnd4j declarable-op parity as namespaced functions.
+
+The reference registers ~500 named ops (libnd4j
+``include/ops/declarable/``) dispatched by enum through JNI; here the
+catalog is namespaced pure functions over jnp/lax that XLA fuses, plus
+Pallas kernels for the few genuinely custom ones (``pallas/``).  The
+namespaces mirror ND4J's generated façades (``Nd4j.math()``, ``Nd4j.nn()``,
+``Nd4j.cnn()``, ``Nd4j.rnn()``, ``Nd4j.loss()``, ``Nd4j.linalg()``,
+``Nd4j.random()``, ``Nd4j.image()``, ``Nd4j.bitwise()`` — nd4j-api
+``org/nd4j/linalg/factory/ops/``).
+"""
+
+from deeplearning4j_tpu.ops import attention
+from deeplearning4j_tpu.ops import namespaces
+from deeplearning4j_tpu.ops.namespaces import math, nn, cnn, rnn, loss, linalg, random, image, bitwise
+
+__all__ = ["attention", "namespaces", "math", "nn", "cnn", "rnn", "loss",
+           "linalg", "random", "image", "bitwise"]

@@ -1,0 +1,66 @@
+"""Attention ops.
+
+Parity with libnd4j ``dot_product_attention`` /
+``multi_head_dot_product_attention`` (declarable ops under
+``include/ops/declarable/generic/nn/attention/``) — the reference
+materializes the [T,T] score matrix; here the standard path is one fused
+einsum chain.  Long-sequence paths (blockwise Pallas kernel, ring
+attention over a mesh `seq` axis) land in later milestones (SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9
+
+
+def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                          mask: Optional[jnp.ndarray] = None,
+                          scaled: bool = True) -> jnp.ndarray:
+    """Single-head attention.  q [B,Tq,D], k/v [B,Tk,D], mask [B,Tk] or
+    [B,Tq,Tk] (1 = attend)."""
+    scale = 1.0 / math.sqrt(q.shape[-1]) if scaled else 1.0
+    scores = jnp.einsum("bqd,bkd->bqk", q, k) * scale
+    if mask is not None:
+        if mask.ndim == 2:
+            mask = mask[:, None, :]
+        scores = jnp.where(mask > 0, scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", weights, v)
+
+
+def multi_head_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                         n_heads: int,
+                         mask: Optional[jnp.ndarray] = None,
+                         kv_mask: Optional[jnp.ndarray] = None,
+                         causal: bool = False) -> jnp.ndarray:
+    """Multi-head attention on pre-projected q/k/v of shape [B,T,H*Dh].
+
+    ``mask``: [B,T] padding mask applied to keys (and zeroing masked query
+    outputs, matching DL4J's masked-attention semantics); ``kv_mask`` masks
+    keys only (cross-attention).  ``causal`` adds the autoregressive mask.
+    """
+    b, tq, d = q.shape
+    tk = k.shape[1]
+    dh = d // n_heads
+    qh = q.reshape(b, tq, n_heads, dh).transpose(0, 2, 1, 3)  # [B,H,Tq,Dh]
+    kh = k.reshape(b, tk, n_heads, dh).transpose(0, 2, 1, 3)
+    vh = v.reshape(b, tk, n_heads, dh).transpose(0, 2, 1, 3)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / math.sqrt(dh)
+    key_mask = mask if mask is not None else kv_mask
+    if key_mask is not None:
+        scores = jnp.where(key_mask[:, None, None, :] > 0, scores, NEG_INF)
+    if causal:
+        cm = jnp.tril(jnp.ones((tq, tk), dtype=bool))
+        scores = jnp.where(cm[None, None], scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", weights, vh)
+    out = out.transpose(0, 2, 1, 3).reshape(b, tq, d)
+    if mask is not None and tq == tk:
+        out = out * mask[:, :, None]
+    return out

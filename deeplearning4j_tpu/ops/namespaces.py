@@ -1,0 +1,269 @@
+"""Namespaced op façades — parity with ND4J's generated namespaces
+(``Nd4j.math()`` etc., nd4j-api ``org/nd4j/linalg/factory/ops/NDMath.java``,
+``NDNN.java``, ``NDCNN.java``, ``NDRNN.java``, ``NDLoss.java``,
+``NDLinalg.java``, ``NDRandom.java``, ``NDImage.java``, ``NDBitwise.java``;
+single-sourced in the reference from contrib/codegen-tools op DSL).
+
+Each namespace is a plain module-level object of pure functions over
+jax.Array.  Everything here is jit-safe and fuses under XLA; there is no
+per-op dispatch layer to port — that's the point of the rewrite.
+"""
+
+from __future__ import annotations
+
+import math as _pymath
+from types import SimpleNamespace
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+# ---------------------------------------------------------------- math
+def _norm1(x, axis=None): return jnp.sum(jnp.abs(x), axis=axis)
+def _norm2(x, axis=None): return jnp.sqrt(jnp.sum(x * x, axis=axis))
+def _normmax(x, axis=None): return jnp.max(jnp.abs(x), axis=axis)
+
+
+def _standardize(x, axis=-1, eps=0.0):
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    std = jnp.std(x, axis=axis, keepdims=True)
+    return (x - mean) / jnp.where(std > eps, std, 1.0)
+
+
+math = SimpleNamespace(
+    abs=jnp.abs, ceil=jnp.ceil, floor=jnp.floor, round=jnp.round,
+    exp=jnp.exp, expm1=jnp.expm1, log=jnp.log, log1p=jnp.log1p,
+    log2=jnp.log2, log10=jnp.log10,
+    sqrt=jnp.sqrt, rsqrt=lax.rsqrt, square=jnp.square, pow=jnp.power,
+    cube=lambda x: x ** 3, reciprocal=jnp.reciprocal, neg=jnp.negative,
+    sign=jnp.sign, sin=jnp.sin, cos=jnp.cos, tan=jnp.tan,
+    asin=jnp.arcsin, acos=jnp.arccos, atan=jnp.arctan, atan2=jnp.arctan2,
+    sinh=jnp.sinh, cosh=jnp.cosh, tanh=jnp.tanh,
+    asinh=jnp.arcsinh, acosh=jnp.arccosh, atanh=jnp.arctanh,
+    erf=lax.erf, erfc=lax.erfc,
+    clip_by_value=jnp.clip,
+    clip_by_norm=lambda x, n: x * jnp.minimum(1.0, n / jnp.maximum(_norm2(x), 1e-12)),
+    cumsum=jnp.cumsum, cumprod=jnp.cumprod,
+    add=jnp.add, sub=jnp.subtract, mul=jnp.multiply, div=jnp.divide,
+    floormod=jnp.mod, floordiv=jnp.floor_divide,
+    maximum=jnp.maximum, minimum=jnp.minimum,
+    mean=jnp.mean, sum=jnp.sum, prod=jnp.prod, max=jnp.max, min=jnp.min,
+    std=jnp.std, var=jnp.var,
+    norm1=_norm1, norm2=_norm2, normmax=_normmax,
+    argmax=jnp.argmax, argmin=jnp.argmin,
+    iamax=lambda x: jnp.argmax(jnp.abs(x)), iamin=lambda x: jnp.argmin(jnp.abs(x)),
+    count_nonzero=jnp.count_nonzero,
+    count_zero=lambda x, axis=None: jnp.sum(x == 0, axis=axis),
+    entropy=lambda x, axis=None: -jnp.sum(x * jnp.log(jnp.clip(x, 1e-12)), axis=axis),
+    log_entropy=lambda x, axis=None: jnp.log(
+        -jnp.sum(x * jnp.log(jnp.clip(x, 1e-12)), axis=axis)),
+    shannon_entropy=lambda x, axis=None: -jnp.sum(
+        x * jnp.log2(jnp.clip(x, 1e-12)), axis=axis),
+    amean=lambda x, axis=None: jnp.mean(jnp.abs(x), axis=axis),
+    amax=lambda x, axis=None: jnp.max(jnp.abs(x), axis=axis),
+    amin=lambda x, axis=None: jnp.min(jnp.abs(x), axis=axis),
+    asum=lambda x, axis=None: jnp.sum(jnp.abs(x), axis=axis),
+    standardize=_standardize,
+    is_nan=jnp.isnan, is_inf=jnp.isinf, is_finite=jnp.isfinite,
+    cosine_similarity=lambda a, b, axis=-1: jnp.sum(a * b, axis=axis)
+    / jnp.clip(_norm2(a, axis) * _norm2(b, axis), 1e-12),
+    cosine_distance=lambda a, b, axis=-1: 1.0 - jnp.sum(a * b, axis=axis)
+    / jnp.clip(_norm2(a, axis) * _norm2(b, axis), 1e-12),
+    euclidean_distance=lambda a, b, axis=-1: _norm2(a - b, axis),
+    manhattan_distance=lambda a, b, axis=-1: _norm1(a - b, axis),
+    hamming_distance=lambda a, b, axis=-1: jnp.sum(a != b, axis=axis),
+    jaccard_distance=lambda a, b, axis=-1: 1.0
+    - jnp.sum(jnp.minimum(a, b), axis=axis) / jnp.clip(jnp.sum(jnp.maximum(a, b), axis=axis), 1e-12),
+)
+
+
+# ---------------------------------------------------------------- nn
+def _dropout(key, x, keep_prob):
+    keep = jax.random.bernoulli(key, keep_prob, x.shape)
+    return jnp.where(keep, x / keep_prob, 0.0)
+
+
+nn = SimpleNamespace(
+    relu=jax.nn.relu, relu6=jax.nn.relu6, elu=jax.nn.elu, selu=jax.nn.selu,
+    gelu=jax.nn.gelu, silu=jax.nn.silu, swish=jax.nn.silu,
+    sigmoid=jax.nn.sigmoid, hard_sigmoid=jax.nn.hard_sigmoid,
+    tanh=jnp.tanh, hard_tanh=jax.nn.hard_tanh,
+    softmax=jax.nn.softmax, log_softmax=jax.nn.log_softmax,
+    softplus=jax.nn.softplus, softsign=jax.nn.soft_sign,
+    leaky_relu=jax.nn.leaky_relu,
+    log_sigmoid=jax.nn.log_sigmoid,
+    one_hot=jax.nn.one_hot,
+    linear=lambda x, w, b=None: jnp.dot(x, w) + (b if b is not None else 0.0),
+    dropout=_dropout,
+    layer_norm=lambda x, gamma, beta=None, eps=1e-5: (
+        (x - jnp.mean(x, -1, keepdims=True))
+        * lax.rsqrt(jnp.var(x, -1, keepdims=True) + eps) * gamma
+        + (beta if beta is not None else 0.0)),
+    batch_norm=lambda x, mean, var, gamma=None, beta=None, eps=1e-5: (
+        (x - mean) * lax.rsqrt(var + eps)
+        * (gamma if gamma is not None else 1.0)
+        + (beta if beta is not None else 0.0)),
+    pad=jnp.pad,
+)
+
+
+# ---------------------------------------------------------------- cnn
+def _conv2d(x, w, stride=(1, 1), padding="SAME", dilation=(1, 1), groups=1):
+    return lax.conv_general_dilated(
+        x, w, stride, padding, rhs_dilation=dilation,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"), feature_group_count=groups)
+
+
+def _max_pool2d(x, k=(2, 2), s=None, padding="VALID"):
+    s = s or k
+    return lax.reduce_window(x, -jnp.inf, lax.max, (1,) + tuple(k) + (1,),
+                             (1,) + tuple(s) + (1,), padding)
+
+
+def _avg_pool2d(x, k=(2, 2), s=None, padding="VALID"):
+    s = s or k
+    y = lax.reduce_window(x, 0.0, lax.add, (1,) + tuple(k) + (1,),
+                          (1,) + tuple(s) + (1,), padding)
+    return y / _pymath.prod(k)
+
+
+def _im2col(x, kh, kw, sh=1, sw=1, ph=0, pw=0):
+    """libnd4j ``im2col`` parity (the reference's conv lowering; exposed for
+    parity tests — XLA convs don't need it)."""
+    x = jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+    n, h, w, c = x.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    idx_h = jnp.arange(oh)[:, None] * sh + jnp.arange(kh)[None, :]
+    idx_w = jnp.arange(ow)[:, None] * sw + jnp.arange(kw)[None, :]
+    cols = x[:, idx_h[:, :, None, None], idx_w[None, None], :]
+    return cols.reshape(n, oh, ow, kh * kw * c)
+
+
+cnn = SimpleNamespace(
+    conv2d=_conv2d,
+    max_pooling2d=_max_pool2d,
+    avg_pooling2d=_avg_pool2d,
+    im2col=_im2col,
+    space_to_depth=lambda x, s: x.reshape(x.shape[0], x.shape[1] // s, s,
+                                          x.shape[2] // s, s, x.shape[3])
+    .transpose(0, 1, 3, 2, 4, 5).reshape(x.shape[0], x.shape[1] // s, x.shape[2] // s, -1),
+    depth_to_space=lambda x, s: x.reshape(x.shape[0], x.shape[1], x.shape[2], s, s, -1)
+    .transpose(0, 1, 3, 2, 4, 5).reshape(x.shape[0], x.shape[1] * s, x.shape[2] * s, -1),
+    upsampling2d=lambda x, s: jnp.repeat(jnp.repeat(x, s, axis=1), s, axis=2),
+)
+
+# ---------------------------------------------------------------- rnn / loss
+from deeplearning4j_tpu.nn import losses as _losses  # noqa: E402
+
+loss = SimpleNamespace(
+    **{name: _losses.get(name) for name in
+       ("mcxent", "mse", "mae", "l1", "l2", "binary_xent", "hinge",
+        "squared_hinge", "poisson", "kl_divergence", "cosine_proximity",
+        "mape", "msle", "sparse_mcxent", "wasserstein", "fmeasure")},
+    mean_score=_losses.mean_score,
+)
+
+rnn = SimpleNamespace()  # populated below to avoid circular imports at module load
+
+
+def _lstm_layer(x, w, u, b, h0=None, c0=None):
+    """Functional LSTM over [B,T,C] with IFOG-packed weights — libnd4j
+    ``lstmLayer`` parity."""
+    from deeplearning4j_tpu.nn.layers.recurrent import LSTM as _LSTM
+    hsz = u.shape[0]
+    layer = _LSTM(n_out=hsz)
+    params = {"W": w, "U": u, "b": b}
+    carry = (h0 if h0 is not None else jnp.zeros((x.shape[0], hsz), x.dtype),
+             c0 if c0 is not None else jnp.zeros((x.shape[0], hsz), x.dtype))
+    y, carry = layer._scan(params, x, None, carry)
+    return y, carry
+
+
+def _gru_cell(x_t, h_prev, w, u, b):
+    from deeplearning4j_tpu.nn.layers.recurrent import GRU as _GRU
+    layer = _GRU(n_out=u.shape[0])
+    new_h, _ = layer.step({"W": w, "U": u, "b": b}, h_prev, x_t)
+    return new_h
+
+
+rnn.lstm_layer = _lstm_layer
+rnn.gru_cell = _gru_cell
+
+
+# ---------------------------------------------------------------- linalg
+linalg = SimpleNamespace(
+    mmul=jnp.matmul, matmul=jnp.matmul,
+    gemm=lambda a, b, alpha=1.0, beta=0.0, c=None, transpose_a=False, transpose_b=False:
+        alpha * jnp.matmul(a.T if transpose_a else a, b.T if transpose_b else b)
+        + (beta * c if c is not None else 0.0),
+    tensormmul=jnp.tensordot,
+    dot=jnp.dot, vdot=jnp.vdot, outer=jnp.outer, einsum=jnp.einsum,
+    cholesky=jnp.linalg.cholesky, svd=jnp.linalg.svd, qr=jnp.linalg.qr,
+    inv=jnp.linalg.inv, pinv=jnp.linalg.pinv, det=jnp.linalg.det,
+    slogdet=jnp.linalg.slogdet, eig=jnp.linalg.eig, eigh=jnp.linalg.eigh,
+    solve=jnp.linalg.solve, lstsq=jnp.linalg.lstsq,
+    matrix_rank=jnp.linalg.matrix_rank, norm=jnp.linalg.norm,
+    trace=jnp.trace, diag=jnp.diag, diag_part=jnp.diagonal,
+    matrix_band_part=lambda x, lower, upper: jnp.where(
+        (jnp.arange(x.shape[-2])[:, None] - jnp.arange(x.shape[-1])[None, :] <= (lower if lower >= 0 else x.shape[-2]))
+        & (jnp.arange(x.shape[-1])[None, :] - jnp.arange(x.shape[-2])[:, None] <= (upper if upper >= 0 else x.shape[-1])),
+        x, 0),
+    tri=jnp.tri, tril=jnp.tril, triu=jnp.triu,
+    cross=jnp.cross, kron=jnp.kron,
+)
+
+
+# ---------------------------------------------------------------- random
+random = SimpleNamespace(
+    normal=jax.random.normal, uniform=jax.random.uniform,
+    bernoulli=jax.random.bernoulli,
+    truncated_normal=jax.random.truncated_normal,
+    gamma=jax.random.gamma, beta=jax.random.beta,
+    exponential=jax.random.exponential, poisson=jax.random.poisson,
+    binomial=jax.random.binomial, categorical=jax.random.categorical,
+    gumbel=jax.random.gumbel, laplace=jax.random.laplace,
+    log_normal=lambda key, shape=(), mean=0.0, std=1.0:
+        jnp.exp(mean + std * jax.random.normal(key, shape)),
+    shuffle=jax.random.permutation, choice=jax.random.choice,
+    split=jax.random.split, key=jax.random.key, fold_in=jax.random.fold_in,
+)
+
+
+# ---------------------------------------------------------------- image
+def _resize_bilinear(img, out_h, out_w):
+    shape = img.shape[:-3] + (out_h, out_w, img.shape[-1])
+    return jax.image.resize(img, shape, method="bilinear")
+
+
+def _resize_nearest(img, out_h, out_w):
+    shape = img.shape[:-3] + (out_h, out_w, img.shape[-1])
+    return jax.image.resize(img, shape, method="nearest")
+
+
+image = SimpleNamespace(
+    resize_bilinear=_resize_bilinear,
+    resize_nearest=_resize_nearest,
+    flip_left_right=lambda x: jnp.flip(x, axis=-2),
+    flip_up_down=lambda x: jnp.flip(x, axis=-3),
+    rot90=lambda x, k=1: jnp.rot90(x, k, axes=(-3, -2)),
+    adjust_brightness=lambda x, delta: x + delta,
+    adjust_contrast=lambda x, factor: (x - jnp.mean(x, axis=(-3, -2), keepdims=True)) * factor
+    + jnp.mean(x, axis=(-3, -2), keepdims=True),
+    crop=lambda x, top, left, h, w: x[..., top:top + h, left:left + w, :],
+    hsv_to_rgb=None,  # gated: provided by data.image when needed
+    rgb_to_grayscale=lambda x: jnp.sum(
+        x * jnp.array([0.2989, 0.5870, 0.1140]), axis=-1, keepdims=True),
+)
+
+
+# ---------------------------------------------------------------- bitwise
+bitwise = SimpleNamespace(
+    and_=jnp.bitwise_and, or_=jnp.bitwise_or, xor=jnp.bitwise_xor,
+    invert=jnp.bitwise_not,
+    left_shift=jnp.left_shift, right_shift=jnp.right_shift,
+    bits_hamming_distance=lambda a, b: jnp.sum(
+        jnp.unpackbits(jnp.bitwise_xor(a, b).view(jnp.uint8))),
+)

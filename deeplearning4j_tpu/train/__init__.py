@@ -1,0 +1,12 @@
+from deeplearning4j_tpu.train import updaters, schedules
+from deeplearning4j_tpu.train.updaters import (
+    Sgd, Adam, AdamW, AdaMax, AMSGrad, Nadam, Nesterovs, AdaGrad, AdaDelta,
+    RmsProp, NoOp,
+)
+from deeplearning4j_tpu.train.trainer import Trainer, make_train_step
+
+__all__ = [
+    "updaters", "schedules", "Trainer", "make_train_step",
+    "Sgd", "Adam", "AdamW", "AdaMax", "AMSGrad", "Nadam", "Nesterovs",
+    "AdaGrad", "AdaDelta", "RmsProp", "NoOp",
+]

@@ -1,0 +1,191 @@
+"""Learning-rate schedules.
+
+Parity with ND4J ``ISchedule`` impls (nd4j-api ``org/nd4j/linalg/schedule/``:
+ExponentialSchedule, InverseSchedule, PolySchedule, SigmoidSchedule,
+StepSchedule, MapSchedule, CycleSchedule, RampSchedule, FixedSchedule).
+
+The reference schedules are keyed by iteration OR epoch
+(``ScheduleType.ITERATION/EPOCH``); here a schedule is a pure
+``f(step) -> lr``, written with jnp so it is jit-safe inside the train step
+(optax calls it on a traced step counter).  Epoch-keyed behavior is
+obtained via ``steps_per_epoch``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax.numpy as jnp
+
+Schedule = Callable[[Any], Any]
+
+_REGISTRY: dict[str, type] = {}
+
+
+def register(name: str):
+    def deco(cls):
+        cls.TYPE_NAME = name
+        _REGISTRY[name] = cls
+        return cls
+    return deco
+
+
+def from_dict(d: dict) -> "BaseSchedule":
+    d = dict(d)
+    cls = _REGISTRY[d.pop("type")]
+    known = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: v for k, v in d.items() if k in known})
+
+
+@dataclasses.dataclass
+class BaseSchedule:
+    TYPE_NAME = "base"
+    steps_per_epoch: int = 1  # 1 → iteration-keyed (ScheduleType.ITERATION)
+
+    def value_at(self, step):
+        raise NotImplementedError
+
+    def __call__(self, step):
+        return self.value_at(step // max(self.steps_per_epoch, 1))
+
+    def to_dict(self) -> dict:
+        out = {"type": self.TYPE_NAME}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            out[f.name] = v.to_dict() if isinstance(v, BaseSchedule) else v
+        return out
+
+
+@register("fixed")
+@dataclasses.dataclass
+class FixedSchedule(BaseSchedule):
+    value: float = 0.001
+
+    def value_at(self, step):
+        return jnp.asarray(self.value, jnp.float32)
+
+
+@register("exponential")
+@dataclasses.dataclass
+class ExponentialSchedule(BaseSchedule):
+    """lr = initial * gamma^t (``ExponentialSchedule.java``)."""
+    initial_value: float = 0.1
+    gamma: float = 0.99
+
+    def value_at(self, step):
+        return self.initial_value * jnp.power(self.gamma, step)
+
+
+@register("inverse")
+@dataclasses.dataclass
+class InverseSchedule(BaseSchedule):
+    """lr = initial / (1 + gamma*t)^power (``InverseSchedule.java``)."""
+    initial_value: float = 0.1
+    gamma: float = 0.99
+    power: float = 1.0
+
+    def value_at(self, step):
+        return self.initial_value / jnp.power(1.0 + self.gamma * step, self.power)
+
+
+@register("poly")
+@dataclasses.dataclass
+class PolySchedule(BaseSchedule):
+    """lr = initial * (1 - t/maxIter)^power (``PolySchedule.java``)."""
+    initial_value: float = 0.1
+    power: float = 1.0
+    max_iter: int = 1000
+
+    def value_at(self, step):
+        frac = jnp.minimum(step / max(self.max_iter, 1), 1.0)
+        return self.initial_value * jnp.power(1.0 - frac, self.power)
+
+
+@register("sigmoid")
+@dataclasses.dataclass
+class SigmoidSchedule(BaseSchedule):
+    """lr = initial / (1 + exp(-gamma*(t - stepSize))) (``SigmoidSchedule.java``)."""
+    initial_value: float = 0.1
+    gamma: float = 0.1
+    step_size: int = 100
+
+    def value_at(self, step):
+        return self.initial_value / (1.0 + jnp.exp(-self.gamma * (step - self.step_size)))
+
+
+@register("step")
+@dataclasses.dataclass
+class StepSchedule(BaseSchedule):
+    """lr = initial * decayRate^floor(t/step) (``StepSchedule.java``)."""
+    initial_value: float = 0.1
+    decay_rate: float = 0.5
+    step: float = 100.0
+
+    def value_at(self, step):
+        return self.initial_value * jnp.power(self.decay_rate, jnp.floor(step / self.step))
+
+
+@register("map")
+@dataclasses.dataclass
+class MapSchedule(BaseSchedule):
+    """Explicit {step: lr} map, last value holds (``MapSchedule.java``).
+    Keys are static python ints; lookup compiles to a where-chain."""
+    values: dict = dataclasses.field(default_factory=dict)
+
+    def value_at(self, step):
+        items = sorted((int(k), float(v)) for k, v in self.values.items())
+        if not items:
+            return jnp.asarray(0.001, jnp.float32)
+        out = jnp.asarray(items[0][1], jnp.float32)
+        for k, v in items:
+            out = jnp.where(step >= k, v, out)
+        return out
+
+
+@register("cycle")
+@dataclasses.dataclass
+class CycleSchedule(BaseSchedule):
+    """1-cycle schedule (``CycleSchedule.java``): linear ramp initial→max
+    over the first half, back down, then annihilation in the final
+    ``annealing_frac`` of the cycle."""
+    initial_value: float = 0.001
+    max_value: float = 0.01
+    cycle_length: int = 1000
+    annealing_frac: float = 0.1
+
+    def value_at(self, step):
+        anneal_start = int(self.cycle_length * (1.0 - self.annealing_frac))
+        pos = jnp.mod(step, max(self.cycle_length, 1))
+        half = max(anneal_start // 2, 1)
+        up = self.initial_value + (self.max_value - self.initial_value) * pos / half
+        down = self.max_value - (self.max_value - self.initial_value) * (pos - half) / half
+        frac = (pos - anneal_start) / max(self.cycle_length - anneal_start, 1)
+        anneal = self.initial_value * (1.0 - frac * 0.99)
+        return jnp.where(pos < half, up, jnp.where(pos < anneal_start, down, anneal))
+
+
+@register("ramp")
+@dataclasses.dataclass
+class RampSchedule(BaseSchedule):
+    """Linear warmup wrapper (``RampSchedule.java``)."""
+    underlying: Any = None
+    num_iterations: int = 100
+
+    def __post_init__(self):
+        if isinstance(self.underlying, dict):
+            self.underlying = from_dict(self.underlying)
+
+    def value_at(self, step):
+        base = self.underlying.value_at(step) if self.underlying else jnp.asarray(1.0)
+        warm = base * (step + 1) / self.num_iterations
+        return jnp.where(step >= self.num_iterations, base, warm)
+
+
+def as_schedule(value) -> Schedule:
+    """Accept a float (fixed lr), an ISchedule object, or a callable."""
+    if isinstance(value, BaseSchedule):
+        return value
+    if callable(value):
+        return value
+    return FixedSchedule(value=float(value))

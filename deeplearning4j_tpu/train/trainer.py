@@ -1,0 +1,255 @@
+"""Training loop — the Solver/StochasticGradientDescent replacement.
+
+Parity with DL4J ``org/deeplearning4j/optimize/solvers/
+StochasticGradientDescent.java`` + ``MultiLayerNetwork.fitHelper`` (stack
+3.1 in SURVEY.md): per-batch {forward, score, backward, updater, listeners}.
+On TPU the whole step — forward, loss, backward, gradient normalization,
+updater, param update — is ONE jit-compiled XLA program; listeners receive
+host-side scalars after the step.
+
+Loss composition (``BaseLayer.calcRegularizationScore`` +
+``ILossFunction.computeScore``): mean per-example loss + Σ layer L1/L2
+penalties.  Gradients are averaged over the minibatch (``mini_batch=True``
+divides by batch size, DL4J semantics).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.config import get_config
+from deeplearning4j_tpu.nn.losses import mean_score
+from deeplearning4j_tpu.obs.listeners import ListenerBus
+from deeplearning4j_tpu.obs.profiler import check_finite
+from deeplearning4j_tpu.train import updaters as updater_mod
+
+
+def make_loss_fn(net):
+    """Build the pure loss fn over (params, state, features, labels, masks,
+    rng) → (scalar_loss, new_state)."""
+
+    def loss_fn(params, state, features, labels, features_mask, labels_mask, rng):
+        out, new_state, score_array = net._forward(
+            params, state, features, train=True, rng=rng,
+            mask=features_mask, labels=labels)
+        if score_array is None:
+            raise ValueError(
+                "last layer has no loss — use OutputLayer/LossLayer/"
+                "RnnOutputLayer as the final layer for fit()")
+        mask = labels_mask
+        if mask is None and score_array.ndim == 2 and features_mask is not None:
+            mask = features_mask  # per-timestep RNN scores fall back to feature mask
+        if net.conf.mini_batch:
+            data_loss = mean_score(score_array, mask)
+        else:
+            # minibatch(false) parity: do NOT divide by batch size
+            if mask is not None:
+                score_array = score_array * jnp.reshape(mask, score_array.shape)
+            data_loss = jnp.sum(score_array)
+        reg = jnp.float32(0.0)
+        layer_params = (net.layer_params(params) if hasattr(net, "layer_params")
+                        else params)
+        for layer, p in zip(net.layers, layer_params):
+            if p:
+                reg = reg + layer.regularization_penalty(p)
+        return data_loss + reg, new_state
+
+    return loss_fn
+
+
+def make_train_step(net, tx):
+    """jit'd (params, state, opt_state, batch..., rng) → updated triple + loss."""
+    loss_fn = make_loss_fn(net)
+
+    @jax.jit
+    def step(params, state, opt_state, features, labels, features_mask,
+             labels_mask, rng):
+        (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, state, features, labels, features_mask, labels_mask, rng)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, new_state, opt_state, loss
+
+    return step
+
+
+class Trainer:
+    def __init__(self, net, listeners=None):
+        self.net = net
+        self.bus = listeners if isinstance(listeners, ListenerBus) else ListenerBus(listeners)
+        conf = net.conf
+        updater = conf.updater or updater_mod.Sgd(0.1)
+        if net.params_ is None:
+            net.init()
+        self._per_layer_updaters = any(
+            getattr(l, "updater", None) is not None for l in net.layers)
+        frozen_mask = None
+        if any(getattr(l, "frozen", False) for l in net.layers):
+            layer_params = (net.layer_params(net.params_) if hasattr(net, "layer_params")
+                            else net.params_)
+            per_layer = [jax.tree_util.tree_map(lambda _: bool(layer.frozen), p)
+                         for layer, p in zip(net.layers, layer_params)]
+            if hasattr(net, "layer_params"):
+                # rebuild the dict-shaped mask for ComputationGraph
+                frozen_mask = {}
+                li = 0
+                for spec in net._topo:
+                    if spec.kind == "layer":
+                        frozen_mask[spec.name] = per_layer[li]
+                        li += 1
+                    else:
+                        frozen_mask[spec.name] = {}
+            else:
+                frozen_mask = per_layer
+        if self._per_layer_updaters:
+            self.tx = self._build_multi_updater(updater, conf, frozen_mask)
+        else:
+            self.tx = updater_mod.build_optimizer(
+                updater, conf.gradient_normalization,
+                conf.gradient_normalization_threshold, frozen_mask)
+        self._step = None
+
+    def _build_multi_updater(self, default_updater, conf, frozen_mask):
+        """Per-layer updater overrides (DL4J allows ``layer.updater(...)``):
+        optax.multi_transform with one label per distinct updater."""
+        import optax
+        net = self.net
+        transforms = {"_default": updater_mod.build_optimizer(
+            default_updater, conf.gradient_normalization,
+            conf.gradient_normalization_threshold)}
+        layer_labels = []
+        for i, layer in enumerate(net.layers):
+            if getattr(layer, "updater", None) is not None:
+                label = f"layer_{i}"
+                transforms[label] = updater_mod.build_optimizer(
+                    layer.updater, conf.gradient_normalization,
+                    conf.gradient_normalization_threshold)
+            else:
+                label = "_default"
+            layer_labels.append(label)
+
+        def label_tree(params):
+            layer_params = (net.layer_params(params) if hasattr(net, "layer_params")
+                            else params)
+            per_layer = [jax.tree_util.tree_map(lambda _: lbl, p)
+                         for lbl, p in zip(layer_labels, layer_params)]
+            if hasattr(net, "layer_params"):
+                out, li = {}, 0
+                for spec in net._topo:
+                    if spec.kind == "layer":
+                        out[spec.name] = per_layer[li]
+                        li += 1
+                    else:
+                        out[spec.name] = {}
+                return out
+            return per_layer
+
+        tx = optax.multi_transform(transforms, label_tree)
+        if frozen_mask is not None:
+            def mask_fn(updates, state, params=None):
+                return jax.tree_util.tree_map(
+                    lambda u, m: jnp.zeros_like(u) if m else u,
+                    updates, frozen_mask), state
+            import optax as _optax
+            tx = _optax.chain(tx, _optax.GradientTransformation(
+                lambda p: _optax.EmptyState(), mask_fn))
+        return tx
+
+    def _ensure_ready(self):
+        net = self.net
+        if net.params_ is None:
+            net.init()
+        if net.opt_state is None:
+            net.opt_state = self.tx.init(net.params_)
+        if self._step is None:
+            self._step = make_train_step(net, self.tx)
+
+    def fit_batch(self, batch, rng) -> float:
+        """One optimization step on one batch; returns host-side loss."""
+        self._ensure_ready()
+        net = self.net
+
+        def _as(v):
+            if v is None:
+                return None
+            if isinstance(v, (list, tuple)):
+                return tuple(None if a is None else jnp.asarray(a) for a in v)
+            return jnp.asarray(v)
+
+        # MultiDataSet batches carry plural-named masks
+        fmask = getattr(batch, "features_mask", None)
+        if fmask is None:
+            fmask = getattr(batch, "features_masks", None)
+        lmask = getattr(batch, "labels_mask", None)
+        if lmask is None:
+            lmask = getattr(batch, "labels_masks", None)
+        params, state, opt_state, loss = self._step(
+            net.params_, net.state_, net.opt_state,
+            _as(batch.features), _as(batch.labels), _as(fmask), _as(lmask),
+            rng)
+        net.params_, net.state_, net.opt_state = params, state, opt_state
+        cfg = get_config()
+        if cfg.nan_panic or cfg.inf_panic:
+            check_finite(params, "params after step")
+        return float(loss)
+
+    def fit(self, iterator, epochs: int = 1):
+        self._ensure_ready()
+        net = self.net
+        key = jax.random.key(net.conf.seed + 7919)
+        self.bus.dispatch("on_fit_start", net)
+        tbptt = net.conf.backprop_type == "tbptt"
+        for _ in range(epochs):
+            self.bus.dispatch("on_epoch_start", net, net.epoch)
+            epoch_t0 = time.perf_counter()
+            n_batches = 0
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            for batch in iterator:
+                key, sub = jax.random.split(key)
+                first = (batch.features[0] if isinstance(batch.features, (list, tuple))
+                         else batch.features)
+                if tbptt and not isinstance(batch.features, (list, tuple)) \
+                        and first.ndim == 3:
+                    for sub_batch in _tbptt_segments(batch, net.conf.tbptt_fwd_length):
+                        loss = self.fit_batch(sub_batch, sub)
+                else:
+                    loss = self.fit_batch(batch, sub)
+                net._score = loss
+                for listener in self.bus.listeners:
+                    if hasattr(listener, "record_batch"):
+                        listener.record_batch(first.shape[0])
+                self.bus.dispatch("iteration_done", net, net.iteration, net.epoch, loss)
+                net.iteration += 1
+                n_batches += 1
+            info = {"epoch_time_s": time.perf_counter() - epoch_t0,
+                    "batches": n_batches, "score": net._score}
+            self.bus.dispatch("on_epoch_end", net, net.epoch, info)
+            net.epoch += 1
+        self.bus.dispatch("on_fit_end", net, {"epochs": epochs})
+        return net
+
+
+def _tbptt_segments(batch, length: int):
+    """Truncated-BPTT segmentation (``MultiLayerConfiguration.tBPTTLength``):
+    split [B, T, C] sequences into chunks of ``length`` steps.  State does
+    NOT flow between chunks in this implementation (matches DL4J's
+    gradient truncation; forward-state carry is a TODO documented in
+    parity notes)."""
+    import dataclasses as _dc
+    t = batch.features.shape[1]
+    for start in range(0, t, length):
+        end = min(start + length, t)
+        yield _dc.replace(
+            batch,
+            features=batch.features[:, start:end],
+            labels=batch.labels[:, start:end] if batch.labels is not None and batch.labels.ndim == 3 else batch.labels,
+            features_mask=None if batch.features_mask is None else batch.features_mask[:, start:end],
+            labels_mask=None if batch.labels_mask is None else (
+                batch.labels_mask[:, start:end] if batch.labels_mask.ndim >= 2 else batch.labels_mask),
+        )

@@ -1,0 +1,286 @@
+"""Updaters (optimizers) — DL4J ``IUpdater`` configs mapped onto optax.
+
+Parity with nd4j-api ``org/nd4j/linalg/learning/config/`` (Sgd, Adam,
+AdaMax, AMSGrad, Nadam, Nesterovs, AdaGrad, AdaDelta, RmsProp, NoOp) and
+the DL4J updater glue (``nn/updater/BaseMultiLayerUpdater.java``:
+gradient normalization, minibatch division).  The flat-vector updater
+blocks of the reference are unnecessary — optax transforms map over the
+param pytree and XLA fuses the elementwise update chains.
+
+Every updater is a dataclass with ``to_optax()``; JSON round-trip via the
+registry (checkpoint ``updaterState`` parity is handled in ``io`` by
+serializing the optax state pytree).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from deeplearning4j_tpu.train import schedules as sched_mod
+
+_REGISTRY: dict[str, type] = {}
+
+
+def register(name: str):
+    def deco(cls):
+        cls.TYPE_NAME = name
+        _REGISTRY[name] = cls
+        return cls
+    return deco
+
+
+def to_dict(updater) -> dict:
+    d = {"type": updater.TYPE_NAME}
+    for f in dataclasses.fields(updater):
+        v = getattr(updater, f.name)
+        if isinstance(v, sched_mod.BaseSchedule):
+            v = v.to_dict()
+        d[f.name] = v
+    return d
+
+
+def from_dict(d: dict):
+    d = dict(d)
+    cls = _REGISTRY[d.pop("type")]
+    known = {f.name for f in dataclasses.fields(cls)}
+    kwargs = {}
+    for k, v in d.items():
+        if k not in known:
+            continue
+        if isinstance(v, dict) and "type" in v and v["type"] in sched_mod._REGISTRY:
+            v = sched_mod.from_dict(v)
+        kwargs[k] = v
+    return cls(**kwargs)
+
+
+def _lr(value) -> Any:
+    """float or ISchedule → optax learning_rate argument.  ISchedule
+    objects are callable jit-safe jnp expressions of the step counter, so
+    optax accepts them directly; floats pass through."""
+    return value
+
+
+class _UpdaterBase:
+    TYPE_NAME = "base"
+
+    def to_optax(self) -> optax.GradientTransformation:
+        raise NotImplementedError
+
+    def to_dict(self) -> dict:
+        return to_dict(self)
+
+
+@register("sgd")
+@dataclasses.dataclass
+class Sgd(_UpdaterBase):
+    learning_rate: Any = 0.1
+
+    def to_optax(self):
+        return optax.sgd(_lr(self.learning_rate))
+
+
+@register("adam")
+@dataclasses.dataclass
+class Adam(_UpdaterBase):
+    learning_rate: Any = 0.001
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self):
+        return optax.adam(_lr(self.learning_rate), b1=self.beta1, b2=self.beta2,
+                          eps=self.epsilon)
+
+
+@register("adamw")
+@dataclasses.dataclass
+class AdamW(_UpdaterBase):
+    learning_rate: Any = 0.001
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+    weight_decay: float = 0.01
+
+    def to_optax(self):
+        return optax.adamw(_lr(self.learning_rate), b1=self.beta1, b2=self.beta2,
+                           eps=self.epsilon, weight_decay=self.weight_decay)
+
+
+@register("adamax")
+@dataclasses.dataclass
+class AdaMax(_UpdaterBase):
+    learning_rate: Any = 0.002
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self):
+        return optax.adamax(_lr(self.learning_rate), b1=self.beta1, b2=self.beta2,
+                            eps=self.epsilon)
+
+
+@register("amsgrad")
+@dataclasses.dataclass
+class AMSGrad(_UpdaterBase):
+    learning_rate: Any = 0.001
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self):
+        return optax.amsgrad(_lr(self.learning_rate), b1=self.beta1, b2=self.beta2,
+                             eps=self.epsilon)
+
+
+@register("nadam")
+@dataclasses.dataclass
+class Nadam(_UpdaterBase):
+    learning_rate: Any = 0.001
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self):
+        return optax.nadam(_lr(self.learning_rate), b1=self.beta1, b2=self.beta2,
+                           eps=self.epsilon)
+
+
+@register("nesterovs")
+@dataclasses.dataclass
+class Nesterovs(_UpdaterBase):
+    """SGD with Nesterov momentum (DL4J default momentum 0.9)."""
+    learning_rate: Any = 0.1
+    momentum: float = 0.9
+
+    def to_optax(self):
+        return optax.sgd(_lr(self.learning_rate), momentum=self.momentum, nesterov=True)
+
+
+@register("adagrad")
+@dataclasses.dataclass
+class AdaGrad(_UpdaterBase):
+    learning_rate: Any = 0.1
+    epsilon: float = 1e-6
+
+    def to_optax(self):
+        return optax.adagrad(_lr(self.learning_rate), eps=self.epsilon)
+
+
+@register("adadelta")
+@dataclasses.dataclass
+class AdaDelta(_UpdaterBase):
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def to_optax(self):
+        return optax.adadelta(learning_rate=1.0, rho=self.rho, eps=self.epsilon)
+
+
+@register("rmsprop")
+@dataclasses.dataclass
+class RmsProp(_UpdaterBase):
+    learning_rate: Any = 0.001
+    rms_decay: float = 0.95
+    epsilon: float = 1e-8
+
+    def to_optax(self):
+        return optax.rmsprop(_lr(self.learning_rate), decay=self.rms_decay,
+                             eps=self.epsilon)
+
+
+@register("noop")
+@dataclasses.dataclass
+class NoOp(_UpdaterBase):
+    def to_optax(self):
+        return optax.set_to_zero()
+
+
+# ------------------------------------------------------------------
+# Gradient normalization (DL4J GradientNormalization enum,
+# deeplearning4j-nn ``nn/conf/GradientNormalization.java``, applied in
+# ``BaseMultiLayerUpdater.preApply``). Implemented as optax-style
+# transforms applied BEFORE the updater, per-layer-subtree where DL4J is
+# per-layer.
+# ------------------------------------------------------------------
+
+def _per_layer_map(fn, updates):
+    """Apply fn to each top-level layer subtree (list elements or dict
+    values at the root of the grad pytree)."""
+    if isinstance(updates, list):
+        return [fn(u) for u in updates]
+    if isinstance(updates, dict):
+        return {k: fn(v) for k, v in updates.items()}
+    return fn(updates)
+
+
+def gradient_normalization(kind: Optional[str], threshold: float = 1.0
+                           ) -> optax.GradientTransformation:
+    """Build the pre-updater normalization transform; kind ∈
+    {None, renormalize_l2_per_layer, renormalize_l2_per_param_type,
+    clip_element_wise_absolute_value, clip_l2_per_layer,
+    clip_l2_per_param_type}."""
+
+    if kind is None or kind == "none":
+        return optax.identity()
+    kind = kind.lower()
+
+    def init_fn(params):
+        return optax.EmptyState()
+
+    def _l2(tree):
+        leaves = jax.tree_util.tree_leaves(tree)
+        return jnp.sqrt(sum(jnp.sum(l * l) for l in leaves)) if leaves else jnp.float32(0.0)
+
+    def update_fn(updates, state, params=None):
+        if kind == "renormalize_l2_per_layer":
+            def norm(layer):
+                n = _l2(layer)
+                scale = 1.0 / jnp.maximum(n, 1e-8)
+                return jax.tree_util.tree_map(lambda g: g * scale, layer)
+            updates = _per_layer_map(norm, updates)
+        elif kind == "renormalize_l2_per_param_type":
+            updates = jax.tree_util.tree_map(
+                lambda g: g / jnp.maximum(jnp.sqrt(jnp.sum(g * g)), 1e-8), updates)
+        elif kind == "clip_element_wise_absolute_value":
+            updates = jax.tree_util.tree_map(
+                lambda g: jnp.clip(g, -threshold, threshold), updates)
+        elif kind == "clip_l2_per_layer":
+            def clip(layer):
+                n = _l2(layer)
+                scale = jnp.where(n > threshold, threshold / (n + 1e-12), 1.0)
+                return jax.tree_util.tree_map(lambda g: g * scale, layer)
+            updates = _per_layer_map(clip, updates)
+        elif kind == "clip_l2_per_param_type":
+            updates = jax.tree_util.tree_map(
+                lambda g: g * jnp.where(jnp.sqrt(jnp.sum(g * g)) > threshold,
+                                        threshold / (jnp.sqrt(jnp.sum(g * g)) + 1e-12), 1.0),
+                updates)
+        else:
+            raise ValueError(f"unknown gradient normalization '{kind}'")
+        return updates, state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def build_optimizer(updater, gradient_norm: Optional[str] = None,
+                    gradient_norm_threshold: float = 1.0,
+                    frozen_mask: Any = None) -> optax.GradientTransformation:
+    """Compose normalization → updater (→ freeze mask).
+
+    ``frozen_mask``: pytree of bools matching params; True = frozen
+    (FrozenLayer parity — updates zeroed)."""
+    tx = optax.chain(
+        gradient_normalization(gradient_norm, gradient_norm_threshold),
+        updater.to_optax(),
+    )
+    if frozen_mask is not None:
+        def mask_fn(updates, state, params=None):
+            return jax.tree_util.tree_map(
+                lambda u, m: jnp.zeros_like(u) if m else u, updates, frozen_mask), state
+        tx = optax.chain(tx, optax.GradientTransformation(lambda p: optax.EmptyState(), mask_fn))
+    return tx

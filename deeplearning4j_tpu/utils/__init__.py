@@ -1,0 +1,13 @@
+from deeplearning4j_tpu.utils.pytree import (
+    flat_param_vector,
+    unflatten_param_vector,
+    param_count,
+    param_table,
+)
+
+__all__ = [
+    "flat_param_vector",
+    "unflatten_param_vector",
+    "param_count",
+    "param_table",
+]

@@ -1,0 +1,71 @@
+"""Flat-parameter-vector view utilities.
+
+DL4J stores every network's parameters as ONE contiguous vector with
+per-layer views (``MultiLayerNetwork.params()`` /
+``BaseMultiLayerUpdater`` in deeplearning4j-nn ``nn/updater/``); that design
+is load-bearing for its updaters, gradient-sharing codec, transfer learning
+and the ``coefficients.bin`` checkpoint format.
+
+On TPU we keep parameters as a sharded pytree on device (XLA-friendly) and
+provide the flat vector as a *view utility* — used by checkpoint serde,
+transfer surgery, the gradient-compression codec, and parity tests.
+Ordering is the deterministic pytree leaf order (sorted dict keys, as
+jax.tree_util defines), so flatten∘unflatten round-trips exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def flat_param_vector(params: Any) -> jnp.ndarray:
+    """Concatenate every leaf of ``params`` (raveled, C order) into one 1-D
+    vector — the ``MultiLayerNetwork.params()`` equivalent."""
+    leaves = jax.tree_util.tree_leaves(params)
+    if not leaves:
+        return jnp.zeros((0,), dtype=jnp.float32)
+    return jnp.concatenate([jnp.ravel(leaf) for leaf in leaves])
+
+
+def unflatten_param_vector(flat: jnp.ndarray, like: Any) -> Any:
+    """Inverse of :func:`flat_param_vector` given a template pytree."""
+    leaves, treedef = jax.tree_util.tree_flatten(like)
+    out = []
+    offset = 0
+    for leaf in leaves:
+        n = int(np.prod(leaf.shape)) if leaf.shape else 1
+        out.append(jnp.reshape(flat[offset : offset + n], leaf.shape).astype(leaf.dtype))
+        offset += n
+    total = sum(int(np.prod(l.shape)) if l.shape else 1 for l in leaves)
+    if flat.shape[0] != total:
+        raise ValueError(f"flat vector length {flat.shape[0]} != template size {total}")
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def param_count(params: Any) -> int:
+    """``Model.numParams()`` parity."""
+    return sum(int(np.prod(l.shape)) if hasattr(l, "shape") else 1 for l in jax.tree_util.tree_leaves(params))
+
+
+def param_table(params: Any) -> dict[str, Any]:
+    """``Model.paramTable()`` parity: flat dict of path → array."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    table = {}
+    for path, leaf in flat:
+        key = "/".join(_path_str(p) for p in path)
+        table[key] = leaf
+    return table
+
+
+def _path_str(entry: Any) -> str:
+    if hasattr(entry, "key"):
+        return str(entry.key)
+    if hasattr(entry, "idx"):
+        return str(entry.idx)
+    if hasattr(entry, "name"):
+        return str(entry.name)
+    return str(entry)

@@ -1,0 +1,22 @@
+"""Test harness config.
+
+Multi-host-without-a-cluster parity (SURVEY.md §4.2 #3, the DummyTransport
+translation): all tests run on CPU with 8 virtual XLA devices so mesh /
+shard_map / DP / TP code paths execute real collectives deterministically,
+no TPU pod needed.  Must be set before jax initializes its backends.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

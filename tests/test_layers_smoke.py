@@ -1,0 +1,126 @@
+"""Layer catalog smoke tests: init + forward shape for every registered
+layer kind (OpValidation-style coverage base; golden numerics in
+test_ops_golden.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.layers import (
+    DenseLayer, OutputLayer, ActivationLayer, DropoutLayer, EmbeddingLayer,
+    EmbeddingSequenceLayer, BatchNormalization, ConvolutionLayer,
+    Convolution1DLayer, Convolution3DLayer, SeparableConvolution2D,
+    DepthwiseConvolution2D, Deconvolution2D, SubsamplingLayer,
+    Subsampling1DLayer, Subsampling3DLayer, UpsamplingLayer, ZeroPaddingLayer,
+    CroppingLayer, SpaceToDepthLayer, GlobalPoolingLayer,
+    LocalResponseNormalization, LSTM, GravesLSTM, SimpleRnn, GRU,
+    Bidirectional, LastTimeStep, TimeDistributed, RnnOutputLayer,
+    SelfAttentionLayer, LearnedSelfAttentionLayer, LayerNormalization,
+    PReLULayer,
+)
+
+KEY = jax.random.key(0)
+B = 4
+
+CASES = [
+    (DenseLayer(n_out=8, activation="relu"), InputType.feed_forward(12), (B, 8)),
+    (OutputLayer(n_out=5, activation="softmax"), InputType.feed_forward(12), (B, 5)),
+    (ActivationLayer(activation="tanh"), InputType.feed_forward(12), (B, 12)),
+    (DropoutLayer(dropout=0.5), InputType.feed_forward(12), (B, 12)),
+    (BatchNormalization(), InputType.feed_forward(12), (B, 12)),
+    (LayerNormalization(), InputType.feed_forward(12), (B, 12)),
+    (PReLULayer(), InputType.feed_forward(12), (B, 12)),
+    (ConvolutionLayer(n_out=6, kernel_size=(3, 3)), InputType.convolutional(8, 8, 3), (B, 6, 6, 6)),
+    (ConvolutionLayer(n_out=6, kernel_size=(3, 3), convolution_mode="same"),
+     InputType.convolutional(8, 8, 3), (B, 8, 8, 6)),
+    (Convolution3DLayer(n_out=4, kernel_size=(2, 2, 2)),
+     InputType.convolutional3d(5, 6, 6, 2), (B, 4, 5, 5, 4)),
+    (Deconvolution2D(n_out=5, kernel_size=(2, 2), stride=(2, 2)),
+     InputType.convolutional(4, 4, 3), (B, 8, 8, 5)),
+    (DepthwiseConvolution2D(kernel_size=(3, 3), depth_multiplier=2),
+     InputType.convolutional(8, 8, 3), (B, 6, 6, 6)),
+    (SeparableConvolution2D(n_out=7, kernel_size=(3, 3)),
+     InputType.convolutional(8, 8, 3), (B, 6, 6, 7)),
+    (SubsamplingLayer(pooling_type="max"), InputType.convolutional(8, 8, 3), (B, 4, 4, 3)),
+    (SubsamplingLayer(pooling_type="avg"), InputType.convolutional(8, 8, 3), (B, 4, 4, 3)),
+    (SubsamplingLayer(pooling_type="pnorm", pnorm=2), InputType.convolutional(8, 8, 3), (B, 4, 4, 3)),
+    (Subsampling3DLayer(), InputType.convolutional3d(4, 4, 4, 2), (B, 2, 2, 2, 2)),
+    (UpsamplingLayer(size=2), InputType.convolutional(4, 4, 3), (B, 8, 8, 3)),
+    (ZeroPaddingLayer(padding=(1, 2)), InputType.convolutional(4, 4, 3), (B, 6, 8, 3)),
+    (CroppingLayer(cropping=(1, 1)), InputType.convolutional(6, 6, 3), (B, 4, 4, 3)),
+    (SpaceToDepthLayer(block_size=2), InputType.convolutional(6, 6, 3), (B, 3, 3, 12)),
+    (GlobalPoolingLayer(pooling_type="avg"), InputType.convolutional(6, 6, 5), (B, 5)),
+    (LocalResponseNormalization(), InputType.convolutional(6, 6, 8), (B, 6, 6, 8)),
+    (LSTM(n_out=9), InputType.recurrent(5, 7), (B, 7, 9)),
+    (GravesLSTM(n_out=9), InputType.recurrent(5, 7), (B, 7, 9)),
+    (SimpleRnn(n_out=9), InputType.recurrent(5, 7), (B, 7, 9)),
+    (GRU(n_out=9), InputType.recurrent(5, 7), (B, 7, 9)),
+    (Bidirectional(fwd=LSTM(n_out=6), mode="concat"), InputType.recurrent(5, 7), (B, 7, 12)),
+    (Bidirectional(fwd=LSTM(n_out=6), mode="add"), InputType.recurrent(5, 7), (B, 7, 6)),
+    (LastTimeStep(underlying=LSTM(n_out=6)), InputType.recurrent(5, 7), (B, 6)),
+    (TimeDistributed(underlying=DenseLayer(n_out=4)), InputType.recurrent(5, 7), (B, 7, 4)),
+    (RnnOutputLayer(n_out=3, activation="softmax"), InputType.recurrent(5, 7), (B, 7, 3)),
+    (SelfAttentionLayer(n_heads=2, head_size=4), InputType.recurrent(8, 6), (B, 6, 8)),
+    (LearnedSelfAttentionLayer(n_heads=2, head_size=4, n_queries=3),
+     InputType.recurrent(8, 6), (B, 3, 8)),
+    (GlobalPoolingLayer(pooling_type="max"), InputType.recurrent(5, 7), (B, 5)),
+]
+
+
+@pytest.mark.parametrize("layer,itype,expected_shape",
+                         CASES, ids=[f"{type(c[0]).__name__}_{i}" for i, c in enumerate(CASES)])
+def test_layer_forward_shape(layer, itype, expected_shape):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=itype.batch_shape(B)).astype(np.float32))
+    params = layer.init_params(KEY, itype) if layer.has_params() else {}
+    state = layer.init_state(itype)
+    y, new_state = layer.apply(params, state, x, train=False)
+    assert y.shape == expected_shape, f"{type(layer).__name__}: {y.shape} != {expected_shape}"
+    assert np.all(np.isfinite(np.asarray(y)))
+    # shape inference agrees with runtime
+    out_type = layer.get_output_type(itype)
+    assert tuple(out_type.batch_shape(B)) == tuple(expected_shape)
+
+
+def test_embedding_layers():
+    layer = EmbeddingLayer(n_in=20, n_out=6)
+    params = layer.init_params(KEY, InputType.feed_forward(1))
+    idx = jnp.asarray(np.array([[1], [2], [3], [19]], dtype=np.int32))
+    y, _ = layer.apply(params, {}, idx)
+    assert y.shape == (4, 6)
+
+    seq = EmbeddingSequenceLayer(n_in=20, n_out=6)
+    params = seq.init_params(KEY, InputType.recurrent(1, 5))
+    idx = jnp.asarray(np.random.default_rng(0).integers(0, 20, (4, 5)).astype(np.int32))
+    y, _ = seq.apply(params, {}, idx)
+    assert y.shape == (4, 5, 6)
+
+
+def test_lstm_masking_carries_state():
+    """Masked steps must not change the carry and must output zeros."""
+    layer = LSTM(n_out=4)
+    itype = InputType.recurrent(3, 6)
+    params = layer.init_params(KEY, itype)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 6, 3)).astype(np.float32))
+    mask = jnp.asarray(np.array([[1, 1, 1, 0, 0, 0], [1, 1, 1, 1, 1, 1]], dtype=np.float32))
+    y, _ = layer.apply(params, {}, x, mask=mask)
+    np.testing.assert_allclose(np.asarray(y[0, 3:]), 0.0, atol=1e-7)
+    assert np.any(np.asarray(y[1, 3:]) != 0.0)
+
+
+def test_batchnorm_running_stats_update():
+    layer = BatchNormalization(decay=0.5)
+    itype = InputType.feed_forward(4)
+    params = layer.init_params(KEY, itype)
+    state = layer.init_state(itype)
+    x = jnp.asarray(np.random.default_rng(0).normal(3.0, 2.0, size=(64, 4)).astype(np.float32))
+    y, new_state = layer.apply(params, state, x, train=True)
+    # train output ~ normalized
+    assert abs(float(jnp.mean(y))) < 0.1
+    # running mean moved toward batch mean (decay 0.5 → halfway)
+    assert np.all(np.asarray(new_state["mean"]) > 1.0)
+    # inference uses running stats
+    y2, s2 = layer.apply(params, new_state, x, train=False)
+    assert s2 is new_state

@@ -1,0 +1,164 @@
+"""Regression tests for review findings (round-1 code review)."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import NeuralNetConfiguration, InputType
+from deeplearning4j_tpu.nn.layers import (
+    DenseLayer, OutputLayer, RnnOutputLayer, LSTM, SubsamplingLayer,
+    LearnedSelfAttentionLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.train import Sgd
+from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator, AsyncDataSetIterator
+from deeplearning4j_tpu.evaluation.classification import Evaluation
+
+
+def test_dense_after_lstm_time_distributed():
+    """Dense fed by an RNN layer = time-distributed (preprocessor-pair
+    parity), then RnnOutputLayer trains."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Sgd(0.1)).list()
+            .layer(LSTM(n_out=8))
+            .layer(DenseLayer(n_out=6, activation="relu"))
+            .layer(RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(4, 10))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(0).normal(size=(2, 10, 4)).astype(np.float32)
+    out = np.asarray(net.output(x))
+    assert out.shape == (2, 10, 3)
+    y = np.zeros((2, 10, 3), np.float32)
+    y[..., 0] = 1.0
+    net.fit(ArrayDataSetIterator(x, y, 2), epochs=1)  # must not crash
+
+
+def test_output_layer_after_rnn_rejected():
+    conf = (NeuralNetConfiguration.builder().list()
+            .layer(LSTM(n_out=8))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(InputType.recurrent(4, 10))
+            .build())
+    with pytest.raises(ValueError, match="RnnOutputLayer"):
+        MultiLayerNetwork(conf).init()
+
+
+def test_frozen_layer_fit_without_explicit_init():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Sgd(0.5)).list()
+            .layer(DenseLayer(n_out=8, activation="relu", frozen=True))
+            .layer(OutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf)  # NO .init()
+    x = np.random.default_rng(0).normal(size=(32, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[np.random.default_rng(1).integers(0, 2, 32)]
+    net.fit(ArrayDataSetIterator(x, y, 16), epochs=2)
+    # frozen layer params unchanged
+    net2 = MultiLayerNetwork(conf).init()
+    np.testing.assert_array_equal(np.asarray(net.params_[0]["W"]),
+                                  np.asarray(net2.params_[0]["W"]))
+    # unfrozen layer params DID change
+    assert not np.allclose(np.asarray(net.params_[1]["W"]),
+                           np.asarray(net2.params_[1]["W"]))
+
+
+def test_avg_pool_exclude_pad():
+    layer = SubsamplingLayer(pooling_type="avg", kernel_size=(2, 2),
+                             stride=(2, 2), padding=(1, 1))
+    x = jnp.ones((1, 2, 2, 1))
+    y, _ = layer.apply({}, {}, x)
+    # corner windows contain exactly 1 real element → exclude-pad avg = 1.0
+    np.testing.assert_allclose(np.asarray(y), 1.0, rtol=1e-6)
+
+
+def test_evaluation_single_sigmoid_output():
+    ev = Evaluation()
+    labels = np.array([[0.0], [1.0], [1.0], [0.0]])
+    preds = np.array([[0.3], [0.9], [0.2], [0.6]])
+    ev.eval(labels, preds)
+    assert ev.confusion.shape == (2, 2)
+    assert ev.accuracy() == 0.5
+
+
+def test_learned_self_attention_no_projection():
+    layer = LearnedSelfAttentionLayer(project_input=False, n_queries=3, n_heads=1)
+    itype = InputType.recurrent(8, 6)
+    assert layer.has_params()
+    params = layer.init_params(jax.random.key(0), itype)
+    assert "Q" in params
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 6, 8)).astype(np.float32))
+    y, _ = layer.apply(params, {}, x)
+    assert y.shape == (2, 3, 8)
+
+
+def test_async_iterator_early_break_releases_producer():
+    base = ArrayDataSetIterator(np.zeros((1000, 4), np.float32),
+                                np.zeros((1000, 2), np.float32), batch_size=10)
+    async_it = AsyncDataSetIterator(base, queue_size=2)
+    before = threading.active_count()
+    for i, _ in enumerate(async_it):
+        if i == 3:
+            break
+    time.sleep(0.5)  # give the producer time to observe the stop flag
+    assert threading.active_count() <= before + 1
+
+
+def test_minibatch_false_scales_loss():
+    def build(mb):
+        b = NeuralNetConfiguration.builder().seed(5).updater(Sgd(0.0)).mini_batch(mb)
+        return MultiLayerNetwork(
+            b.list()
+            .layer(OutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.feed_forward(3)).build()).init()
+
+    x = np.random.default_rng(0).normal(size=(8, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[np.zeros(8, np.int64)]
+    it = ArrayDataSetIterator(x, y, 8)
+    n1 = build(True)
+    n1.fit(it, epochs=1)
+    n2 = build(False)
+    n2.fit(it, epochs=1)
+    np.testing.assert_allclose(n2.score(), n1.score() * 8, rtol=1e-5)
+
+
+def test_per_layer_updater_override_and_serde():
+    from deeplearning4j_tpu.train import Adam
+    conf = (NeuralNetConfiguration.builder()
+            .seed(2).updater(Sgd(0.0)).list()   # global lr 0 — only override moves
+            .layer(DenseLayer(n_out=8, activation="relu", updater=Adam(0.05)))
+            .layer(OutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    js = conf.to_json()  # must not raise on the embedded updater
+    from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+    conf2 = MultiLayerConfiguration.from_json(js)
+    assert conf2.layers[0].updater.learning_rate == 0.05
+
+    net = MultiLayerNetwork(conf).init()
+    w0_before = np.asarray(net.params_[0]["W"]).copy()
+    w1_before = np.asarray(net.params_[1]["W"]).copy()
+    x = np.random.default_rng(0).normal(size=(32, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[np.random.default_rng(1).integers(0, 2, 32)]
+    net.fit(ArrayDataSetIterator(x, y, 16), epochs=2)
+    # layer 0 (Adam override) moved; layer 1 (global sgd lr=0) did not
+    assert not np.allclose(np.asarray(net.params_[0]["W"]), w0_before)
+    np.testing.assert_array_equal(np.asarray(net.params_[1]["W"]), w1_before)
+
+
+def test_async_iterator_full_queue_epoch_end_terminates():
+    """_DONE sentinel must arrive even when the consumer is slow and the
+    queue is full at producer finish."""
+    base = ArrayDataSetIterator(np.zeros((50, 4), np.float32),
+                                np.zeros((50, 2), np.float32), batch_size=10)
+    async_it = AsyncDataSetIterator(base, queue_size=2)
+    seen = 0
+    for _ in async_it:
+        time.sleep(0.05)  # slower than producer
+        seen += 1
+    assert seen == 5
