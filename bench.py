@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Benchmark harness — prints ONE json line with the headline metric.
+
+Headline (BASELINE.json): ResNet-50 ImageNet-shape training throughput in
+images/sec/chip on the real TPU.  The reference publishes no number
+(BASELINE.md), so ``vs_baseline`` is computed against the public
+MLPerf-era proxy for the A100 comparison point named by the north star
+(~2750 img/s bf16 on one A100 — marked as a proxy, not a reference-repo
+measurement).
+
+Runs on whatever platform jax selects (the driver runs it on TPU);
+bfloat16 compute policy, synthetic data (no network), steady-state steps
+timed after compile+warmup.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+A100_PROXY_IMG_PER_SEC = 2750.0  # public MLPerf-era proxy, see BASELINE.md
+
+
+def bench_resnet50(batch: int = 64, image: int = 224, steps: int = 12,
+                   warmup: int = 2) -> dict:
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.config import DTypePolicy, set_dtype_policy
+    from deeplearning4j_tpu.models import resnet50
+    from deeplearning4j_tpu.train.trainer import Trainer
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.train import Nesterovs
+
+    set_dtype_policy(DTypePolicy.bf16())
+    net = resnet50(height=image, width=image, num_classes=1000,
+                   updater=Nesterovs(0.1, 0.9))
+    net.init()
+    trainer = Trainer(net)
+
+    rng = np.random.default_rng(0)
+    x = rng.uniform(0, 1, size=(batch, image, image, 3)).astype(np.float32)
+    y = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)]
+    batch_ds = DataSet(jnp.asarray(x), jnp.asarray(y))
+    key = jax.random.key(0)
+
+    for _ in range(warmup):  # first call compiles
+        float(trainer.fit_batch(batch_ds, key))
+    t0 = time.perf_counter()
+    loss = None
+    for _ in range(steps):
+        loss = trainer.fit_batch(batch_ds, key)  # async dispatch, pipelined
+    final_loss = float(loss)  # one sync closes the timed region
+    dt = time.perf_counter() - t0
+    img_per_sec = batch * steps / dt
+    n_chips = max(len(jax.devices()), 1)
+    return {
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(img_per_sec / n_chips, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_per_sec / n_chips / A100_PROXY_IMG_PER_SEC, 4),
+        "detail": {
+            "batch": batch, "image": image, "steps": steps,
+            "step_time_ms": round(1000 * dt / steps, 2),
+            "device": str(jax.devices()[0]),
+            "baseline_note": "A100 bf16 public proxy (~2750 img/s); reference repo publishes no number",
+        },
+    }
+
+
+def main():
+    batch = 64
+    for attempt in range(3):
+        try:
+            result = bench_resnet50(batch=batch)
+            print(json.dumps(result))
+            return 0
+        except Exception as e:  # OOM etc. → halve the batch and retry
+            msg = str(e)
+            if "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower():
+                batch //= 2
+                continue
+            print(json.dumps({"metric": "resnet50_train_images_per_sec_per_chip",
+                              "value": 0.0, "unit": "images/sec/chip",
+                              "vs_baseline": 0.0, "error": msg[:400]}))
+            return 1
+    print(json.dumps({"metric": "resnet50_train_images_per_sec_per_chip",
+                      "value": 0.0, "unit": "images/sec/chip",
+                      "vs_baseline": 0.0, "error": "OOM at batch>=16"}))
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,29 @@
+"""Autodiff / graph engine — SameDiff parity, the TPU way.
+
+The reference's SameDiff (nd4j-api ``org/nd4j/autodiff/samediff/``) is a
+define-by-graph engine: explicit graph container, hand-written backward
+builders per op (``doDiff``), a topological interpreter
+(``InferenceSession``), and FlatBuffers serialization.  The TPU-native
+equivalents:
+
+- graph build  → python tracing (jax.make_jaxpr); no god-object
+- doDiff       → jax.grad (program transformation)
+- InferenceSession → XLA executable; ``trace`` exposes the jaxpr for
+  debugging (the interpreter's introspection role)
+- FlatBuffers serde (``SameDiff.asFlatBuffers``/``save``) → StableHLO
+  export via jax.export (``export``/``load`` round-trip, serving parity)
+- GradCheckUtil / OpValidation → ``gradcheck`` + the op coverage ledger
+  (``validation``)
+"""
+
+from deeplearning4j_tpu.autodiff.export import (
+    export_stablehlo, save_exported, load_exported, stablehlo_text, trace,
+)
+from deeplearning4j_tpu.autodiff.gradcheck import check_gradients, check_model_gradients
+from deeplearning4j_tpu.autodiff.validation import op_inventory, CoverageLedger
+
+__all__ = [
+    "export_stablehlo", "save_exported", "load_exported", "stablehlo_text",
+    "trace", "check_gradients", "check_model_gradients", "op_inventory",
+    "CoverageLedger",
+]

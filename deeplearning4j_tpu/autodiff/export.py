@@ -1,0 +1,70 @@
+"""StableHLO export — the SameDiff-FlatBuffers serialization parity.
+
+Reference: ``SameDiff.asFlatBuffers()/save()`` serializes the op graph +
+weights for the C++ ``GraphExecutioner`` (libnd4j ``include/graph/``) and
+``.sdz`` deployment.  Here a traced jax function exports to a
+**StableHLO** artifact (``jax.export``): portable, versioned (compatible
+across jax/XLA releases per the StableHLO guarantees), executable without
+python (serving), and inspectable as MLIR text.
+
+``export_stablehlo(fn, *example_args)`` → ``jax.export.Exported``;
+``save_exported``/``load_exported`` round-trip the serialized bytes;
+``call`` on the loaded object re-executes inside jax.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+from jax import export as jax_export
+
+
+def trace(fn: Callable, *example_args, **kwargs):
+    """Expose the traced jaxpr (the debugging role of SameDiff's graph
+    introspection / ``InferenceSession`` stepping)."""
+    return jax.make_jaxpr(fn, **kwargs)(*example_args)
+
+
+def export_stablehlo(fn: Callable, *example_args,
+                     platforms: tuple[str, ...] | None = None):
+    """Trace+lower ``fn`` and return the jax.export artifact."""
+    kwargs = {}
+    if platforms is not None:
+        kwargs["platforms"] = list(platforms)
+    return jax_export.export(jax.jit(fn), **kwargs)(*example_args)
+
+
+def stablehlo_text(fn: Callable, *example_args) -> str:
+    """StableHLO MLIR of the traced fn (inspection/debug)."""
+    return export_stablehlo(fn, *example_args).mlir_module()
+
+
+def save_exported(exported, path: str) -> None:
+    with open(path, "wb") as f:
+        f.write(exported.serialize())
+
+
+def load_exported(path: str):
+    with open(path, "rb") as f:
+        return jax_export.deserialize(f.read())
+
+
+def export_model_forward(net, batch_size: int = 1, path: str | None = None):
+    """Export a network's inference forward at a fixed batch size — the
+    ``SameDiff.save`` / ``.sdz``-for-serving analog."""
+    import jax.numpy as jnp
+
+    x_shape = net.conf.input_type.batch_shape(batch_size) if hasattr(net.conf, "input_type") \
+        else net.conf.input_types[0].batch_shape(batch_size)
+
+    params, state = net.params_, net.state_
+
+    def forward(x):
+        y, _, _ = net._forward(params, state, x, train=False)
+        return y
+
+    exported = export_stablehlo(forward, jnp.zeros(x_shape, jnp.float32))
+    if path is not None:
+        save_exported(exported, path)
+    return exported

@@ -1,0 +1,68 @@
+"""Op-coverage ledger — OpValidation parity.
+
+Reference: ``org/nd4j/autodiff/validation/OpValidation.java`` tracks which
+registered ops have test coverage (forward values + gradients + shape fn)
+and FAILS the suite when coverage regresses.  Here the op inventory is
+enumerated from the ``ops`` namespaces; golden tests register the ops they
+cover; the ledger compares against a checked-in baseline
+(``tests/op_coverage.json``) and fails on regression.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from types import SimpleNamespace
+from typing import Iterable
+
+
+def op_inventory() -> dict[str, list[str]]:
+    """namespace → sorted op names, from the live ops module."""
+    from deeplearning4j_tpu.ops import namespaces as ns
+    inventory = {}
+    for name in ("math", "nn", "cnn", "rnn", "loss", "linalg", "random",
+                 "image", "bitwise"):
+        space = getattr(ns, name)
+        ops = [k for k, v in vars(space).items()
+               if not k.startswith("_") and callable(v)]
+        inventory[name] = sorted(ops)
+    return inventory
+
+
+class CoverageLedger:
+    def __init__(self, baseline_path: str):
+        self.baseline_path = baseline_path
+        self.covered: set[str] = set()   # "namespace.op" keys
+
+    def record(self, *qualified_ops: str) -> None:
+        self.covered.update(qualified_ops)
+
+    def total_ops(self) -> int:
+        return sum(len(v) for v in op_inventory().values())
+
+    def check(self, update_baseline: bool = False) -> dict:
+        """Fail if coverage dropped below the checked-in baseline; report
+        uncovered ops.  ``update_baseline=True`` rewrites the baseline
+        (run deliberately when coverage grows)."""
+        inventory = op_inventory()
+        all_ops = {f"{ns}.{op}" for ns, ops in inventory.items() for op in ops}
+        unknown = self.covered - all_ops
+        if unknown:
+            raise AssertionError(f"ledger records unknown ops: {sorted(unknown)}")
+        coverage = len(self.covered) / max(len(all_ops), 1)
+        baseline = {"covered": [], "coverage": 0.0}
+        if os.path.exists(self.baseline_path):
+            with open(self.baseline_path) as f:
+                baseline = json.load(f)
+        lost = set(baseline["covered"]) - self.covered
+        if lost:
+            raise AssertionError(
+                f"op coverage REGRESSED — previously-covered ops now untested: "
+                f"{sorted(lost)}")
+        if update_baseline or len(self.covered) > len(baseline["covered"]):
+            with open(self.baseline_path, "w") as f:
+                json.dump({"covered": sorted(self.covered),
+                           "coverage": round(coverage, 4)}, f, indent=1)
+        return {"covered": len(self.covered), "total": len(all_ops),
+                "coverage": coverage,
+                "uncovered": sorted(all_ops - self.covered)}

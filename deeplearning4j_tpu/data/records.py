@@ -1,0 +1,252 @@
+"""Record readers — DataVec core parity.
+
+Parity with ``datavec/datavec-api``
+(``org/datavec/api/records/reader/impl/``): CSVRecordReader,
+CSVSequenceRecordReader, LineRecordReader, CollectionRecordReader,
+FileSplit/NumberedFileInputSplit, and the DL4J bridge
+``RecordReaderDataSetIterator`` (deeplearning4j-data
+``datasets/datavec/RecordReaderDataSetIterator.java``) turning records
+into DataSets with label extraction/one-hot.
+
+A record is a list of python values (the Writable row); a sequence record
+is a list of records.
+"""
+
+from __future__ import annotations
+
+import csv
+import glob as globlib
+import os
+from typing import Any, Iterable, Iterator, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import DataSetIterator
+
+
+class RecordReader:
+    def records(self) -> Iterator[list]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+    def __iter__(self):
+        return self.records()
+
+
+class FileSplit:
+    """``org/datavec/api/split/FileSplit.java``: root dir (or glob) →
+    ordered file list."""
+
+    def __init__(self, root: str, allowed_extensions: Optional[list[str]] = None,
+                 recursive: bool = True):
+        self.root = root
+        self.allowed = allowed_extensions
+        self.recursive = recursive
+
+    def locations(self) -> list[str]:
+        if os.path.isfile(self.root):
+            return [self.root]
+        if any(ch in self.root for ch in "*?["):
+            files = sorted(globlib.glob(self.root, recursive=True))
+        else:
+            pattern = "**/*" if self.recursive else "*"
+            files = sorted(globlib.glob(os.path.join(self.root, pattern),
+                                        recursive=self.recursive))
+        files = [f for f in files if os.path.isfile(f)]
+        if self.allowed:
+            files = [f for f in files
+                     if any(f.endswith(ext) for ext in self.allowed)]
+        return files
+
+
+class NumberedFileInputSplit:
+    """``NumberedFileInputSplit``: path pattern with %d over [min, max]."""
+
+    def __init__(self, pattern: str, min_idx: int, max_idx: int):
+        self.pattern = pattern
+        self.min_idx = min_idx
+        self.max_idx = max_idx
+
+    def locations(self) -> list[str]:
+        return [self.pattern % i for i in range(self.min_idx, self.max_idx + 1)]
+
+
+def _parse(value: str):
+    try:
+        return int(value)
+    except ValueError:
+        try:
+            return float(value)
+        except ValueError:
+            return value
+
+
+class CSVRecordReader(RecordReader):
+    """``CSVRecordReader``: one record per CSV line, numeric parsing,
+    skip-lines + delimiter options."""
+
+    def __init__(self, split, skip_lines: int = 0, delimiter: str = ","):
+        self.split = split
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+
+    def records(self):
+        for path in self.split.locations():
+            with open(path, newline="") as f:
+                reader = csv.reader(f, delimiter=self.delimiter)
+                for i, row in enumerate(reader):
+                    if i < self.skip_lines or not row:
+                        continue
+                    yield [_parse(v) for v in row]
+
+
+class CSVSequenceRecordReader(RecordReader):
+    """``CSVSequenceRecordReader``: one FILE per sequence; yields
+    list-of-records per file."""
+
+    def __init__(self, split, skip_lines: int = 0, delimiter: str = ","):
+        self.split = split
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+
+    def records(self):
+        for path in self.split.locations():
+            with open(path, newline="") as f:
+                reader = csv.reader(f, delimiter=self.delimiter)
+                seq = [[_parse(v) for v in row]
+                       for i, row in enumerate(reader)
+                       if i >= self.skip_lines and row]
+            yield seq
+
+
+class LineRecordReader(RecordReader):
+    def __init__(self, split):
+        self.split = split
+
+    def records(self):
+        for path in self.split.locations():
+            with open(path) as f:
+                for line in f:
+                    yield [line.rstrip("\n")]
+
+
+class CollectionRecordReader(RecordReader):
+    def __init__(self, collection: Sequence[list]):
+        self.collection = list(collection)
+
+    def records(self):
+        return iter(self.collection)
+
+
+class RecordReaderDataSetIterator(DataSetIterator):
+    """DataVec→DataSet bridge (``RecordReaderDataSetIterator.java``):
+    label column extraction + one-hot for classification, regression mode,
+    batching."""
+
+    def __init__(self, reader: RecordReader, batch_size: int,
+                 label_index: Optional[int] = None,
+                 num_classes: Optional[int] = None,
+                 regression: bool = False,
+                 label_index_to: Optional[int] = None):
+        self.reader = reader
+        self.batch_size = batch_size
+        self.label_index = label_index
+        self.num_classes = num_classes
+        self.regression = regression
+        self.label_index_to = label_index_to
+
+    def reset(self):
+        self.reader.reset()
+
+    def _split_record(self, record: list):
+        if self.label_index is None:
+            return record, None
+        if self.label_index_to is not None:  # multi-column regression labels
+            lo, hi = self.label_index, self.label_index_to
+            labels = record[lo:hi + 1]
+            features = record[:lo] + record[hi + 1:]
+            return features, labels
+        label = record[self.label_index]
+        features = record[:self.label_index] + record[self.label_index + 1:]
+        return features, label
+
+    def __iter__(self):
+        feats, labels = [], []
+        for record in self.reader.records():
+            f, l = self._split_record(record)
+            feats.append(f)
+            labels.append(l)
+            if len(feats) == self.batch_size:
+                yield self._make_batch(feats, labels)
+                feats, labels = [], []
+        if feats:
+            yield self._make_batch(feats, labels)
+
+    def _make_batch(self, feats, labels) -> DataSet:
+        x = np.asarray(feats, dtype=np.float32)
+        if self.label_index is None:
+            return DataSet(x, None)
+        if self.regression:
+            y = np.asarray(labels, dtype=np.float32)
+            if y.ndim == 1:
+                y = y[:, None]
+        else:
+            idx = np.asarray(labels, dtype=np.int64).reshape(-1)
+            n = self.num_classes or int(idx.max()) + 1
+            y = np.zeros((idx.shape[0], n), dtype=np.float32)
+            y[np.arange(idx.shape[0]), idx] = 1.0
+        return DataSet(x, y)
+
+
+class SequenceRecordReaderDataSetIterator(DataSetIterator):
+    """``SequenceRecordReaderDataSetIterator``: sequences → [B,T,C]
+    DataSets with per-timestep one-hot labels or sequence-level labels;
+    pads to the longest sequence in the batch with masks."""
+
+    def __init__(self, reader: CSVSequenceRecordReader, batch_size: int,
+                 label_index: int, num_classes: int,
+                 sequence_labels: bool = True):
+        self.reader = reader
+        self.batch_size = batch_size
+        self.label_index = label_index
+        self.num_classes = num_classes
+        self.sequence_labels = sequence_labels
+
+    def reset(self):
+        self.reader.reset()
+
+    def __iter__(self):
+        batch = []
+        for seq in self.reader.records():
+            batch.append(seq)
+            if len(batch) == self.batch_size:
+                yield self._make_batch(batch)
+                batch = []
+        if batch:
+            yield self._make_batch(batch)
+
+    def _make_batch(self, seqs) -> DataSet:
+        b = len(seqs)
+        t_max = max(len(s) for s in seqs)
+        n_feat = len(seqs[0][0]) - 1
+        x = np.zeros((b, t_max, n_feat), np.float32)
+        mask = np.zeros((b, t_max), np.float32)
+        if self.sequence_labels:
+            y = np.zeros((b, t_max, self.num_classes), np.float32)
+        else:
+            y = np.zeros((b, self.num_classes), np.float32)
+        for i, seq in enumerate(seqs):
+            for t, row in enumerate(seq):
+                label = int(row[self.label_index])
+                feats = row[:self.label_index] + row[self.label_index + 1:]
+                x[i, t] = feats
+                mask[i, t] = 1.0
+                if self.sequence_labels:
+                    y[i, t, label] = 1.0
+                else:
+                    y[i, label] = 1.0
+        return DataSet(x, y, features_mask=mask,
+                       labels_mask=mask if self.sequence_labels else None)

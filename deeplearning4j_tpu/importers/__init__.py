@@ -1,0 +1,18 @@
+"""Model importers — parity with the reference's import stack
+(deeplearning4j-modelimport Keras .h5 reader; nd4j/samediff-import TF →
+SameDiff, scoped per SURVEY.md §7.8 to the BERT workload).
+
+Environment constraint: no h5py/TF/protobuf runtimes on the box, so the
+binary-container readers are split from the mapping logic:
+
+- ``keras``   — Keras architecture-JSON → our config-first networks
+  (Sequential + Functional), weights from a {name: array} dict (loaded
+  from npz; an .h5 → npz conversion one-liner runs wherever h5py exists).
+- ``tf_bert`` — TF BERT checkpoint variable-name mapping → our
+  ``models.bert`` parameter pytree (the fiddly part the reference's
+  ImportGraph + OpMappingRegistry handles), weights from npz/dict.
+"""
+
+from deeplearning4j_tpu.importers import keras, tf_bert
+
+__all__ = ["keras", "tf_bert"]

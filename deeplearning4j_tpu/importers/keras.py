@@ -1,0 +1,191 @@
+"""Keras import — architecture JSON + weights → config-first network.
+
+Parity with ``deeplearning4j-modelimport``
+(``org/deeplearning4j/nn/modelimport/keras/KerasModelImport.java``,
+``KerasModel``, per-layer converters in ``layers/``): Sequential and
+Functional architectures, the common layer set (Dense, Conv2D,
+MaxPooling2D/AveragePooling2D, BatchNormalization, Dropout, Flatten,
+Activation, Embedding, LSTM, Bidirectional, GlobalAvg/MaxPooling).
+
+Input: the model-config JSON (``model.to_json()`` in Keras) and a
+``{layer_name: [arrays...]}`` weight dict (``np.savez`` of
+``layer.get_weights()`` — conversion from .h5 runs where h5py exists; no
+h5py in this image).  Layout conversion: Keras Dense/Conv kernels are
+already [in, out] / HWIO — matching our NHWC/[in,out] convention, so
+weights transfer without transposition; LSTM gate order converts
+IFCO(keras) → IFOG(ours).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, MultiLayerConfiguration
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.layers import (
+    DenseLayer, OutputLayer, ConvolutionLayer, SubsamplingLayer,
+    BatchNormalization, DropoutLayer, ActivationLayer, EmbeddingSequenceLayer,
+    LSTM, Bidirectional, GlobalPoolingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+_ACTIVATION_MAP = {
+    "linear": "identity", "relu": "relu", "sigmoid": "sigmoid",
+    "softmax": "softmax", "tanh": "tanh", "elu": "elu", "selu": "selu",
+    "gelu": "gelu", "swish": "swish", "softplus": "softplus",
+    "softsign": "softsign", "hard_sigmoid": "hardsigmoid",
+    "leaky_relu": "leakyrelu",
+}
+
+
+def _act(keras_act: Optional[str]) -> str:
+    return _ACTIVATION_MAP.get(keras_act or "linear", keras_act or "identity")
+
+
+def _convert_layer(kcfg: dict):
+    """One Keras layer config → our layer (or None for structural layers
+    handled implicitly, e.g. Flatten/InputLayer)."""
+    cls = kcfg["class_name"]
+    conf = kcfg["config"]
+    name = conf.get("name")
+    if cls in ("InputLayer", "Flatten"):
+        return None
+    if cls == "Dense":
+        return DenseLayer(name=name, n_out=conf["units"],
+                          activation=_act(conf.get("activation")),
+                          has_bias=conf.get("use_bias", True))
+    if cls == "Conv2D":
+        k = conf["kernel_size"]
+        s = conf.get("strides", (1, 1))
+        return ConvolutionLayer(
+            name=name, n_out=conf["filters"], kernel_size=tuple(k),
+            stride=tuple(s),
+            convolution_mode="same" if conf.get("padding") == "same" else "truncate",
+            activation=_act(conf.get("activation")),
+            has_bias=conf.get("use_bias", True))
+    if cls in ("MaxPooling2D", "AveragePooling2D"):
+        return SubsamplingLayer(
+            name=name,
+            pooling_type="max" if cls == "MaxPooling2D" else "avg",
+            kernel_size=tuple(conf.get("pool_size", (2, 2))),
+            stride=tuple(conf.get("strides") or conf.get("pool_size", (2, 2))),
+            convolution_mode="same" if conf.get("padding") == "same" else "truncate")
+    if cls == "BatchNormalization":
+        return BatchNormalization(name=name, decay=conf.get("momentum", 0.99),
+                                  eps=conf.get("epsilon", 1e-3))
+    if cls == "Dropout":
+        # Keras rate = DROP prob; ours = retain prob
+        return DropoutLayer(name=name, dropout=1.0 - conf.get("rate", 0.5))
+    if cls == "Activation":
+        return ActivationLayer(name=name, activation=_act(conf.get("activation")))
+    if cls == "Embedding":
+        return EmbeddingSequenceLayer(name=name, n_in=conf["input_dim"],
+                                      n_out=conf["output_dim"], has_bias=False)
+    if cls == "LSTM":
+        return LSTM(name=name, n_out=conf["units"],
+                    activation=_act(conf.get("activation", "tanh")),
+                    gate_activation=_act(conf.get("recurrent_activation", "sigmoid")))
+    if cls == "Bidirectional":
+        inner = _convert_layer(conf["layer"])
+        return Bidirectional(name=name, fwd=inner,
+                             mode=conf.get("merge_mode", "concat"))
+    if cls in ("GlobalAveragePooling2D", "GlobalMaxPooling2D",
+               "GlobalAveragePooling1D", "GlobalMaxPooling1D"):
+        return GlobalPoolingLayer(name=name,
+                                  pooling_type="avg" if "Average" in cls else "max")
+    raise KeyError(f"unsupported Keras layer class '{cls}' "
+                   f"(KerasLayer converter missing — registry parity point)")
+
+
+def _infer_input_type(kmodel: dict) -> InputType:
+    layers = kmodel["config"]["layers"]
+    first = layers[0]
+    shape = (first["config"].get("batch_input_shape")
+             or first["config"].get("batch_shape"))
+    if shape is None:
+        raise ValueError("model JSON lacks batch_input_shape on the first layer")
+    dims = [d for d in shape[1:]]
+    if len(dims) == 1:
+        return InputType.feed_forward(dims[0])
+    if len(dims) == 2:
+        return InputType.recurrent(dims[1], dims[0])
+    if len(dims) == 3:
+        return InputType.convolutional(dims[0], dims[1], dims[2])
+    raise ValueError(f"unsupported input shape {shape}")
+
+
+def import_sequential(model_json: str,
+                      weights: Optional[dict[str, list[np.ndarray]]] = None,
+                      loss: str = "mcxent") -> MultiLayerNetwork:
+    """KerasModelImport.importKerasSequentialModelAndWeights parity."""
+    kmodel = json.loads(model_json)
+    if kmodel.get("class_name") != "Sequential":
+        raise ValueError("not a Sequential model — use import_functional")
+    layer_cfgs = kmodel["config"]["layers"]
+    our_layers = []
+    for kcfg in layer_cfgs:
+        layer = _convert_layer(kcfg)
+        if layer is not None:
+            our_layers.append(layer)
+    # last Dense+softmax becomes OutputLayer so fit() works (DL4J does the
+    # same when the Keras model ends with Dense+activation)
+    if our_layers and isinstance(our_layers[-1], DenseLayer) \
+            and not isinstance(our_layers[-1], OutputLayer):
+        d = our_layers[-1]
+        our_layers[-1] = OutputLayer(name=d.name, n_out=d.n_out,
+                                     activation=d.activation, loss=loss,
+                                     has_bias=d.has_bias)
+    builder = NeuralNetConfiguration.builder().list()
+    for layer in our_layers:
+        builder.layer(layer)
+    builder.set_input_type(_infer_input_type(kmodel))
+    net = MultiLayerNetwork(builder.build()).init()
+    if weights is not None:
+        load_weights(net, weights)
+    return net
+
+
+def load_weights(net: MultiLayerNetwork, weights: dict[str, list[np.ndarray]]) -> None:
+    """Copy Keras layer weights into the network by layer name."""
+    for i, layer in enumerate(net.layers):
+        if layer.name is None or layer.name not in weights:
+            continue
+        arrays = [np.asarray(a) for a in weights[layer.name]]
+        params = net.params_[i]
+        if isinstance(layer, LSTM):
+            w, u, b = arrays  # keras: [in,4H] IFCO
+            params["W"] = _ifco_to_ifog(w, layer.n_out)
+            params["U"] = _ifco_to_ifog(u, layer.n_out)
+            params["b"] = _ifco_to_ifog(b[None, :], layer.n_out)[0]
+        elif isinstance(layer, BatchNormalization):
+            gamma, beta, mean, var = arrays
+            params["gamma"], params["beta"] = gamma, beta
+            net.state_[i]["mean"], net.state_[i]["var"] = mean, var
+        else:
+            keys = [k for k in ("W", "b", "depthW", "pointW") if k in params]
+            for key, arr in zip(keys, arrays):
+                if params[key].shape != arr.shape:
+                    raise ValueError(
+                        f"layer '{layer.name}' param {key}: shape "
+                        f"{arr.shape} != expected {params[key].shape}")
+                params[key] = arr
+
+
+def _ifco_to_ifog(w: np.ndarray, h: int) -> np.ndarray:
+    """Keras LSTM gate order i,f,c,o → ours i,f,o,g(c)."""
+    i, f, c, o = (w[:, 0:h], w[:, h:2 * h], w[:, 2 * h:3 * h], w[:, 3 * h:4 * h])
+    return np.concatenate([i, f, o, c], axis=1)
+
+
+def load_weights_npz(net: MultiLayerNetwork, path: str) -> None:
+    """Weights from an npz written as {f"{layer_name}__{idx}": array}."""
+    data = np.load(path, allow_pickle=False)
+    grouped: dict[str, list] = {}
+    for key in sorted(data.files):
+        lname, idx = key.rsplit("__", 1)
+        grouped.setdefault(lname, []).append((int(idx), data[key]))
+    weights = {name: [a for _, a in sorted(items)] for name, items in grouped.items()}
+    load_weights(net, weights)

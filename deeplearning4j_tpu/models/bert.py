@@ -1,0 +1,286 @@
+"""BERT — transformer encoder for the MLM fine-tune workload.
+
+The reference runs BERT by importing a TF GraphDef into SameDiff
+(``nd4j/samediff-import/`` + ``TFGraphMapper``; BASELINE config #4) and
+fine-tuning with ``SameDiff.fit``.  TPU-native design: the encoder is a
+pure-jax function over a named parameter pytree whose keys mirror the TF
+BERT checkpoint variable names (bert/embeddings/word_embeddings, ...,
+bert/encoder/layer_N/attention/self/query/kernel, ...) so the
+TF-checkpoint importer (``deeplearning4j_tpu.importers.tf_bert``) is a
+pure name-mapping exercise, and tensor-parallel sharding rules
+(``deeplearning4j_tpu.parallel``) can be keyed by the same names.
+
+Everything traces into one XLA program: embeddings gather, H-head fused
+attention (MXU einsums), GELU FFN, residual+layernorm — no per-op
+dispatch.  Weights are float32; matmuls run in the global dtype policy's
+compute dtype (bf16 on TPU for speed parity).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.config import dtype_policy
+from deeplearning4j_tpu.ops.attention import multi_head_attention
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+
+    @staticmethod
+    def base() -> "BertConfig":
+        return BertConfig()
+
+    @staticmethod
+    def tiny(vocab_size: int = 1000) -> "BertConfig":
+        """Test-sized config (fast on CPU)."""
+        return BertConfig(vocab_size=vocab_size, hidden_size=64, num_layers=2,
+                          num_heads=4, intermediate_size=128, max_position=128)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "BertConfig":
+        known = {f.name for f in dataclasses.fields(BertConfig)}
+        return BertConfig(**{k: v for k, v in d.items() if k in known})
+
+
+def _dense_params(key, n_in, n_out, std):
+    kw, _ = jax.random.split(key)
+    return {"kernel": std * jax.random.truncated_normal(kw, -2.0, 2.0, (n_in, n_out)),
+            "bias": jnp.zeros((n_out,))}
+
+
+def _ln_params(n):
+    return {"gamma": jnp.ones((n,)), "beta": jnp.zeros((n,))}
+
+
+def init_params(config: BertConfig, key: jax.Array) -> dict:
+    """Parameter pytree with TF-BERT-shaped naming."""
+    std = config.initializer_range
+    h = config.hidden_size
+    keys = jax.random.split(key, 4 + config.num_layers)
+    params: dict[str, Any] = {
+        "embeddings": {
+            "word_embeddings": std * jax.random.truncated_normal(
+                keys[0], -2.0, 2.0, (config.vocab_size, h)),
+            "position_embeddings": std * jax.random.truncated_normal(
+                keys[1], -2.0, 2.0, (config.max_position, h)),
+            "token_type_embeddings": std * jax.random.truncated_normal(
+                keys[2], -2.0, 2.0, (config.type_vocab_size, h)),
+            "layer_norm": _ln_params(h),
+        },
+        "encoder": {},
+        "mlm": {
+            "transform": _dense_params(keys[3], h, h, std),
+            "transform_layer_norm": _ln_params(h),
+            "output_bias": jnp.zeros((config.vocab_size,)),
+        },
+        "pooler": _dense_params(jax.random.fold_in(keys[3], 99), h, h, std),
+    }
+    for i in range(config.num_layers):
+        lk = jax.random.split(keys[4 + i], 6)
+        params["encoder"][f"layer_{i}"] = {
+            "attention": {
+                "query": _dense_params(lk[0], h, h, std),
+                "key": _dense_params(lk[1], h, h, std),
+                "value": _dense_params(lk[2], h, h, std),
+                "output": _dense_params(lk[3], h, h, std),
+                "output_layer_norm": _ln_params(h),
+            },
+            "intermediate": _dense_params(lk[4], h, config.intermediate_size, std),
+            "output": _dense_params(lk[5], config.intermediate_size, h, std),
+            "output_layer_norm": _ln_params(h),
+        }
+    return params
+
+
+def _dense(p, x):
+    policy = dtype_policy()
+    y = jnp.einsum("...i,io->...o", x.astype(policy.compute_dtype),
+                   p["kernel"].astype(policy.compute_dtype)).astype(policy.output_dtype)
+    return y + p["bias"]
+
+
+def _layer_norm(p, x, eps):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * p["gamma"] + p["beta"]
+
+
+def _dropout(x, rate, train, rng):
+    if not train or rate <= 0.0 or rng is None:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0)
+
+
+def encode(params: dict, config: BertConfig, input_ids: jnp.ndarray,
+           token_type_ids: Optional[jnp.ndarray] = None,
+           attention_mask: Optional[jnp.ndarray] = None,
+           *, train: bool = False, rng: Optional[jax.Array] = None) -> jnp.ndarray:
+    """input_ids [B,T] int32 → hidden states [B,T,H]."""
+    b, t = input_ids.shape
+    emb = params["embeddings"]
+    x = jnp.take(emb["word_embeddings"], input_ids.astype(jnp.int32), axis=0)
+    x = x + emb["position_embeddings"][None, :t, :]
+    if token_type_ids is None:
+        token_type_ids = jnp.zeros_like(input_ids)
+    x = x + jnp.take(emb["token_type_embeddings"], token_type_ids.astype(jnp.int32), axis=0)
+    x = _layer_norm(emb["layer_norm"], x, config.layer_norm_eps)
+    if rng is not None:
+        rng = jax.random.fold_in(rng, 0)
+    x = _dropout(x, config.hidden_dropout, train, rng)
+
+    for i in range(config.num_layers):
+        lp = params["encoder"][f"layer_{i}"]
+        layer_rng = jax.random.fold_in(rng, i + 1) if rng is not None else None
+        # self-attention
+        q = _dense(lp["attention"]["query"], x)
+        k = _dense(lp["attention"]["key"], x)
+        v = _dense(lp["attention"]["value"], x)
+        attn = multi_head_attention(q, k, v, n_heads=config.num_heads,
+                                    kv_mask=attention_mask)
+        attn = _dense(lp["attention"]["output"], attn)
+        attn = _dropout(attn, config.hidden_dropout, train, layer_rng)
+        x = _layer_norm(lp["attention"]["output_layer_norm"], x + attn,
+                        config.layer_norm_eps)
+        # FFN
+        inter = jax.nn.gelu(_dense(lp["intermediate"], x))
+        out = _dense(lp["output"], inter)
+        out = _dropout(out, config.hidden_dropout, train,
+                       jax.random.fold_in(layer_rng, 7) if layer_rng is not None else None)
+        x = _layer_norm(lp["output_layer_norm"], x + out, config.layer_norm_eps)
+    return x
+
+
+def pool(params: dict, hidden: jnp.ndarray) -> jnp.ndarray:
+    """[CLS] pooler (bert/pooler/dense, tanh)."""
+    return jnp.tanh(_dense(params["pooler"], hidden[:, 0]))
+
+
+def mlm_logits(params: dict, config: BertConfig, hidden: jnp.ndarray) -> jnp.ndarray:
+    """Masked-LM head: transform → layernorm → decode with TIED word
+    embeddings + output bias (TF BERT cls/predictions)."""
+    x = jax.nn.gelu(_dense(params["mlm"]["transform"], hidden))
+    x = _layer_norm(params["mlm"]["transform_layer_norm"], x, config.layer_norm_eps)
+    policy = dtype_policy()
+    logits = jnp.einsum("bth,vh->btv", x.astype(policy.compute_dtype),
+                        params["embeddings"]["word_embeddings"].astype(policy.compute_dtype)
+                        ).astype(policy.output_dtype)
+    return logits + params["mlm"]["output_bias"]
+
+
+def mlm_loss(params: dict, config: BertConfig, input_ids, labels, label_weights,
+             token_type_ids=None, attention_mask=None, *, train=True, rng=None):
+    """Masked-LM loss: mean cross-entropy over positions with
+    label_weights==1 (the masked positions)."""
+    hidden = encode(params, config, input_ids, token_type_ids, attention_mask,
+                    train=train, rng=rng)
+    logits = mlm_logits(params, config, hidden)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, labels.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+    weights = label_weights.astype(logp.dtype)
+    return -jnp.sum(picked * weights) / jnp.maximum(jnp.sum(weights), 1.0)
+
+
+class BertForMaskedLM:
+    """Workload wrapper: holds params + jit'd train step (SameDiff
+    ``TrainingConfig`` + ``fit`` parity for the BERT config)."""
+
+    def __init__(self, config: BertConfig, seed: int = 0):
+        self.config = config
+        self.seed = seed
+        self.params = init_params(config, jax.random.key(seed))
+        self.opt_state = None
+        self._step = None
+        self.iteration = 0
+
+    def num_params(self) -> int:
+        from deeplearning4j_tpu.utils.pytree import param_count
+        return param_count(self.params)
+
+    def make_train_step(self, tx):
+        config = self.config
+
+        @jax.jit
+        def step(params, opt_state, input_ids, labels, label_weights,
+                 attention_mask, rng):
+            def loss_fn(p):
+                return mlm_loss(p, config, input_ids, labels, label_weights,
+                                attention_mask=attention_mask, train=True, rng=rng)
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state2 = tx.update(grads, opt_state, params)
+            params2 = jax.tree_util.tree_map(lambda a, u: a + u, params, updates)
+            return params2, opt_state2, loss
+
+        return step
+
+    def fit(self, batches, updater=None, epochs: int = 1, listeners=None):
+        from deeplearning4j_tpu.train import updaters as updater_mod
+        from deeplearning4j_tpu.obs.listeners import ListenerBus
+        bus = listeners if isinstance(listeners, ListenerBus) else ListenerBus(listeners)
+        tx = (updater or updater_mod.Adam(2e-5)).to_optax()
+        if self.opt_state is None:
+            self.opt_state = tx.init(self.params)
+        if self._step is None:
+            self._step = self.make_train_step(tx)
+        key = jax.random.key(self.seed + 31)
+        last = float("nan")
+        for _ in range(epochs):
+            if hasattr(batches, "reset"):
+                batches.reset()
+            for batch in batches:
+                key, sub = jax.random.split(key)
+                self.params, self.opt_state, loss = self._step(
+                    self.params, self.opt_state,
+                    jnp.asarray(batch["input_ids"]), jnp.asarray(batch["labels"]),
+                    jnp.asarray(batch["label_weights"]),
+                    jnp.asarray(batch["attention_mask"]) if batch.get("attention_mask") is not None else None,
+                    sub)
+                last = float(loss)
+                bus.dispatch("iteration_done", self, self.iteration, 0, last)
+                self.iteration += 1
+        return last
+
+    def predict_mlm(self, input_ids, attention_mask=None):
+        hidden = encode(self.params, self.config, jnp.asarray(input_ids),
+                        attention_mask=attention_mask)
+        return mlm_logits(self.params, self.config, hidden)
+
+    # ------------------------------------------------------------- serde
+    def save(self, path: str) -> None:
+        import zipfile
+        from deeplearning4j_tpu.io.model_serializer import _tree_to_npz_bytes
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr("bert_config.json", json.dumps(self.config.to_dict()))
+            zf.writestr("params.npz", _tree_to_npz_bytes(self.params))
+
+    @staticmethod
+    def load(path: str) -> "BertForMaskedLM":
+        import zipfile
+        from deeplearning4j_tpu.io.model_serializer import (
+            _npz_bytes_to_leaves, _rebuild_like)
+        with zipfile.ZipFile(path, "r") as zf:
+            config = BertConfig.from_dict(json.loads(zf.read("bert_config.json").decode()))
+            model = BertForMaskedLM(config)
+            model.params = _rebuild_like(model.params,
+                                         _npz_bytes_to_leaves(zf.read("params.npz")))
+        return model

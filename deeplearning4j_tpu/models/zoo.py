@@ -1,0 +1,249 @@
+"""Zoo model builders.
+
+Parity notes per model (reference classes under
+``deeplearning4j/deeplearning4j-zoo/src/main/java/org/deeplearning4j/zoo/model/``):
+
+- ``lenet`` → ``LeNet.java`` (conv5x5x20 → pool → conv5x5x50 → pool →
+  dense500 → softmax; DL4J's variant of LeCun's LeNet).
+- ``alexnet`` → ``AlexNet.java`` (the one-GPU variant w/ LRN).
+- ``vgg16`` → ``VGG16.java``.
+- ``resnet50`` → ``ResNet50.java`` (ComputationGraph, bottleneck blocks,
+  conv/identity shortcuts, BN after each conv — v1 architecture).
+- ``simple_cnn`` → ``SimpleCNN.java``.
+- ``text_gen_lstm`` → ``TextGenerationLSTM.java`` (char-RNN,
+  GravesLSTM stack + RnnOutputLayer MCXENT).
+- ``mlp_mnist`` / ``lstm_classifier`` → dl4j-examples workloads named in
+  BASELINE.json (MLPMnistTwoLayerExample; UCI HAR sequence classification).
+
+All CNNs are NHWC; ImageNet-sized models default to 224x224x3 inputs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import (
+    DenseLayer, OutputLayer, ConvolutionLayer, SubsamplingLayer,
+    BatchNormalization, ActivationLayer, DropoutLayer, GlobalPoolingLayer,
+    LocalResponseNormalization, LSTM, GravesLSTM, LastTimeStep, RnnOutputLayer,
+    ZeroPaddingLayer,
+)
+from deeplearning4j_tpu.nn.vertices import ElementWiseVertex
+from deeplearning4j_tpu.train import Adam, Nesterovs, Sgd
+
+
+def mlp_mnist(seed: int = 123, hidden: int = 500, hidden2: int = 100,
+              updater=None) -> MultiLayerNetwork:
+    """MLPMnistTwoLayerExample parity (dl4j-examples)."""
+    return MultiLayerNetwork(
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(updater or Nesterovs(0.0015, 0.98))
+        .weight_init("xavier")
+        .l2(1e-4)
+        .list()
+        .layer(DenseLayer(n_out=hidden, activation="relu"))
+        .layer(DenseLayer(n_out=hidden2, activation="relu"))
+        .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(784))
+        .build())
+
+
+def lenet(seed: int = 123, height: int = 28, width: int = 28, channels: int = 1,
+          num_classes: int = 10, updater=None) -> MultiLayerNetwork:
+    """LeNet.java parity (zoo)."""
+    return MultiLayerNetwork(
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(updater or Adam(1e-3))
+        .weight_init("xavier")
+        .list()
+        .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5), stride=(1, 1),
+                                convolution_mode="same", activation="identity"))
+        .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)))
+        .layer(ConvolutionLayer(n_out=50, kernel_size=(5, 5), stride=(1, 1),
+                                convolution_mode="same", activation="identity"))
+        .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)))
+        .layer(DenseLayer(n_out=500, activation="relu"))
+        .layer(OutputLayer(n_out=num_classes, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.convolutional(height, width, channels))
+        .build())
+
+
+def simple_cnn(seed: int = 123, height: int = 48, width: int = 48, channels: int = 3,
+               num_classes: int = 10) -> MultiLayerNetwork:
+    """SimpleCNN.java parity."""
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed)
+         .updater(Adam(1e-3))
+         .weight_init("relu")
+         .list())
+    for n_out in (16, 32, 64):
+        b.layer(ConvolutionLayer(n_out=n_out, kernel_size=(3, 3),
+                                 convolution_mode="same", activation="relu"))
+        b.layer(BatchNormalization())
+        b.layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)))
+    b.layer(DropoutLayer(dropout=0.5))
+    b.layer(DenseLayer(n_out=256, activation="relu"))
+    b.layer(OutputLayer(n_out=num_classes, activation="softmax", loss="mcxent"))
+    b.set_input_type(InputType.convolutional(height, width, channels))
+    return MultiLayerNetwork(b.build())
+
+
+def alexnet(seed: int = 123, num_classes: int = 1000) -> MultiLayerNetwork:
+    """AlexNet.java parity (one-tower variant with LRN)."""
+    return MultiLayerNetwork(
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(Nesterovs(1e-2, 0.9))
+        .weight_init("normal")
+        .l2(5e-4)
+        .list()
+        .layer(ConvolutionLayer(n_out=96, kernel_size=(11, 11), stride=(4, 4),
+                                activation="relu"))
+        .layer(LocalResponseNormalization())
+        .layer(SubsamplingLayer(pooling_type="max", kernel_size=(3, 3), stride=(2, 2)))
+        .layer(ConvolutionLayer(n_out=256, kernel_size=(5, 5), convolution_mode="same",
+                                activation="relu", bias_init=1.0))
+        .layer(LocalResponseNormalization())
+        .layer(SubsamplingLayer(pooling_type="max", kernel_size=(3, 3), stride=(2, 2)))
+        .layer(ConvolutionLayer(n_out=384, kernel_size=(3, 3), convolution_mode="same",
+                                activation="relu"))
+        .layer(ConvolutionLayer(n_out=384, kernel_size=(3, 3), convolution_mode="same",
+                                activation="relu", bias_init=1.0))
+        .layer(ConvolutionLayer(n_out=256, kernel_size=(3, 3), convolution_mode="same",
+                                activation="relu", bias_init=1.0))
+        .layer(SubsamplingLayer(pooling_type="max", kernel_size=(3, 3), stride=(2, 2)))
+        .layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5, bias_init=1.0))
+        .layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5, bias_init=1.0))
+        .layer(OutputLayer(n_out=num_classes, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.convolutional(224, 224, 3))
+        .build())
+
+
+def vgg16(seed: int = 123, num_classes: int = 1000) -> MultiLayerNetwork:
+    """VGG16.java parity."""
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed)
+         .updater(Nesterovs(1e-2, 0.9))
+         .weight_init("relu")
+         .list())
+    for block, (n_out, convs) in enumerate([(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]):
+        for _ in range(convs):
+            b.layer(ConvolutionLayer(n_out=n_out, kernel_size=(3, 3),
+                                     convolution_mode="same", activation="relu"))
+        b.layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)))
+    b.layer(DenseLayer(n_out=4096, activation="relu"))
+    b.layer(DenseLayer(n_out=4096, activation="relu"))
+    b.layer(OutputLayer(n_out=num_classes, activation="softmax", loss="mcxent"))
+    b.set_input_type(InputType.convolutional(224, 224, 3))
+    return MultiLayerNetwork(b.build())
+
+
+# ------------------------------------------------------------------ ResNet-50
+def _conv_bn(gb, name, n_out, kernel, stride, input_name, activation="identity",
+             mode="same"):
+    gb.add_layer(f"{name}_conv",
+                 ConvolutionLayer(n_out=n_out, kernel_size=kernel, stride=stride,
+                                  convolution_mode=mode, has_bias=False,
+                                  activation="identity"),
+                 input_name)
+    gb.add_layer(f"{name}_bn", BatchNormalization(activation=activation),
+                 f"{name}_conv")
+    return f"{name}_bn"
+
+
+def _bottleneck(gb, name, in_name, filters, stride, project):
+    """ResNet v1 bottleneck: 1x1 reduce → 3x3 → 1x1 expand, +shortcut.
+    ``ResNet50.java`` convBlock/identityBlock parity."""
+    f1, f2, f3 = filters
+    x = _conv_bn(gb, f"{name}_a", f1, (1, 1), stride, in_name, activation="relu")
+    x = _conv_bn(gb, f"{name}_b", f2, (3, 3), (1, 1), x, activation="relu")
+    x = _conv_bn(gb, f"{name}_c", f3, (1, 1), (1, 1), x, activation="identity")
+    if project:
+        shortcut = _conv_bn(gb, f"{name}_proj", f3, (1, 1), stride, in_name,
+                            activation="identity")
+    else:
+        shortcut = in_name
+    gb.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), x, shortcut)
+    gb.add_layer(f"{name}_out", ActivationLayer(activation="relu"), f"{name}_add")
+    return f"{name}_out"
+
+
+def resnet50(seed: int = 123, num_classes: int = 1000, height: int = 224,
+             width: int = 224, channels: int = 3, updater=None) -> ComputationGraph:
+    """ResNet50.java parity: [3, 4, 6, 3] bottleneck stages — the BASELINE
+    headline model.  NHWC + channels-last BN; stride-2 downsampling in the
+    first block of stages 3-5 (v1)."""
+    gb = (NeuralNetConfiguration.builder()
+          .seed(seed)
+          .updater(updater or Nesterovs(1e-1, 0.9))
+          .weight_init("relu")
+          .l2(1e-4)
+          .graph()
+          .add_inputs("in")
+          .set_input_types(InputType.convolutional(height, width, channels)))
+    gb.add_layer("stem_pad", ZeroPaddingLayer(padding=(3, 3)), "in")
+    x = _conv_bn(gb, "stem", 64, (7, 7), (2, 2), "stem_pad", activation="relu",
+                 mode="truncate")
+    gb.add_layer("stem_pool",
+                 SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                  stride=(2, 2), convolution_mode="same"), x)
+    x = "stem_pool"
+    stages = [
+        ("res2", [64, 64, 256], 3, (1, 1)),
+        ("res3", [128, 128, 512], 4, (2, 2)),
+        ("res4", [256, 256, 1024], 6, (2, 2)),
+        ("res5", [512, 512, 2048], 3, (2, 2)),
+    ]
+    for stage_name, filters, blocks, first_stride in stages:
+        for i in range(blocks):
+            x = _bottleneck(gb, f"{stage_name}_{i}", x, filters,
+                            first_stride if i == 0 else (1, 1), project=i == 0)
+    gb.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), x)
+    gb.add_layer("out", OutputLayer(n_out=num_classes, activation="softmax",
+                                    loss="mcxent"), "avgpool")
+    gb.set_outputs("out")
+    return ComputationGraph(gb.build())
+
+
+# ------------------------------------------------------------------ RNN zoo
+def lstm_classifier(seed: int = 123, n_in: int = 9, n_classes: int = 6,
+                    timesteps: Optional[int] = 128, hidden: int = 128,
+                    graves: bool = True, updater=None) -> MultiLayerNetwork:
+    """UCI-HAR / sequence-classification workload (BASELINE config #3):
+    GravesLSTM → LastTimeStep → OutputLayer(MCXENT)."""
+    cell = GravesLSTM(n_out=hidden) if graves else LSTM(n_out=hidden)
+    return MultiLayerNetwork(
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(updater or Adam(5e-3))
+        .weight_init("xavier")
+        .gradient_normalization("clip_element_wise_absolute_value", 0.5)
+        .list()
+        .layer(LastTimeStep(underlying=cell))
+        .layer(OutputLayer(n_out=n_classes, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.recurrent(n_in, timesteps))
+        .build())
+
+
+def text_gen_lstm(seed: int = 123, vocab_size: int = 77, hidden: int = 256,
+                  timesteps: Optional[int] = None, layers: int = 2) -> MultiLayerNetwork:
+    """TextGenerationLSTM.java / char-RNN parity: stacked GravesLSTM +
+    per-timestep softmax with tBPTT."""
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed)
+         .updater(Adam(2e-3))
+         .weight_init("xavier")
+         .gradient_normalization("clip_element_wise_absolute_value", 1.0)
+         .list())
+    for _ in range(layers):
+        b.layer(GravesLSTM(n_out=hidden, activation="tanh"))
+    b.layer(RnnOutputLayer(n_out=vocab_size, activation="softmax", loss="mcxent"))
+    b.set_input_type(InputType.recurrent(vocab_size, timesteps))
+    b.backprop_type("tbptt", 50, 50)
+    return MultiLayerNetwork(b.build())

@@ -1,0 +1,17 @@
+"""Native (C++) host-side components, loaded via ctypes.
+
+Where the reference is native (libnd4j's codec kernels, image pipeline),
+the TPU build keeps host-side native code too (SURVEY.md §7.1 ``native/``):
+
+- ``codec`` — threshold/bitmap gradient codec (libnd4j
+  encodeThresholdP1..P3/encodeBitmap parity) for the DCN compression path.
+
+Compiled on first use with g++ (no pybind11 in the image — plain C ABI +
+ctypes); every native function has a numpy reference implementation in
+``deeplearning4j_tpu.parallel.compression`` that is the test oracle, and
+callers fall back to it automatically when no compiler is available.
+"""
+
+from deeplearning4j_tpu.native import codec
+
+__all__ = ["codec"]

@@ -1,0 +1,132 @@
+"""ctypes loader/wrapper for the native threshold codec.
+
+Builds ``libthreshold_codec.so`` from ``src/threshold_codec.cpp`` with g++
+on first use (cached next to the source; rebuilt when the source is
+newer).  ``available()`` gates callers; the numpy implementation in
+``parallel.compression`` is the fallback and the correctness oracle.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "src", "threshold_codec.cpp")
+_LIB = os.path.join(os.path.dirname(__file__), "src", "libthreshold_codec.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_build_failed = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", _LIB, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            FileNotFoundError):
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        needs_build = (not os.path.exists(_LIB)
+                       or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+        if needs_build and not _build():
+            _build_failed = True
+            return None
+        lib = ctypes.CDLL(_LIB)
+        lib.threshold_count.restype = ctypes.c_int64
+        lib.threshold_count.argtypes = [ctypes.POINTER(ctypes.c_float),
+                                        ctypes.c_int64, ctypes.c_float]
+        lib.threshold_encode.restype = ctypes.c_int64
+        lib.threshold_encode.argtypes = [ctypes.POINTER(ctypes.c_float),
+                                         ctypes.c_int64, ctypes.c_float,
+                                         ctypes.POINTER(ctypes.c_int32),
+                                         ctypes.c_int64]
+        lib.threshold_decode.restype = None
+        lib.threshold_decode.argtypes = [ctypes.POINTER(ctypes.c_int32),
+                                         ctypes.POINTER(ctypes.c_float),
+                                         ctypes.c_int64]
+        lib.bitmap_encode.restype = ctypes.c_int64
+        lib.bitmap_encode.argtypes = [ctypes.POINTER(ctypes.c_float),
+                                      ctypes.c_int64, ctypes.c_float,
+                                      ctypes.POINTER(ctypes.c_uint8)]
+        lib.bitmap_decode.restype = None
+        lib.bitmap_decode.argtypes = [ctypes.POINTER(ctypes.c_uint8),
+                                      ctypes.c_int64, ctypes.c_float,
+                                      ctypes.POINTER(ctypes.c_float)]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def threshold_count(grad: np.ndarray, threshold: float) -> int:
+    lib = _load()
+    grad = np.ascontiguousarray(grad, dtype=np.float32).ravel()
+    return int(lib.threshold_count(_fptr(grad), grad.size, threshold))
+
+
+def threshold_encode(grad: np.ndarray, threshold: float,
+                     max_elements: int | None = None) -> np.ndarray:
+    lib = _load()
+    grad = np.ascontiguousarray(grad, dtype=np.float32).ravel()
+    cap = grad.size if max_elements is None else min(max_elements, grad.size)
+    out = np.zeros(3 + cap, dtype=np.int32)
+    n = lib.threshold_encode(_fptr(grad), grad.size, threshold,
+                             out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                             cap)
+    return out[:3 + int(n)]
+
+
+def threshold_decode(message: np.ndarray, shape: tuple,
+                     out: np.ndarray | None = None) -> np.ndarray:
+    lib = _load()
+    message = np.ascontiguousarray(message, dtype=np.int32)
+    size = int(np.prod(shape))
+    buf = (np.zeros(size, dtype=np.float32) if out is None
+           else np.ascontiguousarray(out, dtype=np.float32).ravel().copy())
+    lib.threshold_decode(message.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                         _fptr(buf), size)
+    return buf.reshape(shape)
+
+
+def bitmap_encode(grad: np.ndarray, threshold: float) -> tuple[np.ndarray, np.ndarray]:
+    lib = _load()
+    grad = np.ascontiguousarray(grad, dtype=np.float32).ravel()
+    packed = np.zeros((grad.size + 3) // 4, dtype=np.uint8)
+    lib.bitmap_encode(_fptr(grad), grad.size, threshold,
+                      packed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    header = np.array([grad.size, np.float32(threshold).view(np.int32)],
+                      dtype=np.int64)
+    return packed, header
+
+
+def bitmap_decode(packed: np.ndarray, header: np.ndarray,
+                  out: np.ndarray | None = None) -> np.ndarray:
+    lib = _load()
+    n = int(header[0])
+    threshold = float(np.array(int(header[1]), dtype=np.int32).view(np.float32))
+    buf = (np.zeros(n, dtype=np.float32) if out is None
+           else np.ascontiguousarray(out, dtype=np.float32).ravel().copy())
+    packed = np.ascontiguousarray(packed, dtype=np.uint8)
+    lib.bitmap_decode(packed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                      n, threshold, _fptr(buf))
+    return buf

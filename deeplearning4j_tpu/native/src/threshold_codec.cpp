@@ -1,0 +1,108 @@
+// Threshold/bitmap gradient codec — native twin of
+// deeplearning4j_tpu/parallel/compression.py.
+//
+// Parity target: libnd4j's C ABI codec entry points (legacy/NativeOps.h:
+// encodeThresholdP1/P2/P3, decodeThreshold, encodeBitmap, decodeBitmap).
+// The reference splits encode into three passes so the CUDA kernels can
+// parallelize (count → prefix-sum → extract); on the host the same
+// structure parallelizes across threads with per-chunk counts + offsets.
+//
+// Wire format (matches the python reference implementation):
+//   int32[0] = number of encoded indices (n)
+//   int32[1] = flags (reserved, 0)
+//   int32[2] = threshold float bits
+//   int32[3..3+n) = ±(index+1)  (sign carries the gradient's sign)
+//
+// Build: g++ -O3 -march=native -shared -fPIC -o libthreshold_codec.so
+//        threshold_codec.cpp  (see deeplearning4j_tpu/native/codec.py)
+
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+#include <vector>
+#include <thread>
+#include <algorithm>
+
+extern "C" {
+
+// Pass 1: count entries with |g| >= threshold (chunked, multi-threaded).
+int64_t threshold_count(const float* grad, int64_t n, float threshold) {
+    unsigned hw = std::thread::hardware_concurrency();
+    int n_threads = std::max(1u, std::min(hw, 16u));
+    if (n < (1 << 16)) n_threads = 1;
+    std::vector<int64_t> counts(n_threads, 0);
+    std::vector<std::thread> threads;
+    int64_t chunk = (n + n_threads - 1) / n_threads;
+    for (int t = 0; t < n_threads; ++t) {
+        threads.emplace_back([&, t]() {
+            int64_t lo = t * chunk, hi = std::min(n, lo + chunk);
+            int64_t c = 0;
+            for (int64_t i = lo; i < hi; ++i)
+                if (std::fabs(grad[i]) >= threshold) ++c;
+            counts[t] = c;
+        });
+    }
+    for (auto& th : threads) th.join();
+    int64_t total = 0;
+    for (auto c : counts) total += c;
+    return total;
+}
+
+// Passes 2+3 fused: write the message. `out` must hold 3 + max_elements
+// int32s. Returns number of encoded indices (clamped to max_elements).
+int64_t threshold_encode(const float* grad, int64_t n, float threshold,
+                         int32_t* out, int64_t max_elements) {
+    int64_t written = 0;
+    for (int64_t i = 0; i < n && written < max_elements; ++i) {
+        float g = grad[i];
+        if (std::fabs(g) >= threshold) {
+            int64_t idx1 = i + 1;
+            out[3 + written] = (int32_t)(g >= 0.0f ? idx1 : -idx1);
+            ++written;
+        }
+    }
+    out[0] = (int32_t)written;
+    out[1] = 0;
+    float th = threshold;
+    std::memcpy(&out[2], &th, sizeof(float));
+    return written;
+}
+
+// Decode: add ±threshold into `out` (accumulate semantics, matching
+// decodeThreshold applying into the updater stream).
+void threshold_decode(const int32_t* message, float* out, int64_t out_len) {
+    int64_t n = message[0];
+    float threshold;
+    std::memcpy(&threshold, &message[2], sizeof(float));
+    for (int64_t i = 0; i < n; ++i) {
+        int32_t e = message[3 + i];
+        int64_t idx = (e > 0 ? e : -e) - 1;
+        if (idx < out_len) out[idx] += (e > 0 ? threshold : -threshold);
+    }
+}
+
+// Bitmap codec: 2 bits/element, 0=zero 1=+t 2=-t, 4 codes per byte.
+int64_t bitmap_encode(const float* grad, int64_t n, float threshold,
+                      uint8_t* packed) {
+    int64_t n_bytes = (n + 3) / 4;
+    std::memset(packed, 0, n_bytes);
+    int64_t non_zero = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        uint8_t code = 0;
+        if (grad[i] >= threshold) { code = 1; ++non_zero; }
+        else if (grad[i] <= -threshold) { code = 2; ++non_zero; }
+        packed[i >> 2] |= (uint8_t)(code << ((i & 3) * 2));
+    }
+    return non_zero;
+}
+
+void bitmap_decode(const uint8_t* packed, int64_t n, float threshold,
+                   float* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        uint8_t code = (packed[i >> 2] >> ((i & 3) * 2)) & 0x3;
+        if (code == 1) out[i] += threshold;
+        else if (code == 2) out[i] -= threshold;
+    }
+}
+
+}  // extern "C"

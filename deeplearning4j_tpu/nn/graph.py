@@ -321,7 +321,7 @@ class ComputationGraph:
 
     # ---------------------------------------------------------- training
     def score(self) -> float:
-        return self._score
+        return float(self._score)
 
     def fit(self, iterator, epochs: int = 1, listeners=None):
         from deeplearning4j_tpu.train.trainer import Trainer

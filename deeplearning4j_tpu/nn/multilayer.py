@@ -111,8 +111,9 @@ class MultiLayerNetwork:
 
     # ---------------------------------------------------------- training
     def score(self) -> float:
-        """Loss of the most recent fit minibatch (``score()``)."""
-        return self._score
+        """Loss of the most recent fit minibatch (``score()``); syncs the
+        device scalar on read."""
+        return float(self._score)
 
     def fit(self, iterator, epochs: int = 1, listeners=None):
         from deeplearning4j_tpu.train.trainer import Trainer

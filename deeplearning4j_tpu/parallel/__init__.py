@@ -1,0 +1,45 @@
+"""Distributed training & parallelism — the north-star replacement for the
+reference's three-transport stack (SURVEY.md §2.7/§3.4: Spark TCP
+orchestration + Aeron UDP parameter-server mesh + JNI threshold codecs).
+
+On TPU the whole pyramid collapses into compiler-scheduled collectives
+over ICI/DCN inside jit-compiled programs:
+
+- ``mesh``              — device mesh builder (axes data/model/seq/stage),
+                          multi-slice/DCN aware (MeshOrganizer parity — the
+                          tree-mesh bookkeeping is jax runtime's job now).
+- ``data_parallel``     — DP trainer: batch sharded over ``data``, gradient
+                          allreduce = psum emitted by GSPMD (ParallelWrapper
+                          + SharedTrainingMaster/ParameterAveraging parity;
+                          synchronous dense allreduce replaces the async
+                          threshold-encoded Aeron path per BASELINE.json).
+- ``tensor_parallel``   — NamedSharding rules for BERT-class models over
+                          the ``model`` axis (capability beyond reference).
+- ``context_parallel``  — ring attention over the ``seq`` axis
+                          (shard_map + ppermute, online softmax; beyond
+                          reference — SURVEY.md §5.7).
+- ``pipeline``          — GPipe-style microbatched stage parallelism over
+                          the ``stage`` axis (beyond reference).
+- ``compression``       — threshold/bitmap gradient codec + residual
+                          accumulator (EncodedGradientsAccumulator +
+                          encodeThresholdP1..P3/encodeBitmap parity) for the
+                          optional DCN path; C++ kernel in ``native/``.
+- ``inference``         — ParallelInference parity: dynamic batching queue
+                          over jit'd replicas.
+- ``launcher``          — multi-host SPMD bootstrap (jax.distributed),
+                          replacing Spark orchestration.
+"""
+
+from deeplearning4j_tpu.parallel.mesh import make_mesh, MeshSpec
+from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+from deeplearning4j_tpu.parallel.compression import (
+    threshold_encode, threshold_decode, bitmap_encode, bitmap_decode,
+    EncodedGradientsAccumulator,
+)
+from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+__all__ = [
+    "make_mesh", "MeshSpec", "ParallelWrapper",
+    "threshold_encode", "threshold_decode", "bitmap_encode", "bitmap_decode",
+    "EncodedGradientsAccumulator", "ParallelInference",
+]

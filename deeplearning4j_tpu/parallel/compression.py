@@ -1,0 +1,171 @@
+"""Gradient compression codec — threshold + bitmap encoding.
+
+Parity with libnd4j's wire codecs (``NativeOps.h``:
+``encodeThresholdP1/P2/P3``, ``decodeThreshold``, ``encodeBitmap``,
+``decodeBitmap``; SURVEY.md §2.1) and DL4J's residual machinery
+(``deeplearning4j-nn org/deeplearning4j/optimize/solvers/accumulation/``:
+``EncodedGradientsAccumulator``, ``encoding/ThresholdAlgorithm``
+(AdaptiveThresholdAlgorithm), ``ResidualPostProcessor``).
+
+Wire format (threshold): int32 array [n_encoded, flags, threshold_bits,
+idx0, idx1, ...] where index sign encodes the value sign — entry i>0 means
++threshold at position i-1, i<0 means -threshold at position |i|-1
+(matching the reference's ±(idx+1) convention).  Decode applies
+±threshold at those positions; the quantization residual (g - decoded)
+carries forward (error feedback).
+
+On-TPU role: intra-slice allreduce is dense psum (ICI makes the codec
+pointless there); this codec is the optional DCN cross-slice compressor.
+The hot encode loop has a C++ twin in ``deeplearning4j_tpu/native``
+(ctypes); this module is the reference implementation + the accumulator
+semantics, and is the ground truth for the native kernel's tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+FLAG_SIGN_IDX = 0  # format marker (reserved, matches reference header slot use)
+
+
+def threshold_encode(grad: np.ndarray, threshold: float,
+                     max_elements: Optional[int] = None) -> np.ndarray:
+    """3-pass threshold encode (P1 count → P2 prefix/index → P3 extract,
+    collapsed here; the pass structure matters only for the parallel C++/
+    Pallas kernels).  Returns int32 message [count, 0, threshold_bits,
+    ±(idx+1)...]."""
+    flat = np.ravel(np.asarray(grad, dtype=np.float32))
+    hits = np.nonzero(np.abs(flat) >= threshold)[0]
+    if max_elements is not None and hits.size > max_elements:
+        hits = hits[:max_elements]
+    signs = np.where(flat[hits] >= 0, 1, -1).astype(np.int64)
+    encoded = (signs * (hits + 1)).astype(np.int32)
+    header = np.array([encoded.size, FLAG_SIGN_IDX,
+                       np.float32(threshold).view(np.int32)], dtype=np.int32)
+    return np.concatenate([header, encoded])
+
+
+def threshold_decode(message: np.ndarray, shape: tuple,
+                     out: Optional[np.ndarray] = None) -> np.ndarray:
+    """Decode into a dense array of ``shape`` (adds into ``out`` when given,
+    matching decodeThreshold's accumulate-into-target semantics)."""
+    message = np.asarray(message, dtype=np.int32)
+    count = int(message[0])
+    threshold = message[2:3].view(np.float32)[0]
+    body = message[3:3 + count].astype(np.int64)
+    if out is None:
+        out = np.zeros(int(np.prod(shape)), dtype=np.float32)
+    else:
+        out = np.ravel(out)
+    idx = np.abs(body) - 1
+    np.add.at(out, idx, np.where(body > 0, threshold, -threshold).astype(np.float32))
+    return out.reshape(shape)
+
+
+def bitmap_encode(grad: np.ndarray, threshold: float) -> tuple[np.ndarray, np.ndarray]:
+    """Bitmap codec (``encodeBitmap``): dense fallback when >~1/16 of
+    entries exceed τ — 2 bits/element beats 32 bits/index.  Returns
+    (bitmap_packed_uint8, header) where 2-bit codes are 0=zero, 1=+τ, 2=-τ."""
+    flat = np.ravel(np.asarray(grad, dtype=np.float32))
+    codes = np.zeros(flat.size, dtype=np.uint8)
+    codes[flat >= threshold] = 1
+    codes[flat <= -threshold] = 2
+    # pack 4 codes per byte
+    pad = (-codes.size) % 4
+    codes_p = np.concatenate([codes, np.zeros(pad, np.uint8)])
+    packed = (codes_p[0::4] | (codes_p[1::4] << 2) | (codes_p[2::4] << 4)
+              | (codes_p[3::4] << 6))
+    return packed, np.array([flat.size, np.float32(threshold).view(np.int32)],
+                            dtype=np.int64)
+
+
+def bitmap_decode(packed: np.ndarray, header: np.ndarray,
+                  out: Optional[np.ndarray] = None) -> np.ndarray:
+    n = int(header[0])
+    threshold = float(np.array(int(header[1]), dtype=np.int32).view(np.float32))
+    codes = np.zeros(packed.size * 4, dtype=np.uint8)
+    codes[0::4] = packed & 0x3
+    codes[1::4] = (packed >> 2) & 0x3
+    codes[2::4] = (packed >> 4) & 0x3
+    codes[3::4] = (packed >> 6) & 0x3
+    codes = codes[:n]
+    decoded = np.zeros(n, dtype=np.float32)
+    decoded[codes == 1] = threshold
+    decoded[codes == 2] = -threshold
+    if out is not None:
+        decoded = decoded + np.ravel(out)
+    return decoded
+
+
+@dataclasses.dataclass
+class AdaptiveThresholdAlgorithm:
+    """``encoding/threshold/AdaptiveThresholdAlgorithm`` parity: steer τ so
+    the encoded fraction tracks a target sparsity."""
+
+    initial_threshold: float = 1e-3
+    target_sparsity: float = 1e-3   # fraction of elements encoded
+    decay: float = 0.95
+    min_threshold: float = 1e-5
+    max_threshold: float = 1.0
+
+    def __post_init__(self):
+        self._threshold = self.initial_threshold
+
+    def current(self) -> float:
+        return self._threshold
+
+    def update(self, n_encoded: int, n_total: int) -> float:
+        observed = n_encoded / max(n_total, 1)
+        if observed > self.target_sparsity * 1.5:
+            self._threshold = min(self._threshold / self.decay, self.max_threshold)
+        elif observed < self.target_sparsity / 1.5:
+            self._threshold = max(self._threshold * self.decay, self.min_threshold)
+        return self._threshold
+
+
+class EncodedGradientsAccumulator:
+    """Residual accumulator with error feedback
+    (``EncodedGradientsAccumulator.java``):
+
+        residual += grad
+        msg       = encode(residual, τ)      (τ from the threshold algorithm)
+        residual -= decode(msg)              (quantization error carried)
+
+    ``store_update`` returns the wire message; ``apply_update`` decodes a
+    peer's message into a parameter-delta buffer.  Used on the DCN path
+    (cross-slice) where dense allreduce is bandwidth-bound.
+    """
+
+    def __init__(self, shape: tuple,
+                 algorithm: Optional[AdaptiveThresholdAlgorithm] = None,
+                 use_native: bool = True):
+        self.shape = tuple(shape)
+        self.residual = np.zeros(int(np.prod(shape)), dtype=np.float32)
+        self.algorithm = algorithm or AdaptiveThresholdAlgorithm()
+        self._codec = None
+        if use_native:
+            try:
+                from deeplearning4j_tpu.native import codec as native_codec
+                self._codec = native_codec if native_codec.available() else None
+            except Exception:
+                self._codec = None
+
+    def store_update(self, grad: np.ndarray) -> np.ndarray:
+        self.residual += np.ravel(np.asarray(grad, dtype=np.float32))
+        threshold = self.algorithm.current()
+        if self._codec is not None:
+            message = self._codec.threshold_encode(self.residual, threshold)
+        else:
+            message = threshold_encode(self.residual, threshold)
+        n_encoded = int(message[0])
+        self.algorithm.update(n_encoded, self.residual.size)
+        decoded = threshold_decode(message, (self.residual.size,))
+        self.residual -= np.ravel(decoded)
+        return message
+
+    def apply_update(self, message: np.ndarray, target: np.ndarray) -> np.ndarray:
+        """Decode ``message`` and add into ``target`` (UpdatesConsumer parity)."""
+        return threshold_decode(message, self.shape, out=target)

@@ -1,0 +1,112 @@
+"""Context / sequence parallelism — ring attention.
+
+Capability BEYOND the reference (SURVEY.md §5.7: the reference's
+``dot_product_attention`` materializes O(T²) scores, practical max a few
+thousand tokens).  Here sequences shard over the mesh ``seq`` axis;
+each device holds a [B, T/n, ...] slice, K/V blocks rotate around the
+ring via ``ppermute`` (ICI neighbor links — ring topology matches TPU
+torus), and softmax is accumulated online (running max + normalizer), so
+per-device memory is O(T/n · T/n) per step and the full [T,T] matrix
+never exists.
+
+Ring vs Ulysses decision (SURVEY.md §5.7): ring's neighbor-only traffic
+fits ICI better than all-to-all head-resharding at pod scale — this is
+the default CP strategy.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+NEG_INF = -1e30
+
+
+def _block_attention(q, k, v, scale, mask):
+    """Scores for one (q-block, kv-block) pair.
+    q [B,H,Tq,D], k/v [B,H,Tk,D], mask broadcastable [Tq,Tk] or None.
+    Returns (unnormalized out, row max, row sumexp)."""
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if mask is not None:
+        scores = jnp.where(mask, scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)                      # [B,H,Tq]
+    p = jnp.exp(scores - m[..., None])
+    if mask is not None:
+        # rows with no visible keys: exp(NEG_INF - NEG_INF) = 1 → zero them
+        any_visible = jnp.any(mask, axis=-1)          # [Tq,Tk] → [Tq]
+        p = p * jnp.broadcast_to(any_visible[None, None, :, None], p.shape)
+        m = jnp.where(any_visible[None, None, :], m, NEG_INF)
+    l = jnp.sum(p, axis=-1)                           # [B,H,Tq]
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return o, m, l
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   mesh: Mesh, axis: str = "seq", n_heads: int = 1,
+                   causal: bool = False) -> jnp.ndarray:
+    """Multi-head ring attention.  q/k/v: [B, T, H*D] GLOBALLY, sharded
+    over ``axis`` on dim 1.  Returns [B, T, H*D] with the same sharding.
+
+    Inside shard_map each device sees its local [B, T/n, H*D] slice; K/V
+    rotate n steps around the ring; online-softmax accumulators merge
+    per-block partial results exactly.
+    """
+    n_dev = mesh.shape[axis]
+
+    def local(q, k, v):
+        b, t_local, dmodel = q.shape
+        dh = dmodel // n_heads
+        scale = 1.0 / math.sqrt(dh)
+        qh = q.reshape(b, t_local, n_heads, dh).transpose(0, 2, 1, 3)
+        kh = k.reshape(b, t_local, n_heads, dh).transpose(0, 2, 1, 3)
+        vh = v.reshape(b, t_local, n_heads, dh).transpose(0, 2, 1, 3)
+        my_idx = lax.axis_index(axis)
+
+        def step(carry, s):
+            k_blk, v_blk, o, m, l = carry
+            src_idx = (my_idx - s) % n_dev  # which device this kv block came from
+            if causal:
+                q_pos = my_idx * t_local + jnp.arange(t_local)
+                k_pos = src_idx * t_local + jnp.arange(t_local)
+                mask = q_pos[:, None] >= k_pos[None, :]
+            else:
+                mask = None
+            o_b, m_b, l_b = _block_attention(qh, k_blk, v_blk, scale, mask)
+            # merge online-softmax accumulators
+            m_new = jnp.maximum(m, m_b)
+            c_old = jnp.exp(m - m_new)
+            c_blk = jnp.exp(m_b - m_new)
+            o = o * c_old[..., None] + o_b * c_blk[..., None]
+            l = l * c_old + l_b * c_blk
+            # rotate kv to the next device (neighbor ring over ICI)
+            perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+            k_blk = lax.ppermute(k_blk, axis, perm)
+            v_blk = lax.ppermute(v_blk, axis, perm)
+            return (k_blk, v_blk, o, m_new, l), None
+
+        # initial accumulators must be marked device-varying for the scan
+        # carry to type-check under shard_map's VMA tracking
+        o0 = jnp.zeros_like(qh)
+        m0 = lax.pcast(jnp.full(qh.shape[:-1], NEG_INF, qh.dtype), (axis,), to="varying")
+        l0 = lax.pcast(jnp.zeros(qh.shape[:-1], qh.dtype), (axis,), to="varying")
+        (k_f, v_f, o, m, l), _ = lax.scan(step, (kh, vh, o0, m0, l0),
+                                          jnp.arange(n_dev))
+        out = o / jnp.maximum(l[..., None], 1e-20)
+        return out.transpose(0, 2, 1, 3).reshape(b, t_local, dmodel)
+
+    spec = P(None, axis, None)
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)(q, k, v)
+
+
+def reference_attention(q, k, v, n_heads: int, causal: bool = False):
+    """Single-device ground truth for ring_attention tests."""
+    from deeplearning4j_tpu.ops.attention import multi_head_attention
+    return multi_head_attention(q, k, v, n_heads=n_heads, causal=causal)

@@ -1,0 +1,86 @@
+"""ParallelInference — dynamic-batching inference server.
+
+Parity with DL4J ``deeplearning4j-scaleout-parallelwrapper
+.../inference/ParallelInference.java`` (+ ``BatchedInferenceObservable``):
+callers submit single inputs from many threads; a worker drains the queue,
+concatenates up to ``batch_limit`` inputs, runs ONE jit'd forward, and
+scatters results back to the waiting callers.
+
+On TPU one jit'd replica saturates the chip, so the reference's
+device-affine replica threads collapse to a single worker per device;
+replicas across devices come from running one ParallelInference per
+process in SPMD (or sharding the batch axis via ParallelWrapper's mesh).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ParallelInference:
+    def __init__(self, model, batch_limit: int = 32, queue_limit: int = 64,
+                 timeout_ms: float = 5.0):
+        """model: anything with ``output(x)`` (MultiLayerNetwork /
+        ComputationGraph) — called with [B, ...] batches."""
+        self.model = model
+        self.batch_limit = batch_limit
+        self.timeout_s = timeout_ms / 1000.0
+        self._queue: queue.Queue = queue.Queue(maxsize=queue_limit)
+        self._shutdown = threading.Event()
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def output(self, x) -> np.ndarray:
+        """Blocking single-example (or small-batch) inference."""
+        return self.output_async(x).result()
+
+    def output_async(self, x) -> Future:
+        future: Future = Future()
+        self._queue.put((np.asarray(x), future))
+        return future
+
+    def _run(self):
+        while not self._shutdown.is_set():
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            pending = [first]
+            total = first[0].shape[0]
+            # drain quickly-arriving requests up to the batch limit
+            while total < self.batch_limit:
+                try:
+                    item = self._queue.get(timeout=self.timeout_s)
+                    pending.append(item)
+                    total += item[0].shape[0]
+                except queue.Empty:
+                    break
+            try:
+                batch = np.concatenate([x for x, _ in pending], axis=0)
+                out = np.asarray(self.model.output(batch))
+                offset = 0
+                for x, future in pending:
+                    n = x.shape[0]
+                    future.set_result(out[offset:offset + n])
+                    offset += n
+            except BaseException as e:
+                for _, future in pending:
+                    if not future.done():
+                        future.set_exception(e)
+
+    def shutdown(self):
+        self._shutdown.set()
+        self._worker.join(timeout=2.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()

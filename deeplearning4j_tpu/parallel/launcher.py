@@ -1,0 +1,103 @@
+"""Multi-host SPMD bootstrap — the Spark-orchestration replacement.
+
+Parity with the reference's cluster story (SURVEY.md §2.7/§3.4: Spark
+driver broadcasts the model, launches one long-lived worker per executor,
+Aeron mesh forms via driver handshake): on TPU pods the runtime IS the
+cluster — one process per host, ``jax.distributed.initialize`` handshakes
+with the coordinator, and every jit'd step runs gang-scheduled SPMD.
+
+Also provides the multi-process CPU test rig (DummyTransport parity,
+SURVEY.md §4.2): spawn N local processes over loopback with
+``spawn_local_cluster`` and run a function under a real multi-process
+``jax.distributed`` runtime without any TPU pod.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+from typing import Callable, Optional
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """``jax.distributed.initialize`` with env-var fallbacks
+    (DL4J VoidConfiguration's controller address/ports equivalent).
+    No-ops on single-process runs."""
+    import jax
+    coordinator_address = coordinator_address or os.environ.get("DL4J_TPU_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("DL4J_TPU_NUM_PROCESSES", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("DL4J_TPU_PROCESS_ID", "0"))
+    if num_processes <= 1:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+_WORKER_TEMPLATE = r"""
+import os, pickle, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count={local_devices}")
+import jax
+jax.distributed.initialize(coordinator_address="127.0.0.1:{port}",
+                           num_processes={n}, process_id={pid})
+with open({fn_path!r}, "rb") as f:
+    fn = pickle.load(f)
+result = fn(jax.process_index(), jax.process_count())
+with open({out_path!r}, "wb") as f:
+    pickle.dump(result, f)
+"""
+
+
+def spawn_local_cluster(fn: Callable, n_processes: int = 2, port: int = 12655,
+                        local_devices: int = 1, timeout: float = 120.0,
+                        extra_env: Optional[dict] = None) -> list:
+    """Run ``fn(process_index, process_count)`` in N fresh local processes
+    under a real jax.distributed runtime (CPU, loopback).  Returns each
+    process's pickled return value.  ``fn`` must be picklable (module-level
+    function).  This is the test rig for launcher/checkpoint/fault-
+    tolerance paths — the DummyTransport translation."""
+    workdir = tempfile.mkdtemp(prefix="dl4j_tpu_cluster_")
+    fn_path = os.path.join(workdir, "fn.pkl")
+    with open(fn_path, "wb") as f:
+        pickle.dump(fn, f)
+    procs = []
+    out_paths = []
+    for pid in range(n_processes):
+        out_path = os.path.join(workdir, f"out_{pid}.pkl")
+        out_paths.append(out_path)
+        script = _WORKER_TEMPLATE.format(n=n_processes, pid=pid, port=port,
+                                         fn_path=fn_path, out_path=out_path,
+                                         local_devices=local_devices)
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)  # template sets its own
+        if extra_env:
+            env.update(extra_env)
+        procs.append(subprocess.Popen([sys.executable, "-c", script], env=env,
+                                      stdout=subprocess.PIPE,
+                                      stderr=subprocess.PIPE))
+    results = []
+    errors = []
+    for pid, proc in enumerate(procs):
+        try:
+            _, stderr = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            errors.append(f"process {pid} timed out")
+            continue
+        if proc.returncode != 0:
+            errors.append(f"process {pid} rc={proc.returncode}: "
+                          f"{stderr.decode()[-800:]}")
+        elif os.path.exists(out_paths[pid]):
+            with open(out_paths[pid], "rb") as f:
+                results.append(pickle.load(f))
+    if errors:
+        raise RuntimeError("local cluster failed:\n" + "\n".join(errors))
+    return results

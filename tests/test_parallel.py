@@ -1,0 +1,188 @@
+"""Distributed/parallelism tests on the 8-device virtual CPU mesh
+(multi-host-without-a-cluster, SURVEY.md §4.2 #3)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu.parallel import (
+    make_mesh, ParallelWrapper, threshold_encode, threshold_decode,
+    bitmap_encode, bitmap_decode, EncodedGradientsAccumulator,
+    ParallelInference,
+)
+from deeplearning4j_tpu.parallel.context_parallel import ring_attention, reference_attention
+from deeplearning4j_tpu.parallel.pipeline import pipeline_apply
+from deeplearning4j_tpu.parallel import tensor_parallel as tp
+from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn import NeuralNetConfiguration, InputType
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.train import Sgd
+from deeplearning4j_tpu.train.trainer import Trainer
+
+
+def _mlp(seed=11):
+    return MultiLayerNetwork(
+        NeuralNetConfiguration.builder()
+        .seed(seed).updater(Sgd(0.1)).weight_init("xavier").list()
+        .layer(DenseLayer(n_out=16, activation="relu"))
+        .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(8))
+        .build())
+
+
+def _toy_data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 4)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[np.argmax(x @ w, -1)]
+    return x, y
+
+
+def test_make_mesh_axes():
+    mesh = make_mesh()
+    assert mesh.shape["data"] == 8
+    mesh2 = make_mesh(data=2, model=2, seq=2)
+    assert mesh2.shape == {"stage": 1, "data": 2, "seq": 2, "model": 2}
+    with pytest.raises(ValueError):
+        make_mesh(data=3, model=3)
+
+
+def test_data_parallel_matches_single_device():
+    """DP over 8 shards must produce the same params as single-device
+    training on the same batches (sync dense allreduce == exact)."""
+    x, y = _toy_data(64)
+    it = lambda: ArrayDataSetIterator(x, y, 32)  # noqa: E731
+
+    net_a = _mlp()
+    Trainer(net_a).fit(it(), epochs=3)
+
+    net_b = _mlp()
+    ParallelWrapper(net_b, mesh=make_mesh(data=8)).fit(it(), epochs=3)
+
+    np.testing.assert_allclose(np.asarray(net_a.params()),
+                               np.asarray(net_b.params()), rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_reference(causal):
+    mesh = make_mesh(data=1, seq=8)
+    b, t, heads, dh = 2, 32, 4, 8
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.normal(size=(b, t, heads * dh)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(b, t, heads * dh)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(b, t, heads * dh)).astype(np.float32))
+    with mesh:
+        out = ring_attention(q, k, v, mesh, axis="seq", n_heads=heads, causal=causal)
+    ref = reference_attention(q, k, v, n_heads=heads, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_matches_sequential():
+    mesh = make_mesh(data=1, stage=8)
+    n_stages, width, batch, micro = 8, 16, 32, 4
+    rng = np.random.default_rng(5)
+    stage_w = jnp.asarray(rng.normal(0, 0.3, size=(n_stages, width, width)).astype(np.float32))
+
+    def stage_fn(params, x):
+        return jnp.tanh(x @ params)
+
+    x = jnp.asarray(rng.normal(size=(batch, width)).astype(np.float32))
+    with mesh:
+        y = pipeline_apply(stage_fn, stage_w, x, mesh, n_microbatches=micro)
+    ref = x
+    for s in range(n_stages):
+        ref = jnp.tanh(ref @ stage_w[s])
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_tensor_parallel_bert_layer():
+    """TP-sharded tiny BERT forward == replicated forward."""
+    from deeplearning4j_tpu.models import bert
+    config = bert.BertConfig.tiny()
+    params = bert.init_params(config, jax.random.key(0))
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 1000, (2, 16)).astype(np.int32))
+
+    ref = bert.encode(params, config, ids)
+
+    mesh = make_mesh(data=1, model=8)
+    sharded = tp.shard_params(params, mesh)
+    out = jax.jit(lambda p, i: bert.encode(p, config, i))(sharded, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+    # verify something actually sharded
+    qk = sharded["encoder"]["layer_0"]["attention"]["query"]["kernel"]
+    assert len(qk.sharding.spec) >= 2 and qk.sharding.spec[1] == "model"
+
+
+# ------------------------------------------------------------------ codec
+def test_threshold_codec_roundtrip():
+    rng = np.random.default_rng(0)
+    grad = rng.normal(0, 1e-3, size=10000).astype(np.float32)
+    grad[rng.choice(10000, 50, replace=False)] = rng.normal(0, 1.0, 50)
+    msg = threshold_encode(grad, 0.1)
+    decoded = threshold_decode(msg, grad.shape)
+    # decoded has ±0.1 exactly at |grad|>=0.1 positions
+    hits = np.abs(grad) >= 0.1
+    assert int(msg[0]) == hits.sum()
+    np.testing.assert_array_equal(decoded != 0, hits)
+    np.testing.assert_allclose(np.abs(decoded[hits]), 0.1, rtol=1e-6)
+    np.testing.assert_array_equal(np.sign(decoded[hits]), np.sign(grad[hits]))
+
+
+def test_bitmap_codec_roundtrip():
+    rng = np.random.default_rng(1)
+    grad = rng.normal(0, 0.5, size=1001).astype(np.float32)
+    packed, header = bitmap_encode(grad, 0.3)
+    decoded = bitmap_decode(packed, header)
+    expect = np.where(grad >= 0.3, 0.3, np.where(grad <= -0.3, -0.3, 0.0)).astype(np.float32)
+    np.testing.assert_allclose(decoded, expect, rtol=1e-6)
+
+
+def test_accumulator_error_feedback():
+    """Residual carries quantization error: summed decoded messages converge
+    to the true gradient sum (the error-feedback property)."""
+    rng = np.random.default_rng(2)
+    n = 500
+    acc = EncodedGradientsAccumulator((n,), use_native=False)
+    true_sum = np.zeros(n, dtype=np.float32)
+    decoded_sum = np.zeros(n, dtype=np.float32)
+    for step in range(50):
+        g = rng.normal(0, 0.01, n).astype(np.float32)
+        true_sum += g
+        msg = acc.store_update(g)
+        decoded_sum = acc.apply_update(msg, decoded_sum)
+    # residual bounds the difference
+    np.testing.assert_allclose(decoded_sum + acc.residual, true_sum, atol=1e-4)
+
+
+def test_native_codec_matches_numpy():
+    from deeplearning4j_tpu.native import codec
+    if not codec.available():
+        pytest.skip("no g++ available")
+    rng = np.random.default_rng(3)
+    grad = rng.normal(0, 0.2, size=4097).astype(np.float32)
+    msg_native = codec.threshold_encode(grad, 0.25)
+    msg_numpy = threshold_encode(grad, 0.25)
+    np.testing.assert_array_equal(msg_native, msg_numpy)
+    np.testing.assert_allclose(codec.threshold_decode(msg_native, grad.shape),
+                               threshold_decode(msg_numpy, grad.shape), rtol=1e-6)
+    assert codec.threshold_count(grad, 0.25) == int(msg_numpy[0])
+    packed_n, header_n = codec.bitmap_encode(grad, 0.25)
+    packed_p, header_p = bitmap_encode(grad, 0.25)
+    np.testing.assert_array_equal(packed_n, packed_p)
+    np.testing.assert_allclose(codec.bitmap_decode(packed_n, header_n),
+                               bitmap_decode(packed_p, header_p), rtol=1e-6)
+
+
+def test_parallel_inference_batching():
+    net = _mlp()
+    net.init()
+    x, _ = _toy_data(16)
+    expected = np.asarray(net.output(x))
+    with ParallelInference(net, batch_limit=8) as pi:
+        futures = [pi.output_async(x[i:i + 1]) for i in range(16)]
+        results = [f.result(timeout=30) for f in futures]
+    got = np.concatenate(results, axis=0)
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
