@@ -86,7 +86,22 @@ class NormalizerStandardize(Normalizer):
         f = np.asarray(ds.features)
         shape = f.shape
         f = f.reshape(shape[0], -1) * self.std + self.mean
-        return DataSet(f.reshape(shape), ds.labels, ds.features_mask, ds.labels_mask)
+        labels = ds.labels
+        if self.fit_labels and labels is not None and self.label_mean is not None:
+            # reference NormalizerStandardize.revert = revertFeatures +
+            # revertLabels when label stats were fit
+            l = np.asarray(labels)
+            labels = (l.reshape(shape[0], -1) * self.label_std
+                      + self.label_mean).reshape(l.shape)
+        return DataSet(f.reshape(shape), labels, ds.features_mask, ds.labels_mask)
+
+    def revert_labels(self, labels):
+        """Un-normalize a labels/predictions array (``revertLabels``)."""
+        if not self.fit_labels or self.label_mean is None:
+            return labels
+        l = np.asarray(labels)
+        return (l.reshape(l.shape[0], -1) * self.label_std
+                + self.label_mean).reshape(l.shape)
 
     def _state(self):
         state = {"mean": self.mean, "std": self.std,

@@ -1,14 +1,21 @@
 """ctypes loader/wrapper for the native threshold codec.
 
 Builds ``libthreshold_codec.so`` from ``src/threshold_codec.cpp`` with g++
-on first use (cached next to the source; rebuilt when the source is
-newer).  ``available()`` gates callers; the numpy implementation in
-``parallel.compression`` is the fallback and the correctness oracle.
+on first use.  The build artifact is never committed; staleness is decided
+by a content hash of the source (git checkouts do not preserve mtimes), and
+a load failure of an existing binary (wrong arch/glibc) triggers one
+rebuild from source before giving up.  ``available()`` gates callers; the
+numpy implementation in ``parallel.compression`` is the fallback and the
+correctness oracle.
+
+Set ``DL4J_TPU_NATIVE_SANITIZE=1`` to compile with ASan/UBSan (used by the
+hygiene test lane; mirrors the reference's sanitizer builds of libnd4j).
 """
 
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -16,21 +23,72 @@ import threading
 import numpy as np
 
 _SRC = os.path.join(os.path.dirname(__file__), "src", "threshold_codec.cpp")
-_LIB = os.path.join(os.path.dirname(__file__), "src", "libthreshold_codec.so")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "src", "build")
+_LIB = os.path.join(_BUILD_DIR, "libthreshold_codec.so")
+_HASH_FILE = _LIB + ".srchash"
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _build_failed = False
 
 
+def _src_hash() -> str:
+    with open(_SRC, "rb") as f:
+        h = hashlib.sha256(f.read())
+    if os.environ.get("DL4J_TPU_NATIVE_SANITIZE"):
+        h.update(b"sanitize")
+    return h.hexdigest()
+
+
 def _build() -> bool:
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", _LIB, _SRC]
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17"]
+    if os.environ.get("DL4J_TPU_NATIVE_SANITIZE"):
+        cmd += ["-fsanitize=address,undefined", "-fno-omit-frame-pointer", "-g"]
+    cmd += ["-o", _LIB, _SRC]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        return True
     except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
             FileNotFoundError):
         return False
+    try:
+        with open(_HASH_FILE, "w") as f:
+            f.write(_src_hash())
+    except OSError:
+        pass
+    return True
+
+
+def _stored_hash() -> str | None:
+    try:
+        with open(_HASH_FILE) as f:
+            return f.read().strip()
+    except OSError:
+        return None
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.threshold_count.restype = ctypes.c_int64
+    lib.threshold_count.argtypes = [ctypes.POINTER(ctypes.c_float),
+                                    ctypes.c_int64, ctypes.c_float]
+    lib.threshold_encode.restype = ctypes.c_int64
+    lib.threshold_encode.argtypes = [ctypes.POINTER(ctypes.c_float),
+                                     ctypes.c_int64, ctypes.c_float,
+                                     ctypes.POINTER(ctypes.c_int32),
+                                     ctypes.c_int64]
+    lib.threshold_decode.restype = None
+    lib.threshold_decode.argtypes = [ctypes.POINTER(ctypes.c_int32),
+                                     ctypes.POINTER(ctypes.c_float),
+                                     ctypes.c_int64]
+    lib.bitmap_encode.restype = ctypes.c_int64
+    lib.bitmap_encode.argtypes = [ctypes.POINTER(ctypes.c_float),
+                                  ctypes.c_int64, ctypes.c_float,
+                                  ctypes.POINTER(ctypes.c_uint8)]
+    lib.bitmap_decode.restype = None
+    lib.bitmap_decode.argtypes = [ctypes.POINTER(ctypes.c_uint8),
+                                  ctypes.c_int64, ctypes.c_float,
+                                  ctypes.POINTER(ctypes.c_float)]
+    return lib
 
 
 def _load() -> ctypes.CDLL | None:
@@ -41,32 +99,22 @@ def _load() -> ctypes.CDLL | None:
         if _build_failed:
             return None
         needs_build = (not os.path.exists(_LIB)
-                       or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+                       or _stored_hash() != _src_hash())
         if needs_build and not _build():
             _build_failed = True
             return None
-        lib = ctypes.CDLL(_LIB)
-        lib.threshold_count.restype = ctypes.c_int64
-        lib.threshold_count.argtypes = [ctypes.POINTER(ctypes.c_float),
-                                        ctypes.c_int64, ctypes.c_float]
-        lib.threshold_encode.restype = ctypes.c_int64
-        lib.threshold_encode.argtypes = [ctypes.POINTER(ctypes.c_float),
-                                         ctypes.c_int64, ctypes.c_float,
-                                         ctypes.POINTER(ctypes.c_int32),
-                                         ctypes.c_int64]
-        lib.threshold_decode.restype = None
-        lib.threshold_decode.argtypes = [ctypes.POINTER(ctypes.c_int32),
-                                         ctypes.POINTER(ctypes.c_float),
-                                         ctypes.c_int64]
-        lib.bitmap_encode.restype = ctypes.c_int64
-        lib.bitmap_encode.argtypes = [ctypes.POINTER(ctypes.c_float),
-                                      ctypes.c_int64, ctypes.c_float,
-                                      ctypes.POINTER(ctypes.c_uint8)]
-        lib.bitmap_decode.restype = None
-        lib.bitmap_decode.argtypes = [ctypes.POINTER(ctypes.c_uint8),
-                                      ctypes.c_int64, ctypes.c_float,
-                                      ctypes.POINTER(ctypes.c_float)]
-        _lib = lib
+        try:
+            _lib = _bind(ctypes.CDLL(_LIB))
+        except OSError:
+            # existing binary incompatible with this host — rebuild once
+            if not _build():
+                _build_failed = True
+                return None
+            try:
+                _lib = _bind(ctypes.CDLL(_LIB))
+            except OSError:
+                _build_failed = True
+                return None
         return _lib
 
 
@@ -96,13 +144,27 @@ def threshold_encode(grad: np.ndarray, threshold: float,
     return out[:3 + int(n)]
 
 
+def _accum_buffer(out: np.ndarray | None, size: int) -> np.ndarray:
+    """Accumulation target matching the numpy oracle: the caller's
+    contiguous float32 buffer (mutated in place); otherwise a fresh copy
+    (the caller gets the result via the return value only)."""
+    if out is None:
+        return np.zeros(size, dtype=np.float32)
+    flat = out.reshape(-1)
+    if flat.dtype == np.float32 and flat.flags["C_CONTIGUOUS"]:
+        return flat
+    return np.ascontiguousarray(flat, dtype=np.float32)
+
+
 def threshold_decode(message: np.ndarray, shape: tuple,
                      out: np.ndarray | None = None) -> np.ndarray:
+    """Decode and ACCUMULATE into ``out`` (in place when ``out`` is a
+    contiguous float32 array, matching ``parallel.compression``'s numpy
+    twin); returns the accumulated array either way."""
     lib = _load()
     message = np.ascontiguousarray(message, dtype=np.int32)
     size = int(np.prod(shape))
-    buf = (np.zeros(size, dtype=np.float32) if out is None
-           else np.ascontiguousarray(out, dtype=np.float32).ravel().copy())
+    buf = _accum_buffer(out, size)
     lib.threshold_decode(message.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
                          _fptr(buf), size)
     return buf.reshape(shape)
@@ -121,11 +183,12 @@ def bitmap_encode(grad: np.ndarray, threshold: float) -> tuple[np.ndarray, np.nd
 
 def bitmap_decode(packed: np.ndarray, header: np.ndarray,
                   out: np.ndarray | None = None) -> np.ndarray:
+    """Decode and ACCUMULATE into ``out`` (in place when contiguous float32,
+    matching the numpy oracle); returns the accumulated array."""
     lib = _load()
     n = int(header[0])
     threshold = float(np.array(int(header[1]), dtype=np.int32).view(np.float32))
-    buf = (np.zeros(n, dtype=np.float32) if out is None
-           else np.ascontiguousarray(out, dtype=np.float32).ravel().copy())
+    buf = _accum_buffer(out, n)
     packed = np.ascontiguousarray(packed, dtype=np.uint8)
     lib.bitmap_decode(packed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
                       n, threshold, _fptr(buf))

@@ -573,8 +573,13 @@ class GlobalPoolingLayer(Layer):
         else:
             axes = (1,)  # NTC: pool over time
         pt = self.pooling_type.lower()
-        if mask is not None and x.ndim == 3:
-            m = mask[..., None]
+        if mask is not None:
+            # broadcast the mask to x's rank: RNN [B,T]→[B,T,1]; CNN
+            # spatial masks [B,H,W] (or [B,H,W,1])→[B,H,W,1]; CNN3D
+            # [B,D,H,W]→[B,D,H,W,1] (DL4J MaskedReductionUtil semantics)
+            m = mask
+            while m.ndim < x.ndim:
+                m = m[..., None]
             if pt == "max":
                 y = jnp.max(jnp.where(m > 0, x, -jnp.inf), axis=axes)
             elif pt == "sum":

@@ -71,10 +71,21 @@ class BaseRecurrentLayer(Layer):
         carry, ys = lax.scan(body, carry, inputs)
         return jnp.swapaxes(ys, 0, 1), carry  # [B, T, H]
 
-    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+    def apply_with_carry(self, params, state, x, carry, *, train=False,
+                         rng=None, mask=None):
+        """Forward from a given initial carry; returns (y, state, final_carry).
+        Used by tBPTT (state flows across segments, DL4J
+        ``MultiLayerNetwork.rnnActivateUsingStoredState`` semantics) and by
+        ``rnnTimeStep`` streaming."""
         x = self._maybe_dropout(x, train, rng)
-        carry = self.init_carry(x.shape[0], x.dtype)
-        y, _ = self._scan(params, x, mask, carry)
+        if carry is None:
+            carry = self.init_carry(x.shape[0], x.dtype)
+        y, new_carry = self._scan(params, x, mask, carry)
+        return y, state, new_carry
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        y, state, _ = self.apply_with_carry(params, state, x, None,
+                                            train=train, rng=rng, mask=mask)
         return y, state
 
 

@@ -71,8 +71,21 @@ class MultiLayerNetwork:
         The per-layer loop is a PYTHON loop over statically-known layers —
         it unrolls at trace time into one fused XLA program.
         """
+        out, new_state, score_array, _ = self._forward_impl(
+            params, state, x, None, train=train, rng=rng, mask=mask,
+            labels=labels)
+        return out, new_state, score_array
+
+    def _forward_impl(self, params, state, x, carries, *, train: bool,
+                      rng=None, mask=None, labels=None):
+        """Forward with optional recurrent-carry threading.  ``carries`` is a
+        per-layer list (None entries for non-recurrent layers); when given,
+        recurrent layers start from ``stop_gradient(carry)`` — forward state
+        flows, gradients truncate at the segment boundary (DL4J tBPTT)."""
+        from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer
         types = self.conf.input_types()
         new_state = []
+        new_carries = [None] * len(self.layers)
         current_mask = mask
         score_array = None
         for i, (layer, itype) in enumerate(zip(self.layers, types)):
@@ -83,11 +96,19 @@ class MultiLayerNetwork:
                 score_array = layer.compute_score_array(
                     params[i], state[i], x, labels, train=train, rng=layer_rng,
                     mask=current_mask)
-            y, s = layer.apply(params[i], state[i], x, train=train, rng=layer_rng,
-                               mask=current_mask)
+            if carries is not None and isinstance(layer, BaseRecurrentLayer):
+                carry = carries[i]
+                if carry is not None:
+                    carry = jax.lax.stop_gradient(carry)
+                y, s, new_carries[i] = layer.apply_with_carry(
+                    params[i], state[i], x, carry, train=train, rng=layer_rng,
+                    mask=current_mask)
+            else:
+                y, s = layer.apply(params[i], state[i], x, train=train,
+                                   rng=layer_rng, mask=current_mask)
             new_state.append(s)
             x = y
-        return x, new_state, score_array
+        return x, new_state, score_array, new_carries
 
     def output(self, x, mask=None) -> jnp.ndarray:
         """Inference forward (``MultiLayerNetwork.output``); jit-cached."""

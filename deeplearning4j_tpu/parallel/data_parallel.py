@@ -41,38 +41,166 @@ class ParallelWrapper(Trainer):
     """
 
     def __init__(self, net, mesh: Optional[Mesh] = None, listeners=None,
-                 averaging_frequency: int = 1):
+                 averaging_frequency: int = 1, average_updater_state: bool = True):
         super().__init__(net, listeners=listeners)
         self.mesh = mesh if mesh is not None else mesh_mod.make_mesh()
         self.averaging_frequency = max(1, averaging_frequency)
+        self.average_updater_state = average_updater_state
         self._placed = False
-        if self.averaging_frequency != 1:
-            raise NotImplementedError(
-                "averaging_frequency > 1 (ParameterAveraging parity mode) "
-                "requires the per-shard updater state machinery; the default "
-                "every-step psum allreduce is the supported (and stronger) mode")
+        self._steps_since_avg = 0
+        self._avg_step = None
+        self._avg_fn = None
 
     def _ensure_ready(self):
         super()._ensure_ready()
         if not self._placed:
             net = self.net
-            net.params_ = mesh_mod.replicate(self.mesh, net.params_)
-            net.state_ = mesh_mod.replicate(self.mesh, net.state_)
-            net.opt_state = mesh_mod.replicate(self.mesh, net.opt_state)
+            if self.averaging_frequency == 1:
+                net.params_ = mesh_mod.replicate(self.mesh, net.params_)
+                net.state_ = mesh_mod.replicate(self.mesh, net.state_)
+                net.opt_state = mesh_mod.replicate(self.mesh, net.opt_state)
+            else:
+                self._place_replicas()
             self._placed = True
 
-    def fit_batch(self, batch, rng) -> float:
-        """Shard the batch over ``data``, then run the ordinary jit step —
-        GSPMD partitions the forward/backward and inserts the gradient
-        psum over ICI automatically (params are replicated, so their
-        gradient must be allreduced to stay consistent)."""
+    def _prepare_batch(self, batch):
+        """Shard every array in the batch over the ``data`` axis — the
+        single-device jit step then runs SPMD with the gradient psum over
+        ICI inserted by GSPMD (params replicated).  Used by both the
+        standard and the tBPTT paths via the Trainer hook."""
         import dataclasses as _dc
+        fields = {}
+        for name in ("features", "labels", "features_mask", "labels_mask",
+                     "features_masks", "labels_masks"):
+            if hasattr(batch, name) and getattr(batch, name) is not None:
+                fields[name] = mesh_mod.shard_batch(self.mesh, getattr(batch, name))
+        return _dc.replace(batch, **fields)
+
+    def fit_batch(self, batch, rng) -> float:
+        """One DP step.
+
+        ``averaging_frequency == 1`` (default): params replicated, GSPMD
+        partitions forward/backward and inserts the gradient psum over ICI
+        automatically — the SharedTrainingMaster/ParallelWrapper
+        gradient-sharing swap.
+
+        ``averaging_frequency > 1``: ParameterAveragingTrainingMaster
+        parity — each data shard trains LOCALLY (divergent per-shard
+        replicas, zero cross-device traffic per step) and params (plus,
+        optionally, updater state) re-sync by mean every N steps.
+        """
         self._ensure_ready()
-        sharded = _dc.replace(
-            batch,
-            features=mesh_mod.shard_batch(self.mesh, batch.features),
-            labels=mesh_mod.shard_batch(self.mesh, batch.labels),
-            features_mask=mesh_mod.shard_batch(self.mesh, batch.features_mask),
-            labels_mask=mesh_mod.shard_batch(self.mesh, batch.labels_mask),
-        )
-        return super().fit_batch(sharded, rng)
+        if self.averaging_frequency > 1:
+            return self._fit_batch_averaging(batch, rng)
+        return super().fit_batch(batch, rng)
+
+    def _fit_tbptt(self, batch, rng):
+        if self.averaging_frequency > 1:
+            raise NotImplementedError(
+                "tBPTT with averaging_frequency > 1 is not supported — use "
+                "the default every-step allreduce (averaging_frequency=1)")
+        return super()._fit_tbptt(batch, rng)
+
+    def fit(self, iterator, epochs: int = 1):
+        result = super().fit(iterator, epochs)
+        if self.averaging_frequency > 1:
+            self._finalize_averaging()
+        return result
+
+    # ------------------------------------------------ param-averaging mode
+    def _n_shards(self) -> int:
+        return int(self.mesh.shape["data"])
+
+    def _place_replicas(self):
+        """Stack per-shard replicas on a new leading axis sharded over
+        ``data`` — each device owns one divergent copy."""
+        net = self.net
+        n = self._n_shards()
+
+        def stack(tree):
+            return jax.tree_util.tree_map(
+                lambda a: jnp.broadcast_to(a[None], (n,) + a.shape), tree)
+
+        net.params_ = mesh_mod.shard_batch(self.mesh, stack(net.params_))
+        net.state_ = mesh_mod.shard_batch(self.mesh, stack(net.state_))
+        net.opt_state = mesh_mod.shard_batch(self.mesh, stack(net.opt_state))
+
+    def _fit_batch_averaging(self, batch, rng):
+        from deeplearning4j_tpu.train.trainer import make_loss_fn
+        net = self.net
+        n = self._n_shards()
+        if self._avg_step is None:
+            loss_fn = make_loss_fn(net)
+            tx = self.tx
+
+            def local_step(params, state, opt_state, features, labels,
+                           features_mask, labels_mask, rng):
+                (loss, new_state), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, state, features, labels,
+                                           features_mask, labels_mask, rng)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+                return params, new_state, opt_state, loss
+
+            # vmap over the replica axis: leading dim is sharded over
+            # 'data', so XLA partitions this with no collectives at all
+            self._avg_step = jax.jit(jax.vmap(local_step),
+                                     donate_argnums=(0, 1, 2))
+
+            @jax.jit
+            def avg(tree):
+                return jax.tree_util.tree_map(
+                    lambda a: jnp.broadcast_to(jnp.mean(a, axis=0), a.shape),
+                    tree)
+            self._avg_fn = avg
+
+        def split_leading(v):
+            if v is None:
+                return None
+            a = jnp.asarray(v)
+            return mesh_mod.shard_batch(
+                self.mesh, a.reshape((n, a.shape[0] // n) + a.shape[1:]))
+
+        rngs = jax.random.split(rng, n)
+        fmask = getattr(batch, "features_mask", None)
+        if fmask is None:
+            fmask = getattr(batch, "features_masks", None)
+        lmask = getattr(batch, "labels_mask", None)
+        if lmask is None:
+            lmask = getattr(batch, "labels_masks", None)
+        params, state, opt_state, losses = self._avg_step(
+            net.params_, net.state_, net.opt_state,
+            split_leading(batch.features), split_leading(batch.labels),
+            split_leading(fmask), split_leading(lmask), rngs)
+        net.params_, net.state_, net.opt_state = params, state, opt_state
+        self._steps_since_avg += 1
+        if self._steps_since_avg >= self.averaging_frequency:
+            net.params_ = self._avg_fn(net.params_)
+            if self.average_updater_state:
+                net.opt_state = self._avg_fn(net.opt_state)
+            self._steps_since_avg = 0
+        from deeplearning4j_tpu.config import get_config
+        from deeplearning4j_tpu.obs.profiler import check_finite
+        cfg = get_config()
+        if cfg.nan_panic or cfg.inf_panic:
+            check_finite(net.params_, "params after averaging step")
+        return jnp.mean(losses)
+
+    def _finalize_averaging(self):
+        """Collapse the stacked replica axis back to a plain usable model
+        (DL4J's ParameterAveragingTrainingMaster hands back the averaged
+        net): average across shards, take one copy, reset placement."""
+        net = self.net
+        if self._steps_since_avg:
+            net.params_ = self._avg_fn(net.params_)
+            if self.average_updater_state:
+                net.opt_state = self._avg_fn(net.opt_state)
+            self._steps_since_avg = 0
+
+        def unstack(tree):
+            return jax.tree_util.tree_map(lambda a: a[0], tree)
+
+        net.params_ = unstack(net.params_)
+        net.state_ = unstack(net.state_)
+        net.opt_state = unstack(net.opt_state)
+        self._placed = False  # next fit() re-stacks

@@ -29,14 +29,13 @@ from deeplearning4j_tpu.obs.profiler import check_finite
 from deeplearning4j_tpu.train import updaters as updater_mod
 
 
-def make_loss_fn(net):
-    """Build the pure loss fn over (params, state, features, labels, masks,
-    rng) → (scalar_loss, new_state)."""
+def make_loss_fn(net, with_carries: bool = False):
+    """Build the pure loss fn.  Default signature: (params, state, features,
+    labels, fmask, lmask, rng) → (scalar_loss, new_state).  With
+    ``with_carries`` (tBPTT), signature gains a ``carries`` arg after
+    ``state`` and the aux becomes ``(new_state, new_carries)``."""
 
-    def loss_fn(params, state, features, labels, features_mask, labels_mask, rng):
-        out, new_state, score_array = net._forward(
-            params, state, features, train=True, rng=rng,
-            mask=features_mask, labels=labels)
+    def _score(params, state, score_array, features_mask, labels_mask):
         if score_array is None:
             raise ValueError(
                 "last layer has no loss — use OutputLayer/LossLayer/"
@@ -57,9 +56,47 @@ def make_loss_fn(net):
         for layer, p in zip(net.layers, layer_params):
             if p:
                 reg = reg + layer.regularization_penalty(p)
-        return data_loss + reg, new_state
+        return data_loss + reg
+
+    if with_carries:
+        def loss_fn(params, state, carries, features, labels, features_mask,
+                    labels_mask, rng):
+            out, new_state, score_array, new_carries = net._forward_impl(
+                params, state, features, carries, train=True, rng=rng,
+                mask=features_mask, labels=labels)
+            loss = _score(params, state, score_array, features_mask, labels_mask)
+            return loss, (new_state, new_carries)
+    else:
+        def loss_fn(params, state, features, labels, features_mask,
+                    labels_mask, rng):
+            out, new_state, score_array = net._forward(
+                params, state, features, train=True, rng=rng,
+                mask=features_mask, labels=labels)
+            loss = _score(params, state, score_array, features_mask, labels_mask)
+            return loss, new_state
 
     return loss_fn
+
+
+def make_tbptt_step(net, tx):
+    """jit'd tBPTT segment step: like ``make_train_step`` but threads
+    recurrent carries — forward state flows across segments, gradients
+    truncate at segment boundaries (``stop_gradient`` inside
+    ``_forward_impl``).  DL4J parity:
+    ``MultiLayerNetwork.rnnActivateUsingStoredState`` + tBPTT."""
+    loss_fn = make_loss_fn(net, with_carries=True)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+    def step(params, state, opt_state, carries, features, labels,
+             features_mask, labels_mask, rng):
+        (loss, (new_state, new_carries)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, state, carries, features, labels,
+                                   features_mask, labels_mask, rng)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, new_state, opt_state, new_carries, loss
+
+    return step
 
 
 def make_train_step(net, tx):
@@ -115,6 +152,7 @@ class Trainer:
                 updater, conf.gradient_normalization,
                 conf.gradient_normalization_threshold, frozen_mask)
         self._step = None
+        self._tbptt_step = None
 
     def _build_multi_updater(self, default_updater, conf, frozen_mask):
         """Per-layer updater overrides (DL4J allows ``layer.updater(...)``):
@@ -171,9 +209,15 @@ class Trainer:
         if self._step is None:
             self._step = make_train_step(net, self.tx)
 
+    def _prepare_batch(self, batch):
+        """Hook for subclasses (ParallelWrapper shards the batch over the
+        mesh here); identity for the single-device trainer."""
+        return batch
+
     def fit_batch(self, batch, rng) -> float:
         """One optimization step on one batch; returns host-side loss."""
         self._ensure_ready()
+        batch = self._prepare_batch(batch)
         net = self.net
 
         def _as(v):
@@ -204,6 +248,38 @@ class Trainer:
         # syncing per *step* would still serialize dispatch on TPU).
         return loss
 
+    def _fit_tbptt(self, batch, rng):
+        """Truncated BPTT over one batch of full sequences: forward state
+        carries between segments (gradient-truncated); dropout rng is
+        folded per segment so masks differ across segments."""
+        from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer
+        self._ensure_ready()
+        net = self.net
+        if self._tbptt_step is None:
+            self._tbptt_step = make_tbptt_step(net, self.tx)
+        b = batch.features.shape[0]
+        dtype = jnp.asarray(batch.features).dtype
+        carries = [layer.init_carry(b, dtype)
+                   if isinstance(layer, BaseRecurrentLayer) else None
+                   for layer in net.layers]
+        loss = None
+        for seg_idx, seg in enumerate(
+                _tbptt_segments(batch, net.conf.tbptt_fwd_length)):
+            seg = self._prepare_batch(seg)
+            seg_rng = jax.random.fold_in(rng, seg_idx)
+            params, state, opt_state, carries, loss = self._tbptt_step(
+                net.params_, net.state_, net.opt_state, carries,
+                jnp.asarray(seg.features),
+                None if seg.labels is None else jnp.asarray(seg.labels),
+                None if seg.features_mask is None else jnp.asarray(seg.features_mask),
+                None if seg.labels_mask is None else jnp.asarray(seg.labels_mask),
+                seg_rng)
+            net.params_, net.state_, net.opt_state = params, state, opt_state
+        cfg = get_config()
+        if cfg.nan_panic or cfg.inf_panic:
+            check_finite(net.params_, "params after tBPTT step")
+        return loss
+
     def fit(self, iterator, epochs: int = 1):
         self._ensure_ready()
         net = self.net
@@ -222,8 +298,7 @@ class Trainer:
                          else batch.features)
                 if tbptt and not isinstance(batch.features, (list, tuple)) \
                         and first.ndim == 3:
-                    for sub_batch in _tbptt_segments(batch, net.conf.tbptt_fwd_length):
-                        loss = self.fit_batch(sub_batch, sub)
+                    loss = self._fit_tbptt(batch, sub)
                 else:
                     loss = self.fit_batch(batch, sub)
                 net._score = loss
@@ -243,10 +318,9 @@ class Trainer:
 
 def _tbptt_segments(batch, length: int):
     """Truncated-BPTT segmentation (``MultiLayerConfiguration.tBPTTLength``):
-    split [B, T, C] sequences into chunks of ``length`` steps.  State does
-    NOT flow between chunks in this implementation (matches DL4J's
-    gradient truncation; forward-state carry is a TODO documented in
-    parity notes)."""
+    split [B, T, C] sequences into chunks of ``length`` steps.  Forward
+    state is carried across chunks by ``Trainer._fit_tbptt`` (gradients
+    truncate at chunk boundaries, DL4J semantics)."""
     import dataclasses as _dc
     t = batch.features.shape[1]
     for start in range(0, t, length):

@@ -1,0 +1,181 @@
+"""Regression tests for round-2 verdict/advice fixes: tBPTT state carry,
+ParameterAveraging mode, GlobalPooling CNN masks, normalizer label revert,
+native codec in-place accumulation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import NeuralNetConfiguration, InputType
+from deeplearning4j_tpu.nn.layers import (
+    DenseLayer, OutputLayer, RnnOutputLayer, LSTM, GlobalPoolingLayer,
+    ConvolutionLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.train import Sgd
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+
+
+def _rnn_net(tbptt=False, length=4):
+    b = NeuralNetConfiguration.builder().seed(3).updater(Sgd(0.05)).list()
+    if tbptt:
+        b = b.backprop_type("tbptt", length)
+    return MultiLayerNetwork(
+        b
+        .layer(LSTM(n_out=8))
+        .layer(RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.recurrent(4, 12))
+        .build()).init()
+
+
+def test_tbptt_forward_state_carries_across_segments():
+    """Forward states flow between tBPTT segments: running the net
+    segment-by-segment with carried state must equal the full-sequence
+    forward (DL4J rnnActivateUsingStoredState semantics)."""
+    net = _rnn_net()
+    x = np.random.default_rng(0).normal(size=(2, 12, 4)).astype(np.float32)
+    full, _, _ = net._forward(net.params_, net.state_, jnp.asarray(x), train=False)
+
+    carries = [None] * len(net.layers)
+    outs = []
+    for s in range(0, 12, 4):
+        seg = jnp.asarray(x[:, s:s + 4])
+        y, _, _, carries = net._forward_impl(
+            net.params_, net.state_, seg, carries, train=False)
+        outs.append(y)
+    seg_out = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(seg_out),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_tbptt_fit_trains_and_converges():
+    net = _rnn_net(tbptt=True, length=4)
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(8, 12, 4)).astype(np.float32)
+    y = np.zeros((8, 12, 3), np.float32)
+    y[..., 1] = 1.0
+    it = ArrayDataSetIterator(x, y, 8)
+    net.fit(it, epochs=1)
+    first = net.score()
+    net.fit(it, epochs=6)
+    assert net.score() < first
+
+
+def test_parallel_wrapper_averaging_mode():
+    from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Sgd(0.2)).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    mesh = make_mesh(data=4, devices=jax.devices()[:4])
+    pw = ParallelWrapper(net, mesh=mesh, averaging_frequency=2)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+    it = ArrayDataSetIterator(x, y, 32)
+    w_before = np.asarray(net.params_[0]["W"]).copy()
+    pw.fit(it, epochs=4)
+    # after fit the stacked replica axis is collapsed back — the net is a
+    # plain usable model (ParameterAveragingTrainingMaster hands back the
+    # averaged net)
+    w = np.asarray(net.params_[0]["W"])
+    assert w.shape == w_before.shape
+    assert not np.allclose(w, w_before)  # training happened
+    assert not np.isnan(net.score())
+    out = np.asarray(net.output(x[:4]))  # model usable post-fit
+    assert out.shape == (4, 2)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_parallel_wrapper_averaging_decreases_loss():
+    from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Sgd(0.3)).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    pw = ParallelWrapper(net, mesh=make_mesh(data=4, devices=jax.devices()[:4]), averaging_frequency=3)
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0] + x[:, 1] > 0).astype(int)]
+    it = ArrayDataSetIterator(x, y, 64)
+    pw.fit(it, epochs=1)
+    first = net.score()
+    pw.fit(it, epochs=10)
+    assert net.score() < first
+
+
+def test_tbptt_under_parallel_wrapper_shards():
+    """tBPTT routes segments through ParallelWrapper's sharding hook."""
+    from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    net = _rnn_net(tbptt=True, length=4)
+    mesh = make_mesh(data=4, devices=jax.devices()[:4])
+    pw = ParallelWrapper(net, mesh=mesh)
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(8, 12, 4)).astype(np.float32)
+    y = np.zeros((8, 12, 3), np.float32)
+    y[..., 1] = 1.0
+    it = ArrayDataSetIterator(x, y, 8)
+    pw.fit(it, epochs=1)
+    first = net.score()
+    pw.fit(it, epochs=5)
+    assert net.score() < first
+    with pytest.raises(NotImplementedError):
+        ParallelWrapper(net, mesh=mesh, averaging_frequency=2)._fit_tbptt(None, None)
+
+
+def test_global_pooling_cnn_mask():
+    layer = GlobalPoolingLayer(pooling_type="avg")
+    x = np.random.default_rng(0).normal(size=(2, 4, 4, 3)).astype(np.float32)
+    mask = np.zeros((2, 4, 4), np.float32)
+    mask[:, :2, :2] = 1.0  # only top-left 2x2 valid
+    y, _ = layer.apply({}, {}, jnp.asarray(x), mask=jnp.asarray(mask))
+    expected = x[:, :2, :2, :].reshape(2, 4, 3).mean(axis=1)
+    np.testing.assert_allclose(np.asarray(y), expected, rtol=1e-5, atol=1e-5)
+    # max variant
+    ymax, _ = GlobalPoolingLayer(pooling_type="max").apply(
+        {}, {}, jnp.asarray(x), mask=jnp.asarray(mask))
+    np.testing.assert_allclose(
+        np.asarray(ymax), x[:, :2, :2, :].reshape(2, 4, 3).max(axis=1),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_normalizer_standardize_reverts_labels():
+    from deeplearning4j_tpu.data.normalizers import NormalizerStandardize
+    rng = np.random.default_rng(0)
+    x = rng.normal(3.0, 2.0, size=(64, 5)).astype(np.float32)
+    y = rng.normal(-1.0, 0.5, size=(64, 2)).astype(np.float32)
+    ds = DataSet(x, y)
+    norm = NormalizerStandardize(fit_labels=True)
+    norm.fit([ds])
+    transformed = norm.transform(ds)
+    assert abs(float(np.mean(transformed.labels))) < 0.1
+    reverted = norm.revert(transformed)
+    np.testing.assert_allclose(np.asarray(reverted.features), x, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(reverted.labels), y, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(norm.revert_labels(transformed.labels), y,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_native_codec_inplace_accumulation():
+    from deeplearning4j_tpu.native import codec
+    if not codec.available():
+        pytest.skip("native codec unavailable (no g++)")
+    grad = np.array([0.0, 0.5, -0.7, 0.0, 0.2], np.float32)
+    msg = codec.threshold_encode(grad, 0.3)
+    target = np.ones(5, np.float32)
+    out = codec.threshold_decode(msg, (5,), out=target)
+    # in-place accumulation into the caller's contiguous f32 buffer,
+    # matching the numpy oracle in parallel.compression
+    np.testing.assert_allclose(target, out)
+    assert target[1] != 1.0  # mutated in place
