@@ -43,16 +43,28 @@ def check_gradients(loss_fn: Callable[[Any], jnp.ndarray], params: Any,
                 else rng.choice(flat.size, max_checks_per_leaf, replace=False))
         for i in idxs:
             def perturbed(delta, i=i, li=li):
-                new_leaves = list(leaves)
-                pl = np.asarray(new_leaves[li]).copy().ravel()
+                # perturb in f64, then measure the value the device array
+                # ACTUALLY holds — dtype rounding of p±ε (f32: up to ~0.3%
+                # of ε) would otherwise read as a systematic "gradient
+                # error"; dividing by the realized perturbation keeps the
+                # check exact in any dtype
+                pl = flat.copy()
                 pl[i] += delta
-                new_leaves[li] = jnp.asarray(pl.reshape(leaves[li].shape),
-                                             leaves[li].dtype)
-                return jax.tree_util.tree_unflatten(treedef, new_leaves)
+                new_leaves = list(leaves)
+                new_leaves[li] = jnp.asarray(pl.reshape(leaf.shape),
+                                             dtype=leaf.dtype)
+                realized_v = float(np.asarray(new_leaves[li]).ravel()[i])
+                return (jax.tree_util.tree_unflatten(treedef, new_leaves),
+                        realized_v)
 
-            f_plus = float(loss_fn(perturbed(+eps)))
-            f_minus = float(loss_fn(perturbed(-eps)))
-            numeric = (f_plus - f_minus) / (2 * eps)
+            args_plus, p_plus = perturbed(+eps)
+            args_minus, p_minus = perturbed(-eps)
+            f_plus = float(loss_fn(args_plus))
+            f_minus = float(loss_fn(args_minus))
+            realized = p_plus - p_minus
+            if realized == 0.0:
+                continue  # eps below dtype resolution for this entry
+            numeric = (f_plus - f_minus) / realized
             analytic = gflat[i]
             denom = max(abs(numeric), abs(analytic))
             if denom < abs_error_floor:
@@ -68,6 +80,11 @@ def check_gradients(loss_fn: Callable[[Any], jnp.ndarray], params: Any,
         raise AssertionError(
             f"gradient check failed on {len(failures)}/{n_checked} entries "
             f"(worst rel {worst:.3g}):\n" + "\n".join(lines))
+    if n_checked == 0 and any(np.asarray(l).size for l in leaves):
+        raise AssertionError(
+            "gradient check validated ZERO entries — eps below the param "
+            "dtype's resolution (or all gradients under the error floor); "
+            "a silent pass here would mean nothing was checked")
     return {"checked": n_checked, "max_rel_error": worst}
 
 
@@ -80,6 +97,13 @@ def check_model_gradients(net, batch, eps: float = 1e-3,
     if net.params_ is None:
         net.init()
     loss_fn_full = make_loss_fn(net)
+    params = net.params_
+    if jax.config.jax_enable_x64:
+        # double-precision whole-network check (the reference's
+        # GradientCheckUtil runs nets cast to DOUBLE the same way)
+        params = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.float64)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
     features = jnp.asarray(batch.features)
     labels = jnp.asarray(batch.labels)
     fmask = None if batch.features_mask is None else jnp.asarray(batch.features_mask)
@@ -89,5 +113,5 @@ def check_model_gradients(net, batch, eps: float = 1e-3,
         loss, _ = loss_fn_full(params, net.state_, features, labels, fmask, lmask, None)
         return loss
 
-    return check_gradients(loss_fn, net.params_, eps=eps,
+    return check_gradients(loss_fn, params, eps=eps,
                            max_rel_error=max_rel_error, **kw)

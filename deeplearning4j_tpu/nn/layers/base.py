@@ -116,11 +116,22 @@ class Layer:
         raise NotImplementedError
 
     # ---- shared helpers ---------------------------------------------
-    def _init_weight(self, key, shape, fan_in, fan_out, dtype=jnp.float32):
+    def _param_dtype(self):
+        """Storage dtype for THIS layer's params (DTypePolicy.param_dtype) —
+        every init_params allocation must use it so param trees stay
+        uniform-dtype for checkpoints and updaters."""
+        from deeplearning4j_tpu.config import dtype_policy
+        return dtype_policy().param_dtype
+
+    def _init_weight(self, key, shape, fan_in, fan_out, dtype=None):
+        if dtype is None:
+            dtype = self._param_dtype()
         init = weight_inits.get(self.weight_init or "xavier")
         return init(key, shape, float(fan_in), float(fan_out), dtype)
 
-    def _init_bias(self, shape, dtype=jnp.float32):
+    def _init_bias(self, shape, dtype=None):
+        if dtype is None:
+            dtype = self._param_dtype()
         return jnp.full(shape, self.bias_init if self.bias_init is not None else 0.0, dtype)
 
     def _maybe_dropout(self, x, train, rng):

@@ -222,11 +222,13 @@ class BatchNormalization(Layer):
         n = self._num_features(input_type)
         if not self.use_gamma_beta or self.lock_gamma_beta:
             return {}
-        return {"gamma": jnp.ones((n,)), "beta": jnp.zeros((n,))}
+        dt = self._param_dtype()
+        return {"gamma": jnp.ones((n,), dt), "beta": jnp.zeros((n,), dt)}
 
     def init_state(self, input_type):
         n = self._num_features(input_type)
-        return {"mean": jnp.zeros((n,)), "var": jnp.ones((n,))}
+        dt = self._param_dtype()
+        return {"mean": jnp.zeros((n,), dt), "var": jnp.ones((n,), dt)}
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         axes = tuple(range(x.ndim - 1))  # all but channel axis (NHWC/NC/NTC)

@@ -35,9 +35,9 @@ class LayerNormalization(Layer):
 
     def init_params(self, key, input_type):
         n = self._n(input_type)
-        params = {"gamma": jnp.ones((n,))}
+        params = {"gamma": jnp.ones((n,), self._param_dtype())}
         if self.use_bias:
-            params["beta"] = jnp.zeros((n,))
+            params["beta"] = jnp.zeros((n,), self._param_dtype())
         return params
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
@@ -59,7 +59,7 @@ class PReLULayer(Layer):
             shape = (input_type.channels,)
         else:
             shape = (input_type.flat_size(),)
-        return {"alpha": jnp.zeros(shape)}
+        return {"alpha": jnp.zeros(shape, self._param_dtype())}
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         return jnp.where(x >= 0, x, params["alpha"] * x), state

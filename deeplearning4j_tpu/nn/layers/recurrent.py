@@ -105,7 +105,7 @@ class LSTM(BaseRecurrentLayer):
         k1, k2 = jax.random.split(key)
         w = self._init_weight(k1, (n_in, 4 * h), n_in, h)
         u = self._init_weight(k2, (h, 4 * h), h, h)
-        b = jnp.zeros((4 * h,))
+        b = jnp.zeros((4 * h,), self._param_dtype())
         # IFOG order: forget block is [h:2h]
         b = b.at[h:2 * h].set(self.forget_gate_bias_init)
         return {"W": w, "U": u, "b": b}
@@ -141,7 +141,7 @@ class GravesLSTM(LSTM):
 
     def init_params(self, key, input_type):
         params = super().init_params(key, input_type)
-        params["wP"] = jnp.zeros((3 * self.n_out,))
+        params["wP"] = jnp.zeros((3 * self.n_out,), self._param_dtype())
         return params
 
     def step(self, params, carry, x_t):

@@ -29,7 +29,8 @@ def expected_kind(layer) -> Optional[str]:
         return "rnn"
     if isinstance(layer, attn_mod.SelfAttentionLayer):
         return "rnn"
-    if isinstance(layer, conv_mod.Convolution3DLayer):
+    if isinstance(layer, (conv_mod.Convolution3DLayer,
+                          conv_mod.Subsampling3DLayer)):
         return "cnn3d"
     if isinstance(layer, (conv_mod.ConvolutionLayer, conv_mod.SubsamplingLayer,
                           conv_mod.UpsamplingLayer, conv_mod.ZeroPaddingLayer,

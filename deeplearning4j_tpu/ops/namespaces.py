@@ -110,10 +110,14 @@ nn = SimpleNamespace(
 
 
 # ---------------------------------------------------------------- cnn
-def _conv2d(x, w, stride=(1, 1), padding="SAME", dilation=(1, 1), groups=1):
+def _conv2d(x, w, stride=(1, 1), padding="SAME", dilation=(1, 1), groups=1,
+            precision=None):
+    """``precision``: None = backend default (bf16 passes on the TPU MXU —
+    the fast path); "highest" = full f32 accumulation (golden tests)."""
     return lax.conv_general_dilated(
         x, w, stride, padding, rhs_dilation=dilation,
-        dimension_numbers=("NHWC", "HWIO", "NHWC"), feature_group_count=groups)
+        dimension_numbers=("NHWC", "HWIO", "NHWC"), feature_group_count=groups,
+        precision=precision)
 
 
 def _max_pool2d(x, k=(2, 2), s=None, padding="VALID"):
@@ -138,7 +142,10 @@ def _im2col(x, kh, kw, sh=1, sw=1, ph=0, pw=0):
     ow = (w - kw) // sw + 1
     idx_h = jnp.arange(oh)[:, None] * sh + jnp.arange(kh)[None, :]
     idx_w = jnp.arange(ow)[:, None] * sw + jnp.arange(kw)[None, :]
+    # advanced indexing broadcasts to (n, oh, kh, ow, kw, c); bring the
+    # patch axes together before flattening to (kh, kw, c)-major columns
     cols = x[:, idx_h[:, :, None, None], idx_w[None, None], :]
+    cols = cols.transpose(0, 1, 3, 2, 4, 5)
     return cols.reshape(n, oh, ow, kh * kw * c)
 
 
