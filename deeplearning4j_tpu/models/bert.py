@@ -115,8 +115,8 @@ def init_params(config: BertConfig, key: jax.Array) -> dict:
 def _dense(p, x):
     policy = dtype_policy()
     y = jnp.einsum("...i,io->...o", x.astype(policy.compute_dtype),
-                   p["kernel"].astype(policy.compute_dtype)).astype(policy.output_dtype)
-    return y + p["bias"]
+                   p["kernel"].astype(policy.compute_dtype))
+    return (y + p["bias"].astype(y.dtype)).astype(policy.output_dtype)
 
 
 def _layer_norm(p, x, eps):
@@ -183,9 +183,10 @@ def mlm_logits(params: dict, config: BertConfig, hidden: jnp.ndarray) -> jnp.nda
     x = _layer_norm(params["mlm"]["transform_layer_norm"], x, config.layer_norm_eps)
     policy = dtype_policy()
     logits = jnp.einsum("bth,vh->btv", x.astype(policy.compute_dtype),
-                        params["embeddings"]["word_embeddings"].astype(policy.compute_dtype)
-                        ).astype(policy.output_dtype)
-    return logits + params["mlm"]["output_bias"]
+                        params["embeddings"]["word_embeddings"].astype(policy.compute_dtype))
+    logits = logits + params["mlm"]["output_bias"].astype(logits.dtype)
+    # MLM softmax/loss math runs in >=f32 downstream
+    return logits.astype(jnp.promote_types(policy.output_dtype, jnp.float32))
 
 
 def mlm_loss(params: dict, config: BertConfig, input_ids, labels, label_weights,

@@ -83,8 +83,12 @@ class ConvolutionLayer(Layer):
         return params
 
     def _conv(self, x, w, stride, padding, dilation, groups=1):
+        """Returns the conv result in COMPUTE dtype — the output-dtype cast
+        happens once at the end of apply(), after bias+activation, so a
+        bf16 policy keeps the whole epilogue bf16 (an f32 bias would
+        otherwise promote everything back and double HBM traffic)."""
         policy = dtype_policy()
-        y = lax.conv_general_dilated(
+        return lax.conv_general_dilated(
             x.astype(policy.compute_dtype), w.astype(policy.compute_dtype),
             window_strides=stride,
             padding=padding,
@@ -92,20 +96,26 @@ class ConvolutionLayer(Layer):
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=groups,
         )
-        return y.astype(policy.output_dtype)
 
     def _padding_arg(self, pad_pairs):
         if self.convolution_mode == "same":
             return "SAME"
         return [(p, p) for p in pad_pairs]
 
+    def _finish(self, y, params):
+        """Shared conv epilogue: bias in y's dtype, activation, ONE cast to
+        the policy output dtype (ordering is load-bearing — an f32 bias
+        added after the cast would re-promote the whole tensor)."""
+        if self.has_bias:
+            y = y + params["b"].astype(y.dtype)
+        y = activations.get(self.activation or "identity")(y)
+        return y.astype(dtype_policy().output_dtype)
+
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         _, stride, pad, dilation = self._dims()
         x = self._maybe_dropout(x, train, rng)
         y = self._conv(x, params["W"], stride, self._padding_arg(pad), dilation)
-        if self.has_bias:
-            y = y + params["b"]
-        return activations.get(self.activation or "identity")(y), state
+        return self._finish(y, params), state
 
 
 @register_layer("conv1d")
@@ -158,10 +168,8 @@ class Convolution1DLayer(ConvolutionLayer):
             x.astype(policy.compute_dtype), params["W"].astype(policy.compute_dtype),
             window_strides=(s,), padding=padding, rhs_dilation=(d,),
             dimension_numbers=("NWC", "WIO", "NWC"),
-        ).astype(policy.output_dtype)
-        if self.has_bias:
-            y = y + params["b"]
-        return activations.get(self.activation or "identity")(y), state
+        )
+        return self._finish(y, params), state
 
 
 @register_layer("conv3d")
@@ -205,10 +213,8 @@ class Convolution3DLayer(ConvolutionLayer):
             x.astype(policy.compute_dtype), params["W"].astype(policy.compute_dtype),
             window_strides=s, padding=padding, rhs_dilation=d,
             dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
-        ).astype(policy.output_dtype)
-        if self.has_bias:
-            y = y + params["b"]
-        return activations.get(self.activation or "identity")(y), state
+        )
+        return self._finish(y, params), state
 
 
 @register_layer("deconv2d")
@@ -239,10 +245,8 @@ class Deconvolution2D(ConvolutionLayer):
             x.astype(policy.compute_dtype), params["W"].astype(policy.compute_dtype),
             strides=stride, padding=padding, rhs_dilation=dilation,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        ).astype(policy.output_dtype)
-        if self.has_bias:
-            y = y + params["b"]
-        return activations.get(self.activation or "identity")(y), state
+        )
+        return self._finish(y, params), state
 
 
 @register_layer("depthwise_conv2d")
@@ -272,9 +276,7 @@ class DepthwiseConvolution2D(ConvolutionLayer):
         x = self._maybe_dropout(x, train, rng)
         y = self._conv(x, params["W"], stride, self._padding_arg(pad), dilation,
                        groups=x.shape[-1])
-        if self.has_bias:
-            y = y + params["b"]
-        return activations.get(self.activation or "identity")(y), state
+        return self._finish(y, params), state
 
 
 @register_layer("separable_conv2d")
@@ -303,9 +305,7 @@ class SeparableConvolution2D(ConvolutionLayer):
         y = self._conv(x, params["depthW"], stride, self._padding_arg(pad), dilation,
                        groups=x.shape[-1])
         y = self._conv(y, params["pointW"], (1, 1), "VALID", (1, 1))
-        if self.has_bias:
-            y = y + params["b"]
-        return activations.get(self.activation or "identity")(y), state
+        return self._finish(y, params), state
 
 
 @register_layer("subsampling")

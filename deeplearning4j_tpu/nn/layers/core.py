@@ -56,10 +56,9 @@ class DenseLayer(Layer):
         elif x.ndim > 2:
             x = x.reshape(x.shape[0], -1)  # CNN→FF flatten
         y = jnp.dot(x.astype(policy.compute_dtype), params["W"].astype(policy.compute_dtype))
-        y = y.astype(policy.output_dtype)
         if self.has_bias:
-            y = y + params["b"]
-        return y
+            y = y + params["b"].astype(y.dtype)
+        return y.astype(policy.output_dtype)
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         z = self.pre_output(params, state, x, train=train, rng=rng)
@@ -86,6 +85,9 @@ class OutputLayer(DenseLayer):
     def compute_score_array(self, params, state, x, labels, *, train=False,
                             rng=None, mask=None):
         z = self.pre_output(params, state, x, train=train, rng=rng)
+        # loss math (softmax/log/…) in at-least-f32 — bf16 output policies
+        # keep the big tensors cheap but the scalar-score path exact
+        z = z.astype(jnp.promote_types(z.dtype, jnp.float32))
         loss_fn = losses.get(self.loss)
         score = loss_fn(labels, z, self.activation or "identity", mask)
         return score
@@ -110,6 +112,7 @@ class LossLayer(Layer):
 
     def compute_score_array(self, params, state, x, labels, *, train=False,
                             rng=None, mask=None):
+        x = x.astype(jnp.promote_types(x.dtype, jnp.float32))
         loss_fn = losses.get(self.loss)
         return loss_fn(labels, x, self.activation or "identity", mask)
 
@@ -233,8 +236,12 @@ class BatchNormalization(Layer):
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         axes = tuple(range(x.ndim - 1))  # all but channel axis (NHWC/NC/NTC)
         if train:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            # stats in ≥f32 regardless of activation dtype (bf16
+            # accumulation would drift); the reduction reads x once, the
+            # cast is fused by XLA
+            x32 = x.astype(jnp.promote_types(x.dtype, jnp.float32))
+            mean = jnp.mean(x32, axis=axes)
+            var = jnp.var(x32, axis=axes)
             new_state = {
                 "mean": self.decay * state["mean"] + (1.0 - self.decay) * mean,
                 "var": self.decay * state["var"] + (1.0 - self.decay) * var,
@@ -242,9 +249,15 @@ class BatchNormalization(Layer):
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
-        inv = jax.lax.rsqrt(var + self.eps)
-        y = (x - mean) * inv
+        # fold (mean, var, gamma, beta) into a per-channel scale/shift in
+        # f32, then apply in x's own dtype — under a bf16 policy the big
+        # [N,H,W,C] arithmetic stays bf16 (f32 gamma would otherwise
+        # promote the whole tensor and double HBM traffic)
+        scale = jax.lax.rsqrt(var + self.eps)
+        shift = -mean * scale
         if params:
-            y = y * params["gamma"] + params["beta"]
+            scale = scale * params["gamma"]
+            shift = shift * params["gamma"] + params["beta"]
+        y = x * scale.astype(x.dtype) + shift.astype(x.dtype)
         y = activations.get(self.activation or "identity")(y)
         return y, new_state

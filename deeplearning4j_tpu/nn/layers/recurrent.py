@@ -79,9 +79,13 @@ class BaseRecurrentLayer(Layer):
         ``rnnTimeStep`` streaming."""
         x = self._maybe_dropout(x, train, rng)
         if carry is None:
-            carry = self.init_carry(x.shape[0], x.dtype)
+            # gate math and carried state run in >=f32 (cell state
+            # accumulates over time; bf16 carries drift) — only the big
+            # [B,T,H] output drops to the policy's output dtype
+            carry = self.init_carry(x.shape[0],
+                                    jnp.promote_types(x.dtype, jnp.float32))
         y, new_carry = self._scan(params, x, mask, carry)
-        return y, state, new_carry
+        return y.astype(dtype_policy().output_dtype), state, new_carry
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         y, state, _ = self.apply_with_carry(params, state, x, None,
@@ -118,9 +122,10 @@ class LSTM(BaseRecurrentLayer):
         h_prev, c_prev = carry
         policy = dtype_policy()
         hsz = self.n_out
+        acc = jnp.promote_types(policy.output_dtype, jnp.float32)
         z = (jnp.dot(x_t.astype(policy.compute_dtype), params["W"].astype(policy.compute_dtype))
              + jnp.dot(h_prev.astype(policy.compute_dtype), params["U"].astype(policy.compute_dtype))
-             ).astype(policy.output_dtype) + params["b"]
+             ).astype(acc) + params["b"].astype(acc)
         gate = activations.get(self.gate_activation)
         cell_act = activations.get(self.activation or "tanh")
         i = gate(z[:, 0 * hsz:1 * hsz])
@@ -148,9 +153,10 @@ class GravesLSTM(LSTM):
         h_prev, c_prev = carry
         policy = dtype_policy()
         hsz = self.n_out
+        acc = jnp.promote_types(policy.output_dtype, jnp.float32)
         z = (jnp.dot(x_t.astype(policy.compute_dtype), params["W"].astype(policy.compute_dtype))
              + jnp.dot(h_prev.astype(policy.compute_dtype), params["U"].astype(policy.compute_dtype))
-             ).astype(policy.output_dtype) + params["b"]
+             ).astype(acc) + params["b"].astype(acc)
         gate = activations.get(self.gate_activation)
         cell_act = activations.get(self.activation or "tanh")
         p_i = params["wP"][0 * hsz:1 * hsz]
@@ -380,10 +386,10 @@ class RnnOutputLayer(Layer):
         x = self._maybe_dropout(x, train, rng)
         policy = dtype_policy()
         z = jnp.dot(x.astype(policy.compute_dtype),
-                    params["W"].astype(policy.compute_dtype)).astype(policy.output_dtype)
+                    params["W"].astype(policy.compute_dtype))
         if self.has_bias:
-            z = z + params["b"]
-        return z
+            z = z + params["b"].astype(z.dtype)
+        return z.astype(policy.output_dtype)
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         z = self.pre_output(params, state, x, train=train, rng=rng)
@@ -393,6 +399,7 @@ class RnnOutputLayer(Layer):
                             rng=None, mask=None):
         from deeplearning4j_tpu.nn import losses as _losses
         z = self.pre_output(params, state, x, train=train, rng=rng)
+        z = z.astype(jnp.promote_types(z.dtype, jnp.float32))  # loss math in ≥f32
         loss_fn = _losses.get(self.loss)
         # flatten time into batch: [B*T, n_out]
         b, t = z.shape[0], z.shape[1]
@@ -420,6 +427,7 @@ class RnnLossLayer(Layer):
     def compute_score_array(self, params, state, x, labels, *, train=False,
                             rng=None, mask=None):
         from deeplearning4j_tpu.nn import losses as _losses
+        x = x.astype(jnp.promote_types(x.dtype, jnp.float32))
         loss_fn = _losses.get(self.loss)
         b, t = x.shape[0], x.shape[1]
         score = loss_fn(labels.reshape(b * t, -1), x.reshape(b * t, -1),
