@@ -69,12 +69,53 @@ def bench_resnet50(batch: int = 256, image: int = 224, steps: int = 12,
     }
 
 
+def bench_bert_mlm(batch: int = 32, seq_len: int = 128, steps: int = 10,
+                   warmup: int = 2) -> dict:
+    """BERT-base MLM fine-tune step time — the second headline metric
+    (BASELINE.json config #4: SameDiff TF-import BERT-base MLM)."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.config import DTypePolicy, set_dtype_policy
+    from deeplearning4j_tpu.models.bert import BertConfig, BertForMaskedLM
+    from deeplearning4j_tpu.train import Adam
+
+    set_dtype_policy(DTypePolicy.bf16())
+    config = BertConfig.base()
+    model = BertForMaskedLM(config, seed=0)
+    tx = Adam(2e-5).to_optax()
+    opt_state = tx.init(model.params)
+    step = model.make_train_step(tx)
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, config.vocab_size, (batch, seq_len)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, config.vocab_size, (batch, seq_len)), jnp.int32)
+    weights = jnp.asarray((rng.random((batch, seq_len)) < 0.15), jnp.float32)
+    attn = jnp.ones((batch, seq_len), jnp.float32)
+    key = jax.random.key(0)
+
+    params, opt = model.params, opt_state
+    for _ in range(warmup):
+        params, opt, loss = step(params, opt, ids, labels, weights, attn, key)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt, loss = step(params, opt, ids, labels, weights, attn, key)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return {"step_time_ms": round(1000 * dt / steps, 2),
+            "batch": batch, "seq_len": seq_len}
+
+
 def main():
     batch = 256  # HBM-bound workload: large batch amortizes weight traffic
                  # (see bench/PROFILE.md; 256 ≈ saturation point on v5e)
     for attempt in range(3):
         try:
             result = bench_resnet50(batch=batch)
+            try:  # second headline metric: BERT-base MLM step time
+                result["detail"]["bert_base_mlm"] = bench_bert_mlm()
+            except Exception as e:
+                result["detail"]["bert_base_mlm"] = {"error": str(e)[:200]}
             print(json.dumps(result))
             return 0
         except Exception as e:  # OOM etc. → halve the batch and retry

@@ -1,0 +1,21 @@
+"""NLP: tokenization + BERT data pipeline.
+
+Parity scope (SURVEY.md §2.6): the reference's ``deeplearning4j-nlp``
+wordpiece tokenization (``BertWordPieceTokenizer``) and the
+``BertIterator`` MLM/classification batch builder that feeds the BERT
+fine-tune workload (BASELINE config #4).  Word2Vec/GloVe/ParagraphVectors
+are out of v1 scope per SURVEY.
+"""
+
+from deeplearning4j_tpu.nlp.tokenization import (
+    BasicTokenizer, WordpieceTokenizer, BertWordPieceTokenizer,
+    Vocabulary, build_vocab)
+from deeplearning4j_tpu.nlp.bert_iterator import (
+    BertIterator, BertMaskedLMMasker, CollectionSentenceProvider,
+    CollectionLabeledSentenceProvider)
+
+__all__ = [
+    "BasicTokenizer", "WordpieceTokenizer", "BertWordPieceTokenizer",
+    "Vocabulary", "build_vocab", "BertIterator", "BertMaskedLMMasker",
+    "CollectionSentenceProvider", "CollectionLabeledSentenceProvider",
+]
