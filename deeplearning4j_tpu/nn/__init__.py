@@ -2,12 +2,15 @@ from deeplearning4j_tpu.nn.input_type import InputType
 from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, ListBuilder
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.nn import activations, weights, losses, layers
+from deeplearning4j_tpu.nn.transfer import TransferLearning, FineTuneConfiguration
 
 __all__ = [
     "InputType",
     "NeuralNetConfiguration",
     "ListBuilder",
     "MultiLayerNetwork",
+    "TransferLearning",
+    "FineTuneConfiguration",
     "activations",
     "weights",
     "losses",

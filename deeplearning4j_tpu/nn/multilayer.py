@@ -218,10 +218,15 @@ class MultiLayerNetwork:
         return "\n".join(lines)
 
     def clone(self) -> "MultiLayerNetwork":
+        # deep-copy device buffers: the jit train step DONATES param buffers,
+        # so aliasing them here would leave the clone holding deleted arrays
+        # after the source trains another step
         net = MultiLayerNetwork(MultiLayerConfiguration.from_dict(self.conf.to_dict()))
         if self.params_ is not None:
-            net.params_ = jax.tree_util.tree_map(lambda a: a, self.params_)
-            net.state_ = jax.tree_util.tree_map(lambda a: a, self.state_)
+            net.params_ = jax.tree_util.tree_map(
+                lambda a: jnp.array(a, copy=True), self.params_)
+            net.state_ = jax.tree_util.tree_map(
+                lambda a: jnp.array(a, copy=True), self.state_)
         return net
 
 

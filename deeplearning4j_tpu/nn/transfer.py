@@ -1,0 +1,175 @@
+"""Transfer learning — param surgery on trained networks.
+
+Parity with DL4J's ``org/deeplearning4j/nn/transferlearning/
+TransferLearning.java`` (Builder) + ``FineTuneConfiguration.java``:
+
+- ``FineTuneConfiguration`` — training-hyperparameter overrides (updater,
+  activation, weight init, dropout, l1/l2, seed) cascaded over ALL layers
+  of the grafted net, without touching kept weights.
+- ``TransferLearning.builder(net)`` — layer surgery: freeze everything up
+  to a feature-extraction boundary (``set_feature_extractor``), remove
+  output layers, change a layer's ``n_out`` (``nout_replace`` — the nIn of
+  the following layer re-derives automatically because our layers infer
+  input width from the InputType chain at init), and append new layers.
+
+TPU-native design: "surgery" is pure-functional — the builder clones the
+config via its JSON round-trip, builds a fresh net, re-initializes only
+modified layers, and copies the retained parameter pytrees (device arrays
+are immutable; no flat-vector copying needed — the flat view stays
+available via ``net.params()``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers.base import Layer, layer_from_dict
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+@dataclasses.dataclass
+class FineTuneConfiguration:
+    """Global hyperparameter overrides for the grafted net
+    (``FineTuneConfiguration.Builder`` parity)."""
+
+    updater: Optional[Any] = None
+    activation: Optional[Any] = None
+    weight_init: Optional[Any] = None
+    bias_init: Optional[float] = None
+    dropout: Optional[float] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    l1_bias: Optional[float] = None
+    l2_bias: Optional[float] = None
+    seed: Optional[int] = None
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: Optional[float] = None
+
+    _LAYER_FIELDS = ("activation", "weight_init", "bias_init", "dropout",
+                     "l1", "l2", "l1_bias", "l2_bias")
+
+    def apply_to(self, conf: MultiLayerConfiguration) -> None:
+        if self.updater is not None:
+            conf.updater = self.updater
+        if self.seed is not None:
+            conf.seed = self.seed
+        if self.gradient_normalization is not None:
+            conf.gradient_normalization = self.gradient_normalization
+        if self.gradient_normalization_threshold is not None:
+            conf.gradient_normalization_threshold = self.gradient_normalization_threshold
+        for layer in conf.layers:
+            for field in self._LAYER_FIELDS:
+                v = getattr(self, field)
+                if v is not None and hasattr(layer, field):
+                    setattr(layer, field, v)
+            if self.updater is not None and getattr(layer, "updater", None) is not None:
+                layer.updater = None  # net-level override wins (DL4J cascade)
+
+
+def _clone_layer(layer: Layer) -> Layer:
+    return layer_from_dict(layer.to_dict())
+
+
+class TransferLearning:
+    """``TransferLearning.Builder`` parity for MultiLayerNetwork."""
+
+    @staticmethod
+    def builder(net: MultiLayerNetwork) -> "TransferLearningBuilder":
+        return TransferLearningBuilder(net)
+
+
+class TransferLearningBuilder:
+    def __init__(self, net: MultiLayerNetwork):
+        if net.params_ is None:
+            raise ValueError("source network must be initialized/trained (call init())")
+        self._src = net
+        # cloned layer list + per-layer origin index (None = new/reinit)
+        self._layers: list[Layer] = [_clone_layer(l) for l in net.conf.layers]
+        self._origin: list[Optional[int]] = list(range(len(self._layers)))
+        self._fine_tune: Optional[FineTuneConfiguration] = None
+        self._freeze_until: Optional[int] = None
+        self._input_type = net.conf.input_type
+
+    # ------------------------------------------------------------ ops
+    def fine_tune_configuration(self, ftc: FineTuneConfiguration) -> "TransferLearningBuilder":
+        self._fine_tune = ftc
+        return self
+
+    def set_feature_extractor(self, layer_index: int) -> "TransferLearningBuilder":
+        """Freeze layers ``0..layer_index`` inclusive (``setFeatureExtractor``)."""
+        self._freeze_until = layer_index
+        return self
+
+    def remove_output_layer(self) -> "TransferLearningBuilder":
+        return self.remove_layers_from_output(1)
+
+    def remove_layers_from_output(self, n: int) -> "TransferLearningBuilder":
+        if n <= 0 or n > len(self._layers):
+            raise ValueError(f"cannot remove {n} layers from a {len(self._layers)}-layer net")
+        del self._layers[-n:]
+        del self._origin[-n:]
+        return self
+
+    def add_layer(self, layer: Layer) -> "TransferLearningBuilder":
+        self._layers.append(layer)
+        self._origin.append(None)
+        return self
+
+    def nout_replace(self, layer_index: int, n_out: int,
+                     weight_init: Optional[Any] = None) -> "TransferLearningBuilder":
+        """Change layer ``layer_index``'s output width; its params and the
+        FOLLOWING layer's params are re-initialized (nIn surgery —
+        ``nOutReplace`` parity)."""
+        layer = self._layers[layer_index]
+        if not hasattr(layer, "n_out"):
+            raise ValueError(f"layer {layer_index} ({layer.TYPE_NAME}) has no n_out")
+        layer.n_out = n_out
+        if weight_init is not None:
+            layer.weight_init = weight_init
+        self._origin[layer_index] = None
+        if layer_index + 1 < len(self._layers):
+            self._origin[layer_index + 1] = None
+        return self
+
+    def set_input_type(self, input_type) -> "TransferLearningBuilder":
+        self._input_type = input_type
+        return self
+
+    # ---------------------------------------------------------- build
+    def build(self) -> MultiLayerNetwork:
+        src_conf = self._src.conf
+        conf = MultiLayerConfiguration(
+            layers=self._layers,
+            input_type=self._input_type,
+            seed=src_conf.seed,
+            updater=src_conf.updater,
+            gradient_normalization=src_conf.gradient_normalization,
+            gradient_normalization_threshold=src_conf.gradient_normalization_threshold,
+            mini_batch=src_conf.mini_batch,
+            backprop_type=src_conf.backprop_type,
+            tbptt_fwd_length=src_conf.tbptt_fwd_length,
+            tbptt_back_length=src_conf.tbptt_back_length,
+            dtype=src_conf.dtype,
+        )
+        if self._fine_tune is not None:
+            self._fine_tune.apply_to(conf)
+        if self._freeze_until is not None:
+            for i in range(min(self._freeze_until + 1, len(conf.layers))):
+                conf.layers[i].frozen = True
+
+        net = MultiLayerNetwork(conf).init()
+        # graft retained params (and state: BN running stats travel too).
+        # Deep-copy: the jit train step donates buffers, so aliasing the
+        # source's arrays would let one net's training delete the other's.
+        import jax
+        import jax.numpy as jnp
+        copy = functools.partial(jax.tree_util.tree_map,
+                                 lambda a: jnp.array(a, copy=True))
+        for i, origin in enumerate(self._origin):
+            if origin is not None:
+                net.params_[i] = copy(self._src.params_[origin])
+                net.state_[i] = copy(self._src.state_[origin])
+        return net

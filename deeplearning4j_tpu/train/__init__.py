@@ -4,9 +4,25 @@ from deeplearning4j_tpu.train.updaters import (
     RmsProp, NoOp,
 )
 from deeplearning4j_tpu.train.trainer import Trainer, make_train_step
+from deeplearning4j_tpu.train.early_stopping import (
+    EarlyStoppingConfiguration, EarlyStoppingTrainer, EarlyStoppingResult,
+    DataSetLossCalculator, ClassificationScoreCalculator,
+    RegressionScoreCalculator, MaxEpochsTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+    MaxTimeIterationTerminationCondition, MaxScoreIterationTerminationCondition,
+    InvalidScoreIterationTerminationCondition, InMemoryModelSaver,
+    LocalFileModelSaver,
+)
 
 __all__ = [
     "updaters", "schedules", "Trainer", "make_train_step",
     "Sgd", "Adam", "AdamW", "AdaMax", "AMSGrad", "Nadam", "Nesterovs",
     "AdaGrad", "AdaDelta", "RmsProp", "NoOp",
+    "EarlyStoppingConfiguration", "EarlyStoppingTrainer", "EarlyStoppingResult",
+    "DataSetLossCalculator", "ClassificationScoreCalculator",
+    "RegressionScoreCalculator", "MaxEpochsTerminationCondition",
+    "ScoreImprovementEpochTerminationCondition",
+    "MaxTimeIterationTerminationCondition", "MaxScoreIterationTerminationCondition",
+    "InvalidScoreIterationTerminationCondition", "InMemoryModelSaver",
+    "LocalFileModelSaver",
 ]

@@ -1,0 +1,350 @@
+"""Early stopping — epoch-driven trainer with termination conditions.
+
+Parity with DL4J's ``org/deeplearning4j/earlystopping/`` package:
+``EarlyStoppingConfiguration`` (score calculator + epoch/iteration
+termination conditions + model saver), ``EarlyStoppingTrainer.fit()`` →
+``EarlyStoppingResult`` (termination reason, score history, best model),
+score calculators (``DataSetLossCalculator``,
+``ClassificationScoreCalculator``, ``RegressionScoreCalculator``), epoch
+conditions (``MaxEpochsTerminationCondition``,
+``ScoreImprovementEpochTerminationCondition``), iteration conditions
+(``MaxTimeIterationTerminationCondition``,
+``MaxScoreIterationTerminationCondition``,
+``InvalidScoreIterationTerminationCondition``), and savers
+(``InMemoryModelSaver``, ``LocalFileModelSaver``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import time
+from typing import Any, Callable, Optional, Sequence
+
+
+# ------------------------------------------------------------------ scores
+class ScoreCalculator:
+    """Computes the model-selection score after each epoch.
+    ``minimize_score()`` says whether lower is better."""
+
+    def calculate_score(self, net) -> float:
+        raise NotImplementedError
+
+    def minimize_score(self) -> bool:
+        return True
+
+
+class DataSetLossCalculator(ScoreCalculator):
+    """Average loss over a held-out iterator (``DataSetLossCalculator``)."""
+
+    def __init__(self, iterator):
+        self.iterator = iterator
+        self._trainer = None  # cached per net: keeps the jit'd eval closure
+
+    def _trainer_for(self, net):
+        from deeplearning4j_tpu.train.trainer import Trainer
+        if self._trainer is None or self._trainer.net is not net:
+            self._trainer = Trainer(net)
+        return self._trainer
+
+    def calculate_score(self, net) -> float:
+        trainer = self._trainer_for(net)
+        total, count = 0.0, 0
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+        for batch in self.iterator:
+            loss = trainer.eval_loss(batch)
+            n = int(batch.features.shape[0]) if hasattr(batch, "features") else 1
+            total += float(loss) * n
+            count += n
+        return total / max(count, 1)
+
+
+class ClassificationScoreCalculator(ScoreCalculator):
+    """Eval-metric score, MAXIMIZED (``ClassificationScoreCalculator``).
+    metric ∈ accuracy|f1|precision|recall."""
+
+    def __init__(self, iterator, metric: str = "accuracy"):
+        self.iterator = iterator
+        self.metric = metric
+
+    def calculate_score(self, net) -> float:
+        ev = net.evaluate(self.iterator)
+        return float(getattr(ev, self.metric)())
+
+    def minimize_score(self) -> bool:
+        return False
+
+
+class RegressionScoreCalculator(ScoreCalculator):
+    """Regression metric, minimized (``RegressionScoreCalculator``).
+    metric ∈ mse|mae|rmse."""
+
+    def __init__(self, iterator, metric: str = "mse"):
+        self.iterator = iterator
+        self.metric = metric
+
+    _METRICS = {"mse": "average_mean_squared_error",
+                "mae": "average_mean_absolute_error",
+                "rmse": "root_mean_squared_error"}
+
+    def calculate_score(self, net) -> float:
+        ev = net.evaluate_regression(self.iterator)
+        return float(getattr(ev, self._METRICS[self.metric])())
+
+
+# ------------------------------------------------------------- conditions
+class EpochTerminationCondition:
+    def initialize(self) -> None:
+        """Reset state at fit() start (DL4J ``initialize()`` parity)."""
+
+    def terminate(self, epoch: int, score: Optional[float], minimize: bool) -> bool:
+        """``score`` is None on epochs where no evaluation ran
+        (``evaluate_every_n_epochs`` > 1)."""
+        raise NotImplementedError
+
+
+class MaxEpochsTerminationCondition(EpochTerminationCondition):
+    def __init__(self, max_epochs: int):
+        self.max_epochs = max_epochs
+
+    def terminate(self, epoch, score, minimize) -> bool:
+        return epoch + 1 >= self.max_epochs
+
+    def __repr__(self):
+        return f"MaxEpochsTerminationCondition({self.max_epochs})"
+
+
+class ScoreImprovementEpochTerminationCondition(EpochTerminationCondition):
+    """Stop when the score hasn't improved by ``min_improvement`` for
+    ``patience`` consecutive evaluated epochs."""
+
+    def __init__(self, patience: int, min_improvement: float = 0.0):
+        self.patience = patience
+        self.min_improvement = min_improvement
+        self._best: Optional[float] = None
+        self._stale = 0
+
+    def initialize(self) -> None:
+        self._best = None
+        self._stale = 0
+
+    def terminate(self, epoch, score, minimize) -> bool:
+        if score is None:       # not an evaluation epoch — no signal
+            return False
+        if self._best is None:
+            self._best = score
+            return False
+        improved = (self._best - score if minimize else score - self._best)
+        if improved > self.min_improvement:
+            self._best = score
+            self._stale = 0
+        else:
+            self._stale += 1
+        return self._stale >= self.patience
+
+    def __repr__(self):
+        return (f"ScoreImprovementEpochTerminationCondition(patience="
+                f"{self.patience}, min_improvement={self.min_improvement})")
+
+
+class IterationTerminationCondition:
+    def initialize(self) -> None:
+        """Reset state at fit() start."""
+
+    def terminate(self, score: float) -> bool:
+        raise NotImplementedError
+
+
+class MaxTimeIterationTerminationCondition(IterationTerminationCondition):
+    def __init__(self, max_seconds: float):
+        self.max_seconds = max_seconds
+        self._start: Optional[float] = None
+
+    def initialize(self):
+        self._start = time.monotonic()
+
+    def terminate(self, score) -> bool:
+        return (time.monotonic() - (self._start or time.monotonic())) > self.max_seconds
+
+    def __repr__(self):
+        return f"MaxTimeIterationTerminationCondition({self.max_seconds}s)"
+
+
+class MaxScoreIterationTerminationCondition(IterationTerminationCondition):
+    """Terminate if the training loss exceeds a bound (divergence guard)."""
+
+    def __init__(self, max_score: float):
+        self.max_score = max_score
+
+    def terminate(self, score) -> bool:
+        return score > self.max_score
+
+    def __repr__(self):
+        return f"MaxScoreIterationTerminationCondition({self.max_score})"
+
+
+class InvalidScoreIterationTerminationCondition(IterationTerminationCondition):
+    def terminate(self, score) -> bool:
+        return math.isnan(score) or math.isinf(score)
+
+    def __repr__(self):
+        return "InvalidScoreIterationTerminationCondition()"
+
+
+# ----------------------------------------------------------------- savers
+class InMemoryModelSaver:
+    """Keeps the best (and optionally latest) model in memory."""
+
+    def __init__(self):
+        self._best = None
+        self._latest = None
+
+    def save_best_model(self, net, score: float) -> None:
+        self._best = (net.clone(), score)
+
+    def save_latest_model(self, net, score: float) -> None:
+        self._latest = (net.clone(), score)
+
+    def get_best_model(self):
+        return self._best[0] if self._best else None
+
+    def get_latest_model(self):
+        return self._latest[0] if self._latest else None
+
+
+class LocalFileModelSaver:
+    """Writes ``bestModel.zip`` / ``latestModel.zip`` under a directory
+    (``LocalFileModelSaver``)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    @property
+    def best_path(self) -> str:
+        return os.path.join(self.directory, "bestModel.zip")
+
+    @property
+    def latest_path(self) -> str:
+        return os.path.join(self.directory, "latestModel.zip")
+
+    def save_best_model(self, net, score: float) -> None:
+        net.save(self.best_path)
+
+    def save_latest_model(self, net, score: float) -> None:
+        net.save(self.latest_path)
+
+    def get_best_model(self):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        if not os.path.exists(self.best_path):
+            return None
+        return MultiLayerNetwork.load(self.best_path)
+
+    def get_latest_model(self):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        if not os.path.exists(self.latest_path):
+            return None
+        return MultiLayerNetwork.load(self.latest_path)
+
+
+# ------------------------------------------------------------ config/result
+@dataclasses.dataclass
+class EarlyStoppingConfiguration:
+    score_calculator: ScoreCalculator
+    epoch_termination_conditions: Sequence[EpochTerminationCondition] = ()
+    iteration_termination_conditions: Sequence[IterationTerminationCondition] = ()
+    model_saver: Any = dataclasses.field(default_factory=InMemoryModelSaver)
+    evaluate_every_n_epochs: int = 1
+    save_last_model: bool = False
+
+
+@dataclasses.dataclass
+class EarlyStoppingResult:
+    termination_reason: str            # "EpochTerminationCondition" | "IterationTerminationCondition" | "Error"
+    termination_details: str
+    score_vs_epoch: dict
+    best_model_epoch: int
+    best_model_score: float
+    total_epochs: int
+    best_model: Any
+
+
+class EarlyStoppingTrainer:
+    """Drives epoch-wise training with early stopping
+    (``EarlyStoppingTrainer.fit`` parity)."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, net, train_iterator,
+                 listeners=None):
+        self.config = config
+        self.net = net
+        self.train_iterator = train_iterator
+        self.listeners = listeners
+
+    def fit(self) -> EarlyStoppingResult:
+        from deeplearning4j_tpu.train.trainer import Trainer
+        cfg = self.config
+        minimize = cfg.score_calculator.minimize_score()
+        best_score = math.inf if minimize else -math.inf
+        best_epoch = -1
+        scores: dict[int, float] = {}
+        trainer = Trainer(self.net, listeners=self.listeners)
+        for cond in cfg.iteration_termination_conditions:
+            cond.initialize()
+        for cond in cfg.epoch_termination_conditions:
+            cond.initialize()
+
+        epoch = 0
+        reason, details = "EpochTerminationCondition", ""
+        while True:
+            # ---- one training epoch, iteration conditions checked per batch
+            stop_iter = None
+            import jax
+            key = jax.random.key(self.net.conf.seed + 1000 + epoch)
+            if hasattr(self.train_iterator, "reset"):
+                self.train_iterator.reset()
+            for batch in self.train_iterator:
+                key, sub = jax.random.split(key)
+                loss = float(trainer.fit_batch(batch, sub))
+                for cond in cfg.iteration_termination_conditions:
+                    if cond.terminate(loss):
+                        stop_iter = cond
+                        break
+                if stop_iter is not None:
+                    break
+            if stop_iter is not None:
+                reason = "IterationTerminationCondition"
+                details = repr(stop_iter)
+                break
+
+            # ---- score + best-model tracking
+            epoch_score: Optional[float] = None
+            if epoch % cfg.evaluate_every_n_epochs == 0:
+                epoch_score = float(cfg.score_calculator.calculate_score(self.net))
+                scores[epoch] = epoch_score
+                better = (epoch_score < best_score if minimize
+                          else epoch_score > best_score)
+                if better:
+                    best_score, best_epoch = epoch_score, epoch
+                    cfg.model_saver.save_best_model(self.net, epoch_score)
+            if cfg.save_last_model:
+                cfg.model_saver.save_latest_model(self.net, epoch_score)
+
+            # ---- epoch conditions (score=None on non-evaluation epochs)
+            stop_epoch = None
+            for cond in cfg.epoch_termination_conditions:
+                if cond.terminate(epoch, epoch_score, minimize):
+                    stop_epoch = cond
+                    break
+            if stop_epoch is not None:
+                details = repr(stop_epoch)
+                break
+            epoch += 1
+
+        best_model = cfg.model_saver.get_best_model()
+        return EarlyStoppingResult(
+            termination_reason=reason, termination_details=details,
+            score_vs_epoch=scores, best_model_epoch=best_epoch,
+            best_model_score=best_score, total_epochs=epoch + 1,
+            best_model=best_model if best_model is not None else self.net)

@@ -29,11 +29,13 @@ from deeplearning4j_tpu.obs.profiler import check_finite
 from deeplearning4j_tpu.train import updaters as updater_mod
 
 
-def make_loss_fn(net, with_carries: bool = False):
+def make_loss_fn(net, with_carries: bool = False, train: bool = True):
     """Build the pure loss fn.  Default signature: (params, state, features,
     labels, fmask, lmask, rng) → (scalar_loss, new_state).  With
     ``with_carries`` (tBPTT), signature gains a ``carries`` arg after
-    ``state`` and the aux becomes ``(new_state, new_carries)``."""
+    ``state`` and the aux becomes ``(new_state, new_carries)``.
+    ``train=False`` scores in inference mode (no dropout; frozen BN stats)
+    — ``DataSetLossCalculator`` / ``MultiLayerNetwork.score(DataSet)``."""
 
     def _score(params, state, score_array, features_mask, labels_mask):
         if score_array is None:
@@ -62,7 +64,7 @@ def make_loss_fn(net, with_carries: bool = False):
         def loss_fn(params, state, carries, features, labels, features_mask,
                     labels_mask, rng):
             out, new_state, score_array, new_carries = net._forward_impl(
-                params, state, features, carries, train=True, rng=rng,
+                params, state, features, carries, train=train, rng=rng,
                 mask=features_mask, labels=labels)
             loss = _score(params, state, score_array, features_mask, labels_mask)
             return loss, (new_state, new_carries)
@@ -70,7 +72,7 @@ def make_loss_fn(net, with_carries: bool = False):
         def loss_fn(params, state, features, labels, features_mask,
                     labels_mask, rng):
             out, new_state, score_array = net._forward(
-                params, state, features, train=True, rng=rng,
+                params, state, features, train=train, rng=rng,
                 mask=features_mask, labels=labels)
             loss = _score(params, state, score_array, features_mask, labels_mask)
             return loss, new_state
@@ -213,6 +215,29 @@ class Trainer:
         """Hook for subclasses (ParallelWrapper shards the batch over the
         mesh here); identity for the single-device trainer."""
         return batch
+
+    def eval_loss(self, batch) -> float:
+        """Inference-mode loss on one batch, no parameter update
+        (``MultiLayerNetwork.score(DataSet)`` parity)."""
+        self._ensure_ready()
+        batch = self._prepare_batch(batch)
+        if getattr(self, "_eval_loss_fn", None) is None:
+            loss_fn = make_loss_fn(self.net, train=False)
+
+            @jax.jit
+            def _eval(params, state, features, labels, fmask, lmask):
+                loss, _ = loss_fn(params, state, features, labels, fmask,
+                                  lmask, None)
+                return loss
+            self._eval_loss_fn = _eval
+        net = self.net
+        fmask = getattr(batch, "features_mask", None)
+        lmask = getattr(batch, "labels_mask", None)
+        return self._eval_loss_fn(
+            net.params_, net.state_, jnp.asarray(batch.features),
+            jnp.asarray(batch.labels),
+            None if fmask is None else jnp.asarray(fmask),
+            None if lmask is None else jnp.asarray(lmask))
 
     def fit_batch(self, batch, rng) -> float:
         """One optimization step on one batch; returns host-side loss."""

@@ -1,0 +1,262 @@
+"""Transfer learning + early stopping (VERDICT #5).
+
+Parity anchors: ``transferlearning/TransferLearning.java`` /
+``FineTuneConfiguration.java`` and ``earlystopping/EarlyStoppingTrainer.java``.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                   TransferLearning, FineTuneConfiguration)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.train import (
+    Adam, Sgd, Trainer, EarlyStoppingConfiguration, EarlyStoppingTrainer,
+    DataSetLossCalculator, ClassificationScoreCalculator,
+    MaxEpochsTerminationCondition, ScoreImprovementEpochTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    InvalidScoreIterationTerminationCondition, InMemoryModelSaver,
+    LocalFileModelSaver)
+
+
+def small_net(n_in=8, n_hidden=16, n_out=3, seed=7):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2)).list()
+            .layer(DenseLayer(n_out=n_hidden, activation="relu"))
+            .layer(DenseLayer(n_out=n_hidden, activation="relu"))
+            .layer(OutputLayer(n_out=n_out, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(n_in)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def blob_data(n=128, n_in=8, n_classes=3, seed=0, center_seed=42):
+    """Gaussian blobs; ``center_seed`` fixes the class geometry so train
+    (seed=0) and held-out (seed=9) sets share the same distribution."""
+    centers = np.random.default_rng(center_seed + n_classes).normal(
+        0, 3.0, (n_classes, n_in))
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, n_classes, n)
+    x = centers[y] + rng.normal(0, 0.5, (n, n_in))
+    return DataSet(x.astype(np.float32),
+                   np.eye(n_classes, dtype=np.float32)[y])
+
+
+def batches(ds, bs=32):
+    return ListDataSetIterator(
+        [DataSet(ds.features[i:i + bs], ds.labels[i:i + bs])
+         for i in range(0, ds.features.shape[0], bs)])
+
+
+class TestTransferLearning:
+    def test_feature_extractor_freezes_and_grafts(self):
+        src = small_net()
+        ds = blob_data()
+        Trainer(src).fit(batches(ds), epochs=3)
+        frozen_w_before = np.asarray(src.params_[0]["W"])
+
+        net2 = (TransferLearning.builder(src)
+                .fine_tune_configuration(FineTuneConfiguration(updater=Sgd(1e-2)))
+                .set_feature_extractor(1)          # freeze layers 0..1
+                .remove_output_layer()
+                .add_layer(OutputLayer(n_out=5, activation="softmax", loss="mcxent"))
+                .build())
+        assert net2.layers[0].frozen and net2.layers[1].frozen
+        assert not net2.layers[2].frozen
+        # grafted weights identical to source
+        np.testing.assert_array_equal(np.asarray(net2.params_[0]["W"]),
+                                      frozen_w_before)
+        # train on a 5-class problem; frozen layers must not move
+        ds5 = blob_data(n_classes=5, seed=1)
+        Trainer(net2).fit(batches(ds5), epochs=2)
+        np.testing.assert_array_equal(np.asarray(net2.params_[0]["W"]),
+                                      frozen_w_before)
+        # new head DID move and the net is trainable end-to-end
+        assert net2.output(ds5.features[:4]).shape == (4, 5)
+
+    def test_nout_replace_reinits_neighbors(self):
+        src = small_net()
+        w1_before = np.asarray(src.params_[1]["W"])
+        net2 = (TransferLearning.builder(src)
+                .nout_replace(1, 32)               # widen hidden layer 1
+                .build())
+        assert net2.params_[1]["W"].shape == (16, 32)
+        assert net2.params_[2]["W"].shape == (32, 3)   # nIn surgery propagated
+        # untouched layer 0 is grafted, not re-initialized
+        np.testing.assert_array_equal(np.asarray(net2.params_[0]["W"]),
+                                      np.asarray(src.params_[0]["W"]))
+        assert w1_before.shape != net2.params_[1]["W"].shape
+
+    def test_fine_tune_overrides_cascade(self):
+        src = small_net()
+        net2 = (TransferLearning.builder(src)
+                .fine_tune_configuration(FineTuneConfiguration(
+                    updater=Sgd(0.5), l2=1e-3, dropout=0.8))
+                .build())
+        assert all(l.l2 == 1e-3 for l in net2.layers)
+        assert all(l.dropout == 0.8 for l in net2.layers)
+        from deeplearning4j_tpu.train.updaters import Sgd as SgdCfg
+        assert isinstance(net2.conf.updater, SgdCfg)
+
+    def test_config_json_round_trip_after_surgery(self):
+        src = small_net()
+        net2 = (TransferLearning.builder(src).set_feature_extractor(0)
+                .remove_output_layer()
+                .add_layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+                .build())
+        from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+        rt = MultiLayerConfiguration.from_json(net2.conf.to_json())
+        assert rt.layers[0].frozen
+        assert rt.layers[-1].n_out == 4
+
+    def test_invalid_surgery_raises(self):
+        src = small_net()
+        with pytest.raises(ValueError):
+            TransferLearning.builder(src).remove_layers_from_output(4)
+        with pytest.raises(ValueError):
+            TransferLearning.builder(MultiLayerNetwork(src.conf))  # uninitialized
+        from deeplearning4j_tpu.nn.layers.core import ActivationLayer
+        net_with_act = (TransferLearning.builder(src)
+                        .add_layer(ActivationLayer(activation="tanh")))
+        with pytest.raises(ValueError):
+            net_with_act.nout_replace(3, 5)  # ActivationLayer has no n_out
+
+
+class TestEarlyStopping:
+    def _fit(self, config, net=None, data_seed=0):
+        net = net or small_net()
+        tr = batches(blob_data(seed=data_seed))
+        return EarlyStoppingTrainer(config, net, tr).fit()
+
+    def test_max_epochs_condition(self):
+        net = small_net()
+        te = batches(blob_data(seed=9))
+        result = self._fit(EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(te),
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(4)]),
+            net=net)
+        assert result.total_epochs == 4
+        assert result.termination_reason == "EpochTerminationCondition"
+        assert "MaxEpochs" in result.termination_details
+        assert len(result.score_vs_epoch) == 4
+        assert result.best_model is not None
+
+    def test_plateau_halts_and_restores_best(self):
+        """Score stops improving → patience trips; best model (not last)
+        is returned."""
+        net = small_net()
+        te = batches(blob_data(seed=9))
+        saver = InMemoryModelSaver()
+        result = self._fit(EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(te),
+            epoch_termination_conditions=[
+                ScoreImprovementEpochTerminationCondition(
+                    patience=3, min_improvement=1e-3),
+                MaxEpochsTerminationCondition(50)],
+            model_saver=saver), net=net)
+        assert result.total_epochs < 50          # plateau tripped before cap
+        best = result.best_model
+        # best model's held-out loss matches the recorded best score
+        calc = DataSetLossCalculator(te)
+        np.testing.assert_allclose(calc.calculate_score(best),
+                                   result.best_model_score, rtol=1e-4)
+        assert result.best_model_epoch in result.score_vs_epoch
+
+    def test_classification_score_maximized(self):
+        net = small_net()
+        te = batches(blob_data(seed=9))
+        result = self._fit(EarlyStoppingConfiguration(
+            score_calculator=ClassificationScoreCalculator(te, "accuracy"),
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(5)]),
+            net=net)
+        assert 0.0 <= result.best_model_score <= 1.0
+        # accuracy improves over random 1/3 on separable blobs
+        assert result.best_model_score > 0.5
+
+    def test_divergence_guard_iteration_condition(self):
+        net = small_net()
+        te = batches(blob_data(seed=9))
+        result = self._fit(EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(te),
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(50)],
+            iteration_termination_conditions=[
+                MaxScoreIterationTerminationCondition(1e-9)]),  # trips instantly
+            net=net)
+        assert result.termination_reason == "IterationTerminationCondition"
+        assert result.total_epochs == 1
+
+    def test_local_file_saver(self, tmp_path):
+        net = small_net()
+        te = batches(blob_data(seed=9))
+        saver = LocalFileModelSaver(str(tmp_path))
+        result = self._fit(EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(te),
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(3)],
+            model_saver=saver), net=net)
+        assert (tmp_path / "bestModel.zip").exists()
+        loaded = saver.get_best_model()
+        x = np.asarray(blob_data(seed=9).features[:4])
+        np.testing.assert_allclose(np.asarray(loaded.output(x)),
+                                   np.asarray(result.best_model.output(x)),
+                                   rtol=1e-5)
+
+    def test_invalid_score_condition(self):
+        cond = InvalidScoreIterationTerminationCondition()
+        assert cond.terminate(float("nan"))
+        assert cond.terminate(float("inf"))
+        assert not cond.terminate(1.0)
+
+    def test_skipped_eval_epochs_dont_count_as_stale(self):
+        """evaluate_every_n_epochs>1: patience counts evaluated epochs only."""
+        net = small_net()
+        te = batches(blob_data(seed=9))
+        result = self._fit(EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(te),
+            epoch_termination_conditions=[
+                ScoreImprovementEpochTerminationCondition(patience=2, min_improvement=1e9),
+                MaxEpochsTerminationCondition(20)],
+            evaluate_every_n_epochs=3), net=net)
+        # min_improvement=1e9 → every eval is "no improvement"; evals happen
+        # at epochs 0,3,6 → patience 2 trips at epoch 6, not at epoch 2
+        assert result.total_epochs == 7
+
+    def test_conditions_reset_between_fits(self):
+        """A reused config starts clean (initialize() parity)."""
+        cond = ScoreImprovementEpochTerminationCondition(patience=1, min_improvement=1e9)
+        te = batches(blob_data(seed=9))
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(te),
+            epoch_termination_conditions=[cond, MaxEpochsTerminationCondition(10)])
+        r1 = self._fit(cfg)
+        r2 = self._fit(cfg)          # fresh net, same config object
+        assert r1.total_epochs == r2.total_epochs == 2
+
+    def test_save_last_model(self, tmp_path):
+        net = small_net()
+        te = batches(blob_data(seed=9))
+        saver = LocalFileModelSaver(str(tmp_path))
+        self._fit(EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(te),
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(2)],
+            model_saver=saver, save_last_model=True), net=net)
+        assert (tmp_path / "latestModel.zip").exists()
+        assert saver.get_latest_model() is not None
+
+
+class TestTransferDonationSafety:
+    def test_source_survives_transfer_net_training(self):
+        """Grafted params are deep copies — training either net must not
+        delete the other's donated buffers."""
+        src = small_net()
+        ds = blob_data()
+        Trainer(src).fit(batches(ds), epochs=1)
+        net2 = (TransferLearning.builder(src).remove_output_layer()
+                .add_layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+                .build())
+        Trainer(net2).fit(batches(ds), epochs=1)     # donates net2 buffers
+        _ = np.asarray(src.output(ds.features[:2]))  # src still alive
+        Trainer(src).fit(batches(ds), epochs=1)      # donates src buffers
+        _ = np.asarray(net2.output(ds.features[:2])) # net2 still alive
