@@ -11,10 +11,16 @@ from deeplearning4j_tpu.data.normalizers import (
     NormalizerMinMaxScaler,
     ImagePreProcessingScaler,
 )
+from deeplearning4j_tpu.data.transform import (
+    Schema, TransformProcess, ColumnCondition, BooleanCondition, Join,
+    analyze, TransformProcessRecordReader,
+)
 
 __all__ = [
     "DataSet", "MultiDataSet",
     "DataSetIterator", "ListDataSetIterator", "ArrayDataSetIterator",
     "AsyncDataSetIterator", "EarlyTerminationIterator",
     "NormalizerStandardize", "NormalizerMinMaxScaler", "ImagePreProcessingScaler",
+    "Schema", "TransformProcess", "ColumnCondition", "BooleanCondition", "Join",
+    "analyze", "TransformProcessRecordReader",
 ]

@@ -1,0 +1,999 @@
+"""DataVec TransformProcess — declarative, schema'd ETL.
+
+Parity with the reference's ``datavec-api``
+(``org/datavec/api/transform/TransformProcess.java``,
+``schema/Schema.java``, ``transform/**``, ``condition/**``,
+``filter/**``, ``reduce/**``, ``sequence/**``, ``join/Join.java``,
+``analysis/AnalyzeLocal``): a ``Schema`` describes typed columns; a
+``TransformProcess`` is a serializable list of operations built fluently
+against that schema (each step derives the next schema eagerly, so
+column-name errors surface at build time, not execute time); a local
+executor applies it to records (lists of values) or sequences (lists of
+records).  JSON round-trip included — the declarative form IS the
+artifact, as in the reference.
+
+Host-side ETL is plain python/numpy by design: the TPU sees only the
+final dense arrays (via ``TransformProcessRecordReader`` →
+``RecordReaderDataSetIterator``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import re as _re
+import time as _time
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+
+# ===================================================================== Schema
+class ColumnType:
+    STRING = "string"
+    INTEGER = "integer"
+    LONG = "long"
+    DOUBLE = "double"
+    FLOAT = "float"
+    CATEGORICAL = "categorical"
+    TIME = "time"
+    BOOLEAN = "boolean"
+
+
+@dataclasses.dataclass
+class ColumnMeta:
+    name: str
+    type: str
+    state: dict = dataclasses.field(default_factory=dict)  # e.g. categories
+
+    def to_dict(self):
+        return {"name": self.name, "type": self.type, "state": self.state}
+
+    @staticmethod
+    def from_dict(d):
+        return ColumnMeta(d["name"], d["type"], d.get("state", {}))
+
+
+class Schema:
+    """Ordered, typed column spec (``schema/Schema.java``)."""
+
+    def __init__(self, columns: Sequence[ColumnMeta]):
+        self.columns = list(columns)
+        names = [c.name for c in self.columns]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate column names: {names}")
+        self._index = {c.name: i for i, c in enumerate(self.columns)}
+
+    # ---- builder ----------------------------------------------------
+    @staticmethod
+    def builder() -> "SchemaBuilder":
+        return SchemaBuilder()
+
+    # ---- queries ----------------------------------------------------
+    def names(self) -> list[str]:
+        return [c.name for c in self.columns]
+
+    def index_of(self, name: str) -> int:
+        if name not in self._index:
+            raise ValueError(f"no column '{name}'; columns: {self.names()}")
+        return self._index[name]
+
+    def column(self, name: str) -> ColumnMeta:
+        return self.columns[self.index_of(name)]
+
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    # ---- serde ------------------------------------------------------
+    def to_dict(self):
+        return {"columns": [c.to_dict() for c in self.columns]}
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    @staticmethod
+    def from_dict(d) -> "Schema":
+        return Schema([ColumnMeta.from_dict(c) for c in d["columns"]])
+
+    @staticmethod
+    def from_json(s: str) -> "Schema":
+        return Schema.from_dict(json.loads(s))
+
+    def __eq__(self, other):
+        return isinstance(other, Schema) and self.to_dict() == other.to_dict()
+
+    def __repr__(self):
+        cols = ", ".join(f"{c.name}:{c.type}" for c in self.columns)
+        return f"Schema({cols})"
+
+
+class SchemaBuilder:
+    def __init__(self):
+        self._cols: list[ColumnMeta] = []
+
+    def add_column_string(self, *names): return self._add(ColumnType.STRING, names)
+    def add_column_integer(self, *names): return self._add(ColumnType.INTEGER, names)
+    def add_column_long(self, *names): return self._add(ColumnType.LONG, names)
+    def add_column_double(self, *names): return self._add(ColumnType.DOUBLE, names)
+    def add_column_float(self, *names): return self._add(ColumnType.FLOAT, names)
+    def add_column_boolean(self, *names): return self._add(ColumnType.BOOLEAN, names)
+    def add_column_time(self, *names): return self._add(ColumnType.TIME, names)
+
+    def add_column_categorical(self, name, categories: Sequence[str]):
+        self._cols.append(ColumnMeta(name, ColumnType.CATEGORICAL,
+                                     {"categories": list(categories)}))
+        return self
+
+    def _add(self, ctype, names):
+        for n in names:
+            self._cols.append(ColumnMeta(n, ctype))
+        return self
+
+    def build(self) -> Schema:
+        return Schema(self._cols)
+
+
+# ================================================================ Conditions
+_CONDITION_REGISTRY: dict[str, type] = {}
+
+
+def register_condition(name):
+    def deco(cls):
+        cls.TYPE_NAME = name
+        _CONDITION_REGISTRY[name] = cls
+        return cls
+    return deco
+
+
+@dataclasses.dataclass
+class Condition:
+    """Per-record predicate (``condition/Condition.java``)."""
+
+    def test(self, record: list, schema: Schema) -> bool:
+        raise NotImplementedError
+
+    def to_dict(self):
+        out = {"type": self.TYPE_NAME}
+        out.update(dataclasses.asdict(self))
+        return out
+
+    @staticmethod
+    def from_dict(d) -> "Condition":
+        d = dict(d)
+        cls = _CONDITION_REGISTRY[d.pop("type")]
+        return cls(**d)
+
+
+_OPS: dict[str, Callable[[Any, Any], bool]] = {
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "in": lambda a, b: a in b,
+    "not_in": lambda a, b: a not in b,
+}
+
+
+@register_condition("column")
+@dataclasses.dataclass
+class ColumnCondition(Condition):
+    """value-vs-constant comparison on one column
+    (``IntegerColumnCondition`` et al., unified)."""
+    column: str = ""
+    op: str = "=="
+    value: Any = None
+
+    def test(self, record, schema):
+        return _OPS[self.op](record[schema.index_of(self.column)], self.value)
+
+
+@register_condition("string_regex")
+@dataclasses.dataclass
+class StringRegexColumnCondition(Condition):
+    column: str = ""
+    regex: str = ""
+
+    def test(self, record, schema):
+        return _re.fullmatch(self.regex, str(record[schema.index_of(self.column)])) is not None
+
+
+@register_condition("null")
+@dataclasses.dataclass
+class NullWritableColumnCondition(Condition):
+    column: str = ""
+
+    def test(self, record, schema):
+        v = record[schema.index_of(self.column)]
+        return v is None or v == "" or (isinstance(v, float) and math.isnan(v))
+
+
+@register_condition("bool_logic")
+@dataclasses.dataclass
+class BooleanCondition(Condition):
+    """AND/OR/NOT combinator (``BooleanCondition``)."""
+    logic: str = "and"            # and | or | not
+    conditions: list = dataclasses.field(default_factory=list)
+
+    def __post_init__(self):
+        self.conditions = [c if isinstance(c, Condition) else Condition.from_dict(c)
+                           for c in self.conditions]
+
+    def test(self, record, schema):
+        results = (c.test(record, schema) for c in self.conditions)
+        if self.logic == "and":
+            return all(results)
+        if self.logic == "or":
+            return any(results)
+        if self.logic == "not":
+            return not self.conditions[0].test(record, schema)
+        raise ValueError(f"unknown logic {self.logic}")
+
+    def to_dict(self):
+        return {"type": self.TYPE_NAME, "logic": self.logic,
+                "conditions": [c.to_dict() for c in self.conditions]}
+
+
+# ================================================================ Transforms
+_STEP_REGISTRY: dict[str, type] = {}
+
+
+def register_step(name):
+    def deco(cls):
+        cls.TYPE_NAME = name
+        _STEP_REGISTRY[name] = cls
+        return cls
+    return deco
+
+
+class Step:
+    """One TransformProcess operation: schema mapping + execution."""
+
+    def output_schema(self, schema: Schema) -> Schema:
+        return schema
+
+    # record-level steps implement apply(record, schema) -> record | None
+    def apply(self, record: list, schema: Schema) -> Optional[list]:
+        raise NotImplementedError
+
+    # sequence-level steps override apply_sequence (1 seq → 1 seq) or
+    # apply_sequences (N seqs → M seqs, e.g. splitting)
+    def apply_sequence(self, seq: list[list], schema: Schema) -> list[list]:
+        out = []
+        for rec in seq:
+            r = self.apply(rec, schema)
+            if r is not None:
+                out.append(r)
+        return out
+
+    def apply_sequences(self, seqs: list[list[list]], schema: Schema) -> list[list[list]]:
+        out = [self.apply_sequence(seq, schema) for seq in seqs]
+        return [s for s in out if s]
+
+    def to_dict(self):
+        out = {"type": self.TYPE_NAME}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if isinstance(v, Condition):
+                v = v.to_dict()
+            out[f.name] = v
+        return out
+
+    @staticmethod
+    def from_dict(d) -> "Step":
+        d = dict(d)
+        cls = _STEP_REGISTRY[d.pop("type")]
+        fields = {f.name: f for f in dataclasses.fields(cls)}
+        kwargs = {}
+        for k, v in d.items():
+            if k in fields:
+                if isinstance(v, dict) and v.get("type") in _CONDITION_REGISTRY:
+                    v = Condition.from_dict(v)
+                kwargs[k] = v
+        return cls(**kwargs)
+
+
+@register_step("remove_columns")
+@dataclasses.dataclass
+class RemoveColumns(Step):
+    columns: list = dataclasses.field(default_factory=list)
+
+    def output_schema(self, schema):
+        for c in self.columns:
+            schema.index_of(c)
+        return Schema([c for c in schema.columns if c.name not in self.columns])
+
+    def apply(self, record, schema):
+        drop = {schema.index_of(c) for c in self.columns}
+        return [v for i, v in enumerate(record) if i not in drop]
+
+
+@register_step("keep_columns")
+@dataclasses.dataclass
+class RemoveAllColumnsExcept(Step):
+    columns: list = dataclasses.field(default_factory=list)
+
+    def output_schema(self, schema):
+        return Schema([schema.column(c) for c in self.columns])
+
+    def apply(self, record, schema):
+        return [record[schema.index_of(c)] for c in self.columns]
+
+
+@register_step("rename_column")
+@dataclasses.dataclass
+class RenameColumn(Step):
+    old: str = ""
+    new: str = ""
+
+    def output_schema(self, schema):
+        cols = [dataclasses.replace(c, name=self.new) if c.name == self.old else c
+                for c in schema.columns]
+        if self.old not in schema.names():
+            raise ValueError(f"no column '{self.old}'")
+        return Schema(cols)
+
+    def apply(self, record, schema):
+        return record
+
+
+@register_step("duplicate_column")
+@dataclasses.dataclass
+class DuplicateColumn(Step):
+    column: str = ""
+    new_name: str = ""
+
+    def output_schema(self, schema):
+        src = schema.column(self.column)
+        return Schema(schema.columns + [dataclasses.replace(src, name=self.new_name)])
+
+    def apply(self, record, schema):
+        return record + [record[schema.index_of(self.column)]]
+
+
+@register_step("categorical_to_integer")
+@dataclasses.dataclass
+class CategoricalToInteger(Step):
+    column: str = ""
+
+    def output_schema(self, schema):
+        col = schema.column(self.column)
+        if col.type != ColumnType.CATEGORICAL:
+            raise ValueError(f"'{self.column}' is {col.type}, not categorical")
+        cols = [ColumnMeta(c.name, ColumnType.INTEGER,
+                           {"categories": c.state["categories"]})
+                if c.name == self.column else c for c in schema.columns]
+        return Schema(cols)
+
+    def apply(self, record, schema):
+        i = schema.index_of(self.column)
+        cats = schema.column(self.column).state["categories"]
+        out = list(record)
+        out[i] = cats.index(out[i])
+        return out
+
+
+@register_step("categorical_to_one_hot")
+@dataclasses.dataclass
+class CategoricalToOneHot(Step):
+    column: str = ""
+
+    def output_schema(self, schema):
+        col = schema.column(self.column)
+        if col.type != ColumnType.CATEGORICAL:
+            raise ValueError(f"'{self.column}' is {col.type}, not categorical")
+        cats = col.state["categories"]
+        cols = []
+        for c in schema.columns:
+            if c.name == self.column:
+                cols.extend(ColumnMeta(f"{self.column}[{cat}]", ColumnType.INTEGER)
+                            for cat in cats)
+            else:
+                cols.append(c)
+        return Schema(cols)
+
+    def apply(self, record, schema):
+        i = schema.index_of(self.column)
+        cats = schema.column(self.column).state["categories"]
+        onehot = [1 if record[i] == cat else 0 for cat in cats]
+        return record[:i] + onehot + record[i + 1:]
+
+
+@register_step("integer_to_categorical")
+@dataclasses.dataclass
+class IntegerToCategorical(Step):
+    column: str = ""
+    categories: list = dataclasses.field(default_factory=list)
+
+    def output_schema(self, schema):
+        cols = [ColumnMeta(c.name, ColumnType.CATEGORICAL,
+                           {"categories": list(self.categories)})
+                if c.name == self.column else c for c in schema.columns]
+        if self.column not in schema.names():
+            raise ValueError(f"no column '{self.column}'")
+        return Schema(cols)
+
+    def apply(self, record, schema):
+        i = schema.index_of(self.column)
+        out = list(record)
+        out[i] = self.categories[int(out[i])]
+        return out
+
+
+@register_step("string_to_categorical")
+@dataclasses.dataclass
+class StringToCategorical(Step):
+    column: str = ""
+    categories: list = dataclasses.field(default_factory=list)
+
+    def output_schema(self, schema):
+        schema.index_of(self.column)  # build-time validation
+        cols = [ColumnMeta(c.name, ColumnType.CATEGORICAL,
+                           {"categories": list(self.categories)})
+                if c.name == self.column else c for c in schema.columns]
+        return Schema(cols)
+
+    def apply(self, record, schema):
+        return record
+
+
+_MATH = {
+    "add": lambda a, b: a + b, "subtract": lambda a, b: a - b,
+    "multiply": lambda a, b: a * b, "divide": lambda a, b: a / b,
+    "modulus": lambda a, b: a % b, "reverse_subtract": lambda a, b: b - a,
+    "reverse_divide": lambda a, b: b / a,
+    "min": min, "max": max, "pow": lambda a, b: a ** b,
+}
+
+
+@register_step("math_op")
+@dataclasses.dataclass
+class MathOpTransform(Step):
+    """column ∘ scalar (``DoubleMathOpTransform``/``IntegerMathOpTransform``)."""
+    column: str = ""
+    op: str = "add"
+    value: float = 0.0
+
+    def apply(self, record, schema):
+        i = schema.index_of(self.column)
+        out = list(record)
+        out[i] = _MATH[self.op](out[i], self.value)
+        return out
+
+
+@register_step("columns_math_op")
+@dataclasses.dataclass
+class ColumnsMathOpTransform(Step):
+    """new column = op(reduce over columns) (``DoubleColumnsMathOpTransform``)."""
+    new_name: str = ""
+    op: str = "add"
+    columns: list = dataclasses.field(default_factory=list)
+
+    def output_schema(self, schema):
+        for c in self.columns:
+            schema.index_of(c)
+        return Schema(schema.columns + [ColumnMeta(self.new_name, ColumnType.DOUBLE)])
+
+    def apply(self, record, schema):
+        vals = [record[schema.index_of(c)] for c in self.columns]
+        acc = vals[0]
+        for v in vals[1:]:
+            acc = _MATH[self.op](acc, v)
+        return record + [acc]
+
+
+@register_step("string_map")
+@dataclasses.dataclass
+class StringMapTransform(Step):
+    column: str = ""
+    mapping: dict = dataclasses.field(default_factory=dict)
+
+    def apply(self, record, schema):
+        i = schema.index_of(self.column)
+        out = list(record)
+        out[i] = self.mapping.get(out[i], out[i])
+        return out
+
+
+@register_step("string_fn")
+@dataclasses.dataclass
+class StringFnTransform(Step):
+    """lower/upper/trim/append/prepend (``ChangeCaseStringTransform`` etc.)."""
+    column: str = ""
+    fn: str = "lower"
+    arg: str = ""
+
+    def apply(self, record, schema):
+        i = schema.index_of(self.column)
+        out = list(record)
+        v = str(out[i])
+        if self.fn == "lower":
+            v = v.lower()
+        elif self.fn == "upper":
+            v = v.upper()
+        elif self.fn == "trim":
+            v = v.strip()
+        elif self.fn == "append":
+            v = v + self.arg
+        elif self.fn == "prepend":
+            v = self.arg + v
+        elif self.fn == "replace":
+            old, _, new = self.arg.partition("→")
+            v = v.replace(old, new)
+        else:
+            raise ValueError(f"unknown string fn {self.fn}")
+        out[i] = v
+        return out
+
+
+@register_step("string_to_time")
+@dataclasses.dataclass
+class StringToTimeTransform(Step):
+    """Parse a time string to epoch millis (``StringToTimeTransform``)."""
+    column: str = ""
+    format: str = "%Y-%m-%d %H:%M:%S"
+
+    def output_schema(self, schema):
+        cols = [ColumnMeta(c.name, ColumnType.TIME) if c.name == self.column else c
+                for c in schema.columns]
+        if self.column not in schema.names():
+            raise ValueError(f"no column '{self.column}'")
+        return Schema(cols)
+
+    def apply(self, record, schema):
+        import calendar
+        import datetime as _dt
+        i = schema.index_of(self.column)
+        out = list(record)
+        dt = _dt.datetime.strptime(str(out[i]), self.format)
+        out[i] = int(calendar.timegm(dt.timetuple()) * 1000)
+        return out
+
+
+@register_step("replace_invalid")
+@dataclasses.dataclass
+class ReplaceInvalidWithIntegerTransform(Step):
+    """Replace unparseable/missing numerics (``ReplaceInvalidWithIntegerTransform``)."""
+    column: str = ""
+    value: Any = 0
+
+    def apply(self, record, schema):
+        i = schema.index_of(self.column)
+        out = list(record)
+        v = out[i]
+        bad = v is None or v == "" or (isinstance(v, float) and math.isnan(v))
+        if not bad and isinstance(v, str):
+            try:
+                float(v)
+            except ValueError:
+                bad = True
+        if bad:
+            out[i] = self.value
+        return out
+
+
+@register_step("conditional_replace")
+@dataclasses.dataclass
+class ConditionalReplaceValueTransform(Step):
+    column: str = ""
+    value: Any = None
+    condition: Any = None
+
+    def apply(self, record, schema):
+        out = list(record)
+        if self.condition.test(record, schema):
+            out[schema.index_of(self.column)] = self.value
+        return out
+
+
+@register_step("filter")
+@dataclasses.dataclass
+class FilterByCondition(Step):
+    """DROP records matching the condition (``ConditionFilter`` semantics:
+    filter = remove when condition true)."""
+    condition: Any = None
+
+    def apply(self, record, schema):
+        return None if self.condition.test(record, schema) else record
+
+
+# ------------------------------------------------------------- sequence ops
+@register_step("convert_to_sequence")
+@dataclasses.dataclass
+class ConvertToSequence(Step):
+    """Group records by key column(s), order each group by a time/compare
+    column (``ConvertToSequence`` + ``NumericalColumnComparator``)."""
+    key_columns: list = dataclasses.field(default_factory=list)
+    order_column: str = ""
+
+    def apply(self, record, schema):  # handled by executor
+        return record
+
+
+@register_step("offset_sequence")
+@dataclasses.dataclass
+class SequenceOffsetTransform(Step):
+    """Shift columns within a sequence by ``offset`` steps (creating
+    next-step prediction targets); trims edge rows (``SequenceOffsetTransform``)."""
+    columns: list = dataclasses.field(default_factory=list)
+    offset: int = 1
+
+    def apply_sequence(self, seq, schema):
+        if not seq:
+            return seq
+        k = self.offset
+        idxs = [schema.index_of(c) for c in self.columns]
+        n = len(seq)
+        if abs(k) >= n:
+            return []
+        out = []
+        for t in range(n - abs(k)):
+            src_shifted = seq[t + abs(k)] if k > 0 else seq[t]
+            src_base = seq[t] if k > 0 else seq[t + abs(k)]
+            row = list(src_base)
+            for i in idxs:
+                row[i] = src_shifted[i]
+            out.append(row)
+        return out
+
+    def apply(self, record, schema):
+        return record
+
+
+@register_step("split_sequence")
+@dataclasses.dataclass
+class SplitSequenceWhenGap(Step):
+    """Split a sequence where consecutive values of ``column`` differ by
+    more than ``max_gap`` (``SequenceSplitTimeSeparation`` analog)."""
+    column: str = ""
+    max_gap: float = 0.0
+
+    def apply(self, record, schema):
+        return record
+
+    def apply_sequences(self, seqs, schema):
+        col = schema.index_of(self.column)
+        out = []
+        for seq in seqs:
+            chunk = [seq[0]] if seq else []
+            for prev, cur in zip(seq, seq[1:]):
+                if abs(cur[col] - prev[col]) > self.max_gap:
+                    out.append(chunk)
+                    chunk = []
+                chunk.append(cur)
+            if chunk:
+                out.append(chunk)
+        return out
+
+
+# ==================================================================== Reduce
+def _stdev(vs):
+    mean = sum(vs) / len(vs)
+    return (sum((v - mean) ** 2 for v in vs) / max(len(vs) - 1, 1)) ** 0.5
+
+
+_REDUCERS: dict[str, Callable] = {
+    "sum": lambda vs: sum(vs),
+    "mean": lambda vs: sum(vs) / len(vs),
+    "min": min, "max": max,
+    "count": len,
+    "first": lambda vs: vs[0],
+    "last": lambda vs: vs[-1],
+    "range": lambda vs: max(vs) - min(vs),
+    "stdev": _stdev,
+    "count_unique": lambda vs: len(set(vs)),
+}
+
+
+@register_step("reduce")
+@dataclasses.dataclass
+class Reducer(Step):
+    """Group-by + per-column aggregation (``reduce/Reducer.java``)."""
+    key_columns: list = dataclasses.field(default_factory=list)
+    ops: dict = dataclasses.field(default_factory=dict)  # column -> op name
+
+    def output_schema(self, schema):
+        cols = [schema.column(k) for k in self.key_columns]
+        for col, op in self.ops.items():
+            src = schema.column(col)
+            ctype = ColumnType.INTEGER if op in ("count", "count_unique") else (
+                ColumnType.DOUBLE if op in ("mean", "stdev") else src.type)
+            cols.append(ColumnMeta(f"{op}({col})", ctype))
+        return Schema(cols)
+
+    def apply(self, record, schema):  # executor-level op
+        return record
+
+    def reduce(self, records: list[list], schema: Schema) -> list[list]:
+        groups: dict[tuple, list[list]] = {}
+        order: list[tuple] = []
+        key_idx = [schema.index_of(k) for k in self.key_columns]
+        for rec in records:
+            key = tuple(rec[i] for i in key_idx)
+            if key not in groups:
+                groups[key] = []
+                order.append(key)
+            groups[key].append(rec)
+        out = []
+        for key in order:
+            row = list(key)
+            for col, op in self.ops.items():
+                vals = [r[schema.index_of(col)] for r in groups[key]]
+                row.append(_REDUCERS[op](vals))
+            out.append(row)
+        return out
+
+
+# ====================================================================== Join
+@dataclasses.dataclass
+class Join:
+    """Two-dataset join on key columns (``join/Join.java``).
+    join_type ∈ inner|left_outer|right_outer|full_outer."""
+    left_schema: Schema
+    right_schema: Schema
+    join_columns: list[str]
+    join_type: str = "inner"
+
+    def output_schema(self) -> Schema:
+        cols = list(self.left_schema.columns)
+        for c in self.right_schema.columns:
+            if c.name not in self.join_columns:
+                cols.append(c)
+        return Schema(cols)
+
+    def execute(self, left: list[list], right: list[list]) -> list[list]:
+        li = [self.left_schema.index_of(c) for c in self.join_columns]
+        ri = [self.right_schema.index_of(c) for c in self.join_columns]
+        r_other = [i for i in range(self.right_schema.num_columns()) if i not in ri]
+        l_width, r_width = self.left_schema.num_columns(), len(r_other)
+
+        right_map: dict[tuple, list[list]] = {}
+        for rec in right:
+            right_map.setdefault(tuple(rec[i] for i in ri), []).append(rec)
+
+        out = []
+        matched_right: set[tuple] = set()
+        for rec in left:
+            key = tuple(rec[i] for i in li)
+            matches = right_map.get(key, [])
+            if matches:
+                matched_right.add(key)
+                for m in matches:
+                    out.append(list(rec) + [m[i] for i in r_other])
+            elif self.join_type in ("left_outer", "full_outer"):
+                out.append(list(rec) + [None] * r_width)
+        if self.join_type in ("right_outer", "full_outer"):
+            for key, recs in right_map.items():
+                if key not in matched_right:
+                    for m in recs:
+                        row = [None] * l_width
+                        for c, i in zip(self.join_columns, li):
+                            row[i] = m[self.right_schema.index_of(c)]
+                        out.append(row + [m[i] for i in r_other])
+        return out
+
+
+# =========================================================== TransformProcess
+class TransformProcess:
+    """Fluent, serializable pipeline (``TransformProcess.Builder`` parity).
+    Built eagerly: every step validates against the running schema."""
+
+    def __init__(self, initial_schema: Schema, steps: Sequence[Step] = ()):
+        self.initial_schema = initial_schema
+        self.steps = list(steps)
+        self._schemas = [initial_schema]
+        for s in self.steps:
+            self._schemas.append(s.output_schema(self._schemas[-1]))
+
+    @staticmethod
+    def builder(schema: Schema) -> "TransformProcessBuilder":
+        return TransformProcessBuilder(schema)
+
+    def final_schema(self) -> Schema:
+        return self._schemas[-1]
+
+    def schema_after(self, step_idx: int) -> Schema:
+        return self._schemas[step_idx + 1]
+
+    # ---- execution ---------------------------------------------------
+    def execute(self, records: Iterable[list]) -> list[list]:
+        """Apply to independent records.  Reducer steps aggregate; a
+        ConvertToSequence step raises (use ``execute_to_sequence``)."""
+        current = [list(r) for r in records]
+        for i, step in enumerate(self.steps):
+            schema = self._schemas[i]
+            if isinstance(step, ConvertToSequence):
+                raise ValueError("pipeline converts to sequences — call "
+                                 "execute_to_sequence()")
+            if isinstance(step, Reducer):
+                current = step.reduce(current, schema)
+            else:
+                nxt = []
+                for rec in current:
+                    r = step.apply(rec, schema)
+                    if r is not None:
+                        nxt.append(r)
+                current = nxt
+        return current
+
+    def execute_to_sequence(self, records: Iterable[list]) -> list[list[list]]:
+        """Apply a pipeline containing ConvertToSequence: record steps run
+        before the conversion, sequence steps after."""
+        current = [list(r) for r in records]
+        seqs: Optional[list[list[list]]] = None
+        for i, step in enumerate(self.steps):
+            schema = self._schemas[i]
+            if isinstance(step, ConvertToSequence):
+                key_idx = [schema.index_of(k) for k in step.key_columns]
+                order_idx = schema.index_of(step.order_column)
+                groups: dict[tuple, list[list]] = {}
+                order: list[tuple] = []
+                for rec in current:
+                    key = tuple(rec[i2] for i2 in key_idx)
+                    if key not in groups:
+                        groups[key] = []
+                        order.append(key)
+                    groups[key].append(rec)
+                seqs = [sorted(groups[k], key=lambda r: r[order_idx]) for k in order]
+            elif seqs is None:
+                if isinstance(step, Reducer):
+                    current = step.reduce(current, schema)
+                else:
+                    nxt = []
+                    for rec in current:
+                        r = step.apply(rec, schema)
+                        if r is not None:
+                            nxt.append(r)
+                    current = nxt
+            elif isinstance(step, Reducer):
+                raise ValueError("Reducer after ConvertToSequence is not "
+                                 "supported — reduce before converting")
+            else:
+                seqs = step.apply_sequences(seqs, schema)
+        if seqs is None:
+            raise ValueError("no ConvertToSequence step in pipeline")
+        return seqs
+
+    def execute_sequences(self, sequences: Iterable[list[list]]) -> list[list[list]]:
+        """Apply to already-sequential data (CSVSequenceRecordReader output)."""
+        seqs = [[list(r) for r in seq] for seq in sequences]
+        for i, step in enumerate(self.steps):
+            schema = self._schemas[i]
+            if isinstance(step, (ConvertToSequence, Reducer)):
+                raise ValueError(f"{step.TYPE_NAME} not valid on sequence input")
+            seqs = step.apply_sequences(seqs, schema)
+        return seqs
+
+    # ---- serde -------------------------------------------------------
+    def to_dict(self):
+        return {"initial_schema": self.initial_schema.to_dict(),
+                "steps": [s.to_dict() for s in self.steps]}
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    @staticmethod
+    def from_dict(d) -> "TransformProcess":
+        return TransformProcess(Schema.from_dict(d["initial_schema"]),
+                                [Step.from_dict(s) for s in d["steps"]])
+
+    @staticmethod
+    def from_json(s: str) -> "TransformProcess":
+        return TransformProcess.from_dict(json.loads(s))
+
+
+class TransformProcessBuilder:
+    def __init__(self, schema: Schema):
+        self._schema = schema
+        self._steps: list[Step] = []
+        self._current = schema
+
+    def _push(self, step: Step) -> "TransformProcessBuilder":
+        self._current = step.output_schema(self._current)  # validates eagerly
+        self._steps.append(step)
+        return self
+
+    def remove_columns(self, *cols): return self._push(RemoveColumns(list(cols)))
+    def remove_all_columns_except(self, *cols): return self._push(RemoveAllColumnsExcept(list(cols)))
+    def rename_column(self, old, new): return self._push(RenameColumn(old, new))
+    def duplicate_column(self, col, new): return self._push(DuplicateColumn(col, new))
+    def categorical_to_integer(self, col): return self._push(CategoricalToInteger(col))
+    def categorical_to_one_hot(self, col): return self._push(CategoricalToOneHot(col))
+    def integer_to_categorical(self, col, cats): return self._push(IntegerToCategorical(col, list(cats)))
+    def string_to_categorical(self, col, cats): return self._push(StringToCategorical(col, list(cats)))
+    def math_op(self, col, op, value): return self._push(MathOpTransform(col, op, value))
+    def columns_math_op(self, new_name, op, *cols): return self._push(ColumnsMathOpTransform(new_name, op, list(cols)))
+    def string_map(self, col, mapping): return self._push(StringMapTransform(col, dict(mapping)))
+    def string_fn(self, col, fn, arg=""): return self._push(StringFnTransform(col, fn, arg))
+    def string_to_time(self, col, fmt="%Y-%m-%d %H:%M:%S"): return self._push(StringToTimeTransform(col, fmt))
+    def replace_invalid_with(self, col, value): return self._push(ReplaceInvalidWithIntegerTransform(col, value))
+    def conditional_replace(self, col, value, condition): return self._push(ConditionalReplaceValueTransform(col, value, condition))
+    def filter(self, condition): return self._push(FilterByCondition(condition))
+    def convert_to_sequence(self, key_cols, order_col):
+        key_cols = [key_cols] if isinstance(key_cols, str) else list(key_cols)
+        return self._push(ConvertToSequence(key_cols, order_col))
+    def offset_sequence(self, cols, offset): return self._push(SequenceOffsetTransform(list(cols), offset))
+    def split_sequence_when_gap(self, col, max_gap): return self._push(SplitSequenceWhenGap(col, max_gap))
+    def reduce(self, key_cols, **ops):
+        key_cols = [key_cols] if isinstance(key_cols, str) else list(key_cols)
+        return self._push(Reducer(key_cols, ops))
+
+    def build(self) -> TransformProcess:
+        return TransformProcess(self._schema, self._steps)
+
+
+# ================================================================== Analysis
+@dataclasses.dataclass
+class ColumnAnalysis:
+    name: str
+    type: str
+    count: int = 0
+    count_missing: int = 0
+    min: Optional[float] = None
+    max: Optional[float] = None
+    mean: Optional[float] = None
+    stdev: Optional[float] = None
+    count_unique: Optional[int] = None
+    histogram: Optional[dict] = None     # categorical counts
+
+
+def analyze(schema: Schema, records: Iterable[list]) -> dict[str, ColumnAnalysis]:
+    """Per-column statistics (``AnalyzeLocal.analyze`` parity)."""
+    stats = {c.name: ColumnAnalysis(c.name, c.type) for c in schema.columns}
+    numeric_vals: dict[str, list[float]] = {c.name: [] for c in schema.columns}
+    uniques: dict[str, set] = {c.name: set() for c in schema.columns}
+    cat_hist: dict[str, dict] = {c.name: {} for c in schema.columns}
+    for rec in records:
+        for c, v in zip(schema.columns, rec):
+            st = stats[c.name]
+            st.count += 1
+            if v is None or v == "" or (isinstance(v, float) and math.isnan(v)):
+                st.count_missing += 1
+                continue
+            uniques[c.name].add(v)
+            if c.type in (ColumnType.INTEGER, ColumnType.LONG, ColumnType.DOUBLE,
+                          ColumnType.FLOAT, ColumnType.TIME):
+                numeric_vals[c.name].append(float(v))
+            elif c.type == ColumnType.CATEGORICAL:
+                cat_hist[c.name][v] = cat_hist[c.name].get(v, 0) + 1
+    for c in schema.columns:
+        st = stats[c.name]
+        st.count_unique = len(uniques[c.name])
+        vals = numeric_vals[c.name]
+        if vals:
+            st.min, st.max = min(vals), max(vals)
+            st.mean = sum(vals) / len(vals)
+            st.stdev = (sum((v - st.mean) ** 2 for v in vals)
+                        / max(len(vals) - 1, 1)) ** 0.5
+        if cat_hist[c.name]:
+            st.histogram = dict(cat_hist[c.name])
+    return stats
+
+
+# =================================================================== Bridges
+class TransformProcessRecordReader:
+    """Wrap a RecordReader with a TransformProcess
+    (``TransformProcessRecordReader`` parity) — plugs straight into
+    ``RecordReaderDataSetIterator``."""
+
+    def __init__(self, reader, tp: TransformProcess):
+        for step in tp.steps:
+            if isinstance(step, (Reducer, ConvertToSequence)):
+                raise ValueError(
+                    f"{step.TYPE_NAME} aggregates across records — it cannot "
+                    "run in a per-record reader bridge; execute the "
+                    "TransformProcess over the full record set instead")
+        self.reader = reader
+        self.tp = tp
+
+    def reset(self):
+        self.reader.reset()
+
+    def records(self):
+        for rec in self.reader.records():
+            out = self.tp.execute([rec])
+            if out:
+                yield out[0]
+
+    def __iter__(self):
+        return self.records()

@@ -285,6 +285,11 @@ class EarlyStoppingTrainer:
     def fit(self) -> EarlyStoppingResult:
         from deeplearning4j_tpu.train.trainer import Trainer
         cfg = self.config
+        if not cfg.epoch_termination_conditions:
+            raise ValueError(
+                "EarlyStoppingConfiguration needs at least one epoch "
+                "termination condition (e.g. MaxEpochsTerminationCondition) — "
+                "otherwise fit() would never return")
         minimize = cfg.score_calculator.minimize_score()
         best_score = math.inf if minimize else -math.inf
         best_epoch = -1
@@ -306,7 +311,9 @@ class EarlyStoppingTrainer:
                 self.train_iterator.reset()
             for batch in self.train_iterator:
                 key, sub = jax.random.split(key)
-                loss = float(trainer.fit_batch(batch, sub))
+                # step_batch keeps full Trainer semantics: tBPTT routing,
+                # listener dispatch, iteration/epoch counters
+                loss = float(trainer.step_batch(batch, sub))
                 for cond in cfg.iteration_termination_conditions:
                     if cond.terminate(loss):
                         stop_iter = cond
@@ -339,7 +346,9 @@ class EarlyStoppingTrainer:
                     break
             if stop_epoch is not None:
                 details = repr(stop_epoch)
+                self.net.epoch += 1
                 break
+            self.net.epoch += 1
             epoch += 1
 
         best_model = cfg.model_saver.get_best_model()

@@ -29,6 +29,26 @@ from deeplearning4j_tpu.obs.profiler import check_finite
 from deeplearning4j_tpu.train import updaters as updater_mod
 
 
+def _as_device(v):
+    """Host → device array(s); MultiDataSet features/labels are tuples."""
+    if v is None:
+        return None
+    if isinstance(v, (list, tuple)):
+        return tuple(None if a is None else jnp.asarray(a) for a in v)
+    return jnp.asarray(v)
+
+
+def _batch_masks(batch):
+    """(features_mask, labels_mask) with MultiDataSet plural-name fallback."""
+    fmask = getattr(batch, "features_mask", None)
+    if fmask is None:
+        fmask = getattr(batch, "features_masks", None)
+    lmask = getattr(batch, "labels_mask", None)
+    if lmask is None:
+        lmask = getattr(batch, "labels_masks", None)
+    return fmask, lmask
+
+
 def make_loss_fn(net, with_carries: bool = False, train: bool = True):
     """Build the pure loss fn.  Default signature: (params, state, features,
     labels, fmask, lmask, rng) → (scalar_loss, new_state).  With
@@ -218,8 +238,10 @@ class Trainer:
 
     def eval_loss(self, batch) -> float:
         """Inference-mode loss on one batch, no parameter update
-        (``MultiLayerNetwork.score(DataSet)`` parity)."""
-        self._ensure_ready()
+        (``MultiLayerNetwork.score(DataSet)`` parity).  Eval-only: does
+        NOT allocate optimizer state or build the donating train step."""
+        if self.net.params_ is None:
+            self.net.init()
         batch = self._prepare_batch(batch)
         if getattr(self, "_eval_loss_fn", None) is None:
             loss_fn = make_loss_fn(self.net, train=False)
@@ -231,38 +253,21 @@ class Trainer:
                 return loss
             self._eval_loss_fn = _eval
         net = self.net
-        fmask = getattr(batch, "features_mask", None)
-        lmask = getattr(batch, "labels_mask", None)
+        fmask, lmask = _batch_masks(batch)
         return self._eval_loss_fn(
-            net.params_, net.state_, jnp.asarray(batch.features),
-            jnp.asarray(batch.labels),
-            None if fmask is None else jnp.asarray(fmask),
-            None if lmask is None else jnp.asarray(lmask))
+            net.params_, net.state_, _as_device(batch.features),
+            _as_device(batch.labels), _as_device(fmask), _as_device(lmask))
 
     def fit_batch(self, batch, rng) -> float:
         """One optimization step on one batch; returns host-side loss."""
         self._ensure_ready()
         batch = self._prepare_batch(batch)
         net = self.net
-
-        def _as(v):
-            if v is None:
-                return None
-            if isinstance(v, (list, tuple)):
-                return tuple(None if a is None else jnp.asarray(a) for a in v)
-            return jnp.asarray(v)
-
-        # MultiDataSet batches carry plural-named masks
-        fmask = getattr(batch, "features_mask", None)
-        if fmask is None:
-            fmask = getattr(batch, "features_masks", None)
-        lmask = getattr(batch, "labels_mask", None)
-        if lmask is None:
-            lmask = getattr(batch, "labels_masks", None)
+        fmask, lmask = _batch_masks(batch)
         params, state, opt_state, loss = self._step(
             net.params_, net.state_, net.opt_state,
-            _as(batch.features), _as(batch.labels), _as(fmask), _as(lmask),
-            rng)
+            _as_device(batch.features), _as_device(batch.labels),
+            _as_device(fmask), _as_device(lmask), rng)
         net.params_, net.state_, net.opt_state = params, state, opt_state
         cfg = get_config()
         if cfg.nan_panic or cfg.inf_panic:
@@ -305,12 +310,32 @@ class Trainer:
             check_finite(net.params_, "params after tBPTT step")
         return loss
 
+    def step_batch(self, batch, rng):
+        """One training iteration with full semantics: tBPTT routing,
+        score tracking, listener dispatch, iteration counter.  Used by
+        ``fit`` and by external epoch drivers (EarlyStoppingTrainer)."""
+        net = self.net
+        first = (batch.features[0] if isinstance(batch.features, (list, tuple))
+                 else batch.features)
+        if net.conf.backprop_type == "tbptt" \
+                and not isinstance(batch.features, (list, tuple)) \
+                and first.ndim == 3:
+            loss = self._fit_tbptt(batch, rng)
+        else:
+            loss = self.fit_batch(batch, rng)
+        net._score = loss
+        for listener in self.bus.listeners:
+            if hasattr(listener, "record_batch"):
+                listener.record_batch(first.shape[0])
+        self.bus.dispatch("iteration_done", net, net.iteration, net.epoch, loss)
+        net.iteration += 1
+        return loss
+
     def fit(self, iterator, epochs: int = 1):
         self._ensure_ready()
         net = self.net
         key = jax.random.key(net.conf.seed + 7919)
         self.bus.dispatch("on_fit_start", net)
-        tbptt = net.conf.backprop_type == "tbptt"
         for _ in range(epochs):
             self.bus.dispatch("on_epoch_start", net, net.epoch)
             epoch_t0 = time.perf_counter()
@@ -319,19 +344,7 @@ class Trainer:
                 iterator.reset()
             for batch in iterator:
                 key, sub = jax.random.split(key)
-                first = (batch.features[0] if isinstance(batch.features, (list, tuple))
-                         else batch.features)
-                if tbptt and not isinstance(batch.features, (list, tuple)) \
-                        and first.ndim == 3:
-                    loss = self._fit_tbptt(batch, sub)
-                else:
-                    loss = self.fit_batch(batch, sub)
-                net._score = loss
-                for listener in self.bus.listeners:
-                    if hasattr(listener, "record_batch"):
-                        listener.record_batch(first.shape[0])
-                self.bus.dispatch("iteration_done", net, net.iteration, net.epoch, loss)
-                net.iteration += 1
+                self.step_batch(batch, sub)
                 n_batches += 1
             info = {"epoch_time_s": time.perf_counter() - epoch_t0,
                     "batches": n_batches, "score": net._score}
