@@ -61,6 +61,32 @@ from deeplearning4j_tpu.nn.layers.attention import (
     LearnedSelfAttentionLayer,
 )
 from deeplearning4j_tpu.nn.layers.norm import LayerNormalization, PReLULayer
+from deeplearning4j_tpu.nn.layers.extra import (
+    ZeroPadding1DLayer,
+    Cropping1DLayer,
+    Upsampling1DLayer,
+    ZeroPadding3DLayer,
+    Cropping3DLayer,
+    Upsampling3DLayer,
+    SpaceToBatchLayer,
+    GaussianDropoutLayer,
+    GaussianNoiseLayer,
+    AlphaDropoutLayer,
+    SpatialDropoutLayer,
+    LocallyConnected1D,
+    LocallyConnected2D,
+    ElementWiseMultiplicationLayer,
+    RepeatVector,
+    MaskZeroLayer,
+    GravesBidirectionalLSTM,
+    CenterLossOutputLayer,
+    Yolo2OutputLayer,
+    VariationalAutoencoder,
+    PrimaryCapsules,
+    CapsuleLayer,
+    CapsuleStrengthLayer,
+    RecurrentAttentionLayer,
+)
 
 __all__ = [
     "Layer", "register_layer", "layer_from_dict", "layer_registry",
@@ -75,4 +101,12 @@ __all__ = [
     "TimeDistributed", "RnnOutputLayer", "RnnLossLayer",
     "SelfAttentionLayer", "LearnedSelfAttentionLayer",
     "LayerNormalization", "PReLULayer",
+    "ZeroPadding1DLayer", "Cropping1DLayer", "Upsampling1DLayer",
+    "ZeroPadding3DLayer", "Cropping3DLayer", "Upsampling3DLayer",
+    "SpaceToBatchLayer", "GaussianDropoutLayer", "GaussianNoiseLayer",
+    "AlphaDropoutLayer", "SpatialDropoutLayer", "LocallyConnected1D",
+    "LocallyConnected2D", "ElementWiseMultiplicationLayer", "RepeatVector",
+    "MaskZeroLayer", "GravesBidirectionalLSTM", "CenterLossOutputLayer",
+    "Yolo2OutputLayer", "VariationalAutoencoder", "PrimaryCapsules",
+    "CapsuleLayer", "CapsuleStrengthLayer", "RecurrentAttentionLayer",
 ]

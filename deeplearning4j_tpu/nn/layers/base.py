@@ -115,6 +115,13 @@ class Layer:
               mask: Optional[jnp.ndarray] = None):
         raise NotImplementedError
 
+    def transform_mask(self, mask: Optional[jnp.ndarray]):
+        """How this layer reshapes a per-timestep [B,T] mask
+        (``Layer.feedForwardMaskArray`` parity).  Default: unchanged.
+        Layers that change the time axis override; layers that destroy
+        the timestep correspondence return None."""
+        return mask
+
     # ---- shared helpers ---------------------------------------------
     def _param_dtype(self):
         """Storage dtype for THIS layer's params (DTypePolicy.param_dtype) —

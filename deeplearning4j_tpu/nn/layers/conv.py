@@ -135,6 +135,14 @@ class Convolution1DLayer(ConvolutionLayer):
         d = self.dilation if not isinstance(self.dilation, (tuple, list)) else self.dilation[0]
         return k, s, p, d
 
+    def transform_mask(self, mask):
+        if mask is None:
+            return None
+        k, s, p, d = self._dims1()
+        if s == 1 and self.convolution_mode in ("same", "causal"):
+            return mask      # length-preserving: mask carries through
+        return None          # length changes — no step correspondence
+
     def get_output_type(self, input_type: InputType) -> InputType:
         k, s, p, d = self._dims1()
         t = input_type.timesteps
@@ -377,6 +385,9 @@ class Subsampling1DLayer(SubsamplingLayer):
     stride: Any = 2
     padding: Any = 0
 
+    def transform_mask(self, mask):
+        return None          # time length changes — no step correspondence
+
     def get_output_type(self, input_type: InputType) -> InputType:
         k = self.kernel_size if not isinstance(self.kernel_size, (tuple, list)) else self.kernel_size[0]
         s = self.stride if not isinstance(self.stride, (tuple, list)) else self.stride[0]
@@ -555,6 +566,9 @@ class GlobalPoolingLayer(Layer):
 
     def has_params(self) -> bool:
         return False
+
+    def transform_mask(self, mask):
+        return None          # pooling consumes the masked dimension
 
     def get_output_type(self, input_type: InputType) -> InputType:
         if input_type.kind == "cnn":

@@ -291,6 +291,9 @@ class LastTimeStep(Layer):
 
     underlying: Any = None
 
+    def transform_mask(self, mask):
+        return None          # time axis consumed
+
     def __post_init__(self):
         if isinstance(self.underlying, dict):
             from deeplearning4j_tpu.nn.layers.base import layer_from_dict

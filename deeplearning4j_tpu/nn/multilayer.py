@@ -108,6 +108,9 @@ class MultiLayerNetwork:
                                    rng=layer_rng, mask=current_mask)
             new_state.append(s)
             x = y
+            # time-geometry layers reshape the [B,T] mask alongside the data
+            # (DL4J Layer.feedForwardMaskArray parity)
+            current_mask = layer.transform_mask(current_mask)
         return x, new_state, score_array, new_carries
 
     def output(self, x, mask=None) -> jnp.ndarray:

@@ -21,7 +21,12 @@ from deeplearning4j_tpu.nn.input_type import InputType
 
 
 def expected_kind(layer) -> Optional[str]:
-    """What input kind a layer wants, judged from its class; None = any."""
+    """What input kind a layer wants; None = any.  Layers can declare it
+    via a class-level ``INPUT_KIND`` ("ff"|"rnn"|"cnn"|"cnn3d"); the
+    isinstance table below covers the original catalog."""
+    declared = getattr(layer, "INPUT_KIND", None)
+    if declared is not None:
+        return declared
     from deeplearning4j_tpu.nn.layers import conv as conv_mod
     from deeplearning4j_tpu.nn.layers import recurrent as rnn_mod
     from deeplearning4j_tpu.nn.layers import attention as attn_mod

@@ -200,7 +200,127 @@ LAYER_CASES = {
                                InputType.recurrent(4, 5),
                                lambda: DataSet(_r().normal(size=(3, 5, 4)),
                                                np.eye(3)[_r().integers(0, 3, 3)])),
+    # ---- layer-catalog tail (nn/layers/extra.py) -----------------------
+    "zero_padding1d": ([ZeroPadding1DLayer(padding=1), RNN_OUT()],
+                       InputType.recurrent(3, 5),
+                       lambda: DataSet(_r().normal(size=(3, 5, 3)),
+                                       _rnn_batch(3, 3, t=7).labels)),
+    "cropping1d": ([Cropping1DLayer(cropping=1), RNN_OUT()],
+                   InputType.recurrent(3, 5),
+                   lambda: DataSet(_r().normal(size=(3, 5, 3)),
+                                   _rnn_batch(3, 3, t=3).labels)),
+    "upsampling1d": ([Upsampling1DLayer(size=2), RNN_OUT()],
+                     InputType.recurrent(3, 4),
+                     lambda: DataSet(_r().normal(size=(3, 4, 3)),
+                                     _rnn_batch(3, 3, t=8).labels)),
+    "zero_padding3d": ([ZeroPadding3DLayer(padding=1),
+                        GlobalPoolingLayer(pooling_type="avg"), FF_OUT()],
+                       InputType.convolutional3d(3, 3, 3, 2),
+                       lambda: _cnn3d_batch(3, 3, 3, 2, 3)),
+    "cropping3d": ([Cropping3DLayer(cropping=1),
+                    GlobalPoolingLayer(pooling_type="avg"), FF_OUT()],
+                   InputType.convolutional3d(4, 4, 4, 2),
+                   lambda: _cnn3d_batch(4, 4, 4, 2, 3)),
+    "upsampling3d": ([Upsampling3DLayer(size=2),
+                      GlobalPoolingLayer(pooling_type="avg"), FF_OUT()],
+                     InputType.convolutional3d(2, 2, 2, 2),
+                     lambda: _cnn3d_batch(2, 2, 2, 2, 3)),
+    "space_to_batch": ([SpaceToBatchLayer(blocks=2),
+                        GlobalPoolingLayer(pooling_type="avg"), FF_OUT()],
+                       InputType.convolutional(4, 4, 2),
+                       # blocks 2x2 quadruple the batch: labels for 4*B rows
+                       lambda: DataSet(_r().normal(size=(2, 4, 4, 2)),
+                                       np.eye(3)[_r().integers(0, 3, 8)])),
+    "gaussian_dropout": ([GaussianDropoutLayer(rate=0.1),
+                          DenseLayer(n_out=5, activation="tanh"), FF_OUT()],
+                         InputType.feed_forward(4), lambda: _ff_batch(4, 3)),
+    "gaussian_noise": ([GaussianNoiseLayer(stddev=0.1),
+                        DenseLayer(n_out=5, activation="tanh"), FF_OUT()],
+                       InputType.feed_forward(4), lambda: _ff_batch(4, 3)),
+    "alpha_dropout": ([AlphaDropoutLayer(p=0.9),
+                       DenseLayer(n_out=5, activation="tanh"), FF_OUT()],
+                      InputType.feed_forward(4), lambda: _ff_batch(4, 3)),
+    "spatial_dropout": ([SpatialDropoutLayer(p=0.9),
+                         ConvolutionLayer(n_out=3, kernel_size=(3, 3),
+                                          activation="tanh"),
+                         GlobalPoolingLayer(pooling_type="avg"), FF_OUT()],
+                        InputType.convolutional(6, 6, 2),
+                        lambda: _cnn_batch(6, 6, 2, 3)),
+    "locally_connected1d": ([LocallyConnected1D(n_out=4, kernel=3,
+                                                activation="tanh"), RNN_OUT()],
+                            InputType.recurrent(2, 6),
+                            lambda: DataSet(_r().normal(size=(3, 6, 2)),
+                                            _rnn_batch(3, 3, t=4).labels)),
+    "locally_connected2d": ([LocallyConnected2D(n_out=4, kernel=3,
+                                                activation="tanh"),
+                             GlobalPoolingLayer(pooling_type="avg"), FF_OUT()],
+                            InputType.convolutional(6, 6, 2),
+                            lambda: _cnn_batch(6, 6, 2, 3)),
+    "element_wise_mult": ([DenseLayer(n_out=5, activation="tanh"),
+                           ElementWiseMultiplicationLayer(n_out=5,
+                                                          activation="tanh"),
+                           FF_OUT()],
+                          InputType.feed_forward(4), lambda: _ff_batch(4, 3)),
+    "repeat_vector": ([DenseLayer(n_out=5, activation="tanh"),
+                       RepeatVector(n=4), RNN_OUT()],
+                      InputType.feed_forward(4),
+                      lambda: DataSet(_r().normal(size=(3, 4)),
+                                      _rnn_batch(3, 3, t=4).labels)),
+    "mask_zero": ([MaskZeroLayer(underlying=LSTM(n_out=5)), RNN_OUT()],
+                  InputType.recurrent(3, 5), lambda: _rnn_batch(3, 3)),
+    "graves_bidirectional_lstm": ([GravesBidirectionalLSTM(n_out=5), RNN_OUT()],
+                                  InputType.recurrent(3, 5),
+                                  lambda: _rnn_batch(3, 3)),
+    "center_loss_output": ([DenseLayer(n_out=6, activation="tanh"),
+                            CenterLossOutputLayer(n_out=3, activation="softmax",
+                                                  loss="mcxent", lambda_=1e-2)],
+                           InputType.feed_forward(4), lambda: _ff_batch(4, 3)),
+    "yolo2_output": ([ConvolutionLayer(n_out=14, kernel_size=(1, 1),
+                                       activation="identity"),
+                      Yolo2OutputLayer(anchors=((1.0, 1.5), (2.0, 1.0)),
+                                       num_classes=2)],
+                     InputType.convolutional(3, 3, 4),
+                     lambda: DataSet(_r().normal(size=(2, 3, 3, 4)),
+                                     _yolo_batch(3, 3, 2, 2).labels)),
+    "vae": ([VariationalAutoencoder(n_out=3, encoder_layer_sizes=(6,),
+                                    decoder_layer_sizes=(6,),
+                                    activation="tanh",
+                                    reconstruction="gaussian")],
+            InputType.feed_forward(4),
+            lambda: (lambda x: DataSet(x, x))(_r().normal(size=(3, 4)))),
+    "primary_capsules": ([PrimaryCapsules(capsules=2, capsule_dimensions=4,
+                                          kernel=3, stride=2),
+                          GlobalPoolingLayer(pooling_type="avg"), FF_OUT()],
+                         InputType.convolutional(7, 7, 2),
+                         lambda: _cnn_batch(7, 7, 2, 3)),
+    "capsules": ([CapsuleLayer(capsules=3, capsule_dimensions=4, routings=2),
+                  GlobalPoolingLayer(pooling_type="avg"), FF_OUT()],
+                 InputType.recurrent(4, 6),
+                 lambda: DataSet(_r().normal(size=(3, 6, 4)),
+                                 np.eye(3)[_r().integers(0, 3, 3)])),
+    "capsule_strength": ([CapsuleStrengthLayer(), FF_OUT()],
+                         InputType.recurrent(4, 5),
+                         lambda: DataSet(_r().normal(size=(3, 5, 4)),
+                                         np.eye(3)[_r().integers(0, 3, 3)])),
+    "recurrent_attention": ([RecurrentAttentionLayer(n_out=4, activation="tanh"),
+                             RNN_OUT()],
+                            InputType.recurrent(3, 5), lambda: _rnn_batch(3, 3)),
 }
+
+
+def _yolo_batch(h, w, a, c, b=2):
+    """Grid labels: per anchor (tx,ty,tw,th,obj,classes) with obj∈{0,1}
+    and one-hot classes on object cells."""
+    r = _r()
+    x = r.normal(size=(b, h, w, a * (5 + c)))
+    y = np.zeros((b, h, w, a, 5 + c))
+    obj = r.integers(0, 2, (b, h, w, a))
+    y[..., 0:2] = r.uniform(0.2, 0.8, (b, h, w, a, 2))
+    y[..., 2:4] = r.normal(0, 0.3, (b, h, w, a, 2))
+    y[..., 4] = obj
+    cls = np.eye(c)[r.integers(0, c, (b, h, w, a))]
+    y[..., 5:] = cls * obj[..., None]
+    return DataSet(x, y.reshape(b, h, w, a * (5 + c)))
 
 
 def test_all_registered_layer_types_have_gradcheck_cases():

@@ -19,6 +19,14 @@ from deeplearning4j_tpu.nn.layers import (
     Bidirectional, LastTimeStep, TimeDistributed, RnnOutputLayer,
     SelfAttentionLayer, LearnedSelfAttentionLayer, LayerNormalization,
     PReLULayer,
+    ZeroPadding1DLayer, Cropping1DLayer, Upsampling1DLayer,
+    ZeroPadding3DLayer, Cropping3DLayer, Upsampling3DLayer,
+    SpaceToBatchLayer, GaussianDropoutLayer, GaussianNoiseLayer,
+    AlphaDropoutLayer, SpatialDropoutLayer, LocallyConnected1D,
+    LocallyConnected2D, ElementWiseMultiplicationLayer, RepeatVector,
+    MaskZeroLayer, GravesBidirectionalLSTM, VariationalAutoencoder,
+    PrimaryCapsules, CapsuleLayer, CapsuleStrengthLayer,
+    RecurrentAttentionLayer,
 )
 
 KEY = jax.random.key(0)
@@ -66,7 +74,122 @@ CASES = [
     (LearnedSelfAttentionLayer(n_heads=2, head_size=4, n_queries=3),
      InputType.recurrent(8, 6), (B, 3, 8)),
     (GlobalPoolingLayer(pooling_type="max"), InputType.recurrent(5, 7), (B, 5)),
+    # ---- layer-catalog tail (nn/layers/extra.py) -----------------------
+    (ZeroPadding1DLayer(padding=2), InputType.recurrent(5, 7), (B, 11, 5)),
+    (Cropping1DLayer(cropping=1), InputType.recurrent(5, 7), (B, 5, 5)),
+    (Upsampling1DLayer(size=3), InputType.recurrent(5, 4), (B, 12, 5)),
+    (ZeroPadding3DLayer(padding=1), InputType.convolutional3d(3, 4, 5, 2), (B, 5, 6, 7, 2)),
+    (Cropping3DLayer(cropping=1), InputType.convolutional3d(4, 5, 6, 2), (B, 2, 3, 4, 2)),
+    (Upsampling3DLayer(size=2), InputType.convolutional3d(2, 3, 4, 2), (B, 4, 6, 8, 2)),
+    (GaussianDropoutLayer(rate=0.2), InputType.feed_forward(12), (B, 12)),
+    (GaussianNoiseLayer(stddev=0.1), InputType.feed_forward(12), (B, 12)),
+    (AlphaDropoutLayer(p=0.9), InputType.feed_forward(12), (B, 12)),
+    (SpatialDropoutLayer(p=0.9), InputType.convolutional(6, 6, 3), (B, 6, 6, 3)),
+    (LocallyConnected2D(n_out=5, kernel=3), InputType.convolutional(6, 6, 2), (B, 4, 4, 5)),
+    (LocallyConnected1D(n_out=5, kernel=3), InputType.recurrent(2, 6), (B, 4, 5)),
+    (ElementWiseMultiplicationLayer(), InputType.feed_forward(9), (B, 9)),
+    (RepeatVector(n=6), InputType.feed_forward(5), (B, 6, 5)),
+    (MaskZeroLayer(underlying=LSTM(n_out=4)), InputType.recurrent(3, 6), (B, 6, 4)),
+    (GravesBidirectionalLSTM(n_out=5), InputType.recurrent(3, 6), (B, 6, 5)),
+    (VariationalAutoencoder(n_out=4, encoder_layer_sizes=(8,),
+                            decoder_layer_sizes=(8,)), InputType.feed_forward(10), (B, 4)),
+    (PrimaryCapsules(capsules=2, capsule_dimensions=4, kernel=3, stride=2),
+     InputType.convolutional(7, 7, 2), (B, 18, 4)),
+    (CapsuleLayer(capsules=3, capsule_dimensions=5, routings=2),
+     InputType.recurrent(4, 6), (B, 3, 5)),
+    (CapsuleStrengthLayer(), InputType.recurrent(4, 6), (B, 6)),
+    (RecurrentAttentionLayer(n_out=6), InputType.recurrent(3, 5), (B, 5, 6)),
 ]
+
+
+def test_space_to_batch_shape():
+    """SpaceToBatch changes the batch dim — checked outside the generic
+    harness (which assumes batch B in == batch out)."""
+    layer = SpaceToBatchLayer(blocks=2)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(B, 4, 6, 3)).astype(np.float32))
+    y, _ = layer.apply({}, {}, x)
+    assert y.shape == (B * 4, 2, 3, 3)
+    out_type = layer.get_output_type(InputType.convolutional(4, 6, 3))
+    assert (out_type.height, out_type.width, out_type.channels) == (2, 3, 3)
+
+
+def test_center_loss_and_yolo_heads():
+    from deeplearning4j_tpu.nn.layers import CenterLossOutputLayer, Yolo2OutputLayer
+    cl = CenterLossOutputLayer(n_out=3, activation="softmax", loss="mcxent")
+    itype = InputType.feed_forward(6)
+    params = cl.init_params(KEY, itype)
+    assert params["centers"].shape == (3, 6)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(B, 6)).astype(np.float32))
+    labels = jnp.asarray(np.eye(3, dtype=np.float32)[[0, 1, 2, 0]])
+    score = cl.compute_score_array(params, {}, x, labels)
+    assert score.shape == (B,) and np.all(np.isfinite(np.asarray(score)))
+
+    yolo = Yolo2OutputLayer(anchors=((1.0, 1.0),), num_classes=2)
+    g = np.random.default_rng(0).normal(size=(B, 3, 3, 7)).astype(np.float32)
+    y = np.zeros_like(g)
+    y[..., 4] = 1.0
+    y[..., 5] = 1.0
+    score = yolo.compute_score_array({}, {}, jnp.asarray(g), jnp.asarray(y))
+    assert score.shape == (B,) and np.all(np.asarray(score) > 0)
+    # apply() returns ACTIVATED predictions (YoloUtils.activate parity)
+    out, _ = yolo.apply({}, {}, jnp.asarray(g))
+    out = np.asarray(out).reshape(B, 3, 3, 1, 7)
+    assert np.all((out[..., 0:2] >= 0) & (out[..., 0:2] <= 1))   # sigmoid xy
+    assert np.all(out[..., 2:4] > 0)                             # exp wh
+    assert np.all((out[..., 4] >= 0) & (out[..., 4] <= 1))       # sigmoid conf
+    np.testing.assert_allclose(out[..., 5:].sum(-1), 1.0, rtol=1e-5)
+
+
+def test_time_geometry_layers_transform_masks():
+    """Time-axis-changing layers reshape the propagated [B,T] mask
+    (Layer.feedForwardMaskArray parity; review regression)."""
+    from deeplearning4j_tpu.nn import NeuralNetConfiguration, InputType
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.layers import RnnOutputLayer
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.train import Trainer, Sgd
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1)).list()
+            .layer(ZeroPadding1DLayer(padding=1))       # T 4 → 6
+            .layer(LSTM(n_out=5))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(3, 4)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(0).normal(size=(2, 4, 3)).astype(np.float32)
+    y = np.zeros((2, 6, 2), np.float32); y[..., 0] = 1
+    fmask = np.array([[1, 1, 0, 0], [1, 1, 1, 1]], np.float32)
+    lmask = np.ones((2, 6), np.float32)
+    batch = DataSet(x, y, features_mask=fmask, labels_mask=lmask)
+    loss = float(Trainer(net).fit_batch(batch, jax.random.key(0)))
+    assert np.isfinite(loss)          # crashes pre-fix: [B,4] mask at T=6
+    # per-layer transforms agree with shapes
+    assert ZeroPadding1DLayer(padding=1).transform_mask(
+        jnp.ones((2, 4))).shape == (2, 6)
+    assert Cropping1DLayer(cropping=1).transform_mask(
+        jnp.ones((2, 6))).shape == (2, 4)
+    assert Upsampling1DLayer(size=2).transform_mask(
+        jnp.ones((2, 4))).shape == (2, 8)
+    assert GlobalPoolingLayer().transform_mask(jnp.ones((2, 4))) is None
+
+
+def test_extra_layers_preprocessor_adaptation():
+    """cnn_flat input auto-reshapes into the new CNN-kind layers, and CNN
+    activations auto-flatten into the new FF-kind layers (review
+    regression: expected_kind must cover the catalog tail)."""
+    from deeplearning4j_tpu.nn import NeuralNetConfiguration, InputType
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.layers import (LocallyConnected2D,
+                                              GlobalPoolingLayer, OutputLayer,
+                                              ElementWiseMultiplicationLayer)
+    conf = (NeuralNetConfiguration.builder().seed(0).list()
+            .layer(LocallyConnected2D(n_out=4, kernel=3, activation="relu"))
+            .layer(GlobalPoolingLayer(pooling_type="avg"))
+            .layer(ElementWiseMultiplicationLayer(activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional_flat(6, 6, 1)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(0).normal(size=(2, 36)).astype(np.float32)
+    out = net.output(x)          # crashes without the preprocessor mapping
+    assert out.shape == (2, 3)
 
 
 @pytest.mark.parametrize("layer,itype,expected_shape",
