@@ -23,7 +23,6 @@ import dataclasses
 import json
 import math
 import re as _re
-import time as _time
 from typing import Any, Callable, Iterable, Optional, Sequence
 
 
@@ -151,6 +150,12 @@ class Condition:
     def test(self, record: list, schema: Schema) -> bool:
         raise NotImplementedError
 
+    def validate(self, schema: Schema) -> None:
+        """Raise ValueError for unknown columns (build-time validation)."""
+        col = getattr(self, "column", None)
+        if col:
+            schema.index_of(col)
+
     def to_dict(self):
         out = {"type": self.TYPE_NAME}
         out.update(dataclasses.asdict(self))
@@ -218,6 +223,10 @@ class BooleanCondition(Condition):
     def __post_init__(self):
         self.conditions = [c if isinstance(c, Condition) else Condition.from_dict(c)
                            for c in self.conditions]
+
+    def validate(self, schema):
+        for c in self.conditions:
+            c.validate(schema)
 
     def test(self, record, schema):
         results = (c.test(record, schema) for c in self.conditions)
@@ -454,6 +463,12 @@ class MathOpTransform(Step):
     op: str = "add"
     value: float = 0.0
 
+    def output_schema(self, schema):
+        schema.index_of(self.column)  # build-time validation
+        if self.op not in _MATH:
+            raise ValueError(f"unknown math op {self.op!r}")
+        return schema
+
     def apply(self, record, schema):
         i = schema.index_of(self.column)
         out = list(record)
@@ -488,6 +503,10 @@ class StringMapTransform(Step):
     column: str = ""
     mapping: dict = dataclasses.field(default_factory=dict)
 
+    def output_schema(self, schema):
+        schema.index_of(self.column)
+        return schema
+
     def apply(self, record, schema):
         i = schema.index_of(self.column)
         out = list(record)
@@ -502,6 +521,12 @@ class StringFnTransform(Step):
     column: str = ""
     fn: str = "lower"
     arg: str = ""
+
+    def output_schema(self, schema):
+        schema.index_of(self.column)
+        if self.fn not in ("lower", "upper", "trim", "append", "prepend", "replace"):
+            raise ValueError(f"unknown string fn {self.fn!r}")
+        return schema
 
     def apply(self, record, schema):
         i = schema.index_of(self.column)
@@ -557,6 +582,10 @@ class ReplaceInvalidWithIntegerTransform(Step):
     column: str = ""
     value: Any = 0
 
+    def output_schema(self, schema):
+        schema.index_of(self.column)
+        return schema
+
     def apply(self, record, schema):
         i = schema.index_of(self.column)
         out = list(record)
@@ -579,6 +608,11 @@ class ConditionalReplaceValueTransform(Step):
     value: Any = None
     condition: Any = None
 
+    def output_schema(self, schema):
+        schema.index_of(self.column)
+        self.condition.validate(schema)
+        return schema
+
     def apply(self, record, schema):
         out = list(record)
         if self.condition.test(record, schema):
@@ -593,6 +627,10 @@ class FilterByCondition(Step):
     filter = remove when condition true)."""
     condition: Any = None
 
+    def output_schema(self, schema):
+        self.condition.validate(schema)
+        return schema
+
     def apply(self, record, schema):
         return None if self.condition.test(record, schema) else record
 
@@ -606,6 +644,12 @@ class ConvertToSequence(Step):
     key_columns: list = dataclasses.field(default_factory=list)
     order_column: str = ""
 
+    def output_schema(self, schema):
+        for c in self.key_columns:
+            schema.index_of(c)
+        schema.index_of(self.order_column)
+        return schema
+
     def apply(self, record, schema):  # handled by executor
         return record
 
@@ -617,6 +661,11 @@ class SequenceOffsetTransform(Step):
     next-step prediction targets); trims edge rows (``SequenceOffsetTransform``)."""
     columns: list = dataclasses.field(default_factory=list)
     offset: int = 1
+
+    def output_schema(self, schema):
+        for c in self.columns:
+            schema.index_of(c)
+        return schema
 
     def apply_sequence(self, seq, schema):
         if not seq:
@@ -647,6 +696,10 @@ class SplitSequenceWhenGap(Step):
     more than ``max_gap`` (``SequenceSplitTimeSeparation`` analog)."""
     column: str = ""
     max_gap: float = 0.0
+
+    def output_schema(self, schema):
+        schema.index_of(self.column)
+        return schema
 
     def apply(self, record, schema):
         return record

@@ -1,0 +1,259 @@
+"""Stats pipeline — per-layer param/grad/update statistics + HTML report.
+
+Parity with the reference's UI stack (SURVEY.md §2.8):
+``deeplearning4j-ui-model StatsListener.java`` (samples score, per-layer
+parameter / gradient / update histograms, norms, mean-magnitude ratios)
+→ ``StatsStorage`` (in-memory / file) → the Vert.x web UI, scoped per
+SURVEY's plan to jsonl storage + a static HTML report.
+
+TPU-native design: the statistics are computed ON DEVICE inside the
+jit'd train step (small reductions fused into the step program —
+``make_train_step(with_stats=True)``), so sampling costs a few scalars
+of device→host traffic instead of shipping full tensors like the
+reference's host-side NDArray scans.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.obs.listeners import TrainingListener
+
+NUM_BINS = 20
+
+
+# ============================================================ device side
+def _leaf_concat(tree):
+    leaves = [jnp.ravel(l) for l in jax.tree_util.tree_leaves(tree)
+              if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)]
+    if not leaves:
+        return None
+    return jnp.concatenate([l.astype(jnp.float32) for l in leaves])
+
+
+def _stats_of(vec):
+    lo, hi = jnp.min(vec), jnp.max(vec)
+    span = jnp.where(hi - lo < 1e-12, 1.0, hi - lo)
+    counts = jnp.histogram(vec, bins=NUM_BINS,
+                           range=(lo, lo + span))[0]
+    return {
+        "norm": jnp.linalg.norm(vec),
+        "mean": jnp.mean(vec),
+        "stdev": jnp.std(vec),
+        "mean_magnitude": jnp.mean(jnp.abs(vec)),
+        "min": lo,
+        "max": hi,
+        "hist_counts": counts,
+        "hist_min": lo,
+        "hist_max": lo + span,
+    }
+
+
+def device_layer_stats(tree):
+    """Per-layer stats pytree.  ``tree`` is a list (MultiLayerNetwork) or
+    dict (ComputationGraph) of per-layer param pytrees."""
+    items = enumerate(tree) if isinstance(tree, list) else tree.items()
+    out = {}
+    for key, sub in items:
+        vec = _leaf_concat(sub)
+        if vec is not None and vec.size:
+            out[str(key)] = _stats_of(vec)
+    return out
+
+
+# ============================================================== storage
+class InMemoryStatsStorage:
+    """(``InMemoryStatsStorage`` parity) record dicts in a list."""
+
+    def __init__(self):
+        self.records: list[dict] = []
+
+    def put(self, record: dict) -> None:
+        self.records.append(record)
+
+    def all(self) -> list[dict]:
+        return list(self.records)
+
+
+class FileStatsStorage(InMemoryStatsStorage):
+    """(``FileStatsStorage`` parity) jsonl file, replayable."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        if os.path.exists(path):
+            with open(path) as f:
+                self.records = [json.loads(line) for line in f if line.strip()]
+        self._f = open(path, "a")
+
+    def put(self, record: dict) -> None:
+        super().put(record)
+        self._f.write(json.dumps(record) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+
+# ============================================================== listener
+def _host(stats_tree) -> dict:
+    def conv(v):
+        a = np.asarray(v)
+        if a.ndim == 0:
+            f = float(a)
+            return f if math.isfinite(f) else None
+        return a.tolist()
+    return jax.tree_util.tree_map(conv, stats_tree)
+
+
+class StatsListener(TrainingListener):
+    """Samples model stats every N iterations into a StatsStorage
+    (``StatsListener.java`` parity).  The Trainer detects this listener
+    (``wants_model_stats``) and runs its stats-collecting train step on
+    sampling iterations, then dispatches ``stats_ready``."""
+
+    wants_model_stats = True
+
+    def __init__(self, storage, frequency: int = 10):
+        self.storage = storage
+        self.frequency = max(frequency, 1)
+        self._last_stats_iteration = -1
+
+    def wants_stats_now(self, iteration: int) -> bool:
+        return iteration % self.frequency == 0
+
+    def stats_ready(self, model, iteration: int, epoch: int, score: float,
+                    stats: dict) -> None:
+        self._last_stats_iteration = iteration
+        record = {"type": "stats", "iteration": iteration, "epoch": epoch,
+                  "score": float(score)}
+        record.update(_host(stats))
+        self.storage.put(record)
+
+    def iteration_done(self, model, iteration, epoch, score):
+        # score-only record whenever stats_ready did NOT fire this
+        # iteration (non-sampled iterations, and paths without a stats
+        # step like tBPTT) — keeps the score chart dense
+        if iteration != self._last_stats_iteration:
+            self.storage.put({"type": "score", "iteration": iteration,
+                              "epoch": epoch, "score": float(score)})
+
+
+# ================================================================ report
+_SVG_W, _SVG_H, _PAD = 640, 180, 30
+
+
+def _polyline(xs, ys, w=_SVG_W, h=_SVG_H, color="#1f77b4"):
+    if not xs:
+        return ""
+    x0, x1 = min(xs), max(xs) or 1
+    finite = [y for y in ys if y is not None and math.isfinite(y)]
+    if not finite:
+        return ""
+    y0, y1 = min(finite), max(finite)
+    span_x = (x1 - x0) or 1
+    span_y = (y1 - y0) or 1
+    pts = " ".join(
+        f"{_PAD + (x - x0) / span_x * (w - 2 * _PAD):.1f},"
+        f"{h - _PAD - (y - y0) / span_y * (h - 2 * _PAD):.1f}"
+        for x, y in zip(xs, ys) if y is not None and math.isfinite(y))
+    return (f'<svg width="{w}" height="{h}">'
+            f'<polyline fill="none" stroke="{color}" stroke-width="1.5" '
+            f'points="{pts}"/>'
+            f'<text x="{_PAD}" y="12" font-size="10">max {y1:.4g}</text>'
+            f'<text x="{_PAD}" y="{h - 8}" font-size="10">min {y0:.4g}</text>'
+            f'</svg>')
+
+
+def _histogram_svg(counts, lo, hi, w=320, h=120, color="#ff7f0e"):
+    if not counts:
+        return ""
+    peak = max(counts) or 1
+    n = len(counts)
+    bw = (w - 2 * _PAD) / n
+    bars = "".join(
+        f'<rect x="{_PAD + i * bw:.1f}" '
+        f'y="{h - _PAD - c / peak * (h - 2 * _PAD):.1f}" '
+        f'width="{max(bw - 1, 1):.1f}" '
+        f'height="{c / peak * (h - 2 * _PAD):.1f}" fill="{color}"/>'
+        for i, c in enumerate(counts))
+    return (f'<svg width="{w}" height="{h}">{bars}'
+            f'<text x="{_PAD}" y="{h - 8}" font-size="10">{lo:.3g}</text>'
+            f'<text x="{w - _PAD - 40}" y="{h - 8}" font-size="10">{hi:.3g}</text>'
+            f'</svg>')
+
+
+def render_html_report(storage, out_path: str, title: str = "Training report") -> str:
+    """StatsStorage → static self-contained HTML (UI-lite per SURVEY §2.8):
+    score chart, per-layer param/grad/update norms and update:param
+    mean-magnitude ratio over time, latest histograms."""
+    records = storage.all() if hasattr(storage, "all") else list(storage)
+    scores = [(r["iteration"], r.get("score")) for r in records
+              if r.get("score") is not None]
+    stats = [r for r in records if r.get("type") == "stats"]
+
+    parts = [f"<html><head><meta charset='utf-8'><title>{title}</title>",
+             "<style>body{font-family:sans-serif;margin:24px} "
+             "h2{border-bottom:1px solid #ccc} .row{display:flex;gap:24px;"
+             "flex-wrap:wrap} .card{margin:8px}</style></head><body>",
+             f"<h1>{title}</h1>"]
+
+    parts.append("<h2>Score (loss)</h2>")
+    parts.append(_polyline([i for i, _ in scores], [s for _, s in scores]))
+
+    layer_names: list[str] = []
+    if stats:
+        layer_names = sorted(stats[-1].get("params", {}),
+                             key=lambda k: (len(k), k))
+    for group, color in (("params", "#1f77b4"), ("gradients", "#2ca02c"),
+                         ("updates", "#d62728")):
+        if not stats:
+            break
+        parts.append(f"<h2>{group}: L2 norm per layer</h2><div class='row'>")
+        for name in layer_names:
+            xs = [r["iteration"] for r in stats if name in r.get(group, {})]
+            ys = [r[group][name]["norm"] for r in stats
+                  if name in r.get(group, {})]
+            parts.append(f"<div class='card'><h4>layer {name}</h4>"
+                         f"{_polyline(xs, ys, w=320, h=140, color=color)}</div>")
+        parts.append("</div>")
+
+    if stats:
+        parts.append("<h2>update : param mean-magnitude ratio (log10)</h2>"
+                     "<div class='row'>")
+        for name in layer_names:
+            xs, ys = [], []
+            for r in stats:
+                p = r.get("params", {}).get(name)
+                u = r.get("updates", {}).get(name)
+                if p and u and p["mean_magnitude"] and u["mean_magnitude"]:
+                    xs.append(r["iteration"])
+                    ys.append(math.log10(u["mean_magnitude"] /
+                                         max(p["mean_magnitude"], 1e-30)))
+            parts.append(f"<div class='card'><h4>layer {name}</h4>"
+                         f"{_polyline(xs, ys, w=320, h=140, color='#9467bd')}</div>")
+        parts.append("</div>")
+
+        last = stats[-1]
+        parts.append("<h2>Latest parameter histograms</h2><div class='row'>")
+        for name in layer_names:
+            st = last.get("params", {}).get(name)
+            if st:
+                parts.append(
+                    f"<div class='card'><h4>layer {name}</h4>"
+                    f"{_histogram_svg(st['hist_counts'], st['hist_min'], st['hist_max'])}"
+                    f"</div>")
+        parts.append("</div>")
+
+    parts.append("</body></html>")
+    html = "\n".join(parts)
+    with open(out_path, "w") as f:
+        f.write(html)
+    return out_path

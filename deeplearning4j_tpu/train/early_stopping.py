@@ -285,11 +285,13 @@ class EarlyStoppingTrainer:
     def fit(self) -> EarlyStoppingResult:
         from deeplearning4j_tpu.train.trainer import Trainer
         cfg = self.config
-        if not cfg.epoch_termination_conditions:
+        if not cfg.epoch_termination_conditions and \
+                not cfg.iteration_termination_conditions:
             raise ValueError(
-                "EarlyStoppingConfiguration needs at least one epoch "
-                "termination condition (e.g. MaxEpochsTerminationCondition) — "
-                "otherwise fit() would never return")
+                "EarlyStoppingConfiguration needs at least one termination "
+                "condition (e.g. MaxEpochsTerminationCondition or "
+                "MaxTimeIterationTerminationCondition) — otherwise fit() "
+                "would never return")
         minimize = cfg.score_calculator.minimize_score()
         best_score = math.inf if minimize else -math.inf
         best_epoch = -1

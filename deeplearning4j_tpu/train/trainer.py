@@ -121,9 +121,19 @@ def make_tbptt_step(net, tx):
     return step
 
 
-def make_train_step(net, tx):
-    """jit'd (params, state, opt_state, batch..., rng) → updated triple + loss."""
+def make_train_step(net, tx, with_stats: bool = False):
+    """jit'd (params, state, opt_state, batch..., rng) → updated triple + loss.
+
+    ``with_stats=True`` additionally returns per-layer parameter /
+    gradient / update statistics (L2 norms, mean/stdev, 20-bin histograms)
+    computed ON DEVICE inside the same program — the StatsListener samples
+    this step at its frequency, so stats cost nothing on non-sampled
+    iterations and never round-trip full tensors to the host."""
     loss_fn = make_loss_fn(net)
+
+    def _layer_stats(tree):
+        from deeplearning4j_tpu.obs.stats import device_layer_stats
+        return device_layer_stats(tree)
 
     # donate params/state/opt_state buffers: the step's outputs reuse their
     # HBM (essential for large models — no 2x parameter memory)
@@ -133,8 +143,13 @@ def make_train_step(net, tx):
         (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params, state, features, labels, features_mask, labels_mask, rng)
         updates, opt_state = tx.update(grads, opt_state, params)
-        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
-        return params, new_state, opt_state, loss
+        new_params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        if with_stats:
+            stats = {"params": _layer_stats(new_params),
+                     "gradients": _layer_stats(grads),
+                     "updates": _layer_stats(updates)}
+            return new_params, new_state, opt_state, loss, stats
+        return new_params, new_state, opt_state, loss
 
     return step
 
@@ -175,6 +190,9 @@ class Trainer:
                 conf.gradient_normalization_threshold, frozen_mask)
         self._step = None
         self._tbptt_step = None
+        self._stats_step = None
+        self._stats_listeners = [l for l in self.bus.listeners
+                                 if getattr(l, "wants_model_stats", False)]
 
     def _build_multi_updater(self, default_updater, conf, frozen_mask):
         """Per-layer updater overrides (DL4J allows ``layer.updater(...)``):
@@ -264,10 +282,23 @@ class Trainer:
         batch = self._prepare_batch(batch)
         net = self.net
         fmask, lmask = _batch_masks(batch)
-        params, state, opt_state, loss = self._step(
-            net.params_, net.state_, net.opt_state,
-            _as_device(batch.features), _as_device(batch.labels),
-            _as_device(fmask), _as_device(lmask), rng)
+        sampling = [l for l in self._stats_listeners
+                    if l.wants_stats_now(net.iteration)]
+        args = (net.params_, net.state_, net.opt_state,
+                _as_device(batch.features), _as_device(batch.labels),
+                _as_device(fmask), _as_device(lmask), rng)
+        if sampling:
+            if self._stats_step is None:
+                self._stats_step = make_train_step(net, self.tx, with_stats=True)
+            params, state, opt_state, loss, stats = self._stats_step(*args)
+            # publish the fresh (non-donated) buffers BEFORE listeners run —
+            # net.params_ still references donated inputs at this point
+            net.params_, net.state_, net.opt_state = params, state, opt_state
+            for listener in sampling:
+                listener.stats_ready(net, net.iteration, net.epoch,
+                                     float(loss), stats)
+        else:
+            params, state, opt_state, loss = self._step(*args)
         net.params_, net.state_, net.opt_state = params, state, opt_state
         cfg = get_config()
         if cfg.nan_panic or cfg.inf_panic:

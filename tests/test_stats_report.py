@@ -1,0 +1,126 @@
+"""Stats/UI lite tests (VERDICT #9): StatsListener histograms + norms →
+storage → static HTML report.
+
+Parity anchors: ``deeplearning4j-ui-model StatsListener.java``,
+``InMemoryStatsStorage`` / ``FileStatsStorage``, UI scoped per SURVEY §2.8.
+"""
+
+import json
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn import NeuralNetConfiguration, InputType
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.obs.stats import (
+    StatsListener, InMemoryStatsStorage, FileStatsStorage, render_html_report,
+    NUM_BINS)
+from deeplearning4j_tpu.train import Adam, Trainer
+
+
+def _net():
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-2)).list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=64):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return ListDataSetIterator([DataSet(x[i:i + 16], y[i:i + 16])
+                                for i in range(0, n, 16)])
+
+
+class TestStatsListener:
+    def test_records_norms_and_histograms(self):
+        storage = InMemoryStatsStorage()
+        net = _net()
+        Trainer(net, listeners=[StatsListener(storage, frequency=2)]).fit(
+            _data(), epochs=2)
+        stats = [r for r in storage.all() if r["type"] == "stats"]
+        scores = [r for r in storage.all() if r["type"] == "score"]
+        assert stats and scores                       # both record kinds
+        rec = stats[0]
+        assert set(rec["params"]) == {"0", "1"}       # both layers
+        layer0 = rec["params"]["0"]
+        for key in ("norm", "mean", "stdev", "mean_magnitude", "min", "max"):
+            assert isinstance(layer0[key], float)
+        assert len(layer0["hist_counts"]) == NUM_BINS
+        # histogram covers all parameter entries of the layer
+        n_params = sum(np.asarray(p).size for p in net.params_[0].values())
+        assert sum(layer0["hist_counts"]) == n_params
+        # gradient + update groups present with sane norms
+        assert rec["gradients"]["0"]["norm"] > 0
+        assert rec["updates"]["0"]["norm"] > 0
+
+    def test_sampling_frequency(self):
+        storage = InMemoryStatsStorage()
+        net = _net()
+        Trainer(net, listeners=[StatsListener(storage, frequency=4)]).fit(
+            _data(), epochs=3)                        # 12 iterations
+        stats = [r for r in storage.all() if r["type"] == "stats"]
+        assert [r["iteration"] for r in stats] == [0, 4, 8]
+
+    def test_tbptt_records_scores(self):
+        """tBPTT path has no stats step — every iteration must still land
+        a score record (review regression)."""
+        from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+                .list()
+                .layer(LSTM(n_out=4))
+                .layer(RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.recurrent(3, 8))
+                .backprop_type("tbptt", fwd_length=4, back_length=4).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(4, 8, 3)).astype(np.float32)
+        y = np.zeros((4, 8, 2), np.float32); y[..., 0] = 1
+        it = ListDataSetIterator([DataSet(x, y)])
+        storage = InMemoryStatsStorage()
+        Trainer(net, listeners=[StatsListener(storage, frequency=1)]).fit(
+            it, epochs=3)
+        assert len(storage.all()) == 3            # one record per iteration
+
+    def test_file_storage_replay(self, tmp_path):
+        path = str(tmp_path / "stats.jsonl")
+        storage = FileStatsStorage(path)
+        net = _net()
+        Trainer(net, listeners=[StatsListener(storage, frequency=2)]).fit(
+            _data(), epochs=1)
+        storage.close()
+        # file is valid jsonl and replays into a fresh storage
+        with open(path) as f:
+            lines = [json.loads(l) for l in f]
+        assert lines
+        replay = FileStatsStorage(path)
+        assert len(replay.all()) == len(lines)
+        replay.close()
+
+
+class TestHtmlReport:
+    def test_training_produces_openable_report(self, tmp_path):
+        """The VERDICT acceptance shape: training MLPMnist-style produces
+        an openable HTML report with score + per-layer sections."""
+        storage = InMemoryStatsStorage()
+        net = _net()
+        Trainer(net, listeners=[StatsListener(storage, frequency=2)]).fit(
+            _data(), epochs=2)
+        out = render_html_report(storage, str(tmp_path / "report.html"))
+        html = open(out).read()
+        assert html.startswith("<html>")
+        assert "Score (loss)" in html
+        assert "params: L2 norm per layer" in html
+        assert "gradients: L2 norm per layer" in html
+        assert "updates: L2 norm per layer" in html
+        assert "mean-magnitude ratio" in html
+        assert "<svg" in html and "<polyline" in html and "<rect" in html
+
+    def test_report_empty_storage_no_crash(self, tmp_path):
+        out = render_html_report(InMemoryStatsStorage(),
+                                 str(tmp_path / "empty.html"))
+        assert "<html>" in open(out).read()

@@ -234,6 +234,27 @@ class TestEarlyStopping:
         r2 = self._fit(cfg)          # fresh net, same config object
         assert r1.total_epochs == r2.total_epochs == 2
 
+    def test_iteration_only_config_allowed(self):
+        """A config terminating via iteration conditions alone is valid
+        (review regression: 'train for at most N seconds' setups)."""
+        from deeplearning4j_tpu.train import MaxTimeIterationTerminationCondition
+        net = small_net()
+        te = batches(blob_data(seed=9))
+        result = self._fit(EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(te),
+            iteration_termination_conditions=[
+                MaxTimeIterationTerminationCondition(0.0)]),  # trips at once
+            net=net)
+        assert result.termination_reason == "IterationTerminationCondition"
+
+    def test_no_conditions_rejected(self):
+        net = small_net()
+        te = batches(blob_data(seed=9))
+        with pytest.raises(ValueError):
+            EarlyStoppingTrainer(EarlyStoppingConfiguration(
+                score_calculator=DataSetLossCalculator(te)),
+                net, batches(blob_data())).fit()
+
     def test_save_last_model(self, tmp_path):
         net = small_net()
         te = batches(blob_data(seed=9))

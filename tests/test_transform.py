@@ -257,6 +257,33 @@ class TestExecutorGuards:
         with pytest.raises(ValueError):
             TransformProcess.builder(s).string_to_categorical("typo", ["a"])
 
+    def test_all_steps_validate_columns_at_build_time(self):
+        """Eager-validation contract holds for every step kind
+        (review regression)."""
+        s = (Schema.builder().add_column_double("x")
+             .add_column_string("name").add_column_integer("t").build())
+        b = lambda: TransformProcess.builder(s)
+        with pytest.raises(ValueError):
+            b().math_op("typo", "add", 1.0)
+        with pytest.raises(ValueError):
+            b().math_op("x", "frobnicate", 1.0)
+        with pytest.raises(ValueError):
+            b().string_map("typo", {})
+        with pytest.raises(ValueError):
+            b().string_fn("typo", "lower")
+        with pytest.raises(ValueError):
+            b().replace_invalid_with("typo", 0)
+        with pytest.raises(ValueError):
+            b().conditional_replace("x", 0, ColumnCondition("typo", ">", 1))
+        with pytest.raises(ValueError):
+            b().filter(ColumnCondition("typo", ">", 1))
+        with pytest.raises(ValueError):
+            b().convert_to_sequence("typo", "t")
+        with pytest.raises(ValueError):
+            b().offset_sequence(["typo"], 1)
+        with pytest.raises(ValueError):
+            b().split_sequence_when_gap("typo", 1.0)
+
 
 class TestIteratorBridge:
     def test_csv_to_dataset_flow(self):
