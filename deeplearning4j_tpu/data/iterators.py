@@ -32,6 +32,57 @@ class DataSetIterator:
         pass
 
 
+class ResumableIterator(DataSetIterator):
+    """Wraps any iterator with position tracking + fast-forward
+    (SURVEY §5.4 resumable iterator state: epoch, batch index).
+
+    ``state()`` captures (epoch, batch_index); ``set_state`` restores it —
+    the next iteration SKIPS already-consumed batches so a mid-epoch
+    checkpoint restart does not replay or drop data."""
+
+    def __init__(self, base: DataSetIterator):
+        self.base = base
+        self.epoch = 0
+        self.batch_index = 0
+        self._skip = 0
+        self._restored = False
+
+    def __iter__(self):
+        skipped = 0
+        for batch in self.base:
+            if skipped < self._skip:
+                skipped += 1
+                continue
+            self.batch_index += 1
+            yield batch
+        self._skip = 0
+        self._restored = False
+
+    def reset(self):
+        if self._restored:
+            # a reset between set_state() and the first pass (Trainer.fit
+            # resets at every epoch start) must NOT discard the restored
+            # fast-forward position or advance the epoch
+            if hasattr(self.base, "reset"):
+                self.base.reset()
+            return
+        if self.batch_index or self._skip:
+            self.epoch += 1
+        self.batch_index = 0
+        self._skip = 0
+        if hasattr(self.base, "reset"):
+            self.base.reset()
+
+    def state(self) -> dict:
+        return {"epoch": self.epoch, "batch_index": self.batch_index}
+
+    def set_state(self, state: dict) -> None:
+        self.epoch = int(state.get("epoch", 0))
+        self._skip = int(state.get("batch_index", 0))
+        self.batch_index = self._skip
+        self._restored = True
+
+
 class ListDataSetIterator(DataSetIterator):
     """Iterate a list of pre-built DataSets (``ListDataSetIterator.java``)."""
 

@@ -23,12 +23,16 @@ class CheckpointListener(TrainingListener):
                  save_every_n_epochs: Optional[int] = None,
                  save_every_seconds: Optional[float] = None,
                  keep_last: Optional[int] = 3,
-                 keep_all: bool = False):
+                 keep_all: bool = False,
+                 iterator=None):
+        """``iterator``: a ResumableIterator whose position is stored in
+        every checkpoint (iteratorState.json) for mid-epoch restarts."""
         self.directory = directory
         self.every_iter = save_every_n_iterations
         self.every_epoch = save_every_n_epochs
         self.every_seconds = save_every_seconds
         self.keep_last = None if keep_all else (keep_last or 3)
+        self.iterator = iterator
         self._last_save_time = time.time()
         self._saved: list[str] = []
         os.makedirs(directory, exist_ok=True)
@@ -36,7 +40,10 @@ class CheckpointListener(TrainingListener):
     def _save(self, model, iteration: int, epoch: int) -> str:
         name = f"checkpoint_iter{iteration}_epoch{epoch}.zip"
         path = os.path.join(self.directory, name)
-        model.save(path)
+        it_state = (self.iterator.state()
+                    if self.iterator is not None and hasattr(self.iterator, "state")
+                    else None)
+        model.save(path, iterator_state=it_state)
         self._saved.append(path)
         with open(os.path.join(self.directory, "checkpoints.json"), "w") as f:
             json.dump({"checkpoints": self._saved}, f)

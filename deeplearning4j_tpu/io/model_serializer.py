@@ -56,7 +56,11 @@ def _rebuild_like(template: Any, leaves: list[np.ndarray]) -> Any:
 
 
 def write_model(net, path: str, save_updater: bool = True,
-                normalizer=None) -> None:
+                normalizer=None, iterator_state: dict | None = None) -> None:
+    """``iterator_state``: resumable input-pipeline position
+    (``ResumableIterator.state()``) stored as ``iteratorState.json`` so a
+    mid-epoch restart can fast-forward instead of replaying data
+    (SURVEY §5.4)."""
     directory = os.path.dirname(os.path.abspath(path))
     os.makedirs(directory, exist_ok=True)
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
@@ -71,10 +75,20 @@ def write_model(net, path: str, save_updater: bool = True,
             "epoch": net.epoch,
             "model_type": type(net).__name__,
         }))
+        if iterator_state is not None:
+            zf.writestr("iteratorState.json", json.dumps(iterator_state))
         if normalizer is not None:
             buf = _io.BytesIO()
             np.savez(buf, _type=type(normalizer).__name__, **normalizer._state())
             zf.writestr("normalizer.npz", buf.getvalue())
+
+
+def read_iterator_state(path: str) -> dict | None:
+    """Resumable iterator position from a checkpoint zip, if present."""
+    with zipfile.ZipFile(path, "r") as zf:
+        if "iteratorState.json" not in zf.namelist():
+            return None
+        return json.loads(zf.read("iteratorState.json").decode())
 
 
 def _restore(path: str, conf_cls, net_cls, load_updater: bool):

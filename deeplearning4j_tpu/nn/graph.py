@@ -339,9 +339,11 @@ class ComputationGraph:
         return evaluation
 
     # ---------------------------------------------------------- serde
-    def save(self, path: str, save_updater: bool = True) -> None:
+    def save(self, path: str, save_updater: bool = True,
+             iterator_state=None) -> None:
         from deeplearning4j_tpu.io.model_serializer import write_model
-        write_model(self, path, save_updater=save_updater)
+        write_model(self, path, save_updater=save_updater,
+                    iterator_state=iterator_state)
 
     @staticmethod
     def load(path: str, load_updater: bool = True) -> "ComputationGraph":

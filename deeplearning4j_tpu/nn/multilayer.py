@@ -200,9 +200,11 @@ class MultiLayerNetwork:
         return x[:, -1, :] if single and x.ndim == 3 else x
 
     # ---------------------------------------------------------- serde
-    def save(self, path: str, save_updater: bool = True) -> None:
+    def save(self, path: str, save_updater: bool = True,
+             iterator_state: Optional[dict] = None) -> None:
         from deeplearning4j_tpu.io.model_serializer import write_model
-        write_model(self, path, save_updater=save_updater)
+        write_model(self, path, save_updater=save_updater,
+                    iterator_state=iterator_state)
 
     @staticmethod
     def load(path: str, load_updater: bool = True) -> "MultiLayerNetwork":

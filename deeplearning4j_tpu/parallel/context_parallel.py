@@ -50,18 +50,29 @@ def _block_attention(q, k, v, scale, mask):
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    mesh: Mesh, axis: str = "seq", n_heads: int = 1,
-                   causal: bool = False) -> jnp.ndarray:
+                   causal: bool = False, data_axis: str | None = None,
+                   head_axis: str | None = None) -> jnp.ndarray:
     """Multi-head ring attention.  q/k/v: [B, T, H*D] GLOBALLY, sharded
     over ``axis`` on dim 1.  Returns [B, T, H*D] with the same sharding.
 
     Inside shard_map each device sees its local [B, T/n, H*D] slice; K/V
     rotate n steps around the ring; online-softmax accumulators merge
     per-block partial results exactly.
+
+    Composable mesh axes: ``data_axis`` shards the batch dim (dp×sp);
+    ``head_axis`` shards the HEADS across a tensor-parallel axis (tp×sp —
+    the ring rotates within each head group, Ulysses-meets-ring layout;
+    ``n_heads`` is the GLOBAL head count and must divide by the axis size).
     """
     n_dev = mesh.shape[axis]
+    if head_axis and n_heads % mesh.shape[head_axis]:
+        raise ValueError(f"n_heads={n_heads} not divisible by mesh axis "
+                         f"'{head_axis}' size {mesh.shape[head_axis]}")
+    local_heads = n_heads // mesh.shape[head_axis] if head_axis else n_heads
 
     def local(q, k, v):
         b, t_local, dmodel = q.shape
+        n_heads = local_heads
         dh = dmodel // n_heads
         scale = 1.0 / math.sqrt(dh)
         qh = q.reshape(b, t_local, n_heads, dh).transpose(0, 2, 1, 3)
@@ -92,16 +103,18 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             return (k_blk, v_blk, o, m_new, l), None
 
         # initial accumulators must be marked device-varying for the scan
-        # carry to type-check under shard_map's VMA tracking
+        # carry to type-check under shard_map's VMA tracking — over EVERY
+        # sharded axis in play (seq ring + optional data/head axes)
+        varying = tuple(a for a in (axis, data_axis, head_axis) if a)
         o0 = jnp.zeros_like(qh)
-        m0 = lax.pcast(jnp.full(qh.shape[:-1], NEG_INF, qh.dtype), (axis,), to="varying")
-        l0 = lax.pcast(jnp.zeros(qh.shape[:-1], qh.dtype), (axis,), to="varying")
+        m0 = lax.pcast(jnp.full(qh.shape[:-1], NEG_INF, qh.dtype), varying, to="varying")
+        l0 = lax.pcast(jnp.zeros(qh.shape[:-1], qh.dtype), varying, to="varying")
         (k_f, v_f, o, m, l), _ = lax.scan(step, (kh, vh, o0, m0, l0),
                                           jnp.arange(n_dev))
         out = o / jnp.maximum(l[..., None], 1e-20)
         return out.transpose(0, 2, 1, 3).reshape(b, t_local, dmodel)
 
-    spec = P(None, axis, None)
+    spec = P(data_axis, axis, head_axis)
     return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec)(q, k, v)
 

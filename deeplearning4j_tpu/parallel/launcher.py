@@ -46,6 +46,7 @@ import os, pickle, sys
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count={local_devices}")
 import jax
+jax.config.update("jax_platforms", "cpu")  # sitecustomize may pin a TPU platform
 jax.distributed.initialize(coordinator_address="127.0.0.1:{port}",
                            num_processes={n}, process_id={pid})
 with open({fn_path!r}, "rb") as f:

@@ -27,19 +27,26 @@ from jax import shard_map
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, x: jnp.ndarray,
-                   mesh: Mesh, n_microbatches: int, axis: str = "stage"):
+                   mesh: Mesh, n_microbatches: int, axis: str = "stage",
+                   data_axis: str | None = None):
     """Run a homogeneous S-stage pipeline.
 
     - ``stage_params``: pytree whose leaves have a leading stage dim S,
       sharded over ``axis`` (each device sees its own stage's slice).
     - ``x``: global batch [B, ...]; split into M = n_microbatches chunks.
       All data enters at stage 0 and exits at stage S-1.
+    - ``data_axis``: optional second mesh axis for dp×pp — the batch is
+      additionally sharded over it (each data-parallel pipeline replica
+      runs the schedule on its own batch shard; stage params replicate
+      across ``data_axis``).
 
     Returns y [B, ...] (the last stage's outputs, gathered).
     """
     n_stages = mesh.shape[axis]
-    if x.shape[0] % n_microbatches:
-        raise ValueError(f"batch {x.shape[0]} not divisible by microbatches {n_microbatches}")
+    data_par = mesh.shape[data_axis] if data_axis else 1
+    if x.shape[0] % (n_microbatches * data_par):
+        raise ValueError(f"batch {x.shape[0]} not divisible by "
+                         f"microbatches*data_par={n_microbatches * data_par}")
 
     def local(params, x_local):
         # params: this stage's slice (leading dim 1) → squeeze
@@ -77,9 +84,11 @@ def pipeline_apply(stage_fn: Callable, stage_params, x: jnp.ndarray,
     # ticks but replication keeps the schedule simple); out taken from the
     # last stage — psum_scatter not needed since only one stage wrote it.
     param_spec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    x_spec = P(data_axis) if data_axis else P()
+    out_spec = P((axis, data_axis)) if data_axis else P(axis)
     y = shard_map(local, mesh=mesh,
-                  in_specs=(param_spec, P()),
-                  out_specs=P(axis))(stage_params, x)  # each stage emits its block
+                  in_specs=(param_spec, x_spec),
+                  out_specs=out_spec)(stage_params, x)  # each stage emits its block
     # keep only the LAST stage's block (others are zeros): [S*B] → [B]
     b = x.shape[0]
     return y[(n_stages - 1) * b:]

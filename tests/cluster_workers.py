@@ -1,0 +1,121 @@
+"""Module-level worker functions for spawn_local_cluster tests.
+
+Lives in a plain module (not a test file) so the spawned processes can
+unpickle function references via PYTHONPATH.  Each worker runs under a
+REAL multi-process ``jax.distributed`` runtime on CPU loopback — the
+DummyTransport translation (SURVEY §4.2-3).
+"""
+
+import os
+
+import numpy as np
+
+
+def _small_net(seed=7):
+    from deeplearning4j_tpu.nn import NeuralNetConfiguration, InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.train import Sgd
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1)).list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    return MultiLayerNetwork(conf).init()
+
+
+def global_batch(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return x, y
+
+
+def psum_worker(pid, n):
+    """Smoke: a real cross-process collective over the global device mesh."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    got = multihost_utils.process_allgather(jnp.asarray([float(pid + 1)]))
+    return {"pid": pid, "n_processes": jax.process_count(),
+            "n_devices": len(jax.devices()),
+            "allgather_sum": float(np.sum(np.asarray(got)))}
+
+
+def dp_step_worker(pid, n):
+    """One data-parallel step: local grads on this process's shard of the
+    global batch, cross-process gradient averaging (the SharedTrainingMaster
+    semantic swap: synchronous dense allreduce), one SGD update.  Every
+    process must end with identical params equal to the full-batch step."""
+    import jax
+    from jax.experimental import multihost_utils
+    from deeplearning4j_tpu.train.trainer import make_loss_fn
+    from deeplearning4j_tpu.utils.pytree import flat_param_vector
+
+    net = _small_net()
+    x, y = global_batch()
+    shard = slice(pid * len(x) // n, (pid + 1) * len(x) // n)
+    loss_fn = make_loss_fn(net)
+
+    def local_loss(params):
+        loss, _ = loss_fn(params, net.state_, x[shard], y[shard],
+                          None, None, None)
+        return loss
+
+    grads = jax.grad(local_loss)(net.params_)
+    # gradient sharing: allreduce-mean across processes over loopback
+    gathered = multihost_utils.process_allgather(grads)
+    grads = jax.tree_util.tree_map(lambda g: np.mean(np.asarray(g), axis=0),
+                                   gathered)
+    params = jax.tree_util.tree_map(lambda p, g: np.asarray(p) - 0.1 * g,
+                                    net.params_, grads)
+    return {"pid": pid, "params": np.asarray(flat_param_vector(params))}
+
+
+def fault_tolerant_train_worker(pid, n, phase="full", workdir="/tmp"):
+    """Checkpoint/restart with iterator fast-forward (SURVEY §5.3/§5.4).
+
+    phase="full":   train 6 batches straight through, checkpoint after #3.
+    phase="fail":   same, but process 1 dies at batch #5 (fault injection).
+    phase="resume": restore the checkpoint + iterator position, finish the
+                    remaining batches.
+    Each phase ends (if it survives) by allgathering the flat params to
+    prove the gang is alive and bitwise-identical across processes.
+    """
+    import jax
+    from jax.experimental import multihost_utils
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator, ResumableIterator
+    from deeplearning4j_tpu.io.model_serializer import read_iterator_state
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.train import Trainer
+    from deeplearning4j_tpu.utils.pytree import flat_param_vector
+
+    x, y = global_batch(n=24, seed=1)
+    batches = [DataSet(x[i:i + 4], y[i:i + 4]) for i in range(0, 24, 4)]
+    iterator = ResumableIterator(ListDataSetIterator(batches))
+    ckpt = os.path.join(workdir, "cluster_ckpt.zip")
+
+    if phase == "resume":
+        net = MultiLayerNetwork.load(ckpt)
+        iterator.set_state(read_iterator_state(ckpt))
+        start = iterator.batch_index
+    else:
+        net = _small_net()
+        start = 0
+
+    trainer = Trainer(net)
+    key = jax.random.key(123)
+    for i, batch in enumerate(iterator, start=start):
+        key, sub = jax.random.split(key)
+        trainer.fit_batch(batch, sub)
+        if phase != "resume" and i == 2 and pid == 0:
+            net.save(ckpt, iterator_state=iterator.state())
+        if phase == "fail" and i == 4 and pid == 1:
+            os._exit(3)          # fault injection: hard-kill this process
+
+    flat = np.asarray(flat_param_vector(net.params_))
+    gathered = np.asarray(multihost_utils.process_allgather(
+        jax.numpy.asarray(flat)))
+    return {"pid": pid, "params": flat,
+            "all_equal": bool(np.allclose(gathered, gathered[0:1], atol=0)),
+            "batches_seen": iterator.batch_index - start}

@@ -1,0 +1,193 @@
+"""Multi-process cluster tests (VERDICT #6): spawn_local_cluster actually
+runs — DP gradient-sharing equivalence across processes, checkpoint under
+sharding, and kill-one-process fault injection with checkpoint restart +
+iterator fast-forward.
+
+Parity anchors: SURVEY §4.2-3 (DummyTransport in-process cluster rig),
+§5.3 (failure recovery = fast checkpoint/restart + iterator fast-forward),
+§5.4 (resumable iterator state in the checkpoint zip).
+
+These spawn REAL processes with a real ``jax.distributed`` runtime over
+loopback — slow (~15-30s each), marked accordingly.
+"""
+
+import functools
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+import cluster_workers  # noqa: E402
+
+from deeplearning4j_tpu.parallel.launcher import spawn_local_cluster  # noqa: E402
+
+_ENV = {"PYTHONPATH": os.path.dirname(__file__) + os.pathsep +
+        os.environ.get("PYTHONPATH", "")}
+
+
+class TestLocalCluster:
+    def test_collective_across_processes(self):
+        """2 procs × 4 local devices: the distributed runtime forms and a
+        cross-process allgather returns both processes' contributions."""
+        results = spawn_local_cluster(cluster_workers.psum_worker,
+                                      n_processes=2, port=12711,
+                                      local_devices=4, extra_env=_ENV)
+        assert len(results) == 2
+        for r in results:
+            assert r["n_processes"] == 2
+            assert r["n_devices"] == 8           # global view
+            assert r["allgather_sum"] == 3.0     # (pid0+1) + (pid1+1)
+
+    def test_dp_gradient_sharing_matches_single_process(self):
+        """Cross-process gradient averaging == full-batch single-process
+        step (the SharedTrainingMaster → dense-allreduce swap, proven over
+        a real process boundary)."""
+        import jax
+        from deeplearning4j_tpu.train.trainer import make_loss_fn
+        from deeplearning4j_tpu.utils.pytree import flat_param_vector
+
+        results = spawn_local_cluster(cluster_workers.dp_step_worker,
+                                      n_processes=2, port=12713,
+                                      local_devices=2, extra_env=_ENV)
+        assert len(results) == 2
+        np.testing.assert_array_equal(results[0]["params"], results[1]["params"])
+
+        # single-process full-batch reference
+        net = cluster_workers._small_net()
+        x, y = cluster_workers.global_batch()
+        loss_fn = make_loss_fn(net)
+        grads = jax.grad(lambda p: loss_fn(p, net.state_, x, y,
+                                           None, None, None)[0])(net.params_)
+        ref = jax.tree_util.tree_map(lambda p, g: np.asarray(p) - 0.1 * np.asarray(g),
+                                     net.params_, grads)
+        np.testing.assert_allclose(results[0]["params"],
+                                   np.asarray(flat_param_vector(ref)), rtol=2e-5)
+
+    def test_fault_injection_and_checkpoint_restart(self, tmp_path):
+        """Kill one process mid-training → gang fails (RuntimeError);
+        restart from the checkpoint with iterator fast-forward → final
+        params identical to an uninterrupted run, no batch replayed."""
+        wd = str(tmp_path)
+        # uninterrupted reference run
+        full = spawn_local_cluster(
+            functools.partial(cluster_workers.fault_tolerant_train_worker,
+                              phase="full", workdir=wd + "/full"),
+            n_processes=2, port=12715, local_devices=1, extra_env=_ENV)
+        assert all(r["all_equal"] for r in full)
+        assert full[0]["batches_seen"] == 6
+
+        # fault run: process 1 hard-exits at batch 5, after the checkpoint
+        with pytest.raises(RuntimeError):
+            spawn_local_cluster(
+                functools.partial(cluster_workers.fault_tolerant_train_worker,
+                                  phase="fail", workdir=wd + "/fail"),
+                n_processes=2, port=12717, local_devices=1, timeout=90.0,
+                extra_env=_ENV)
+        ckpt = wd + "/fail/cluster_ckpt.zip"
+        assert os.path.exists(ckpt), "checkpoint must have landed pre-fault"
+
+        # restart: restore + fast-forward, finish the epoch
+        resumed = spawn_local_cluster(
+            functools.partial(cluster_workers.fault_tolerant_train_worker,
+                              phase="resume", workdir=wd + "/fail"),
+            n_processes=2, port=12719, local_devices=1, extra_env=_ENV)
+        assert all(r["all_equal"] for r in resumed)
+        assert resumed[0]["batches_seen"] == 3      # fast-forwarded past 3
+        np.testing.assert_allclose(resumed[0]["params"], full[0]["params"],
+                                   rtol=1e-6)
+
+
+class TestResumableIterator:
+    def _it(self):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.data.iterators import (ListDataSetIterator,
+                                                       ResumableIterator)
+        data = [DataSet(np.full((2, 4), i, np.float32),
+                        np.eye(3, dtype=np.float32)[[i % 3, (i + 1) % 3]])
+                for i in range(5)]
+        return ResumableIterator(ListDataSetIterator(data))
+
+    def test_tracks_position_and_epoch(self):
+        it = self._it()
+        for i, _ in enumerate(it):
+            if i == 2:
+                break
+        assert it.state() == {"epoch": 0, "batch_index": 3}
+        it.reset()
+        assert it.state() == {"epoch": 1, "batch_index": 0}
+        assert len(list(it)) == 5
+
+    def test_fast_forward_skips_consumed(self):
+        it = self._it()
+        it.set_state({"epoch": 2, "batch_index": 3})
+        seen = [float(np.asarray(b.features)[0, 0]) for b in it]
+        assert seen == [3.0, 4.0]            # batches 0-2 not replayed
+        assert it.state() == {"epoch": 2, "batch_index": 5}
+        it.reset()
+        assert len(list(it)) == 5            # next epoch is full again
+
+    def test_resume_through_trainer_fit(self):
+        """set_state → Trainer.fit (which reset()s at epoch start) must
+        fast-forward, not replay (review regression)."""
+        from deeplearning4j_tpu.train import Trainer
+        net = cluster_workers._small_net()
+        it = self._it()
+        it.set_state({"epoch": 0, "batch_index": 3})
+        Trainer(net).fit(it, epochs=1)
+        assert it.batch_index == 5             # only batches 3..4 trained
+        assert it.epoch == 0
+        # second epoch is full again
+        Trainer(net).fit(it, epochs=1)
+        assert it.epoch == 1 and it.batch_index == 5
+
+    def test_ring_attention_head_axis_divisibility(self):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.parallel.mesh import make_mesh
+        from deeplearning4j_tpu.parallel.context_parallel import ring_attention
+        mesh = make_mesh(data=1, model=2, seq=4)
+        q = jnp.zeros((2, 16, 24), jnp.float32)   # 3 heads × dh 8
+        with pytest.raises(ValueError):
+            ring_attention(q, q, q, mesh, axis="seq", n_heads=3,
+                           head_axis="model")
+
+    def test_checkpoint_listener_stores_iterator_state(self, tmp_path):
+        from deeplearning4j_tpu.io.checkpoint import CheckpointListener
+        from deeplearning4j_tpu.io.model_serializer import read_iterator_state
+        from deeplearning4j_tpu.train import Trainer
+        net = cluster_workers._small_net()
+        it = self._it()
+        listener = CheckpointListener(str(tmp_path), save_every_n_iterations=2,
+                                      iterator=it)
+        Trainer(net, listeners=[listener]).fit(it, epochs=1)
+        state = read_iterator_state(listener.last_checkpoint())
+        assert state is not None and state["batch_index"] > 0
+
+
+class TestCheckpointUnderSharding:
+    def test_sharded_params_round_trip(self, tmp_path):
+        """Checkpoint save/restore with params laid out on an 8-device
+        mesh: device→host gather on save, identical outputs on load."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+        net = cluster_workers._small_net()
+        mesh = make_mesh(data=8)
+        with mesh:
+            sharding = NamedSharding(mesh, P())          # replicated layout
+            net.params_ = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, sharding), net.params_)
+            # shard the big dense weight over the data axis
+            w = net.params_[0]["W"]                      # [4, 8]
+            net.params_[0]["W"] = jax.device_put(
+                w, NamedSharding(mesh, P(None, "data")))
+        assert len(net.params_[0]["W"].sharding.device_set) == 8
+        x = np.random.default_rng(0).normal(size=(4, 4)).astype(np.float32)
+        before = np.asarray(net.output(x))
+        path = str(tmp_path / "sharded.zip")
+        net.save(path)
+        net2 = type(net).load(path)
+        np.testing.assert_allclose(np.asarray(net2.output(x)), before,
+                                   rtol=1e-6)
