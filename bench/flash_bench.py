@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Flash-attention kernel microbench (run on the real TPU).
+
+Compares the Pallas blockwise kernel against the materializing jnp
+reference at growing sequence lengths; prints one JSON line per config.
+Numbers recorded in bench/PROFILE.md.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops.pallas import flash_attention
+from deeplearning4j_tpu.parallel.context_parallel import reference_attention
+
+
+STEPS = 20
+
+
+def _chained(attn_fn):
+    """20 data-dependent attention calls inside ONE jit — a single
+    host↔device round trip, so remote-tunnel latency can't pollute the
+    per-call time."""
+    @jax.jit
+    def run(q, k, v):
+        def body(_, acc):
+            out = attn_fn(acc, k, v)
+            return acc + 1e-6 * out          # data dependency between steps
+        return jax.lax.fori_loop(0, STEPS, body, q)
+    return run
+
+
+def bench(fn, args):
+    float(jnp.sum(fn(*args).astype(jnp.float32)))        # warm + compile
+    t0 = time.perf_counter()
+    float(jnp.sum(fn(*args).astype(jnp.float32)))        # hard sync
+    return (time.perf_counter() - t0) / STEPS * 1000
+
+
+def main():
+    rng = np.random.default_rng(0)
+    h, d = 8, 64
+    for t in (4096, 8192, 16384, 32768):
+        q = jnp.asarray(rng.normal(size=(2, t, h * d)).astype(np.float32)
+                        ).astype(jnp.bfloat16)
+        k = jnp.asarray(rng.normal(size=(2, t, h * d)).astype(np.float32)
+                        ).astype(jnp.bfloat16)
+        v = jnp.asarray(rng.normal(size=(2, t, h * d)).astype(np.float32)
+                        ).astype(jnp.bfloat16)
+        f = _chained(lambda a, b, c: flash_attention(
+            a, b, c, n_heads=h, causal=True, block_q=512, block_k=1024))
+        flash_ms = bench(f, (q, k, v))
+        try:
+            r = _chained(lambda a, b, c: reference_attention(
+                a, b, c, n_heads=h, causal=True))
+            ref_ms = bench(r, (q, k, v))
+        except Exception:        # [T,T] materialization OOMs at long seq
+            ref_ms = None
+        print(json.dumps({
+            "metric": "flash_attention_ms", "seq_len": t, "value": round(flash_ms, 2),
+            "unit": "ms", "reference_ms": None if ref_ms is None else round(ref_ms, 2),
+            "speedup": None if ref_ms is None else round(ref_ms / flash_ms, 2)}))
+
+
+if __name__ == "__main__":
+    main()

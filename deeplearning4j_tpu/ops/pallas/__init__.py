@@ -1,0 +1,15 @@
+"""Pallas TPU kernels (SURVEY.md §5.7/§7.7).
+
+The compute path of the framework is XLA; Pallas covers the few ops where
+hand-tiling beats the compiler — currently the blockwise (flash)
+attention inner kernel used by ring attention, which keeps score tiles in
+VMEM instead of materializing per-block [Tq,Tk] matrices in HBM.
+
+Kernels run compiled on TPU and in interpreter mode on CPU (tests), with
+the pure-jnp implementations kept as numerical oracles.
+"""
+
+from deeplearning4j_tpu.ops.pallas.flash_attention import (
+    flash_attention_block, flash_attention)
+
+__all__ = ["flash_attention_block", "flash_attention"]

@@ -1,0 +1,213 @@
+"""Flash (blockwise) attention Pallas kernel.
+
+Replaces the jnp ``_block_attention`` inner step of ring attention
+(SURVEY §5.7: the reference's ``dot_product_attention`` materializes the
+full score matrix; the round-1 ring path still materialized per-BLOCK
+score matrices in HBM).  This kernel tiles Q into [block_q, D] and
+iterates K/V tiles of [block_k, D] entirely in VMEM with the classic
+online-softmax recurrence — the [Tq, Tk] matrix never exists outside a
+VMEM tile, scores accumulate in f32 on the MXU.
+
+Contract matches the jnp oracle: returns UNNORMALIZED (o, m, l) — the
+per-row running max and sum-exp — so ring attention can merge partial
+results across ring steps exactly.  ``q_offset``/``k_offset`` give the
+global positions of the local blocks for causal masking inside a sharded
+ring (traced scalars are fine: they enter through SMEM).
+
+Grid: (B*H, Tq/block_q, Tk/block_k), K-axis innermost (sequential on
+TPU) with VMEM scratch carrying (acc, m, l) across K tiles.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _kernel(qoff_ref, koff_ref, klen_ref, q_ref, k_ref, v_ref,
+            o_ref, m_ref, l_ref, acc_scr, m_scr, l_scr,
+            *, scale: float, causal: bool, block_q: int, block_k: int,
+            n_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+
+    def _compute():
+        q = q_ref[0]                                  # [block_q, D]
+        k = k_ref[0]                                  # [block_k, D]
+        v = v_ref[0]
+        # f32 inputs: force exact (multi-pass) MXU f32 — the default would
+        # round through bf16 and diverge from the jnp oracle; bf16 inputs
+        # use the native single-pass MXU path with f32 accumulation
+        f32_in = q.dtype == jnp.float32
+        prec = jax.lax.Precision.HIGHEST if f32_in else None
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                                precision=prec) * scale
+
+        q_pos = qoff_ref[0] + qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos_local = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        k_pos = koff_ref[0] + k_pos_local
+        mask = k_pos_local < klen_ref[0]              # mask padded keys
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[...]                           # [block_q, 128]
+        m_blk = jnp.max(s, axis=1, keepdims=True)     # [block_q, 1]
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_blk, m_prev.shape))
+        # rows with nothing visible stay at NEG_INF; exp(NEG_INF-NEG_INF)
+        # must not produce 1s
+        alive = m_new[:, :1] > NEG_INF / 2
+        p = jnp.exp(s - m_new[:, :1])
+        p = jnp.where(mask & jnp.broadcast_to(alive, mask.shape), p, 0.0)
+        correction = jnp.where(alive,
+                               jnp.exp(m_prev[:, :1] - m_new[:, :1]), 0.0)
+
+        # p @ v in the inputs' dtype (bf16 stays on the fast MXU path)
+        pv = jax.lax.dot_general(
+            p if f32_in else p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST if f32_in else None)
+        acc_scr[...] = acc_scr[...] * correction + pv
+        l_scr[...] = l_scr[...] * correction + jnp.broadcast_to(
+            jnp.sum(p, axis=1, keepdims=True), l_scr.shape)
+        m_scr[...] = m_new
+
+    if causal:
+        # skip k-blocks strictly in this q-block's future — they never
+        # contribute (halves the causal FLOPs)
+        last_q_pos = qoff_ref[0] + (qi + 1) * block_q - 1
+        first_k_pos = koff_ref[0] + ki * block_k
+        pl.when(last_q_pos >= first_k_pos)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == n_k - 1)
+    def _flush():
+        o_ref[0] = acc_scr[...].astype(o_ref.dtype)
+        # m/l emitted lane-replicated [block_q, 128] (TPU tiling needs the
+        # last dim = 128); callers read lane 0
+        m_ref[0] = m_scr[...].astype(m_ref.dtype)
+        l_ref[0] = l_scr[...].astype(l_ref.dtype)
+
+
+def _sds(q, k, shape):
+    """Output ShapeDtypeStruct carrying the inputs' varying-manual-axes —
+    required when the kernel runs inside shard_map (ring attention)."""
+    vma = frozenset()
+    for a in (q, k):
+        vma = vma | (getattr(jax.typeof(a), "vma", None) or frozenset())
+    if vma:
+        return jax.ShapeDtypeStruct(shape, jnp.float32, vma=vma)
+    return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+
+def _pad_to(x, axis, multiple):
+    n = x.shape[axis]
+    pad = (-n) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k", "interpret"))
+def flash_attention_block(q, k, v, *, scale: float, causal: bool = False,
+                          q_offset=0, k_offset=0, block_q: int = 128,
+                          block_k: int = 128,
+                          interpret: bool | None = None):
+    """One (q-block, kv-block) flash pass.
+
+    q [B,H,Tq,D], k/v [B,H,Tk,D] → (o [B,H,Tq,D] unnormalized,
+    m [B,H,Tq] row max, l [B,H,Tq] row sum-exp) — drop-in for the jnp
+    ``_block_attention`` oracle.  ``q_offset``/``k_offset``: global
+    positions of row/col 0 (ints or traced scalars).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    # clamp to the sequence, then round UP to the sublane tile (8 for f32,
+    # 16 for bf16) — Mosaic requires block dims aligned to the tile; the
+    # padding below absorbs the remainder
+    sublane = 16 if q.dtype == jnp.bfloat16 else 8
+    block_q = -(-min(block_q, max(tq, sublane)) // sublane) * sublane
+    block_k = -(-min(block_k, max(tk, sublane)) // sublane) * sublane
+
+    qf = _pad_to(q.reshape(b * h, tq, d), 1, block_q)
+    kf = _pad_to(k.reshape(b * h, tk, d), 1, block_k)
+    vf = _pad_to(v.reshape(b * h, tk, d), 1, block_k)
+    tq_p, tk_p = qf.shape[1], kf.shape[1]
+    n_q, n_k = tq_p // block_q, tk_p // block_k
+
+    qoff = jnp.asarray(q_offset, jnp.int32).reshape(1)
+    koff = jnp.asarray(k_offset, jnp.int32).reshape(1)
+    klen = jnp.asarray(tk, jnp.int32).reshape(1)
+
+    kernel = functools.partial(_kernel, scale=float(scale), causal=causal,
+                               block_q=block_q, block_k=block_k, n_k=n_k)
+    o, m, l = pl.pallas_call(
+        kernel,
+        grid=(b * h, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_shape=[_sds(qf, kf, (b * h, tq_p, d)),
+                   _sds(qf, kf, (b * h, tq_p, 128)),
+                   _sds(qf, kf, (b * h, tq_p, 128))],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qoff, koff, klen, qf, kf, vf)
+    o = o[:, :tq].reshape(b, h, tq, d)
+    m = m[:, :tq, 0].reshape(b, h, tq)
+    l = l[:, :tq, 0].reshape(b, h, tq)
+    return o, m, l
+
+
+def flash_attention(q, k, v, *, n_heads: int, causal: bool = False,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool | None = None):
+    """Full single-device flash attention: [B, T, H*D] → [B, T, H*D].
+    Normalized output (softmax(QKᵀ/√d)·V) with no [T,T] materialization —
+    the libnd4j ``multi_head_dot_product_attention`` replacement for long
+    sequences on one chip."""
+    b, t, dm = q.shape
+    dh = dm // n_heads
+    qh = q.reshape(b, t, n_heads, dh).transpose(0, 2, 1, 3)
+    kh = k.reshape(b, t, n_heads, dh).transpose(0, 2, 1, 3)
+    vh = v.reshape(b, t, n_heads, dh).transpose(0, 2, 1, 3)
+    o, m, l = flash_attention_block(qh, kh, vh, scale=1.0 / (dh ** 0.5),
+                                    causal=causal, block_q=block_q,
+                                    block_k=block_k, interpret=interpret)
+    out = o / jnp.maximum(l[..., None], 1e-20)
+    return out.transpose(0, 2, 1, 3).reshape(b, t, dm).astype(q.dtype)

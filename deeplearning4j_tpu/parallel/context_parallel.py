@@ -51,7 +51,8 @@ def _block_attention(q, k, v, scale, mask):
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    mesh: Mesh, axis: str = "seq", n_heads: int = 1,
                    causal: bool = False, data_axis: str | None = None,
-                   head_axis: str | None = None) -> jnp.ndarray:
+                   head_axis: str | None = None, use_flash: bool = False,
+                   flash_block: int = 128) -> jnp.ndarray:
     """Multi-head ring attention.  q/k/v: [B, T, H*D] GLOBALLY, sharded
     over ``axis`` on dim 1.  Returns [B, T, H*D] with the same sharding.
 
@@ -83,13 +84,27 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         def step(carry, s):
             k_blk, v_blk, o, m, l = carry
             src_idx = (my_idx - s) % n_dev  # which device this kv block came from
-            if causal:
-                q_pos = my_idx * t_local + jnp.arange(t_local)
-                k_pos = src_idx * t_local + jnp.arange(t_local)
-                mask = q_pos[:, None] >= k_pos[None, :]
+            if use_flash:
+                # Pallas blockwise kernel: VMEM score tiles, no per-block
+                # [Tq,Tk] matrix in HBM (SURVEY §5.7/§7.7)
+                from deeplearning4j_tpu.ops.pallas import flash_attention_block
+                o_b, m_b, l_b = flash_attention_block(
+                    qh, k_blk, v_blk, scale=scale, causal=causal,
+                    q_offset=my_idx * t_local, k_offset=src_idx * t_local,
+                    block_q=flash_block, block_k=flash_block)
+                # kernel accumulates in f32; match the scan carry dtypes
+                # (bf16 inputs carry bf16 accumulators like the jnp path)
+                o_b = o_b.astype(o.dtype)
+                m_b = m_b.astype(m.dtype)
+                l_b = l_b.astype(l.dtype)
             else:
-                mask = None
-            o_b, m_b, l_b = _block_attention(qh, k_blk, v_blk, scale, mask)
+                if causal:
+                    q_pos = my_idx * t_local + jnp.arange(t_local)
+                    k_pos = src_idx * t_local + jnp.arange(t_local)
+                    mask = q_pos[:, None] >= k_pos[None, :]
+                else:
+                    mask = None
+                o_b, m_b, l_b = _block_attention(qh, k_blk, v_blk, scale, mask)
             # merge online-softmax accumulators
             m_new = jnp.maximum(m, m_b)
             c_old = jnp.exp(m - m_new)
@@ -115,8 +130,10 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         return out.transpose(0, 2, 1, 3).reshape(b, t_local, dmodel)
 
     spec = P(data_axis, axis, head_axis)
+    # check_vma off on the flash path: the Pallas interpreter (CPU tests)
+    # can't yet thread varying-manual-axes through its internal jaxpr eval
     return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec)(q, k, v)
+                     out_specs=spec, check_vma=not use_flash)(q, k, v)
 
 
 def reference_attention(q, k, v, n_heads: int, causal: bool = False):

@@ -20,6 +20,8 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+import gc
+
 import numpy as np
 import pytest
 
@@ -27,3 +29,14 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_jax_memory_per_module():
+    """Drop jit executables + buffers between test modules — the suite
+    compiles hundreds of programs (gradchecks alone build ~120 nets in
+    f64) and the accumulated cache otherwise OOMs the process before the
+    last modules run."""
+    yield
+    gc.collect()
+    jax.clear_caches()
