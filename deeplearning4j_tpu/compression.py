@@ -1,0 +1,176 @@
+"""General NDArray compression — FLOAT16 / INT8 / GZIP / NOOP codecs.
+
+Parity with ND4J's ``org/nd4j/linalg/compression/`` (``BasicNDArrayCompressor``
+registry + ``NDArrayCompressor`` impls: lossy FLOAT16 and INT8
+quantization, lossless GZIP, NOOP).  The gradient-sharing threshold/bitmap
+WIRE codec is separate (``parallel/compression.py`` + the native C++
+twin) — these are the general-purpose array compressors used for storage
+and host-side transport.
+
+Host-side by design: compression is an IO/transport concern; device
+arrays are gathered to numpy first (the reference likewise round-trips
+through host buffers for GZIP).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import gzip
+import json
+from typing import Any
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class CompressedArray:
+    """Self-describing compressed buffer (``CompressedDataBuffer`` +
+    ``CompressionDescriptor`` parity)."""
+
+    codec: str
+    data: bytes
+    shape: tuple
+    orig_dtype: str
+    meta: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def compressed_bytes(self) -> int:
+        return len(self.data)
+
+    @property
+    def original_bytes(self) -> int:
+        return int(np.prod(self.shape, dtype=np.int64)) * np.dtype(self.orig_dtype).itemsize
+
+    def ratio(self) -> float:
+        return self.original_bytes / max(self.compressed_bytes, 1)
+
+    # ---- serde ------------------------------------------------------
+    def to_bytes(self) -> bytes:
+        header = json.dumps({"codec": self.codec, "shape": list(self.shape),
+                             "orig_dtype": self.orig_dtype,
+                             "meta": self.meta}).encode()
+        return len(header).to_bytes(4, "little") + header + self.data
+
+    @staticmethod
+    def from_bytes(blob: bytes) -> "CompressedArray":
+        n = int.from_bytes(blob[:4], "little")
+        header = json.loads(blob[4:4 + n].decode())
+        return CompressedArray(header["codec"], blob[4 + n:],
+                               tuple(header["shape"]), header["orig_dtype"],
+                               header.get("meta", {}))
+
+
+class NDArrayCompressor:
+    """Codec SPI (``NDArrayCompressor.java``)."""
+
+    NAME = "base"
+    LOSSY = False
+
+    def compress(self, arr) -> CompressedArray:
+        raise NotImplementedError
+
+    def decompress(self, c: CompressedArray) -> np.ndarray:
+        raise NotImplementedError
+
+
+class NoopCompressor(NDArrayCompressor):
+    NAME = "NOOP"
+
+    def compress(self, arr):
+        arr = np.asarray(arr)
+        return CompressedArray(self.NAME, arr.tobytes(), arr.shape,
+                               str(arr.dtype))
+
+    def decompress(self, c):
+        return np.frombuffer(c.data, dtype=c.orig_dtype).reshape(c.shape).copy()
+
+
+class GzipCompressor(NDArrayCompressor):
+    """Lossless DEFLATE (``Gzip.java``)."""
+
+    NAME = "GZIP"
+
+    def __init__(self, level: int = 6):
+        self.level = level
+
+    def compress(self, arr):
+        arr = np.asarray(arr)
+        return CompressedArray(self.NAME, gzip.compress(arr.tobytes(), self.level),
+                               arr.shape, str(arr.dtype))
+
+    def decompress(self, c):
+        return np.frombuffer(gzip.decompress(c.data),
+                             dtype=c.orig_dtype).reshape(c.shape).copy()
+
+
+class Float16Compressor(NDArrayCompressor):
+    """Lossy fp16 cast (``Float16.java``)."""
+
+    NAME = "FLOAT16"
+    LOSSY = True
+
+    def compress(self, arr):
+        arr = np.asarray(arr)
+        return CompressedArray(self.NAME,
+                               arr.astype(np.float16).tobytes(),
+                               arr.shape, str(arr.dtype))
+
+    def decompress(self, c):
+        return np.frombuffer(c.data, dtype=np.float16).reshape(c.shape) \
+            .astype(c.orig_dtype)
+
+
+class Int8Compressor(NDArrayCompressor):
+    """Lossy linear int8 quantization with per-array scale
+    (``Int8.java`` / threshold-style quantization)."""
+
+    NAME = "INT8"
+    LOSSY = True
+
+    def compress(self, arr):
+        arr = np.asarray(arr)
+        peak = float(np.max(np.abs(arr))) if arr.size else 0.0
+        scale = peak / 127.0 if peak > 0 else 1.0
+        q = np.clip(np.round(arr / scale), -127, 127).astype(np.int8)
+        return CompressedArray(self.NAME, q.tobytes(), arr.shape,
+                               str(arr.dtype), {"scale": scale})
+
+    def decompress(self, c):
+        q = np.frombuffer(c.data, dtype=np.int8).reshape(c.shape)
+        return (q.astype(np.float64) * c.meta["scale"]).astype(c.orig_dtype)
+
+
+class BasicNDArrayCompressor:
+    """Codec registry + default-codec façade (``BasicNDArrayCompressor``)."""
+
+    _instance = None
+
+    def __init__(self):
+        self.codecs: dict[str, NDArrayCompressor] = {}
+        for codec in (NoopCompressor(), GzipCompressor(), Float16Compressor(),
+                      Int8Compressor()):
+            self.codecs[codec.NAME] = codec
+        self.default = "FLOAT16"
+
+    @classmethod
+    def get_instance(cls) -> "BasicNDArrayCompressor":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def register(self, codec: NDArrayCompressor) -> None:
+        self.codecs[codec.NAME] = codec
+
+    def set_default_compression(self, name: str) -> None:
+        if name not in self.codecs:
+            raise KeyError(f"unknown codec {name!r}; have {sorted(self.codecs)}")
+        self.default = name
+
+    def compress(self, arr, codec: str | None = None) -> CompressedArray:
+        name = codec or self.default
+        if name not in self.codecs:
+            raise KeyError(f"unknown codec {name!r}; have {sorted(self.codecs)}")
+        return self.codecs[name].compress(arr)
+
+    def decompress(self, c: CompressedArray) -> np.ndarray:
+        return self.codecs[c.codec].decompress(c)

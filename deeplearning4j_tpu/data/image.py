@@ -1,0 +1,254 @@
+"""Image ETL: loading, label extraction, augmentation.
+
+Parity with the reference's ``datavec-data-image``
+(``org/datavec/image/recordreader/ImageRecordReader.java``,
+``loader/NativeImageLoader.java`` (JavaCPP OpenCV),
+``transform/ImageTransform.java`` chain: Crop/Flip/Warp/Rotate/Scale/
+ColorConversion + ``PipelineImageTransform``, and
+``api/io/labels/ParentPathLabelGenerator.java``).
+
+TPU-native design: host-side decode/augment in PIL+numpy feeding NHWC
+float32 batches; augmentation randomness is a seeded ``numpy.random
+.Generator`` per transform (deterministic pipelines — the reference uses
+a java ``Random`` seed the same way).  Heavy lifting (normalization,
+mixup-style batch ops) belongs on device; these transforms are the
+decode-adjacent per-image ops that must run on host.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.data.records import RecordReader
+
+
+def _pil():
+    from PIL import Image
+    return Image
+
+
+# ================================================================= loading
+class NativeImageLoader:
+    """Decode + resize to [H, W, C] float32 (``NativeImageLoader`` —
+    OpenCV there, PIL here; same contract)."""
+
+    def __init__(self, height: int, width: int, channels: int = 3):
+        self.height = height
+        self.width = width
+        self.channels = channels
+
+    def load(self, source) -> np.ndarray:
+        Image = _pil()
+        if isinstance(source, np.ndarray):
+            arr = source
+        else:
+            with Image.open(source) as im:
+                im = im.convert("L" if self.channels == 1 else "RGB")
+                im = im.resize((self.width, self.height), Image.BILINEAR)
+                arr = np.asarray(im, dtype=np.float32)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if arr.shape[:2] != (self.height, self.width):
+            im = Image.fromarray(arr.astype(np.uint8).squeeze())
+            im = im.resize((self.width, self.height), Image.BILINEAR)
+            arr = np.asarray(im, dtype=np.float32)
+            if arr.ndim == 2:
+                arr = arr[:, :, None]
+        return arr.astype(np.float32)
+
+
+# ================================================================== labels
+class ParentPathLabelGenerator:
+    """Label = name of the file's parent directory
+    (``ParentPathLabelGenerator.java``)."""
+
+    def get_label(self, path: str) -> str:
+        return os.path.basename(os.path.dirname(os.path.abspath(path)))
+
+
+class PathLabelGenerator:
+    """Custom callable label extractor."""
+
+    def __init__(self, fn: Callable[[str], str]):
+        self.fn = fn
+
+    def get_label(self, path: str) -> str:
+        return self.fn(path)
+
+
+# ============================================================== transforms
+class ImageTransform:
+    """Per-image [H,W,C] float32 → [H,W,C] transform
+    (``transform/ImageTransform.java``)."""
+
+    def __call__(self, image: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def reset_seed(self, seed: int) -> None:
+        if hasattr(self, "rng"):
+            self.rng = np.random.default_rng(seed)
+
+
+class ResizeImageTransform(ImageTransform):
+    def __init__(self, height: int, width: int):
+        self.height, self.width = height, width
+
+    def __call__(self, image):
+        Image = _pil()
+        im = Image.fromarray(np.clip(image, 0, 255).astype(np.uint8).squeeze())
+        im = im.resize((self.width, self.height), Image.BILINEAR)
+        out = np.asarray(im, dtype=np.float32)
+        return out[:, :, None] if out.ndim == 2 else out
+
+
+class FlipImageTransform(ImageTransform):
+    """mode: 'horizontal' | 'vertical' | 'random' (``FlipImageTransform``)."""
+
+    def __init__(self, mode: str = "horizontal", seed: int = 0):
+        self.mode = mode
+        self.rng = np.random.default_rng(seed)
+
+    def __call__(self, image):
+        mode = self.mode
+        if mode == "random":
+            if self.rng.random() < 0.5:
+                return image
+            mode = "horizontal" if self.rng.random() < 0.5 else "vertical"
+        if mode == "horizontal":
+            return image[:, ::-1]
+        return image[::-1]
+
+
+class CropImageTransform(ImageTransform):
+    """Random crop of up to ``crop`` pixels per edge, padded back to the
+    original size? No — DL4J crops then the loader resizes; here we crop
+    and resize back so shapes stay static (``CropImageTransform``)."""
+
+    def __init__(self, crop: int, seed: int = 0):
+        self.crop = crop
+        self.rng = np.random.default_rng(seed)
+
+    def __call__(self, image):
+        h, w = image.shape[:2]
+        t, b, l, r = self.rng.integers(0, self.crop + 1, 4)
+        cropped = image[t:h - b if b else h, l:w - r if r else w]
+        return ResizeImageTransform(h, w)(cropped)
+
+
+class RotateImageTransform(ImageTransform):
+    """Random rotation in [-angle, angle] degrees (``RotateImageTransform``)."""
+
+    def __init__(self, angle: float, seed: int = 0):
+        self.angle = angle
+        self.rng = np.random.default_rng(seed)
+
+    def __call__(self, image):
+        Image = _pil()
+        deg = float(self.rng.uniform(-self.angle, self.angle))
+        im = Image.fromarray(np.clip(image, 0, 255).astype(np.uint8).squeeze())
+        im = im.rotate(deg, resample=Image.BILINEAR)
+        out = np.asarray(im, dtype=np.float32)
+        return out[:, :, None] if out.ndim == 2 else out
+
+
+class WarpImageTransform(ImageTransform):
+    """Random corner jitter (affine-ish warp, ``WarpImageTransform``)."""
+
+    def __init__(self, delta: float, seed: int = 0):
+        self.delta = delta
+        self.rng = np.random.default_rng(seed)
+
+    def __call__(self, image):
+        Image = _pil()
+        h, w = image.shape[:2]
+        d = self.delta
+        # QUAD transform: map output corners to jittered input corners
+        corners = np.array([[0, 0], [0, h], [w, h], [w, 0]], np.float32)
+        jitter = self.rng.uniform(-d, d, corners.shape).astype(np.float32)
+        quad = (corners + jitter).flatten().tolist()
+        im = Image.fromarray(np.clip(image, 0, 255).astype(np.uint8).squeeze())
+        im = im.transform((w, h), Image.QUAD, quad, resample=Image.BILINEAR)
+        out = np.asarray(im, dtype=np.float32)
+        return out[:, :, None] if out.ndim == 2 else out
+
+
+class ScaleImageTransform(ImageTransform):
+    """Pixel-value scaling (``ScaleImageTransform``)."""
+
+    def __init__(self, scale: float):
+        self.scale = scale
+
+    def __call__(self, image):
+        return image * self.scale
+
+
+class ColorConversionTransform(ImageTransform):
+    """RGB → grayscale (kept 3-channel or 1-channel;
+    ``ColorConversionTransform`` scoped to the common conversion)."""
+
+    def __init__(self, keep_channels: bool = True):
+        self.keep_channels = keep_channels
+
+    def __call__(self, image):
+        gray = image @ np.asarray([0.299, 0.587, 0.114], np.float32) \
+            if image.shape[-1] == 3 else image[..., 0]
+        if self.keep_channels and image.shape[-1] == 3:
+            return np.repeat(gray[..., None], 3, axis=-1)
+        return gray[..., None]
+
+
+class PipelineImageTransform(ImageTransform):
+    """Chain with per-transform probabilities (``PipelineImageTransform``)."""
+
+    def __init__(self, transforms: Sequence, seed: int = 0):
+        """transforms: list of ImageTransform or (ImageTransform, prob)."""
+        self.steps = [(t, 1.0) if not isinstance(t, tuple) else t
+                      for t in transforms]
+        self.rng = np.random.default_rng(seed)
+
+    def __call__(self, image):
+        for transform, prob in self.steps:
+            if prob >= 1.0 or self.rng.random() < prob:
+                image = transform(image)
+        return image
+
+
+# ================================================================== reader
+class ImageRecordReader(RecordReader):
+    """Directory-of-images → records [image [H,W,C] f32, label_index]
+    (``ImageRecordReader.java``).  Plugs into
+    ``RecordReaderDataSetIterator(label_index=1, num_classes=...)``."""
+
+    def __init__(self, height: int, width: int, channels: int = 3,
+                 label_generator=None, transform: Optional[ImageTransform] = None):
+        self.loader = NativeImageLoader(height, width, channels)
+        self.label_generator = label_generator or ParentPathLabelGenerator()
+        self.transform = transform
+        self.labels: list[str] = []
+        self._split = None
+
+    def initialize(self, split) -> "ImageRecordReader":
+        self._split = split
+        self.labels = sorted({self.label_generator.get_label(p)
+                              for p in split.locations()})
+        self._label_index = {l: i for i, l in enumerate(self.labels)}
+        return self
+
+    def num_classes(self) -> int:
+        return len(self.labels)
+
+    def records(self):
+        if self._split is None:
+            raise ValueError("call initialize(FileSplit) first")
+        for path in self._split.locations():
+            img = self.loader.load(path)
+            if self.transform is not None:
+                img = self.transform(img)
+            label = self._label_index[self.label_generator.get_label(path)]
+            yield [img, label]
+
+    def reset(self):
+        pass

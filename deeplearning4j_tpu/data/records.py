@@ -186,7 +186,12 @@ class RecordReaderDataSetIterator(DataSetIterator):
             yield self._make_batch(feats, labels)
 
     def _make_batch(self, feats, labels) -> DataSet:
-        x = np.asarray(feats, dtype=np.float32)
+        if feats and len(feats[0]) == 1 and isinstance(feats[0][0], np.ndarray) \
+                and feats[0][0].ndim >= 2:
+            # image records: [tensor, label] → stack [B, H, W, C]
+            x = np.stack([f[0] for f in feats]).astype(np.float32)
+        else:
+            x = np.asarray(feats, dtype=np.float32)
         if self.label_index is None:
             return DataSet(x, None)
         if self.regression:

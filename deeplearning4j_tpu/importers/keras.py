@@ -85,13 +85,32 @@ def _convert_layer(kcfg: dict):
         return EmbeddingSequenceLayer(name=name, n_in=conf["input_dim"],
                                       n_out=conf["output_dim"], has_bias=False)
     if cls == "LSTM":
-        return LSTM(name=name, n_out=conf["units"],
+        cell = LSTM(name=name, n_out=conf["units"],
                     activation=_act(conf.get("activation", "tanh")),
                     gate_activation=_act(conf.get("recurrent_activation", "sigmoid")))
+        if not conf.get("return_sequences", False):
+            # Keras default emits only the final step — LastTimeStep parity
+            from deeplearning4j_tpu.nn.layers import LastTimeStep
+            return LastTimeStep(name=name, underlying=cell)
+        return cell
     if cls == "Bidirectional":
-        inner = _convert_layer(conf["layer"])
-        return Bidirectional(name=name, fwd=inner,
-                             mode=conf.get("merge_mode", "concat"))
+        inner_cfg = conf["layer"]
+        inner_conf = inner_cfg["config"]
+        # build the bare cell: return_sequences handling belongs to the
+        # WRAPPER (last-step of the merged fwd/bwd output), not the cell
+        cell = LSTM(name=inner_conf.get("name"), n_out=inner_conf["units"],
+                    activation=_act(inner_conf.get("activation", "tanh")),
+                    gate_activation=_act(inner_conf.get("recurrent_activation",
+                                                        "sigmoid")))
+        mode = {"concat": "concat", "sum": "add", "ave": "average",
+                "mul": "mul"}.get(conf.get("merge_mode", "concat"), "concat")
+        if not inner_conf.get("return_sequences", False):
+            # Keras merges the two directions' FINAL STATES — the backward
+            # half's lives at unflipped position 0, so a plain
+            # LastTimeStep over the merged sequence would be wrong
+            from deeplearning4j_tpu.nn.layers import BidirectionalLastStep
+            return BidirectionalLastStep(name=name, fwd=cell, mode=mode)
+        return Bidirectional(name=name, fwd=cell, mode=mode)
     if cls in ("GlobalAveragePooling2D", "GlobalMaxPooling2D",
                "GlobalAveragePooling1D", "GlobalMaxPooling1D"):
         return GlobalPoolingLayer(name=name,
@@ -150,12 +169,22 @@ def import_sequential(model_json: str,
 
 def load_weights(net: MultiLayerNetwork, weights: dict[str, list[np.ndarray]]) -> None:
     """Copy Keras layer weights into the network by layer name."""
+    from deeplearning4j_tpu.nn.layers import LastTimeStep
     for i, layer in enumerate(net.layers):
         if layer.name is None or layer.name not in weights:
             continue
         arrays = [np.asarray(a) for a in weights[layer.name]]
         params = net.params_[i]
-        if isinstance(layer, LSTM):
+        if isinstance(layer, LastTimeStep):
+            layer = layer.underlying      # params delegate to the wrapped cell
+        if isinstance(layer, Bidirectional) and isinstance(layer.fwd, LSTM):
+            # keras order: fwd (W,U,b) then bwd (W,U,b), each IFCO
+            h = layer.fwd.n_out
+            for half, (w, u, b) in (("fwd", arrays[:3]), ("bwd", arrays[3:])):
+                params[half]["W"] = _ifco_to_ifog(np.asarray(w), h)
+                params[half]["U"] = _ifco_to_ifog(np.asarray(u), h)
+                params[half]["b"] = _ifco_to_ifog(np.asarray(b)[None, :], h)[0]
+        elif isinstance(layer, LSTM):
             w, u, b = arrays  # keras: [in,4H] IFCO
             params["W"] = _ifco_to_ifog(w, layer.n_out)
             params["U"] = _ifco_to_ifog(u, layer.n_out)
@@ -189,3 +218,41 @@ def load_weights_npz(net: MultiLayerNetwork, path: str) -> None:
         grouped.setdefault(lname, []).append((int(idx), data[key]))
     weights = {name: [a for _, a in sorted(items)] for name, items in grouped.items()}
     load_weights(net, weights)
+
+
+# ------------------------------------------------------------- HDF5 (.h5)
+def _h5_weights(h5file) -> dict[str, list[np.ndarray]]:
+    """model_weights group → {layer_name: [arrays in weight_names order]}
+    (the layout ``KerasModel``'s HDF5 reader walks via JavaCPP-HDF5)."""
+    root = h5file["model_weights"] if "model_weights" in h5file else h5file
+    weights: dict[str, list[np.ndarray]] = {}
+    for layer_name in root:
+        group = root[layer_name]
+        names = group.attrs.get("weight_names")
+        if names is None or len(names) == 0:
+            continue
+        arrays = []
+        for wname in names:
+            if isinstance(wname, bytes):
+                wname = wname.decode()
+            arrays.append(np.asarray(group[wname]))
+        weights[layer_name] = arrays
+    return weights
+
+
+def import_keras_model_and_weights(path: str, loss: str = "mcxent") -> MultiLayerNetwork:
+    """Full .h5 import (``KerasModelImport.importKerasSequentialModelAndWeights``):
+    architecture from the file's ``model_config`` attribute + weights from
+    ``model_weights``.  Requires h5py (present in this environment)."""
+    import h5py
+    with h5py.File(path, "r") as f:
+        model_config = f.attrs.get("model_config")
+        if model_config is None:
+            raise ValueError(f"{path} has no model_config attribute — not a "
+                             "Keras full-model HDF5 file")
+        if isinstance(model_config, bytes):
+            model_config = model_config.decode()
+        weights = _h5_weights(f)
+    net = import_sequential(model_config, loss=loss)
+    load_weights(net, weights)
+    return net

@@ -19,9 +19,21 @@ from deeplearning4j_tpu.models.zoo import (
     lstm_classifier,
     text_gen_lstm,
 )
+from deeplearning4j_tpu.models.zoo_extra import (
+    squeezenet,
+    darknet19,
+    tiny_yolo,
+    yolo2,
+    unet,
+    xception,
+    inception_resnet_v1,
+    nasnet_mobile,
+)
 from deeplearning4j_tpu.models import bert
 
 __all__ = [
     "mlp_mnist", "lenet", "simple_cnn", "alexnet", "vgg16", "resnet50",
     "lstm_classifier", "text_gen_lstm", "bert",
+    "squeezenet", "darknet19", "tiny_yolo", "yolo2", "unet", "xception",
+    "inception_resnet_v1", "nasnet_mobile",
 ]

@@ -51,6 +51,7 @@ from deeplearning4j_tpu.nn.layers.recurrent import (
     SimpleRnn,
     GRU,
     Bidirectional,
+    BidirectionalLastStep,
     LastTimeStep,
     TimeDistributed,
     RnnOutputLayer,
@@ -97,7 +98,8 @@ __all__ = [
     "SubsamplingLayer", "Subsampling1DLayer", "Subsampling3DLayer",
     "UpsamplingLayer", "ZeroPaddingLayer", "CroppingLayer", "SpaceToDepthLayer",
     "GlobalPoolingLayer", "LocalResponseNormalization",
-    "LSTM", "GravesLSTM", "SimpleRnn", "GRU", "Bidirectional", "LastTimeStep",
+    "LSTM", "GravesLSTM", "SimpleRnn", "GRU", "Bidirectional",
+    "BidirectionalLastStep", "LastTimeStep",
     "TimeDistributed", "RnnOutputLayer", "RnnLossLayer",
     "SelfAttentionLayer", "LearnedSelfAttentionLayer",
     "LayerNormalization", "PReLULayer",

@@ -283,6 +283,51 @@ class Bidirectional(Layer):
         return d
 
 
+@register_layer("bidirectional_last")
+@dataclasses.dataclass
+class BidirectionalLastStep(Bidirectional):
+    """Bidirectional collapsed to its final states
+    (Keras ``Bidirectional(return_sequences=False)`` / DL4J
+    Bidirectional→LastTimeStep composition): merge(fwd final step,
+    bwd FINAL state) — the backward half's final state is its output at
+    unflipped position 0, which a LastTimeStep over the merged sequence
+    would miss."""
+
+    def transform_mask(self, mask):
+        return None           # time axis consumed
+
+    def get_output_type(self, input_type):
+        inner = self.fwd.get_output_type(input_type)
+        size = inner.size * 2 if self.mode == "concat" else inner.size
+        return InputType.feed_forward(size)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        y_f, _ = self.fwd.apply(params["fwd"], {}, x, train=train, rng=rng,
+                                mask=mask)
+        x_rev = jnp.flip(x, axis=1)
+        mask_rev = jnp.flip(mask, axis=1) if mask is not None else None
+        y_b, _ = self.fwd.apply(params["bwd"], {}, x_rev, train=train,
+                                rng=rng, mask=mask_rev)
+        if mask is None:
+            f_last = y_f[:, -1, :]
+            b_last = y_b[:, -1, :]        # reversed run's final state
+        else:
+            idx = jnp.maximum(jnp.sum(mask, axis=1).astype(jnp.int32) - 1, 0)
+            f_last = jnp.take_along_axis(y_f, idx[:, None, None], axis=1)[:, 0, :]
+            idx_b = jnp.maximum(jnp.sum(mask_rev, axis=1).astype(jnp.int32) - 1, 0)
+            b_last = jnp.take_along_axis(y_b, idx_b[:, None, None], axis=1)[:, 0, :]
+        m = self.mode.lower()
+        if m == "concat":
+            return jnp.concatenate([f_last, b_last], axis=-1), state
+        if m == "add":
+            return f_last + b_last, state
+        if m == "mul":
+            return f_last * b_last, state
+        if m == "average":
+            return 0.5 * (f_last + b_last), state
+        raise ValueError(self.mode)
+
+
 @register_layer("last_time_step")
 @dataclasses.dataclass
 class LastTimeStep(Layer):

@@ -268,6 +268,11 @@ LAYER_CASES = {
                                       _rnn_batch(3, 3, t=4).labels)),
     "mask_zero": ([MaskZeroLayer(underlying=LSTM(n_out=5)), RNN_OUT()],
                   InputType.recurrent(3, 5), lambda: _rnn_batch(3, 3)),
+    "bidirectional_last": ([BidirectionalLastStep(fwd=LSTM(n_out=4),
+                                                  mode="concat"), FF_OUT()],
+                           InputType.recurrent(3, 5),
+                           lambda: DataSet(_r().normal(size=(3, 5, 3)),
+                                           np.eye(3)[_r().integers(0, 3, 3)])),
     "graves_bidirectional_lstm": ([GravesBidirectionalLSTM(n_out=5), RNN_OUT()],
                                   InputType.recurrent(3, 5),
                                   lambda: _rnn_batch(3, 3)),

@@ -1,0 +1,136 @@
+"""Image pipeline + general array compression tests (VERDICT missing #6,
+partial #11: ImageRecordReader/ImageTransform chain; FLOAT16/INT8/GZIP
+NDArray compressors).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.compression import (
+    BasicNDArrayCompressor, CompressedArray, GzipCompressor, Int8Compressor,
+    Float16Compressor)
+from deeplearning4j_tpu.data.image import (
+    NativeImageLoader, ImageRecordReader, ParentPathLabelGenerator,
+    FlipImageTransform, CropImageTransform, RotateImageTransform,
+    WarpImageTransform, ScaleImageTransform, ColorConversionTransform,
+    ResizeImageTransform, PipelineImageTransform)
+from deeplearning4j_tpu.data.records import FileSplit, RecordReaderDataSetIterator
+
+
+def _write_images(root, classes=("cats", "dogs"), per_class=3, size=20):
+    from PIL import Image
+    rng = np.random.default_rng(0)
+    for ci, cls in enumerate(classes):
+        d = os.path.join(root, cls)
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            arr = rng.integers(0, 255, (size, size, 3), dtype=np.uint8)
+            arr[:, :, ci] = 250          # class-coded channel
+            Image.fromarray(arr).save(os.path.join(d, f"img{i}.png"))
+
+
+class TestImagePipeline:
+    def test_loader_resize_and_channels(self, tmp_path):
+        _write_images(str(tmp_path), per_class=1)
+        path = str(tmp_path / "cats" / "img0.png")
+        img = NativeImageLoader(14, 10, 3).load(path)
+        assert img.shape == (14, 10, 3) and img.dtype == np.float32
+        gray = NativeImageLoader(14, 10, 1).load(path)
+        assert gray.shape == (14, 10, 1)
+
+    def test_reader_to_dataset_flow(self, tmp_path):
+        """The canonical flow: dir-of-class-dirs → ImageRecordReader →
+        RecordReaderDataSetIterator → NHWC DataSet batches."""
+        _write_images(str(tmp_path))
+        reader = ImageRecordReader(16, 16, 3).initialize(
+            FileSplit(str(tmp_path), allowed_extensions=[".png"]))
+        assert reader.labels == ["cats", "dogs"]
+        it = RecordReaderDataSetIterator(reader, batch_size=4, label_index=1,
+                                         num_classes=reader.num_classes())
+        batches = list(it)
+        assert batches[0].features.shape == (4, 16, 16, 3)
+        assert batches[0].labels.shape == (4, 2)
+        total = sum(b.features.shape[0] for b in batches)
+        assert total == 6
+        np.testing.assert_allclose(
+            np.asarray(np.concatenate([b.labels for b in batches])).sum(), 6.0)
+
+    def test_transforms_preserve_shape(self):
+        rng = np.random.default_rng(1)
+        img = rng.uniform(0, 255, (24, 20, 3)).astype(np.float32)
+        for t in (FlipImageTransform("horizontal"),
+                  FlipImageTransform("random", seed=3),
+                  CropImageTransform(4, seed=3),
+                  RotateImageTransform(15, seed=3),
+                  WarpImageTransform(3, seed=3),
+                  ScaleImageTransform(1 / 255.0),
+                  ColorConversionTransform(),
+                  ResizeImageTransform(24, 20)):
+            out = t(img)
+            assert out.shape == img.shape, type(t).__name__
+            assert np.all(np.isfinite(out))
+
+    def test_flip_semantics(self):
+        img = np.arange(12, dtype=np.float32).reshape(2, 2, 3)
+        np.testing.assert_array_equal(
+            FlipImageTransform("horizontal")(img), img[:, ::-1])
+        np.testing.assert_array_equal(
+            FlipImageTransform("vertical")(img), img[::-1])
+
+    def test_pipeline_with_probabilities(self):
+        img = np.full((8, 8, 3), 100.0, np.float32)
+        pipe = PipelineImageTransform(
+            [(ScaleImageTransform(2.0), 1.0),
+             (ScaleImageTransform(100.0), 0.0)], seed=0)   # never applied
+        np.testing.assert_allclose(pipe(img), img * 2.0)
+
+    def test_augmented_reader(self, tmp_path):
+        _write_images(str(tmp_path), per_class=2)
+        pipe = PipelineImageTransform([FlipImageTransform("random", seed=1),
+                                       ScaleImageTransform(1 / 255.0)], seed=1)
+        reader = ImageRecordReader(16, 16, 3, transform=pipe).initialize(
+            FileSplit(str(tmp_path), allowed_extensions=[".png"]))
+        batch = next(iter(RecordReaderDataSetIterator(
+            reader, batch_size=4, label_index=1, num_classes=2)))
+        assert float(np.asarray(batch.features).max()) <= 1.0
+
+
+class TestCompression:
+    def test_gzip_lossless_round_trip(self):
+        rng = np.random.default_rng(0)
+        arr = rng.normal(size=(64, 32)).astype(np.float32)
+        c = GzipCompressor().compress(arr)
+        np.testing.assert_array_equal(GzipCompressor().decompress(c), arr)
+
+    def test_float16_lossy_round_trip(self):
+        arr = np.linspace(-3, 3, 1000, dtype=np.float32)
+        c = Float16Compressor().compress(arr)
+        assert c.compressed_bytes == arr.nbytes // 2
+        out = Float16Compressor().decompress(c)
+        assert out.dtype == np.float32
+        np.testing.assert_allclose(out, arr, atol=2e-3)
+
+    def test_int8_lossy_round_trip(self):
+        arr = np.linspace(-1, 1, 255, dtype=np.float32)
+        c = Int8Compressor().compress(arr)
+        assert c.compressed_bytes == arr.nbytes // 4
+        out = Int8Compressor().decompress(c)
+        np.testing.assert_allclose(out, arr, atol=1.0 / 127 + 1e-6)
+        assert c.ratio() == 4.0
+
+    def test_registry_and_serde(self):
+        comp = BasicNDArrayCompressor.get_instance()
+        arr = np.random.default_rng(1).normal(size=(10, 10)).astype(np.float32)
+        comp.set_default_compression("GZIP")
+        c = comp.compress(arr)
+        assert c.codec == "GZIP"
+        blob = c.to_bytes()
+        c2 = CompressedArray.from_bytes(blob)
+        np.testing.assert_array_equal(comp.decompress(c2), arr)
+        with pytest.raises(KeyError):
+            comp.compress(arr, codec="LZ4")
+        with pytest.raises(KeyError):
+            comp.set_default_compression("SNAPPY")
+        comp.set_default_compression("FLOAT16")   # restore default

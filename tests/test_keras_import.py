@@ -1,0 +1,128 @@
+"""Keras .h5 import golden tests — REAL cross-framework fixtures
+(SURVEY §4.2-2: import the graph, assert numerical equality against the
+source framework's own outputs).
+
+tf.keras builds, saves, and predicts in a SUBPROCESS (TF and JAX share
+fragile native deps — loading TF into the pytest process segfaults);
+the pytest process then imports the .h5 with OUR importer and must
+reproduce Keras's recorded activations.  Skips when tensorflow is absent.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.importers.keras import import_keras_model_and_weights
+
+_GEN = r"""
+import json, sys
+import numpy as np
+import tensorflow as tf
+spec = json.loads(sys.argv[1])
+keras = tf.keras
+layers = []
+for l in spec["layers"]:
+    kind = l.pop("kind")
+    if kind == "input":
+        layers.append(keras.layers.Input(shape=tuple(l["shape"])))
+    elif kind == "dense":
+        layers.append(keras.layers.Dense(l["units"], activation=l["act"], name=l["name"]))
+    elif kind == "conv2d":
+        layers.append(keras.layers.Conv2D(l["filters"], l["kernel"], activation=l["act"],
+                                          padding=l["padding"], name=l["name"]))
+    elif kind == "maxpool":
+        layers.append(keras.layers.MaxPooling2D(l["pool"], name=l["name"]))
+    elif kind == "flatten":
+        layers.append(keras.layers.Flatten(name=l["name"]))
+    elif kind == "lstm":
+        layers.append(keras.layers.LSTM(l["units"], return_sequences=l.get("seq", False),
+                                        name=l["name"]))
+    elif kind == "bidi_lstm":
+        layers.append(keras.layers.Bidirectional(keras.layers.LSTM(l["units"]),
+                                                 name=l["name"]))
+model = keras.Sequential(layers)
+model.save(spec["h5"])
+rng = np.random.default_rng(spec["seed"])
+x = rng.normal(size=tuple(spec["x_shape"])).astype(np.float32)
+np.savez(spec["npz"], x=x, golden=model.predict(x, verbose=0))
+"""
+
+
+def _make_fixture(tmp_path, spec_layers, x_shape, seed=0):
+    h5 = str(tmp_path / "model.h5")
+    npz = str(tmp_path / "golden.npz")
+    spec = {"layers": spec_layers, "h5": h5, "npz": npz,
+            "x_shape": list(x_shape), "seed": seed}
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = ""           # TF subprocess: no jax involved
+    proc = subprocess.run([sys.executable, "-c", _GEN, json.dumps(spec)],
+                          capture_output=True, timeout=300, env=env)
+    if proc.returncode != 0:
+        if b"No module named 'tensorflow'" in proc.stderr:
+            pytest.skip("tensorflow unavailable")
+        raise RuntimeError(proc.stderr.decode()[-1500:])
+    data = np.load(npz)
+    return h5, data["x"], data["golden"]
+
+
+class TestKerasH5Golden:
+    def test_mlp_golden_activations(self, tmp_path):
+        h5, x, golden = _make_fixture(tmp_path, [
+            {"kind": "input", "shape": [12]},
+            {"kind": "dense", "units": 16, "act": "relu", "name": "d1"},
+            {"kind": "dense", "units": 8, "act": "tanh", "name": "d2"},
+            {"kind": "dense", "units": 3, "act": "softmax", "name": "out"},
+        ], (5, 12))
+        net = import_keras_model_and_weights(h5)
+        np.testing.assert_allclose(np.asarray(net.output(x)), golden,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_cnn_golden_activations(self, tmp_path):
+        h5, x, golden = _make_fixture(tmp_path, [
+            {"kind": "input", "shape": [12, 12, 3]},
+            {"kind": "conv2d", "filters": 4, "kernel": 3, "act": "relu",
+             "padding": "same", "name": "c1"},
+            {"kind": "maxpool", "pool": 2, "name": "p1"},
+            {"kind": "conv2d", "filters": 6, "kernel": 3, "act": "relu",
+             "padding": "valid", "name": "c2"},
+            {"kind": "flatten", "name": "f"},
+            {"kind": "dense", "units": 5, "act": "softmax", "name": "out"},
+        ], (3, 12, 12, 3), seed=1)
+        net = import_keras_model_and_weights(h5)
+        np.testing.assert_allclose(np.asarray(net.output(x)), golden,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_lstm_golden_activations(self, tmp_path):
+        h5, x, golden = _make_fixture(tmp_path, [
+            {"kind": "input", "shape": [7, 5]},
+            {"kind": "lstm", "units": 6, "name": "lstm"},
+            {"kind": "dense", "units": 3, "act": "softmax", "name": "out"},
+        ], (4, 7, 5), seed=2)
+        net = import_keras_model_and_weights(h5)
+        np.testing.assert_allclose(np.asarray(net.output(x)), golden,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_bidirectional_lstm_golden_activations(self, tmp_path):
+        """Bidirectional(return_sequences=False): last-step wrap goes
+        around the merged output (the bwd half's final state lives at
+        unflipped position 0) and all 6 weight arrays load."""
+        h5, x, golden = _make_fixture(tmp_path, [
+            {"kind": "input", "shape": [6, 4]},
+            {"kind": "bidi_lstm", "units": 5, "name": "bidi"},
+            {"kind": "dense", "units": 2, "act": "softmax", "name": "out"},
+        ], (4, 6, 4), seed=3)
+        net = import_keras_model_and_weights(h5)
+        np.testing.assert_allclose(np.asarray(net.output(x)), golden,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_missing_model_config_raises(self, tmp_path):
+        h5py = pytest.importorskip("h5py")
+        path = str(tmp_path / "bare.h5")
+        with h5py.File(path, "w") as f:
+            f.create_dataset("x", data=np.zeros(3))
+        with pytest.raises(ValueError):
+            import_keras_model_and_weights(path)

@@ -1,0 +1,93 @@
+"""Zoo tail model tests (VERDICT missing #11): build + forward shape for
+every remaining reference model family, at reduced input sizes so the
+suite stays fast.
+
+Parity anchors: ``deeplearning4j-zoo org/deeplearning4j/zoo/model/``
+SqueezeNet/Darknet19/TinyYOLO/YOLO2/UNet/Xception/InceptionResNetV1/NASNet.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import (
+    squeezenet, darknet19, tiny_yolo, yolo2, unet, xception,
+    inception_resnet_v1, nasnet_mobile)
+
+
+def _x(h, w, c=3, b=2, seed=0):
+    return np.random.default_rng(seed).normal(size=(b, h, w, c)).astype(np.float32)
+
+
+class TestZooTail:
+    def test_squeezenet(self):
+        net = squeezenet(height=96, width=96, num_classes=10).init()
+        out = net.output(_x(96, 96))
+        assert out.shape == (2, 10)
+        np.testing.assert_allclose(np.asarray(out).sum(axis=1), 1.0, rtol=1e-4)
+
+    def test_darknet19(self):
+        net = darknet19(height=64, width=64, num_classes=12).init()
+        out = net.output(_x(64, 64))
+        assert out.shape == (2, 12)
+        # 19 conv layers (18 body + 1 head) — the name
+        from deeplearning4j_tpu.nn.layers import ConvolutionLayer
+        n_convs = sum(isinstance(l, ConvolutionLayer) for l in net.layers)
+        assert n_convs == 19
+
+    def test_tiny_yolo(self):
+        net = tiny_yolo(height=96, width=96, num_classes=4).init()
+        out = net.output(_x(96, 96))
+        # 5 pools: 96/32 = 3; 5 anchors × (5+4) = 45 channels
+        assert out.shape == (2, 3, 3, 45)
+        out = np.asarray(out).reshape(2, 3, 3, 5, 9)
+        assert np.all((out[..., 4] >= 0) & (out[..., 4] <= 1))   # conf activated
+
+    def test_yolo2_passthrough_graph(self):
+        net = yolo2(height=128, width=128, num_classes=3).init()
+        out = net.output(_x(128, 128))
+        assert out.shape == (2, 4, 4, 5 * (5 + 3))   # 128/32 grid
+
+    def test_unet(self):
+        net = unet(height=64, width=64, num_classes=1).init()
+        out = net.output(_x(64, 64))
+        assert out.shape == (2, 64, 64, 1)           # same-size segmentation
+        assert np.all((np.asarray(out) >= 0) & (np.asarray(out) <= 1))
+
+    def test_xception(self):
+        net = xception(height=96, width=96, num_classes=7, middle_blocks=2).init()
+        out = net.output(_x(96, 96))
+        assert out.shape == (2, 7)
+
+    def test_inception_resnet_v1(self):
+        net = inception_resnet_v1(height=96, width=96, num_classes=16,
+                                  blocks_a=1, blocks_b=1, blocks_c=1).init()
+        out = net.output(_x(96, 96))
+        assert out.shape == (2, 16)
+
+    def test_nasnet_mobile(self):
+        net = nasnet_mobile(height=64, width=64, num_classes=9, cells=1).init()
+        out = net.output(_x(64, 64))
+        assert out.shape == (2, 9)
+
+    def test_full_size_configs_build(self):
+        """Reference-sized configs construct + shape-infer without init
+        (no params allocated — config-time validation only)."""
+        for model, kw in ((squeezenet, {}), (darknet19, {}),
+                          (tiny_yolo, {}), (yolo2, {}),
+                          (unet, {"height": 256, "width": 256}),
+                          (xception, {}),
+                          (inception_resnet_v1, {}),
+                          (nasnet_mobile, {})):
+            net = model(**kw)
+            assert net.conf is not None
+
+    def test_zoo_tail_config_round_trip(self):
+        """Graph/MLN configs of the tail serialize and rebuild."""
+        from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+        net = darknet19(height=64, width=64, num_classes=5)
+        rt = MultiLayerConfiguration.from_json(net.conf.to_json())
+        assert len(rt.layers) == len(net.conf.layers)
+        from deeplearning4j_tpu.nn.graph import ComputationGraphConfiguration
+        g = squeezenet(height=96, width=96, num_classes=4)
+        rt2 = ComputationGraphConfiguration.from_json(g.conf.to_json())
+        assert [v.name for v in rt2.vertices] == [v.name for v in g.conf.vertices]
