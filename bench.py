@@ -106,6 +106,46 @@ def bench_bert_mlm(batch: int = 32, seq_len: int = 128, steps: int = 10,
             "batch": batch, "seq_len": seq_len}
 
 
+def _bench_net_step(net, features, labels, steps=10, warmup=2):
+    """Steady-state fit_batch time for a workload net."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.train.trainer import Trainer
+    trainer = Trainer(net)
+    batch = DataSet(jnp.asarray(features), jnp.asarray(labels))
+    key = jax.random.key(0)
+    for _ in range(warmup):
+        loss = trainer.fit_batch(batch, key)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.fit_batch(batch, key)
+    float(loss)
+    return round((time.perf_counter() - t0) / steps * 1000, 2)
+
+
+def bench_workload_steps() -> dict:
+    """BASELINE rows 'MLPMnist / LeNet CIFAR-10 / LSTM UCI-HAR step time'
+    (SURVEY §7.2 M1/M3/M4 measurements)."""
+    from deeplearning4j_tpu.models import mlp_mnist, lenet, lstm_classifier
+    rng = np.random.default_rng(0)
+    out = {}
+    net = mlp_mnist()
+    out["mlp_mnist_step_ms"] = _bench_net_step(
+        net, rng.normal(size=(128, 784)).astype(np.float32),
+        np.eye(10, dtype=np.float32)[rng.integers(0, 10, 128)])
+    net = lenet(height=32, width=32, channels=3)       # CIFAR-10 shape
+    out["lenet_cifar10_step_ms"] = _bench_net_step(
+        net, rng.normal(size=(128, 32, 32, 3)).astype(np.float32),
+        np.eye(10, dtype=np.float32)[rng.integers(0, 10, 128)])
+    net = lstm_classifier(timesteps=128)               # UCI-HAR shape
+    out["lstm_har_step_ms"] = _bench_net_step(
+        net, rng.normal(size=(64, 128, 9)).astype(np.float32),
+        np.eye(6, dtype=np.float32)[rng.integers(0, 6, 64)])
+    return out
+
+
 def main():
     batch = 256  # HBM-bound workload: large batch amortizes weight traffic
                  # (see bench/PROFILE.md; 256 ≈ saturation point on v5e)
@@ -116,6 +156,10 @@ def main():
                 result["detail"]["bert_base_mlm"] = bench_bert_mlm()
             except Exception as e:
                 result["detail"]["bert_base_mlm"] = {"error": str(e)[:200]}
+            try:  # BASELINE M1/M3/M4 workload step times
+                result["detail"]["workloads"] = bench_workload_steps()
+            except Exception as e:
+                result["detail"]["workloads"] = {"error": str(e)[:200]}
             print(json.dumps(result))
             return 0
         except Exception as e:  # OOM etc. → halve the batch and retry

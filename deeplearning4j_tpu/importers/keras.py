@@ -95,6 +95,11 @@ def _convert_layer(kcfg: dict):
         return cell
     if cls == "Bidirectional":
         inner_cfg = conf["layer"]
+        if inner_cfg.get("class_name") != "LSTM":
+            raise KeyError(
+                f"unsupported Keras Bidirectional inner layer "
+                f"'{inner_cfg.get('class_name')}' (only LSTM is converted — "
+                f"KerasLayer converter missing)")
         inner_conf = inner_cfg["config"]
         # build the bare cell: return_sequences handling belongs to the
         # WRAPPER (last-step of the merged fwd/bwd output), not the cell

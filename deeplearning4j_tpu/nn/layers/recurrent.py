@@ -314,8 +314,10 @@ class BidirectionalLastStep(Bidirectional):
         else:
             idx = jnp.maximum(jnp.sum(mask, axis=1).astype(jnp.int32) - 1, 0)
             f_last = jnp.take_along_axis(y_f, idx[:, None, None], axis=1)[:, 0, :]
-            idx_b = jnp.maximum(jnp.sum(mask_rev, axis=1).astype(jnp.int32) - 1, 0)
-            b_last = jnp.take_along_axis(y_b, idx_b[:, None, None], axis=1)[:, 0, :]
+            # right-padded mask reverses to LEFT padding: the backward
+            # run's final valid output sits at the END of the reversed
+            # sequence (position T-1), not at sum(mask)-1
+            b_last = y_b[:, -1, :]
         m = self.mode.lower()
         if m == "concat":
             return jnp.concatenate([f_last, b_last], axis=-1), state

@@ -182,6 +182,38 @@ class ShiftVertex(GraphVertex):
         return inputs[0] + self.shift
 
 
+@register_vertex("attention")
+@dataclasses.dataclass
+class AttentionVertex(GraphVertex):
+    """Multi-head dot-product attention combinator
+    (``conf/graph/AttentionVertex.java`` backed by libnd4j
+    ``multi_head_dot_product_attention``).
+
+    Inputs: 1 = self-attention over [B,T,H*Dh]; 3 = (queries, keys,
+    values) cross-attention.  This vertex is the reference's
+    ``projectInput=false`` form — input projections decompose into
+    preceding Dense/TimeDistributed layers (the TPU-native factoring:
+    each projection is one MXU einsum the compiler fuses anyway)."""
+
+    n_heads: int = 1
+    causal: bool = False
+
+    def apply(self, inputs):
+        from deeplearning4j_tpu.ops.attention import multi_head_attention
+        if len(inputs) == 1:
+            q = k = v = inputs[0]
+        elif len(inputs) == 3:
+            q, k, v = inputs
+        else:
+            raise ValueError("AttentionVertex takes 1 (self) or 3 (q,k,v) inputs")
+        return multi_head_attention(q, k, v, n_heads=self.n_heads,
+                                    causal=self.causal)
+
+    def get_output_type(self, input_types):
+        q, v = input_types[0], input_types[-1]
+        return InputType.recurrent(v.size, q.timesteps)   # q steps, v width
+
+
 @register_vertex("reshape")
 @dataclasses.dataclass
 class ReshapeVertex(GraphVertex):

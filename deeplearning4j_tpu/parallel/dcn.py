@@ -50,7 +50,7 @@ def make_multislice_mesh(n_slices: int, data_per_slice: int, model: int = 1,
         if getattr(devices[0], "slice_index", None) is not None and n_slices > 1:
             arr = mesh_utils.create_hybrid_device_mesh(
                 (data_per_slice, model), (n_slices, 1), devices=devices)
-            arr = np.moveaxis(arr.reshape(n_slices, data_per_slice, model), 0, 0)
+            arr = arr.reshape(n_slices, data_per_slice, model)
             return jax.sharding.Mesh(arr, ("dcn", "data", "model"))
     except Exception:
         pass
@@ -62,25 +62,37 @@ def make_multislice_mesh(n_slices: int, data_per_slice: int, model: int = 1,
 class InProcessTransport:
     """N-rank in-process message router (``DummyTransport`` parity): each
     rank posts its wire message; ``exchange`` barriers and returns the
-    peers' messages.  Thread-safe — ranks may run on worker threads."""
+    peers' SAME-ROUND messages.  Rounds are tracked per rank, so a fast
+    rank entering round k+1 blocks until every peer has posted round k+1
+    — it can never pick up stale round-k payloads."""
 
     def __init__(self, n_ranks: int):
         self.n_ranks = n_ranks
         self._lock = threading.Condition()
-        self._round: dict[int, np.ndarray] = {}
-        self._generation = 0
+        self._rounds: dict[int, dict[int, np.ndarray]] = {}
+        self._rank_round: dict[int, int] = {r: 0 for r in range(n_ranks)}
 
     def exchange(self, rank: int, message: np.ndarray) -> list[np.ndarray]:
         with self._lock:
-            generation = self._generation
-            self._round[rank] = message
-            if len(self._round) == self.n_ranks:
-                self._generation += 1
+            generation = self._rank_round[rank]
+            self._rank_round[rank] += 1
+            bucket = self._rounds.setdefault(generation, {})
+            bucket[rank] = message
+            if len(bucket) == self.n_ranks:
                 self._lock.notify_all()
             else:
-                while generation == self._generation:
-                    self._lock.wait(timeout=30.0)
-        return [self._round[r] for r in range(self.n_ranks) if r != rank]
+                while len(self._rounds[generation]) < self.n_ranks:
+                    if not self._lock.wait(timeout=30.0):
+                        raise TimeoutError(
+                            f"rank {rank} round {generation}: peers missing "
+                            f"({sorted(self._rounds[generation])})")
+            result = [self._rounds[generation][r]
+                      for r in range(self.n_ranks) if r != rank]
+            # free completed rounds every rank has moved past
+            oldest_active = min(self._rank_round.values())
+            for g in [g for g in self._rounds if g < oldest_active - 1]:
+                del self._rounds[g]
+            return result
 
 
 # ======================================================= compressed allreduce

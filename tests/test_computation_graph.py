@@ -84,3 +84,48 @@ def test_cycle_detection():
         input_types=[InputType.feed_forward(2)])
     with pytest.raises(ValueError, match="cycle"):
         conf.topo_order()
+
+
+def test_attention_vertex_self_and_cross():
+    """AttentionVertex (``conf/graph/AttentionVertex.java`` parity):
+    self-attention in a graph with projection Dense layers, and the raw
+    vertex math vs ops.attention directly."""
+    from deeplearning4j_tpu.nn.vertices import AttentionVertex
+    from deeplearning4j_tpu.nn.layers import DenseLayer, RnnOutputLayer
+    from deeplearning4j_tpu.ops.attention import multi_head_attention
+    import jax.numpy as jnp
+
+    # vertex math == the op (self-attention)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 6, 8)).astype(np.float32))
+    v = AttentionVertex(n_heads=2, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(v.apply([x])),
+        np.asarray(multi_head_attention(x, x, x, n_heads=2, causal=True)),
+        rtol=1e-6)
+    # cross-attention arity + shape inference
+    q = jnp.asarray(rng.normal(size=(2, 4, 8)).astype(np.float32))
+    out = v.apply([q, x, x])
+    assert out.shape == (2, 4, 8)
+    with pytest.raises(ValueError):
+        v.apply([q, x])
+
+    # inside a ComputationGraph: projections as Dense layers (the
+    # projectInput=true decomposition), trains end-to-end
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-2))
+            .graph().add_inputs("in")
+            .set_input_types(InputType.recurrent(5, 6))
+            .add_layer("q", DenseLayer(n_out=8, activation="identity"), "in")
+            .add_layer("k", DenseLayer(n_out=8, activation="identity"), "in")
+            .add_layer("v", DenseLayer(n_out=8, activation="identity"), "in")
+            .add_vertex("attn", AttentionVertex(n_heads=2), "q", "k", "v")
+            .add_layer("out", RnnOutputLayer(n_out=3, activation="softmax",
+                                             loss="mcxent"), "attn")
+            .set_outputs("out").build())
+    net = ComputationGraph(conf).init()
+    xs = np.random.default_rng(1).normal(size=(4, 6, 5)).astype(np.float32)
+    out = net.output(xs)
+    assert out.shape == (4, 6, 3)
+    # json round-trip keeps the vertex
+    rt = ComputationGraphConfiguration.from_json(conf.to_json())
+    assert any(s.name == "attn" for s in rt.vertices)

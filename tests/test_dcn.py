@@ -90,6 +90,33 @@ class TestCompressedAllReduce:
         stats = reducers[0].wire_stats(msg)
         assert stats["wire_bytes"] < stats["dense_bytes"]
 
+    def test_transport_rounds_never_mix(self):
+        """A fast rank entering round 2 must BLOCK for peers' round-2
+        posts, not return their stale round-1 messages (review regression)."""
+        transport = InProcessTransport(2)
+        order = []
+
+        def fast():
+            r1 = transport.exchange(0, np.array([10.0]))
+            order.append(("fast-r1", float(r1[0][0])))
+            r2 = transport.exchange(0, np.array([20.0]))
+            order.append(("fast-r2", float(r2[0][0])))
+
+        def slow():
+            r1 = transport.exchange(1, np.array([11.0]))
+            order.append(("slow-r1", float(r1[0][0])))
+            import time
+            time.sleep(0.3)              # fast rank reaches round 2 first
+            r2 = transport.exchange(1, np.array([21.0]))
+            order.append(("slow-r2", float(r2[0][0])))
+
+        t1, t2 = threading.Thread(target=fast), threading.Thread(target=slow)
+        t1.start(); t2.start(); t1.join(); t2.join()
+        got = dict(order)
+        assert got["fast-r1"] == 11.0 and got["slow-r1"] == 10.0
+        assert got["fast-r2"] == 21.0      # round-2, never the stale 11.0
+        assert got["slow-r2"] == 20.0
+
     def test_mismatched_size_raises(self):
         transport = InProcessTransport(1)
         red = CompressedAllReducer(0, 16, transport)

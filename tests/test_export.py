@@ -1,0 +1,69 @@
+"""StableHLO export tests (round-1 dead-code item: ``autodiff/export.py``
+had zero callers).  SameDiff-FlatBuffers serialization parity: trace →
+portable artifact → serialize → reload → identical execution.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.export import (
+    trace, export_stablehlo, stablehlo_text, save_exported, load_exported,
+    export_model_forward)
+from deeplearning4j_tpu.nn import NeuralNetConfiguration, InputType
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer, LSTM, RnnOutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _net():
+    conf = (NeuralNetConfiguration.builder().seed(4).list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(5)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestExport:
+    def test_trace_exposes_jaxpr(self):
+        jaxpr = trace(lambda x: jnp.tanh(x) * 2.0, jnp.zeros((2, 3)))
+        text = str(jaxpr)
+        assert "tanh" in text and "mul" in text
+
+    def test_stablehlo_text_inspectable(self):
+        text = stablehlo_text(lambda x: jnp.dot(x, x.T), jnp.zeros((4, 2)))
+        assert "stablehlo" in text and "dot" in text
+
+    def test_export_serialize_reload_execute(self, tmp_path):
+        def fn(x, w):
+            return jax.nn.relu(x @ w)
+
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(3, 4)), jnp.float32)
+        w = jnp.asarray(np.random.default_rng(1).normal(size=(4, 2)), jnp.float32)
+        exported = export_stablehlo(fn, x, w)
+        path = str(tmp_path / "fn.stablehlo")
+        save_exported(exported, path)
+        loaded = load_exported(path)
+        np.testing.assert_allclose(np.asarray(loaded.call(x, w)),
+                                   np.asarray(fn(x, w)), rtol=1e-6)
+
+    def test_export_model_forward_round_trip(self, tmp_path):
+        """The .sdz-for-serving analog: the exported artifact reproduces
+        net.output exactly after reload."""
+        net = _net()
+        path = str(tmp_path / "model.stablehlo")
+        export_model_forward(net, batch_size=4, path=path)
+        loaded = load_exported(path)
+        x = np.random.default_rng(2).normal(size=(4, 5)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(loaded.call(jnp.asarray(x))),
+                                   np.asarray(net.output(x)), rtol=1e-5)
+
+    def test_export_recurrent_model(self, tmp_path):
+        conf = (NeuralNetConfiguration.builder().seed(5).list()
+                .layer(LSTM(n_out=6))
+                .layer(RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.recurrent(3, 7)).build())
+        net = MultiLayerNetwork(conf).init()
+        exported = export_model_forward(net, batch_size=2)
+        x = np.random.default_rng(3).normal(size=(2, 7, 3)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(exported.call(jnp.asarray(x))),
+                                   np.asarray(net.output(x)), rtol=1e-5)

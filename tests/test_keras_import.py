@@ -119,6 +119,23 @@ class TestKerasH5Golden:
         np.testing.assert_allclose(np.asarray(net.output(x)), golden,
                                    rtol=1e-4, atol=1e-5)
 
+    def test_bidirectional_non_lstm_inner_rejected(self):
+        """Bidirectional(GRU) must fail loudly, not import as LSTM
+        (review regression)."""
+        from deeplearning4j_tpu.importers.keras import import_sequential
+        model_json = json.dumps({
+            "class_name": "Sequential",
+            "config": {"layers": [
+                {"class_name": "InputLayer",
+                 "config": {"batch_input_shape": [None, 6, 4]}},
+                {"class_name": "Bidirectional",
+                 "config": {"name": "bidi", "merge_mode": "concat",
+                            "layer": {"class_name": "GRU",
+                                      "config": {"name": "gru", "units": 5}}}},
+            ]}})
+        with pytest.raises(KeyError):
+            import_sequential(model_json)
+
     def test_missing_model_config_raises(self, tmp_path):
         h5py = pytest.importorskip("h5py")
         path = str(tmp_path / "bare.h5")

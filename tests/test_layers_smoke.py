@@ -247,3 +247,22 @@ def test_batchnorm_running_stats_update():
     # inference uses running stats
     y2, s2 = layer.apply(params, new_state, x, train=False)
     assert s2 is new_state
+
+
+def test_bidirectional_last_step_masked_backward():
+    """Right-padded mask: the backward half's final state is at reversed
+    position T-1 and must equal running the truncated sequence (review
+    regression)."""
+    from deeplearning4j_tpu.nn.layers import BidirectionalLastStep, LSTM
+    layer = BidirectionalLastStep(fwd=LSTM(n_out=4), mode="concat")
+    itype = InputType.recurrent(3, 5)
+    params = layer.init_params(KEY, itype)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(1, 5, 3)).astype(np.float32))
+    mask = jnp.asarray(np.array([[1, 1, 1, 0, 0]], np.float32))
+    out, _ = layer.apply(params, {}, x, mask=mask)
+    # ground truth: run the 3-step truncated sequence unmasked
+    x3 = x[:, :3]
+    ref, _ = layer.apply(params, {}, x3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
