@@ -175,3 +175,135 @@ def iris(batch_size: int = 150, seed: int = 42) -> ArrayDataSetIterator:
     y = np.repeat(np.arange(3), 50)
     idx = rng.permutation(150)
     return ArrayDataSetIterator(x[idx], _one_hot(y[idx], 3), batch_size, shuffle=False)
+
+
+# ------------------------------------------------------------------ EMNIST
+_EMNIST_CLASSES = {"balanced": 47, "byclass": 62, "bymerge": 47,
+                   "letters": 26, "digits": 10, "mnist": 10}
+
+
+def emnist(split: str = "balanced", batch_size: int = 128, train: bool = True,
+           root: str = DEFAULT_ROOT, flatten: bool = True,
+           n_synthetic: int = 8000, seed: int = 555,
+           shuffle: Optional[bool] = None) -> ArrayDataSetIterator:
+    """EmnistDataSetIterator parity (``datasets/iterator/impl/
+    EmnistDataSetIterator.java``): MNIST-format idx files per split
+    (BALANCED/BYCLASS/BYMERGE/LETTERS/DIGITS/MNIST), 28x28 grayscale.
+    The LETTERS split's labels are 1-based in the released files; they
+    are shifted to 0-based here, as the reference does."""
+    if split not in _EMNIST_CLASSES:
+        raise ValueError(f"unknown EMNIST split {split!r}; "
+                         f"one of {sorted(_EMNIST_CLASSES)}")
+    n_classes = _EMNIST_CLASSES[split]
+    eroot = os.path.join(root, "emnist")
+    prefix = f"emnist-{split}-{'train' if train else 'test'}"
+    img_path = _find(eroot, [f"{prefix}-images-idx3-ubyte"])
+    lbl_path = _find(eroot, [f"{prefix}-labels-idx1-ubyte"])
+    if img_path and lbl_path:
+        x = _read_idx(img_path).astype(np.float32) / 255.0
+        y = _read_idx(lbl_path).astype(np.int64)
+        if split == "letters":
+            y = y - 1
+        synthetic = False
+    else:
+        n = n_synthetic if train else max(n_synthetic // 6, 500)
+        x, y = _synthetic_images(n, n_classes, (28, 28), seed,
+                                 seed if train else seed + 1)
+        synthetic = True
+    x = x.reshape(x.shape[0], -1) if flatten else x[..., None]
+    it = ArrayDataSetIterator(x, _one_hot(y, n_classes), batch_size,
+                              shuffle=train if shuffle is None else shuffle,
+                              seed=seed)
+    it.synthetic = synthetic
+    return it
+
+
+# ------------------------------------------------------------------ SVHN
+def svhn(batch_size: int = 128, train: bool = True, root: str = DEFAULT_ROOT,
+         n_synthetic: int = 6000, seed: int = 666,
+         shuffle: Optional[bool] = None) -> ArrayDataSetIterator:
+    """SvhnDataFetcher parity (``datasets/fetchers/SvhnDataFetcher.java``):
+    cropped street-view digits, 32x32x3 NHWC in [0,1], 10 classes.  Real
+    data: the ``{train,test}_32x32.mat`` files (label 10 means digit 0 in
+    the released files; remapped to 0 as the reference does)."""
+    sroot = os.path.join(root, "svhn")
+    mat_path = _find(sroot, [f"{'train' if train else 'test'}_32x32.mat"])
+    if mat_path:
+        from scipy.io import loadmat
+        m = loadmat(mat_path)
+        x = m["X"].transpose(3, 0, 1, 2).astype(np.float32) / 255.0  # NHWC
+        y = m["y"].ravel().astype(np.int64)
+        y[y == 10] = 0
+        synthetic = False
+    else:
+        n = n_synthetic if train else max(n_synthetic // 6, 500)
+        x, y = _synthetic_images(n, 10, (32, 32, 3), seed,
+                                 seed if train else seed + 1)
+        synthetic = True
+    it = ArrayDataSetIterator(x, _one_hot(y, 10), batch_size,
+                              shuffle=train if shuffle is None else shuffle,
+                              seed=seed)
+    it.synthetic = synthetic
+    return it
+
+
+# ------------------------------------------------------------- TinyImageNet
+def tiny_imagenet(batch_size: int = 128, train: bool = True,
+                  root: str = DEFAULT_ROOT, n_synthetic: int = 4000,
+                  seed: int = 888, limit_per_class: Optional[int] = None,
+                  shuffle: Optional[bool] = None) -> ArrayDataSetIterator:
+    """TinyImageNetDataSetIterator parity (``TinyImageNetFetcher.java``):
+    200 classes, 64x64x3 NHWC in [0,1].  Real data: the standard
+    ``tiny-imagenet-200/`` layout (train/<wnid>/images/*.JPEG decoded via
+    the image ETL loader; val/ uses ``val_annotations.txt``)."""
+    troot = os.path.join(root, "tiny-imagenet-200")
+    if os.path.isdir(troot):
+        from deeplearning4j_tpu.data.image import NativeImageLoader
+        loader = NativeImageLoader(64, 64, 3)
+        wnids = sorted(os.listdir(os.path.join(troot, "train")))
+        wnid_to_idx = {w: i for i, w in enumerate(wnids)}
+        if train:
+            # collect paths first, decode into a preallocated array — the
+            # full split is 100k images (~4.9 GB f32); a list + np.stack
+            # would hold it twice
+            items = []
+            for w in wnids:
+                img_dir = os.path.join(troot, "train", w, "images")
+                names = sorted(os.listdir(img_dir))[:limit_per_class]
+                items += [(os.path.join(img_dir, n), wnid_to_idx[w])
+                          for n in names]
+            x = np.empty((len(items), 64, 64, 3), np.float32)
+            y = np.empty(len(items), np.int64)
+            for i, (path, cls) in enumerate(items):
+                x[i] = loader.load(path)
+                y[i] = cls
+            x /= 255.0
+        else:
+            ann = os.path.join(troot, "val", "val_annotations.txt")
+            with open(ann) as f:
+                rows = [line.split("\t")[:2] for line in f if line.strip()]
+            if limit_per_class is not None:
+                per_class: dict[str, int] = {}
+                kept = []
+                for name, w in rows:
+                    if per_class.get(w, 0) < limit_per_class:
+                        per_class[w] = per_class.get(w, 0) + 1
+                        kept.append((name, w))
+                rows = kept
+            x = np.empty((len(rows), 64, 64, 3), np.float32)
+            y = np.empty(len(rows), np.int64)
+            for i, (name, w) in enumerate(rows):
+                x[i] = loader.load(os.path.join(troot, "val", "images", name))
+                y[i] = wnid_to_idx[w]
+            x /= 255.0
+        synthetic = False
+    else:
+        n = n_synthetic if train else max(n_synthetic // 8, 400)
+        x, y = _synthetic_images(n, 200, (64, 64, 3), seed,
+                                 seed if train else seed + 1)
+        synthetic = True
+    it = ArrayDataSetIterator(x, _one_hot(y, 200), batch_size,
+                              shuffle=train if shuffle is None else shuffle,
+                              seed=seed)
+    it.synthetic = synthetic
+    return it

@@ -11,8 +11,13 @@ binary-container readers are split from the mapping logic:
 - ``tf_bert`` — TF BERT checkpoint variable-name mapping → our
   ``models.bert`` parameter pytree (the fiddly part the reference's
   ImportGraph + OpMappingRegistry handles), weights from npz/dict.
+- ``onnx_import`` — ONNX protobuf → jittable forward fn
+  (samediff-import-onnx parity); the protobuf wire format is decoded by
+  the in-repo ``onnx_wire`` codec (no onnx package needed).
 """
 
-from deeplearning4j_tpu.importers import keras, tf_bert
+from deeplearning4j_tpu.importers import keras, onnx_import, onnx_wire, tf_bert
+from deeplearning4j_tpu.importers.onnx_import import OnnxModel, import_onnx_model
 
-__all__ = ["keras", "tf_bert"]
+__all__ = ["keras", "tf_bert", "onnx_import", "onnx_wire",
+           "OnnxModel", "import_onnx_model"]

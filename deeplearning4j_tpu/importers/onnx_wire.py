@@ -1,0 +1,286 @@
+"""Minimal protobuf wire-format codec for the ONNX schema subset.
+
+Parity context: the reference's ``nd4j/samediff-import/samediff-import-onnx``
+parses ONNX protobufs with the official generated classes.  This
+environment has no ``onnx`` package, so this module reads (and, for test
+fixtures, writes) the protobuf *wire format* directly — varint keys,
+length-delimited submessages — against a hand-declared field map of the
+public ``onnx.proto`` schema (ModelProto/GraphProto/NodeProto/
+TensorProto/AttributeProto/ValueInfoProto field numbers).
+
+Only what the importer needs is mapped; unknown fields are skipped, as
+any protobuf reader must.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Iterator
+
+import numpy as np
+
+# wire types
+_VARINT, _I64, _LEN, _I32 = 0, 1, 2, 5
+
+
+def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _fields(buf: bytes) -> Iterator[tuple[int, int, Any]]:
+    """Yield (field_number, wire_type, raw value) over a message buffer."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == _VARINT:
+            val, pos = _read_varint(buf, pos)
+        elif wire == _I64:
+            val = buf[pos:pos + 8]
+            pos += 8
+        elif wire == _LEN:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == _I32:
+            val = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, val
+
+
+def _zigzag_to_signed(v: int, bits: int = 64) -> int:
+    # onnx int64 fields are plain (not zigzag) — but varints are
+    # two's-complement for negatives
+    if v >= 1 << (bits - 1):
+        v -= 1 << bits
+    return v
+
+
+# ------------------------------------------------------------------ schema
+# field maps: {field_number: (name, kind)} where kind is one of
+# 'varint', 'string', 'bytes', 'float', 'packed_i64', 'packed_f32', or a
+# nested field map (dict).  'repeated' wraps any kind in a list.
+
+def _msg(fields: dict) -> dict:
+    return fields
+
+
+TENSOR = {
+    1: ("dims", "repeated_i64"),
+    2: ("data_type", "varint"),
+    4: ("float_data", "packed_f32"),
+    5: ("int32_data", "packed_i64"),
+    7: ("int64_data", "packed_i64"),
+    8: ("name", "string"),
+    9: ("raw_data", "bytes"),
+    10: ("double_data", "packed_f64"),
+}
+
+ATTRIBUTE: dict = {
+    1: ("name", "string"),
+    2: ("f", "f32"),
+    3: ("i", "varint_signed"),
+    4: ("s", "bytes"),
+    5: ("t", TENSOR),
+    7: ("floats", "packed_f32"),
+    8: ("ints", "packed_i64"),
+    9: ("strings", "repeated_bytes"),
+    20: ("type", "varint"),
+}
+
+DIM = {1: ("dim_value", "varint_signed"), 2: ("dim_param", "string")}
+SHAPE = {1: ("dim", ("repeated", DIM))}
+TENSOR_TYPE = {1: ("elem_type", "varint"), 2: ("shape", SHAPE)}
+TYPE = {1: ("tensor_type", TENSOR_TYPE)}
+VALUE_INFO = {1: ("name", "string"), 2: ("type", TYPE)}
+
+NODE = {
+    1: ("input", "repeated_string"),
+    2: ("output", "repeated_string"),
+    3: ("name", "string"),
+    4: ("op_type", "string"),
+    5: ("attribute", ("repeated", ATTRIBUTE)),
+    7: ("domain", "string"),
+}
+
+GRAPH = {
+    1: ("node", ("repeated", NODE)),
+    2: ("name", "string"),
+    5: ("initializer", ("repeated", TENSOR)),
+    11: ("input", ("repeated", VALUE_INFO)),
+    12: ("output", ("repeated", VALUE_INFO)),
+}
+
+MODEL = {
+    1: ("ir_version", "varint"),
+    5: ("model_version", "varint"),
+    7: ("graph", GRAPH),
+    8: ("opset_import", ("repeated", {1: ("domain", "string"),
+                                      2: ("version", "varint_signed")})),
+}
+
+
+def parse(buf: bytes, schema: dict = MODEL) -> dict:
+    """Decode one message per ``schema`` into a plain dict."""
+    out: dict[str, Any] = {}
+    for field, wire, raw in _fields(buf):
+        if field not in schema:
+            continue
+        name, kind = schema[field]
+        if isinstance(kind, tuple) and kind[0] == "repeated":
+            out.setdefault(name, []).append(parse(raw, kind[1]))
+        elif isinstance(kind, dict):
+            out[name] = parse(raw, kind)
+        elif kind == "varint":
+            out[name] = raw
+        elif kind == "varint_signed":
+            out[name] = _zigzag_to_signed(raw)
+        elif kind == "string":
+            out[name] = raw.decode("utf-8")
+        elif kind == "bytes":
+            out[name] = raw
+        elif kind == "repeated_string":
+            out.setdefault(name, []).append(raw.decode("utf-8"))
+        elif kind == "repeated_bytes":
+            out.setdefault(name, []).append(raw)
+        elif kind == "f32":
+            out[name] = struct.unpack("<f", raw)[0]
+        elif kind == "repeated_i64":
+            if wire == _LEN:   # packed
+                out.setdefault(name, []).extend(_unpack_varints(raw))
+            else:
+                out.setdefault(name, []).append(_zigzag_to_signed(raw))
+        elif kind == "packed_i64":
+            if wire == _LEN:
+                out.setdefault(name, []).extend(_unpack_varints(raw))
+            else:
+                out.setdefault(name, []).append(_zigzag_to_signed(raw))
+        elif kind == "packed_f32":
+            if wire == _I32:
+                out.setdefault(name, []).append(struct.unpack("<f", raw)[0])
+            else:
+                out.setdefault(name, []).extend(
+                    np.frombuffer(raw, "<f4").tolist())
+        elif kind == "packed_f64":
+            if wire == _I64:
+                out.setdefault(name, []).append(struct.unpack("<d", raw)[0])
+            else:
+                out.setdefault(name, []).extend(
+                    np.frombuffer(raw, "<f8").tolist())
+        else:
+            raise ValueError(f"unknown kind {kind}")
+    return out
+
+
+def _unpack_varints(raw: bytes) -> list[int]:
+    out, pos = [], 0
+    while pos < len(raw):
+        v, pos = _read_varint(raw, pos)
+        out.append(_zigzag_to_signed(v))
+    return out
+
+
+# ------------------------------------------------------------------ writer
+# (test fixtures only — enough of an encoder to build valid models)
+
+def _varint(v: int) -> bytes:
+    if v < 0:
+        v += 1 << 64
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _key(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def emit(schema: dict, data: dict) -> bytes:
+    """Encode ``data`` (same dict shape ``parse`` produces) per schema."""
+    by_name = {name: (num, kind) for num, (name, kind) in schema.items()}
+    out = bytearray()
+    for name, value in data.items():
+        num, kind = by_name[name]
+        if isinstance(kind, tuple) and kind[0] == "repeated":
+            for item in value:
+                sub = emit(kind[1], item)
+                out += _key(num, _LEN) + _varint(len(sub)) + sub
+        elif isinstance(kind, dict):
+            sub = emit(kind, value)
+            out += _key(num, _LEN) + _varint(len(sub)) + sub
+        elif kind in ("varint", "varint_signed"):
+            out += _key(num, _VARINT) + _varint(int(value))
+        elif kind == "string":
+            b = value.encode("utf-8")
+            out += _key(num, _LEN) + _varint(len(b)) + b
+        elif kind == "bytes":
+            out += _key(num, _LEN) + _varint(len(value)) + bytes(value)
+        elif kind == "repeated_string":
+            for s in value:
+                b = s.encode("utf-8")
+                out += _key(num, _LEN) + _varint(len(b)) + b
+        elif kind == "repeated_bytes":
+            for b in value:
+                out += _key(num, _LEN) + _varint(len(b)) + bytes(b)
+        elif kind == "f32":
+            out += _key(num, _I32) + struct.pack("<f", value)
+        elif kind in ("repeated_i64", "packed_i64"):
+            packed = b"".join(_varint(int(v)) for v in value)
+            out += _key(num, _LEN) + _varint(len(packed)) + packed
+        elif kind == "packed_f32":
+            packed = np.asarray(value, "<f4").tobytes()
+            out += _key(num, _LEN) + _varint(len(packed)) + packed
+        elif kind == "packed_f64":
+            packed = np.asarray(value, "<f8").tobytes()
+            out += _key(num, _LEN) + _varint(len(packed)) + packed
+        else:
+            raise ValueError(f"unknown kind {kind}")
+    return bytes(out)
+
+
+# ONNX TensorProto.DataType values we support
+DTYPES = {1: np.float32, 6: np.int32, 7: np.int64, 9: np.bool_,
+          11: np.float64, 10: np.float16}
+DTYPE_TO_ONNX = {np.dtype(np.float32): 1, np.dtype(np.int64): 7,
+                 np.dtype(np.int32): 6, np.dtype(np.float64): 11}
+
+
+def tensor_to_array(t: dict) -> np.ndarray:
+    dims = t.get("dims", [])
+    dtype = DTYPES.get(t.get("data_type", 1), np.float32)
+    if "raw_data" in t and t["raw_data"]:
+        arr = np.frombuffer(t["raw_data"], dtype=np.dtype(dtype).newbyteorder("<"))
+    elif "float_data" in t:
+        arr = np.asarray(t["float_data"], np.float32)
+    elif "int64_data" in t:
+        arr = np.asarray(t["int64_data"], np.int64)
+    elif "int32_data" in t:
+        arr = np.asarray(t["int32_data"], np.int32)
+    elif "double_data" in t:
+        arr = np.asarray(t["double_data"], np.float64)
+    else:
+        arr = np.zeros(0, dtype)
+    return arr.astype(dtype).reshape(dims)
+
+
+def array_to_tensor(name: str, arr: np.ndarray) -> dict:
+    return {"name": name, "dims": list(arr.shape),
+            "data_type": DTYPE_TO_ONNX[np.dtype(arr.dtype)],
+            "raw_data": np.ascontiguousarray(arr).astype(
+                arr.dtype.newbyteorder("<")).tobytes()}

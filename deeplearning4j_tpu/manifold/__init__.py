@@ -1,0 +1,5 @@
+"""Manifold learning — parity with ``deeplearning4j-manifold``."""
+
+from deeplearning4j_tpu.manifold.tsne import Tsne
+
+__all__ = ["Tsne"]

@@ -15,6 +15,7 @@ from deeplearning4j_tpu.models.zoo import (
     simple_cnn,
     alexnet,
     vgg16,
+    vgg19,
     resnet50,
     lstm_classifier,
     text_gen_lstm,
@@ -32,7 +33,8 @@ from deeplearning4j_tpu.models.zoo_extra import (
 from deeplearning4j_tpu.models import bert
 
 __all__ = [
-    "mlp_mnist", "lenet", "simple_cnn", "alexnet", "vgg16", "resnet50",
+    "mlp_mnist", "lenet", "simple_cnn", "alexnet", "vgg16", "vgg19",
+    "resnet50",
     "lstm_classifier", "text_gen_lstm", "bert",
     "squeezenet", "darknet19", "tiny_yolo", "yolo2", "unet", "xception",
     "inception_resnet_v1", "nasnet_mobile",

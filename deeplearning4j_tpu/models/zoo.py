@@ -144,6 +144,25 @@ def vgg16(seed: int = 123, num_classes: int = 1000) -> MultiLayerNetwork:
     return MultiLayerNetwork(b.build())
 
 
+def vgg19(seed: int = 123, num_classes: int = 1000) -> MultiLayerNetwork:
+    """VGG19.java parity: VGG16 with 4-conv blocks at 256/512."""
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed)
+         .updater(Nesterovs(1e-2, 0.9))
+         .weight_init("relu")
+         .list())
+    for n_out, convs in [(64, 2), (128, 2), (256, 4), (512, 4), (512, 4)]:
+        for _ in range(convs):
+            b.layer(ConvolutionLayer(n_out=n_out, kernel_size=(3, 3),
+                                     convolution_mode="same", activation="relu"))
+        b.layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)))
+    b.layer(DenseLayer(n_out=4096, activation="relu"))
+    b.layer(DenseLayer(n_out=4096, activation="relu"))
+    b.layer(OutputLayer(n_out=num_classes, activation="softmax", loss="mcxent"))
+    b.set_input_type(InputType.convolutional(224, 224, 3))
+    return MultiLayerNetwork(b.build())
+
+
 # ------------------------------------------------------------------ ResNet-50
 def _conv_bn(gb, name, n_out, kernel, stride, input_name, activation="identity",
              mode="same"):

@@ -1,10 +1,11 @@
-"""NLP: tokenization + BERT data pipeline.
+"""NLP: tokenization, BERT data pipeline, and embedding models.
 
 Parity scope (SURVEY.md §2.6): the reference's ``deeplearning4j-nlp``
-wordpiece tokenization (``BertWordPieceTokenizer``) and the
-``BertIterator`` MLM/classification batch builder that feeds the BERT
-fine-tune workload (BASELINE config #4).  Word2Vec/GloVe/ParagraphVectors
-are out of v1 scope per SURVEY.
+wordpiece tokenization (``BertWordPieceTokenizer``), the ``BertIterator``
+MLM/classification batch builder that feeds the BERT fine-tune workload
+(BASELINE config #4), the embedding stack (Word2Vec / GloVe /
+ParagraphVectors with sentence iterators and a vocab cache), and
+``deeplearning4j-graph``'s DeepWalk vertex embeddings.
 """
 
 from deeplearning4j_tpu.nlp.tokenization import (
@@ -13,9 +14,17 @@ from deeplearning4j_tpu.nlp.tokenization import (
 from deeplearning4j_tpu.nlp.bert_iterator import (
     BertIterator, BertMaskedLMMasker, CollectionSentenceProvider,
     CollectionLabeledSentenceProvider)
+from deeplearning4j_tpu.nlp.embeddings import (
+    Word2Vec, Glove, ParagraphVectors, VocabCache, SentenceIterator,
+    CollectionSentenceIterator, LineSentenceIterator,
+    DefaultTokenizerFactory)
+from deeplearning4j_tpu.nlp.deepwalk import DeepWalk, Graph, random_walks
 
 __all__ = [
     "BasicTokenizer", "WordpieceTokenizer", "BertWordPieceTokenizer",
     "Vocabulary", "build_vocab", "BertIterator", "BertMaskedLMMasker",
     "CollectionSentenceProvider", "CollectionLabeledSentenceProvider",
+    "Word2Vec", "Glove", "ParagraphVectors", "VocabCache",
+    "SentenceIterator", "CollectionSentenceIterator", "LineSentenceIterator",
+    "DefaultTokenizerFactory", "DeepWalk", "Graph", "random_walks",
 ]

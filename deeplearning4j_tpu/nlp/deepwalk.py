@@ -1,0 +1,108 @@
+"""DeepWalk graph embeddings.
+
+Parity: the reference's ``deeplearning4j-graph`` module
+(``org/deeplearning4j/graph/models/deepwalk/DeepWalk.java``,
+``graph/iterator/RandomWalkIterator.java``, ``graph/graph/Graph.java``):
+uniform random walks over a graph, fed to a skip-gram trainer.
+
+The walk generator is host-side ETL (numpy); training reuses the batched
+jit-compiled :class:`~deeplearning4j_tpu.nlp.embeddings.Word2Vec` step,
+so the device program is the same one-SGD-step-per-batch XLA executable.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.embeddings import Word2Vec
+
+
+class Graph:
+    """Undirected-or-directed adjacency-list graph
+    (reference ``org/deeplearning4j/graph/graph/Graph.java``)."""
+
+    def __init__(self, n_vertices: int, directed: bool = False):
+        self.n_vertices = n_vertices
+        self.directed = directed
+        self._adj: list[list[int]] = [[] for _ in range(n_vertices)]
+
+    def add_edge(self, a: int, b: int) -> None:
+        self._adj[a].append(b)
+        if not self.directed:
+            self._adj[b].append(a)
+
+    def neighbors(self, v: int) -> list[int]:
+        return self._adj[v]
+
+    @staticmethod
+    def from_edges(n_vertices: int, edges: Sequence[tuple[int, int]],
+                   directed: bool = False) -> "Graph":
+        g = Graph(n_vertices, directed)
+        for a, b in edges:
+            g.add_edge(a, b)
+        return g
+
+
+def random_walks(graph: Graph, walk_length: int, walks_per_vertex: int = 1,
+                 seed: int = 0) -> list[list[int]]:
+    """Uniform random walks from every vertex
+    (reference ``RandomWalkIterator``: fixed length, restart per vertex)."""
+    rng = np.random.default_rng(seed)
+    walks: list[list[int]] = []
+    for _ in range(walks_per_vertex):
+        for start in rng.permutation(graph.n_vertices):
+            walk = [int(start)]
+            while len(walk) < walk_length:
+                nbrs = graph.neighbors(walk[-1])
+                if not nbrs:
+                    break
+                walk.append(int(nbrs[rng.integers(len(nbrs))]))
+            if len(walk) > 1:
+                walks.append(walk)
+    return walks
+
+
+class _VertexTokenizer:
+    """Adapter: a walk is already a token list (vertex ids as strings)."""
+
+    def create(self, text: str) -> list[str]:
+        return text.split()
+
+
+class DeepWalk:
+    """DeepWalk: random walks → skip-gram vertex embeddings
+    (reference ``DeepWalk.Builder``: vectorSize, windowSize, walkLength,
+    learningRate)."""
+
+    def __init__(self, vector_size: int = 64, window: int = 4,
+                 walk_length: int = 20, walks_per_vertex: int = 8,
+                 epochs: int = 2, learning_rate: float = 0.025,
+                 negative: int = 5, hs: bool = False, seed: int = 0):
+        self.walk_length = walk_length
+        self.walks_per_vertex = walks_per_vertex
+        self.seed = seed
+        self._w2v = Word2Vec(vector_size=vector_size, window=window,
+                             min_count=1, negative=negative, hs=hs,
+                             sample=0.0, epochs=epochs,
+                             learning_rate=learning_rate, seed=seed,
+                             tokenizer=_VertexTokenizer())
+        self.graph: Optional[Graph] = None
+
+    def fit(self, graph: Graph) -> "DeepWalk":
+        self.graph = graph
+        walks = random_walks(graph, self.walk_length, self.walks_per_vertex,
+                             self.seed)
+        sentences = [" ".join(str(v) for v in w) for w in walks]
+        self._w2v.fit(sentences)
+        return self
+
+    def vertex_vector(self, v: int) -> np.ndarray:
+        return self._w2v.word_vector(str(v))
+
+    def similarity(self, a: int, b: int) -> float:
+        return self._w2v.similarity(str(a), str(b))
+
+    def vertices_nearest(self, v: int, top: int = 10) -> list[int]:
+        return [int(w) for w in self._w2v.words_nearest(str(v), top)]

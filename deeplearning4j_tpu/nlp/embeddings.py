@@ -1,0 +1,676 @@
+"""Word/paragraph embeddings: Word2Vec, GloVe, ParagraphVectors.
+
+Parity: the reference's ``deeplearning4j-nlp`` embedding stack
+(``org/deeplearning4j/models/word2vec/Word2Vec.java``,
+``models/glove/Glove.java``,
+``models/paragraphvectors/ParagraphVectors.java``, vocab in
+``models/word2vec/wordstore/inmemory/AbstractCache.java``, sentence
+sources in ``text/sentenceiterator/``).
+
+TPU-first design: the reference trains one (word, context) pair at a
+time with hand-rolled per-row SGD in Java threads (``SkipGram.java``,
+``CBOW.java``).  Here the host side only *tokenizes and batches* —
+pair generation with dynamic-window + subsampling produces int32
+arrays — and the math is ONE jit'd SGD step over a [B]-batch of pairs:
+embedding gathers hit the MXU-friendly dense path, negative sampling
+draws on-device via ``jax.random.categorical`` over the unigram^0.75
+distribution, and the scatter-add transpose of the gather is generated
+by XLA.  Both of the reference's objectives are implemented:
+
+- negative sampling (``negative=k``), and
+- hierarchical softmax (``hs=True``) with host-built Huffman codes
+  padded to a static max code length (masked) so the whole batch stays
+  a single static-shape XLA program.
+"""
+
+from __future__ import annotations
+
+import heapq
+import json
+import os
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Iterable, Iterator, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import BasicTokenizer
+
+
+# --------------------------------------------------------------------------
+# Sentence sources (reference: text/sentenceiterator/*)
+# --------------------------------------------------------------------------
+
+class SentenceIterator:
+    """Resettable stream of raw sentences (strings)."""
+
+    def __iter__(self) -> Iterator[str]:
+        raise NotImplementedError
+
+    def reset(self) -> None:  # stateless iterators need nothing
+        pass
+
+
+class CollectionSentenceIterator(SentenceIterator):
+    """In-memory list of sentences (``CollectionSentenceIterator.java``)."""
+
+    def __init__(self, sentences: Sequence[str]):
+        self.sentences = list(sentences)
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self.sentences)
+
+
+class LineSentenceIterator(SentenceIterator):
+    """One sentence per line of a text file (``LineSentenceIterator.java``)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def __iter__(self) -> Iterator[str]:
+        with open(self.path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield line
+
+
+class DefaultTokenizerFactory:
+    """Whitespace/punct tokenizer factory (``DefaultTokenizerFactory.java``)."""
+
+    def __init__(self, lower_case: bool = True):
+        self._basic = BasicTokenizer(lower_case=lower_case)
+
+    def create(self, text: str) -> list[str]:
+        return self._basic.tokenize(text)
+
+
+# --------------------------------------------------------------------------
+# Vocab cache (reference: wordstore/inmemory/AbstractCache.java)
+# --------------------------------------------------------------------------
+
+@dataclass
+class VocabCache:
+    """Word ↔ index table with counts, frequency-ordered like word2vec."""
+
+    words: list[str] = field(default_factory=list)
+    counts: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int64))
+    index: dict[str, int] = field(default_factory=dict)
+
+    @staticmethod
+    def build(token_stream: Iterable[list[str]], min_count: int = 1,
+              max_size: Optional[int] = None) -> "VocabCache":
+        raw: dict[str, int] = {}
+        for tokens in token_stream:
+            for t in tokens:
+                raw[t] = raw.get(t, 0) + 1
+        items = sorted(((w, c) for w, c in raw.items() if c >= min_count),
+                       key=lambda kv: (-kv[1], kv[0]))
+        if max_size is not None:
+            items = items[:max_size]
+        words = [w for w, _ in items]
+        counts = np.array([c for _, c in items], np.int64)
+        return VocabCache(words, counts, {w: i for i, w in enumerate(words)})
+
+    def __len__(self) -> int:
+        return len(self.words)
+
+    def __contains__(self, word: str) -> bool:
+        return word in self.index
+
+    def id(self, word: str) -> int:
+        return self.index[word]
+
+    def total_count(self) -> int:
+        return int(self.counts.sum())
+
+    def huffman(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Build the Huffman coding used by hierarchical softmax.
+
+        Returns ``(codes, points, code_lens)`` padded to the max code
+        length: ``codes[w, l]`` ∈ {0,1}, ``points[w, l]`` = inner-node
+        row in ``syn1``, ``code_lens[w]`` = true length.  Mirrors the
+        reference's ``Huffman.java`` applied over count-ordered vocab.
+        """
+        n = len(self.words)
+        if n < 2:
+            codes = np.zeros((n, 1), np.int32)
+            points = np.zeros((n, 1), np.int32)
+            return codes, points, np.ones(n, np.int32) if n else np.zeros(0, np.int32)
+        heap: list[tuple[int, int, object]] = []
+        for i, c in enumerate(self.counts):
+            heapq.heappush(heap, (int(c), i, ("leaf", i)))
+        next_inner = 0
+        while len(heap) > 1:
+            c1, _, t1 = heapq.heappop(heap)
+            c2, _, t2 = heapq.heappop(heap)
+            node = ("inner", next_inner, t1, t2)
+            heapq.heappush(heap, (c1 + c2, n + next_inner, node))
+            next_inner += 1
+        codes_l: dict[int, list[int]] = {}
+        points_l: dict[int, list[int]] = {}
+
+        def walk(tree, code, path):
+            if tree[0] == "leaf":
+                codes_l[tree[1]] = code
+                points_l[tree[1]] = path
+                return
+            _, inner, left, right = tree
+            walk(left, code + [0], path + [inner])
+            walk(right, code + [1], path + [inner])
+
+        walk(heap[0][2], [], [])
+        maxlen = max(len(c) for c in codes_l.values())
+        codes = np.zeros((n, maxlen), np.int32)
+        points = np.zeros((n, maxlen), np.int32)
+        lens = np.zeros(n, np.int32)
+        for w in range(n):
+            c, p = codes_l[w], points_l[w]
+            codes[w, :len(c)] = c
+            points[w, :len(p)] = p
+            lens[w] = len(c)
+        return codes, points, lens
+
+
+# --------------------------------------------------------------------------
+# Pair batching (host-side ETL)
+# --------------------------------------------------------------------------
+
+def _encode_corpus(sentences: Iterable[str], tokenizer, vocab: VocabCache
+                   ) -> list[np.ndarray]:
+    out = []
+    for s in sentences:
+        ids = [vocab.index[t] for t in tokenizer.create(s) if t in vocab.index]
+        if len(ids) > 1:
+            out.append(np.array(ids, np.int32))
+    return out
+
+
+def _skipgram_pairs(docs: list[np.ndarray], window: int, keep_prob: np.ndarray,
+                    rng: np.random.Generator) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(center, context, doc_id) with dynamic window + subsampling,
+    exactly the word2vec scheme the reference's ``SkipGram.java`` uses."""
+    centers, contexts, doc_ids = [], [], []
+    for d, ids in enumerate(docs):
+        keep = rng.random(len(ids)) < keep_prob[ids]
+        ids = ids[keep]
+        n = len(ids)
+        if n < 2:
+            continue
+        b = rng.integers(1, window + 1, n)  # per-position reduced window
+        for i in range(n):
+            lo, hi = max(0, i - b[i]), min(n, i + b[i] + 1)
+            for j in range(lo, hi):
+                if j != i:
+                    centers.append(ids[i])
+                    contexts.append(ids[j])
+                    doc_ids.append(d)
+    return (np.array(centers, np.int32), np.array(contexts, np.int32),
+            np.array(doc_ids, np.int32))
+
+
+def _cbow_batches(docs: list[np.ndarray], window: int, keep_prob: np.ndarray,
+                  rng: np.random.Generator
+                  ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """(context_ids[B, 2W], context_mask, center, doc_id) for CBOW."""
+    ctxs, masks, centers, doc_ids = [], [], [], []
+    width = 2 * window
+    for d, ids in enumerate(docs):
+        keep = rng.random(len(ids)) < keep_prob[ids]
+        ids = ids[keep]
+        n = len(ids)
+        if n < 2:
+            continue
+        b = rng.integers(1, window + 1, n)
+        for i in range(n):
+            lo, hi = max(0, i - b[i]), min(n, i + b[i] + 1)
+            ctx = [ids[j] for j in range(lo, hi) if j != i]
+            if not ctx:
+                continue
+            row = np.zeros(width, np.int32)
+            row[:len(ctx)] = ctx
+            m = np.zeros(width, np.float32)
+            m[:len(ctx)] = 1.0
+            ctxs.append(row); masks.append(m)
+            centers.append(ids[i]); doc_ids.append(d)
+    return (np.stack(ctxs) if ctxs else np.zeros((0, width), np.int32),
+            np.stack(masks) if masks else np.zeros((0, width), np.float32),
+            np.array(centers, np.int32), np.array(doc_ids, np.int32))
+
+
+# --------------------------------------------------------------------------
+# Word2Vec
+# --------------------------------------------------------------------------
+
+class Word2Vec:
+    """Skip-gram / CBOW word embeddings with NS or HS objectives.
+
+    Mirrors the reference builder surface (``Word2Vec.Builder``:
+    layerSize, windowSize, minWordFrequency, negativeSample, useHierarchicSoftmax,
+    sampling, iterations/epochs, learningRate → minLearningRate) with a
+    batched, jit-compiled trainer.
+
+    ``batch_size`` trades throughput against fidelity to word2vec's
+    sequential per-pair SGD: the batch loss is SUMMED, so a row that
+    occurs k times in one batch takes one k-sized step instead of k
+    small ones.  The 256 default keeps k small even for tiny vocabs;
+    raise it for large-vocab corpora where rows rarely repeat in-batch.
+    """
+
+    def __init__(self, vector_size: int = 100, window: int = 5,
+                 min_count: int = 1, negative: int = 5, hs: bool = False,
+                 cbow: bool = False, sample: float = 1e-3, epochs: int = 1,
+                 learning_rate: float = 0.025, min_learning_rate: float = 1e-4,
+                 batch_size: int = 256, seed: int = 0,
+                 tokenizer: Optional[DefaultTokenizerFactory] = None):
+        self.vector_size = vector_size
+        self.window = window
+        self.min_count = min_count
+        self.negative = negative
+        self.hs = hs
+        self.cbow = cbow
+        self.sample = sample
+        self.epochs = epochs
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.batch_size = batch_size
+        self.seed = seed
+        self.tokenizer = tokenizer or DefaultTokenizerFactory()
+        self.vocab: Optional[VocabCache] = None
+        self.syn0: Optional[np.ndarray] = None   # input vectors [V, D]
+        self.syn1: Optional[np.ndarray] = None   # output vectors (NS or HS)
+
+    # -- training ----------------------------------------------------------
+
+    def fit(self, sentences: Iterable[str] | SentenceIterator) -> "Word2Vec":
+        sents = list(sentences)
+        vocab = VocabCache.build(
+            (self.tokenizer.create(s) for s in sents), min_count=self.min_count)
+        if len(vocab) < 2:
+            raise ValueError("need at least 2 vocabulary words to train")
+        self.vocab = vocab
+        docs = _encode_corpus(sents, self.tokenizer, vocab)
+        rng = np.random.default_rng(self.seed)
+        self._init_params(rng)
+        self._train_docs(docs, rng, doc_vecs=None)
+        return self
+
+    def _init_params(self, rng: np.random.Generator) -> None:
+        v, d = len(self.vocab), self.vector_size
+        self.syn0 = ((rng.random((v, d)) - 0.5) / d).astype(np.float32)
+        if self.hs:
+            self._codes, self._points, self._code_lens = self.vocab.huffman()
+            rows = max(len(self.vocab) - 1, 1)
+        else:
+            rows = v
+        self.syn1 = np.zeros((rows, d), np.float32)
+
+    def _keep_prob(self) -> np.ndarray:
+        """word2vec subsampling: P(keep) = min(1, sqrt(t/f) + t/f)."""
+        if self.sample <= 0:
+            return np.ones(len(self.vocab), np.float32)
+        freq = self.vocab.counts / max(self.vocab.total_count(), 1)
+        ratio = self.sample / np.maximum(freq, 1e-12)
+        return np.minimum(1.0, np.sqrt(ratio) + ratio).astype(np.float32)
+
+    def _unigram_logits(self) -> np.ndarray:
+        p = self.vocab.counts.astype(np.float64) ** 0.75
+        return np.log(p / p.sum()).astype(np.float32)
+
+    def _train_docs(self, docs: list[np.ndarray], rng: np.random.Generator,
+                    doc_vecs: Optional[np.ndarray], dbow: bool = False,
+                    freeze_words: bool = False) -> Optional[np.ndarray]:
+        """Shared trainer for Word2Vec (doc_vecs=None) and ParagraphVectors."""
+        import jax
+        import jax.numpy as jnp
+
+        keep = self._keep_prob()
+        syn0 = jnp.asarray(self.syn0)
+        syn1 = jnp.asarray(self.syn1)
+        dvecs = None if doc_vecs is None else jnp.asarray(doc_vecs)
+        neg_logits = None if self.hs else jnp.asarray(self._unigram_logits())
+        key = jax.random.key(self.seed)
+        step = _make_step(self.hs, self.negative, self.cbow and not dbow,
+                          has_docs=dvecs is not None, dbow=dbow,
+                          freeze_words=freeze_words)
+        hs_tabs = ((jnp.asarray(self._codes), jnp.asarray(self._points),
+                    jnp.asarray(self._code_lens)) if self.hs else None)
+
+        def make_epoch():
+            """One epoch's pair arrays (regenerated per epoch — fresh
+            dynamic windows/subsampling, and only one epoch of pairs is
+            ever resident on the host)."""
+            if self.cbow and not dbow:
+                batch = _cbow_batches(docs, self.window, keep, rng)
+                return batch, len(batch[2])
+            batch = _skipgram_pairs(docs, self.window, keep, rng)
+            return batch, len(batch[0])
+
+        first = make_epoch()
+        # LR decay horizon: pair counts vary slightly per epoch (dynamic
+        # window + subsampling), so extrapolate from epoch 0 — word2vec's
+        # own decay uses the same approximation (expected total words)
+        steps_per_epoch = max(1, (first[1] + self.batch_size - 1)
+                              // self.batch_size)
+        total_steps = steps_per_epoch * self.epochs
+
+        step_i = 0
+        for epoch in range(self.epochs):
+            batch, n = first if epoch == 0 else make_epoch()
+            first = None   # drop epoch-0 arrays once superseded
+            perm = rng.permutation(n)
+            for s in range(0, n, self.batch_size):
+                idx = perm[s:s + self.batch_size]
+                if len(idx) == 0:
+                    continue
+                # pad the ragged tail so one static shape is compiled
+                if len(idx) < self.batch_size:
+                    pad = rng.choice(n, self.batch_size - len(idx))
+                    idx = np.concatenate([idx, perm[pad]])
+                lr = max(self.min_learning_rate,
+                         self.learning_rate * (1 - step_i / max(total_steps, 1)))
+                key, sub = jax.random.split(key)
+                if self.cbow and not dbow:
+                    ctx, msk, ctr, did = (jnp.asarray(batch[0][idx]),
+                                          jnp.asarray(batch[1][idx]),
+                                          jnp.asarray(batch[2][idx]),
+                                          jnp.asarray(batch[3][idx]))
+                    args = (ctx, msk, ctr, did)
+                else:
+                    args = (jnp.asarray(batch[0][idx]),
+                            jnp.asarray(batch[1][idx]),
+                            jnp.asarray(batch[2][idx]))
+                syn0, syn1, dvecs = step(syn0, syn1, dvecs, args, hs_tabs,
+                                         neg_logits, sub, jnp.float32(lr))
+                step_i += 1
+
+        if not freeze_words:
+            self.syn0 = np.asarray(syn0)
+            self.syn1 = np.asarray(syn1)
+        return None if dvecs is None else np.asarray(dvecs)
+
+    # -- queries (reference WordVectors interface) -------------------------
+
+    def word_vector(self, word: str) -> np.ndarray:
+        return self.syn0[self.vocab.id(word)]
+
+    def has_word(self, word: str) -> bool:
+        return self.vocab is not None and word in self.vocab
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.word_vector(a), self.word_vector(b)
+        denom = np.linalg.norm(va) * np.linalg.norm(vb)
+        return float(va @ vb / denom) if denom else 0.0
+
+    def words_nearest(self, word: str, top: int = 10) -> list[str]:
+        v = self.word_vector(word)
+        norms = np.linalg.norm(self.syn0, axis=1) * np.linalg.norm(v)
+        sims = (self.syn0 @ v) / np.maximum(norms, 1e-12)
+        sims[self.vocab.id(word)] = -np.inf
+        order = np.argsort(-sims)[:top]
+        return [self.vocab.words[i] for i in order]
+
+    # -- serde: the word2vec text format the reference reads/writes --------
+
+    def save_text(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(f"{len(self.vocab)} {self.vector_size}\n")
+            for i, w in enumerate(self.vocab.words):
+                vec = " ".join(f"{x:.6g}" for x in self.syn0[i])
+                f.write(f"{w} {vec}\n")
+
+    @staticmethod
+    def load_text(path: str) -> "Word2Vec":
+        with open(path, encoding="utf-8") as f:
+            header = f.readline().split()
+            v, d = int(header[0]), int(header[1])
+            words, vecs = [], np.zeros((v, d), np.float32)
+            for i in range(v):
+                parts = f.readline().rstrip("\n").split(" ")
+                words.append(parts[0])
+                vecs[i] = [float(x) for x in parts[1:d + 1]]
+        model = Word2Vec(vector_size=d)
+        counts = np.arange(v, 0, -1, dtype=np.int64)  # order encodes rank
+        model.vocab = VocabCache(words, counts, {w: i for i, w in enumerate(words)})
+        model.syn0 = vecs
+        model.syn1 = np.zeros_like(vecs)
+        return model
+
+
+def _make_step(hs: bool, negative: int, cbow: bool, has_docs: bool,
+               dbow: bool, freeze_words: bool):
+    """Build the jit'd SGD step for one batch of pairs.
+
+    One compiled program per (objective, architecture) combination; all
+    batch contents are traced arguments so every step reuses the cache.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def in_vec(syn0, dvecs, args):
+        """Input-side vector per example + how to write its gradient back."""
+        if cbow:
+            ctx, msk, ctr, did = args
+            base = syn0[ctx]                       # [B, 2W, D]
+            denom = jnp.maximum(msk.sum(-1, keepdims=True), 1.0)
+            h = (base * msk[..., None]).sum(1) / denom
+            if has_docs:
+                h = h + dvecs[did]
+            return h, ctr
+        ctr_w, ctx_w, did = args
+        if dbow:  # PV-DBOW: doc vector predicts each word
+            return dvecs[did], ctx_w
+        h = syn0[ctr_w]
+        if has_docs:
+            h = h + dvecs[did]
+        return h, ctx_w
+
+    def objective(syn1, h, target, hs_tabs, neg_logits, key):
+        if hs:
+            codes, points, lens = hs_tabs
+            c = codes[target]                      # [B, L]
+            p = points[target]                     # [B, L]
+            mask = (jnp.arange(c.shape[1])[None, :] < lens[target][:, None])
+            logits = jnp.einsum("bd,bld->bl", h, syn1[p])
+            # code bit 1 → sigmoid(-x): loss = -log σ((1-2c)·x)
+            ll = jax.nn.log_sigmoid(jnp.where(c == 0, logits, -logits))
+            # SUM over the batch, not mean: each pair touches its own
+            # embedding rows, so summing reproduces word2vec's per-pair
+            # SGD step size independent of batch size.
+            return -(ll * mask).sum()
+        uo = syn1[target]                          # [B, D]
+        b = target.shape[0]
+        negs = jax.random.categorical(key, neg_logits, shape=(b, negative))
+        # word2vec skips draws that hit the positive target (matters for
+        # small vocabs, e.g. DeepWalk graphs); mask instead of resampling
+        # to keep the shape static
+        valid = (negs != target[:, None]).astype(h.dtype)
+        pos = jax.nn.log_sigmoid(jnp.sum(h * uo, -1))
+        neg = (jax.nn.log_sigmoid(-jnp.einsum("bd,bkd->bk", h, syn1[negs]))
+               * valid).sum(-1)
+        return -(pos + neg).sum()  # sum: per-pair step size (see HS note)
+
+    @jax.jit
+    def step(syn0, syn1, dvecs, args, hs_tabs, neg_logits, key, lr):
+        def loss_fn(syn0_, syn1_, dvecs_):
+            h, target = in_vec(syn0_, dvecs_, args)
+            return objective(syn1_, h, target, hs_tabs, neg_logits, key)
+
+        argnums = (0, 1, 2) if has_docs else (0, 1)
+        grads = jax.grad(loss_fn, argnums=argnums)(syn0, syn1, dvecs)
+        if not freeze_words:
+            syn0 = syn0 - lr * grads[0]
+            syn1 = syn1 - lr * grads[1]
+        if has_docs:
+            dvecs = dvecs - lr * grads[2]
+        return syn0, syn1, dvecs
+
+    return step
+
+
+# --------------------------------------------------------------------------
+# ParagraphVectors (doc2vec; reference models/paragraphvectors/)
+# --------------------------------------------------------------------------
+
+class ParagraphVectors(Word2Vec):
+    """PV-DM (dm=True: doc vector joins the context) and PV-DBOW
+    (dm=False: doc vector alone predicts words), per the reference's
+    ``ParagraphVectors`` with ``sequenceLearningAlgorithm`` DM/DBOW."""
+
+    def __init__(self, dm: bool = True, **kw):
+        kw.setdefault("cbow", dm)  # PV-DM builds on the CBOW context sum
+        super().__init__(**kw)
+        self.dm = dm
+        self.doc_vecs: Optional[np.ndarray] = None
+        self.labels: list[str] = []
+
+    def fit(self, documents: Sequence[str],
+            labels: Optional[Sequence[str]] = None) -> "ParagraphVectors":
+        docs_raw = list(documents)
+        self.labels = list(labels) if labels else [f"DOC_{i}" for i in
+                                                   range(len(docs_raw))]
+        vocab = VocabCache.build((self.tokenizer.create(s) for s in docs_raw),
+                                 min_count=self.min_count)
+        if len(vocab) < 2:
+            raise ValueError("need at least 2 vocabulary words to train")
+        self.vocab = vocab
+        docs = _encode_corpus(docs_raw, self.tokenizer, vocab)
+        rng = np.random.default_rng(self.seed)
+        self._init_params(rng)
+        dvecs = ((rng.random((len(docs_raw), self.vector_size)) - 0.5)
+                 / self.vector_size).astype(np.float32)
+        self.doc_vecs = self._train_docs(docs, rng, doc_vecs=dvecs,
+                                         dbow=not self.dm)
+        return self
+
+    def doc_vector(self, label: str) -> np.ndarray:
+        return self.doc_vecs[self.labels.index(label)]
+
+    def infer_vector(self, text: str, epochs: int = 16) -> np.ndarray:
+        """Train a fresh doc vector against frozen word/output tables
+        (reference ``ParagraphVectors.inferVector``)."""
+        ids = [self.vocab.index[t] for t in self.tokenizer.create(text)
+               if t in self.vocab.index]
+        if len(ids) < 2:
+            return np.zeros(self.vector_size, np.float32)
+        rng = np.random.default_rng(self.seed + 17)
+        dvec = ((rng.random((1, self.vector_size)) - 0.5)
+                / self.vector_size).astype(np.float32)
+        docs = [np.array(ids, np.int32)]
+        old_epochs = self.epochs
+        self.epochs = epochs
+        try:
+            out = self._train_docs(docs, rng, doc_vecs=dvec,
+                                   dbow=not self.dm, freeze_words=True)
+        finally:
+            self.epochs = old_epochs
+        return out[0]
+
+    def similarity_to_label(self, text: str, label: str) -> float:
+        v, d = self.infer_vector(text), self.doc_vector(label)
+        denom = np.linalg.norm(v) * np.linalg.norm(d)
+        return float(v @ d / denom) if denom else 0.0
+
+
+# --------------------------------------------------------------------------
+# GloVe (reference models/glove/Glove.java)
+# --------------------------------------------------------------------------
+
+class Glove:
+    """Global-vectors embeddings: co-occurrence counting on host, then a
+    jit'd AdaGrad loop over shuffled co-occurrence triples — the same
+    weighted-least-squares objective as the reference
+    (f(x)·(wᵢ·w̃ⱼ + bᵢ + b̃ⱼ − log x)²), batched for the MXU instead of
+    per-pair updates."""
+
+    def __init__(self, vector_size: int = 50, window: int = 5,
+                 min_count: int = 1, x_max: float = 100.0, alpha: float = 0.75,
+                 epochs: int = 10, learning_rate: float = 0.05,
+                 batch_size: int = 1024, seed: int = 0,
+                 tokenizer: Optional[DefaultTokenizerFactory] = None):
+        self.vector_size = vector_size
+        self.window = window
+        self.min_count = min_count
+        self.x_max = x_max
+        self.alpha = alpha
+        self.epochs = epochs
+        self.learning_rate = learning_rate
+        self.batch_size = batch_size
+        self.seed = seed
+        self.tokenizer = tokenizer or DefaultTokenizerFactory()
+        self.vocab: Optional[VocabCache] = None
+        self.vectors: Optional[np.ndarray] = None
+
+    def fit(self, sentences: Iterable[str]) -> "Glove":
+        import jax
+        import jax.numpy as jnp
+
+        sents = list(sentences)
+        vocab = VocabCache.build((self.tokenizer.create(s) for s in sents),
+                                 min_count=self.min_count)
+        if len(vocab) < 2:
+            raise ValueError("need at least 2 vocabulary words to train")
+        self.vocab = vocab
+        docs = _encode_corpus(sents, self.tokenizer, vocab)
+
+        cooc: dict[tuple[int, int], float] = {}
+        for ids in docs:
+            n = len(ids)
+            for i in range(n):
+                for j in range(max(0, i - self.window), i):
+                    w = 1.0 / (i - j)  # distance-weighted, as in GloVe
+                    for a, b in ((int(ids[i]), int(ids[j])),
+                                 (int(ids[j]), int(ids[i]))):
+                        cooc[(a, b)] = cooc.get((a, b), 0.0) + w
+        if not cooc:
+            raise ValueError("no co-occurrences found")
+        keys = np.array(list(cooc.keys()), np.int32)
+        vals = np.array(list(cooc.values()), np.float32)
+
+        v, d = len(vocab), self.vector_size
+        rng = np.random.default_rng(self.seed)
+        w = ((rng.random((v, d)) - 0.5) / d).astype(np.float32)
+        wt = ((rng.random((v, d)) - 0.5) / d).astype(np.float32)
+        b = np.zeros(v, np.float32)
+        bt = np.zeros(v, np.float32)
+        params = tuple(jnp.asarray(x) for x in (w, wt, b, bt))
+        accum = tuple(jnp.full_like(p, 1e-8) for p in params)
+        x_max, alpha, lr = self.x_max, self.alpha, self.learning_rate
+
+        @jax.jit
+        def glove_step(params, accum, ii, jj, xx):
+            def loss_fn(params):
+                w, wt, b, bt = params
+                pred = (jnp.sum(w[ii] * wt[jj], -1) + b[ii] + bt[jj])
+                f = jnp.minimum(1.0, (xx / x_max) ** alpha)
+                return jnp.mean(f * (pred - jnp.log(xx)) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            accum = tuple(a + g * g for a, g in zip(accum, grads))
+            params = tuple(p - lr * g / jnp.sqrt(a)
+                           for p, g, a in zip(params, grads, accum))
+            return params, accum, loss
+
+        n = len(vals)
+        bs = min(self.batch_size, n)
+        for _ in range(self.epochs):
+            perm = rng.permutation(n)
+            for s in range(0, n, bs):
+                idx = perm[s:s + bs]
+                if len(idx) < bs:  # pad tail to keep one compiled shape
+                    idx = np.concatenate([idx, perm[rng.choice(n, bs - len(idx))]])
+                params, accum, _ = glove_step(
+                    params, accum, jnp.asarray(keys[idx, 0]),
+                    jnp.asarray(keys[idx, 1]), jnp.asarray(vals[idx]))
+
+        w, wt, _, _ = (np.asarray(p) for p in params)
+        self.vectors = w + wt  # GloVe convention: sum both tables
+        return self
+
+    def word_vector(self, word: str) -> np.ndarray:
+        return self.vectors[self.vocab.id(word)]
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.word_vector(a), self.word_vector(b)
+        denom = np.linalg.norm(va) * np.linalg.norm(vb)
+        return float(va @ vb / denom) if denom else 0.0

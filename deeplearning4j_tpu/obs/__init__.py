@@ -9,6 +9,10 @@ from deeplearning4j_tpu.obs.listeners import (
 )
 from deeplearning4j_tpu.obs.metrics import MetricsWriter
 from deeplearning4j_tpu.obs.profiler import check_finite, StepTimer
+from deeplearning4j_tpu.obs.stats import (
+    StatsListener, InMemoryStatsStorage, FileStatsStorage,
+    render_html_report, render_html)
+from deeplearning4j_tpu.obs.ui_server import UIServer
 
 __all__ = [
     "TrainingListener",
@@ -21,4 +25,10 @@ __all__ = [
     "MetricsWriter",
     "check_finite",
     "StepTimer",
+    "StatsListener",
+    "InMemoryStatsStorage",
+    "FileStatsStorage",
+    "render_html_report",
+    "render_html",
+    "UIServer",
 ]

@@ -194,12 +194,25 @@ def render_html_report(storage, out_path: str, title: str = "Training report") -
     """StatsStorage → static self-contained HTML (UI-lite per SURVEY §2.8):
     score chart, per-layer param/grad/update norms and update:param
     mean-magnitude ratio over time, latest histograms."""
+    html = render_html(storage, title)
+    with open(out_path, "w") as f:
+        f.write(html)
+    return out_path
+
+
+def render_html(storage, title: str = "Training report",
+                refresh_seconds: int = 0) -> str:
+    """Render the report to a string (shared by the static report and the
+    live :class:`~deeplearning4j_tpu.obs.ui_server.UIServer`)."""
     records = storage.all() if hasattr(storage, "all") else list(storage)
     scores = [(r["iteration"], r.get("score")) for r in records
               if r.get("score") is not None]
     stats = [r for r in records if r.get("type") == "stats"]
 
-    parts = [f"<html><head><meta charset='utf-8'><title>{title}</title>",
+    refresh = (f"<meta http-equiv='refresh' content='{refresh_seconds}'>"
+               if refresh_seconds else "")
+    parts = [f"<html><head><meta charset='utf-8'>{refresh}"
+             f"<title>{title}</title>",
              "<style>body{font-family:sans-serif;margin:24px} "
              "h2{border-bottom:1px solid #ccc} .row{display:flex;gap:24px;"
              "flex-wrap:wrap} .card{margin:8px}</style></head><body>",
@@ -253,7 +266,4 @@ def render_html_report(storage, out_path: str, title: str = "Training report") -
         parts.append("</div>")
 
     parts.append("</body></html>")
-    html = "\n".join(parts)
-    with open(out_path, "w") as f:
-        f.write(html)
-    return out_path
+    return "\n".join(parts)

@@ -1,0 +1,114 @@
+"""Live training dashboard server.
+
+Parity: the reference's ``deeplearning4j-ui`` ``UIServer`` /
+``VertxUIServer`` (``org/deeplearning4j/ui/api/UIServer.java``): a
+singleton HTTP server that StatsStorage instances attach to, serving an
+auto-refreshing training dashboard.
+
+Design: the reference embeds a Vert.x server + a JS front-end; here a
+stdlib ``ThreadingHTTPServer`` renders the same content server-side via
+:func:`deeplearning4j_tpu.obs.stats.render_html` on every request (the
+storage is the single source of truth, so a page reload IS the live
+update; ``<meta refresh>`` makes it hands-free).  Endpoints:
+
+- ``/``            dashboard (first attached storage, auto-refresh)
+- ``/train/<i>``   dashboard for attached storage i
+- ``/data/<i>.json`` raw records (the UI's JSON API surface)
+- ``/healthz``     liveness
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from deeplearning4j_tpu.obs.stats import render_html
+
+
+class UIServer:
+    """Singleton live dashboard (``UIServer.getInstance()`` parity)."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 0, refresh_seconds: int = 5):
+        self._storages: list = []
+        self._lock = threading.Lock()
+        self.refresh_seconds = refresh_seconds
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silence request logging
+                pass
+
+            def _send(self, body: bytes, ctype: str, code: int = 200):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                with server._lock:
+                    storages = list(server._storages)
+                path = self.path.split("?")[0].rstrip("/") or "/"
+                if path == "/healthz":
+                    return self._send(b'{"status":"ok"}', "application/json")
+                if path.startswith("/data/") and path.endswith(".json"):
+                    idx = path[len("/data/"):-len(".json")]
+                    if idx.isdigit() and int(idx) < len(storages):
+                        recs = storages[int(idx)].all()
+                        return self._send(json.dumps(recs).encode(),
+                                          "application/json")
+                    return self._send(b"not found", "text/plain", 404)
+                idx = 0
+                if path.startswith("/train/"):
+                    tail = path[len("/train/"):]
+                    if tail.isdigit():
+                        idx = int(tail)
+                if not storages:
+                    return self._send(
+                        b"<html><body><h1>No StatsStorage attached</h1>"
+                        b"</body></html>", "text/html")
+                if idx >= len(storages):
+                    return self._send(b"not found", "text/plain", 404)
+                html = render_html(storages[idx],
+                                   title=f"Training session {idx}",
+                                   refresh_seconds=server.refresh_seconds)
+                return self._send(html.encode(), "text/html")
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    # -- reference API surface --------------------------------------------
+
+    @classmethod
+    def get_instance(cls, port: int = 0) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = UIServer(port=port)
+        return cls._instance
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}/"
+
+    def attach(self, storage) -> None:
+        with self._lock:
+            if storage not in self._storages:
+                self._storages.append(storage)
+
+    def detach(self, storage) -> None:
+        with self._lock:
+            if storage in self._storages:
+                self._storages.remove(storage)
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+        if UIServer._instance is self:
+            UIServer._instance = None

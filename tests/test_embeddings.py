@@ -1,0 +1,191 @@
+"""Word2Vec / GloVe / ParagraphVectors / DeepWalk tests.
+
+Mirrors the reference's embedding test strategy (deeplearning4j-nlp
+``Word2VecTests.java``: train on a small corpus, assert nearest-neighbor
+structure and serialization round-trips; deeplearning4j-graph
+``DeepWalkGradientCheck``-adjacent structural tests) on a tiny
+deterministic corpus so the suite stays hermetic and fast.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    CollectionSentenceIterator, DeepWalk, DefaultTokenizerFactory, Glove,
+    Graph, LineSentenceIterator, ParagraphVectors, VocabCache, Word2Vec,
+    random_walks)
+
+
+def _two_topic_corpus(n=120, seed=0):
+    """Sentences drawn from two disjoint topic vocabularies: words within
+    a topic co-occur, across topics never — embeddings must reflect it."""
+    rng = np.random.default_rng(seed)
+    animals = ["cat", "dog", "horse", "sheep", "cow"]
+    tech = ["cpu", "gpu", "tpu", "ram", "disk"]
+    out = []
+    for i in range(n):
+        words = rng.choice(animals if i % 2 == 0 else tech, size=6)
+        out.append(" ".join(words))
+    return out
+
+
+class TestVocabCache:
+    def test_frequency_ordered(self):
+        tok = DefaultTokenizerFactory()
+        vocab = VocabCache.build(tok.create(s) for s in
+                                 ["a a a b b c", "a b c d"])
+        assert vocab.words[0] == "a"
+        assert vocab.counts[0] == 4
+        assert vocab.id("a") == 0 and "d" in vocab
+
+    def test_min_count_filters(self):
+        tok = DefaultTokenizerFactory()
+        vocab = VocabCache.build((tok.create(s) for s in ["a a b"]),
+                                 min_count=2)
+        assert "b" not in vocab and "a" in vocab
+
+    def test_huffman_codes_prefix_free(self):
+        tok = DefaultTokenizerFactory()
+        vocab = VocabCache.build(tok.create(s) for s in
+                                 ["a a a a b b b c c d"])
+        codes, points, lens = vocab.huffman()
+        strs = ["".join(str(b) for b in codes[w, :lens[w]])
+                for w in range(len(vocab))]
+        assert len(set(strs)) == len(strs)          # unique
+        for i, a in enumerate(strs):                # prefix-free
+            for j, b in enumerate(strs):
+                if i != j:
+                    assert not b.startswith(a)
+        # frequent words get shorter codes
+        assert lens[vocab.id("a")] <= lens[vocab.id("d")]
+        assert points.max() < len(vocab) - 1
+
+
+class TestSentenceIterators:
+    def test_line_iterator(self, tmp_path):
+        p = os.path.join(tmp_path, "corpus.txt")
+        with open(p, "w") as f:
+            f.write("one two\n\nthree four\n")
+        it = LineSentenceIterator(p)
+        assert list(it) == ["one two", "three four"]
+        assert list(it) == ["one two", "three four"]  # resettable
+
+    def test_collection_iterator(self):
+        it = CollectionSentenceIterator(["a b", "c d"])
+        assert list(it) == ["a b", "c d"]
+
+
+@pytest.mark.parametrize("kw", [
+    dict(negative=5, hs=False),            # skip-gram + negative sampling
+    dict(negative=0, hs=True),             # skip-gram + hierarchical softmax
+    dict(negative=5, hs=False, cbow=True), # CBOW + negative sampling
+], ids=["sg-ns", "sg-hs", "cbow-ns"])
+def test_word2vec_topic_structure(kw):
+    model = Word2Vec(vector_size=24, window=3, epochs=10, seed=7,
+                     sample=0.0, batch_size=256, **kw)
+    model.fit(_two_topic_corpus())
+    within = model.similarity("cat", "dog")
+    across = model.similarity("cat", "gpu")
+    assert within > across + 0.2, (within, across)
+    near = model.words_nearest("cpu", top=4)
+    assert set(near) <= {"gpu", "tpu", "ram", "disk"}, near
+
+
+def test_word2vec_text_serde_roundtrip(tmp_path):
+    model = Word2Vec(vector_size=16, window=2, epochs=2, seed=3)
+    model.fit(_two_topic_corpus(40))
+    p = os.path.join(tmp_path, "vecs.txt")
+    model.save_text(p)
+    loaded = Word2Vec.load_text(p)
+    assert loaded.vocab.words == model.vocab.words
+    np.testing.assert_allclose(loaded.syn0, model.syn0, atol=1e-5)
+    assert abs(loaded.similarity("cat", "dog")
+               - model.similarity("cat", "dog")) < 1e-5
+
+
+def test_word2vec_sentence_iterator_input():
+    model = Word2Vec(vector_size=12, epochs=2, seed=1)
+    model.fit(CollectionSentenceIterator(_two_topic_corpus(40)))
+    assert model.has_word("cat") and not model.has_word("zebra")
+
+
+def test_glove_topic_structure():
+    model = Glove(vector_size=24, window=3, epochs=30, seed=7)
+    model.fit(_two_topic_corpus())
+    within = model.similarity("cat", "dog")
+    across = model.similarity("cat", "gpu")
+    assert within > across + 0.2, (within, across)
+
+
+@pytest.mark.parametrize("dm", [True, False], ids=["pv-dm", "pv-dbow"])
+def test_paragraph_vectors_doc_structure(dm):
+    docs = _two_topic_corpus(60)
+    labels = [f"animal_{i}" if i % 2 == 0 else f"tech_{i}"
+              for i in range(len(docs))]
+    model = ParagraphVectors(dm=dm, vector_size=24, window=3, epochs=20,
+                             seed=5, sample=0.0)
+    model.fit(docs, labels)
+    assert model.doc_vecs.shape == (60, 24)
+    # documents of the same topic should be closer than across topics
+    d = model.doc_vecs / np.linalg.norm(model.doc_vecs, axis=1, keepdims=True)
+    sims = d @ d.T
+    same = np.mean([sims[i, j] for i in range(0, 20, 2)
+                    for j in range(i + 2, 20, 2)])
+    diff = np.mean([sims[i, j] for i in range(0, 20, 2)
+                    for j in range(1, 20, 2)])
+    assert same > diff + 0.1, (same, diff)
+
+
+def test_paragraph_vectors_infer_vector():
+    docs = _two_topic_corpus(60)
+    model = ParagraphVectors(dm=True, vector_size=24, window=3, epochs=20,
+                             seed=5, sample=0.0)
+    model.fit(docs)
+    v_animal = model.infer_vector("cat dog sheep cow horse dog")
+    v_tech = model.infer_vector("cpu gpu ram disk tpu gpu")
+    d = model.doc_vecs / np.linalg.norm(model.doc_vecs, axis=1, keepdims=True)
+
+    def mean_sim(v, rows):
+        v = v / np.linalg.norm(v)
+        return float(np.mean(d[rows] @ v))
+
+    animal_rows = list(range(0, 60, 2))
+    tech_rows = list(range(1, 60, 2))
+    assert mean_sim(v_animal, animal_rows) > mean_sim(v_animal, tech_rows)
+    assert mean_sim(v_tech, tech_rows) > mean_sim(v_tech, animal_rows)
+
+
+def _two_cliques(k=6):
+    """Two k-cliques joined by one bridge edge."""
+    edges = [(i, j) for i in range(k) for j in range(i + 1, k)]
+    edges += [(k + i, k + j) for i in range(k) for j in range(i + 1, k)]
+    edges.append((0, k))
+    return Graph.from_edges(2 * k, edges)
+
+
+class TestDeepWalk:
+    def test_random_walks_stay_on_graph(self):
+        g = _two_cliques()
+        walks = random_walks(g, walk_length=10, walks_per_vertex=2, seed=0)
+        assert len(walks) == 24
+        for w in walks:
+            for a, b in zip(w, w[1:]):
+                assert b in g.neighbors(a)
+
+    def test_community_structure_recovered(self):
+        g = _two_cliques()
+        dw = DeepWalk(vector_size=16, window=3, walk_length=12,
+                      walks_per_vertex=12, epochs=2, seed=3)
+        dw.fit(g)
+        within = dw.similarity(1, 2)      # same clique
+        across = dw.similarity(1, 8)      # other clique
+        assert within > across, (within, across)
+        near = dw.vertices_nearest(2, top=3)
+        assert set(near) <= set(range(6)), near
+
+    def test_isolated_vertex_walks_skipped(self):
+        g = Graph.from_edges(3, [(0, 1)])
+        walks = random_walks(g, walk_length=5, walks_per_vertex=1, seed=0)
+        assert all(2 not in w for w in walks)

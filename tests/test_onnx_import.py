@@ -1,0 +1,254 @@
+"""ONNX importer tests (reference: nd4j/samediff-import-onnx
+``OnnxFrameworkImporter`` — protobuf graph → executable graph).
+
+Fixtures are synthesized with the in-repo wire encoder (no onnx package
+in this environment), then parsed back through the importer — the same
+protobuf bytes a real export produces for this op subset."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.importers import onnx_wire as wire
+from deeplearning4j_tpu.importers.onnx_import import OnnxModel, import_onnx_model
+
+
+def _vi(name, shape):
+    return {"name": name,
+            "type": {"tensor_type": {
+                "elem_type": 1,
+                "shape": {"dim": [{"dim_value": d} for d in shape]}}}}
+
+
+def _model_bytes(nodes, initializers, inputs, outputs):
+    graph = {"name": "g", "node": nodes,
+             "initializer": [wire.array_to_tensor(n, a)
+                             for n, a in initializers.items()],
+             "input": [_vi(n, s) for n, s in inputs.items()],
+             "output": [_vi(n, s) for n, s in outputs.items()]}
+    model = {"ir_version": 8, "graph": graph,
+             "opset_import": [{"domain": "", "version": 17}]}
+    return wire.emit(wire.MODEL, model)
+
+
+def _node(op, ins, outs, **attrs):
+    node = {"op_type": op, "input": ins, "output": outs, "name": outs[0]}
+    alist = []
+    for k, v in attrs.items():
+        if isinstance(v, float):
+            alist.append({"name": k, "f": v, "type": 1})
+        elif isinstance(v, int):
+            alist.append({"name": k, "i": v, "type": 2})
+        elif isinstance(v, (list, tuple)):
+            alist.append({"name": k, "ints": list(v), "type": 7})
+        else:
+            raise TypeError(k)
+    if alist:
+        node["attribute"] = alist
+    return node
+
+
+def test_wire_roundtrip():
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    t = wire.array_to_tensor("w", arr)
+    buf = wire.emit(wire.TENSOR, t)
+    back = wire.tensor_to_array(wire.parse(buf, wire.TENSOR))
+    np.testing.assert_array_equal(back, arr)
+
+
+def test_mlp_gemm_relu_softmax():
+    rng = np.random.default_rng(0)
+    w1 = rng.normal(0, 0.5, (8, 4)).astype(np.float32)   # [out, in], transB
+    b1 = rng.normal(0, 0.1, (8,)).astype(np.float32)
+    w2 = rng.normal(0, 0.5, (3, 8)).astype(np.float32)
+    b2 = rng.normal(0, 0.1, (3,)).astype(np.float32)
+    buf = _model_bytes(
+        nodes=[_node("Gemm", ["x", "w1", "b1"], ["h"], transB=1),
+               _node("Relu", ["h"], ["hr"]),
+               _node("Gemm", ["hr", "w2", "b2"], ["logits"], transB=1),
+               _node("Softmax", ["logits"], ["probs"], axis=-1)],
+        initializers={"w1": w1, "b1": b1, "w2": w2, "b2": b2},
+        inputs={"x": [2, 4]}, outputs={"probs": [2, 3]})
+    model = import_onnx_model(buf)
+    assert model.input_names == ["x"]
+    x = rng.normal(size=(2, 4)).astype(np.float32)
+    got = np.asarray(model(x))
+
+    h = np.maximum(x @ w1.T + b1, 0)
+    logits = h @ w2.T + b2
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    np.testing.assert_allclose(got, e / e.sum(-1, keepdims=True), atol=1e-5)
+
+
+def test_conv_bn_pool_flatten():
+    rng = np.random.default_rng(1)
+    w = rng.normal(0, 0.3, (5, 2, 3, 3)).astype(np.float32)  # OIHW
+    b = rng.normal(0, 0.1, (5,)).astype(np.float32)
+    scale = rng.uniform(0.5, 1.5, 5).astype(np.float32)
+    bias = rng.normal(0, 0.1, 5).astype(np.float32)
+    mean = rng.normal(0, 0.1, 5).astype(np.float32)
+    var = rng.uniform(0.5, 1.5, 5).astype(np.float32)
+    buf = _model_bytes(
+        nodes=[_node("Conv", ["x", "w", "b"], ["c"], kernel_shape=[3, 3],
+                     pads=[1, 1, 1, 1]),
+               _node("BatchNormalization",
+                     ["c", "scale", "bias", "mean", "var"], ["bn"],
+                     epsilon=1e-5),
+               _node("Relu", ["bn"], ["r"]),
+               _node("MaxPool", ["r"], ["p"], kernel_shape=[2, 2],
+                     strides=[2, 2]),
+               _node("Flatten", ["p"], ["f"]),],
+        initializers={"w": w, "b": b, "scale": scale, "bias": bias,
+                      "mean": mean, "var": var},
+        inputs={"x": [1, 2, 8, 8]}, outputs={"f": [1, 5 * 4 * 4]})
+    model = import_onnx_model(buf)
+    x = rng.normal(size=(1, 2, 8, 8)).astype(np.float32)
+    got = np.asarray(model(x))
+    assert got.shape == (1, 5 * 4 * 4)
+
+    # reference conv in pure numpy (NCHW, pad 1)
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    conv = np.zeros((1, 5, 8, 8), np.float32)
+    for o in range(5):
+        for i in range(2):
+            for u in range(8):
+                for v in range(8):
+                    conv[0, o, u, v] += np.sum(
+                        xp[0, i, u:u + 3, v:v + 3] * w[o, i])
+        conv[0, o] += b[o]
+    bn = ((conv - mean[None, :, None, None])
+          / np.sqrt(var[None, :, None, None] + 1e-5)
+          * scale[None, :, None, None] + bias[None, :, None, None])
+    r = np.maximum(bn, 0)
+    pooled = r.reshape(1, 5, 4, 2, 4, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(got, pooled.reshape(1, -1), atol=1e-4)
+
+
+def test_imported_model_jits_and_grads():
+    import jax
+    import jax.numpy as jnp
+    w = np.eye(4, dtype=np.float32)
+    buf = _model_bytes(
+        nodes=[_node("MatMul", ["x", "w"], ["y"]),
+               _node("Tanh", ["y"], ["z"])],
+        initializers={"w": w}, inputs={"x": [2, 4]}, outputs={"z": [2, 4]})
+    model = import_onnx_model(buf)
+    fn = jax.jit(model.as_fn())
+    x = jnp.ones((2, 4))
+    np.testing.assert_allclose(np.asarray(fn(x)),
+                               np.tanh(np.ones((2, 4))), atol=1e-6)
+    g = jax.grad(lambda x: jnp.sum(model.as_fn()(x)))(x)
+    np.testing.assert_allclose(np.asarray(g),
+                               1 - np.tanh(1.0) ** 2, atol=1e-5)
+
+
+def test_unsupported_op_reported():
+    buf = _model_bytes(nodes=[_node("LSTM", ["x"], ["y"])],
+                       initializers={}, inputs={"x": [1, 2]},
+                       outputs={"y": [1, 2]})
+    with pytest.raises(NotImplementedError, match="LSTM"):
+        import_onnx_model(buf)
+
+
+def test_missing_input_reported():
+    buf = _model_bytes(nodes=[_node("Relu", ["x"], ["y"])],
+                       initializers={}, inputs={"x": [1, 2]},
+                       outputs={"y": [1, 2]})
+    with pytest.raises(ValueError, match="missing graph inputs"):
+        import_onnx_model(buf)()
+
+
+def test_proto3_zero_attribute_omitted_on_wire():
+    """proto3 serializers omit zero scalars: keepdims=0 arrives as
+    name+type only.  The importer must not fall back to the default."""
+    node = _node("ReduceMean", ["x"], ["y"], axes=[1])
+    node["attribute"].append({"name": "keepdims", "type": 2})  # i=0 omitted
+    buf = _model_bytes(nodes=[node], initializers={},
+                       inputs={"x": [2, 3]}, outputs={"y": [2]})
+    model = import_onnx_model(buf)
+    x = np.ones((2, 3), np.float32)
+    assert np.asarray(model(x)).shape == (2,)   # keepdims honored as 0
+
+
+def test_conv_same_lower_vs_upper():
+    """SAME_LOWER puts the surplus pad element at the BEGINNING; with an
+    even kernel the two modes differ by a one-pixel shift."""
+    w = np.zeros((1, 1, 2, 2), np.float32)
+    w[0, 0, 0, 0] = 1.0    # kernel picks the top-left of its window
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+
+    def run(auto_pad):
+        node = _node("Conv", ["x", "w"], ["y"], kernel_shape=[2, 2])
+        node["attribute"].append({"name": "auto_pad", "s": auto_pad.encode(),
+                                  "type": 3})
+        buf = _model_bytes(nodes=[node], initializers={"w": w},
+                           inputs={"x": [1, 1, 4, 4]},
+                           outputs={"y": [1, 1, 4, 4]})
+        return np.asarray(import_onnx_model(buf)(x))[0, 0]
+
+    upper = run("SAME_UPPER")    # pad at end → y[i,j] = x[i,j]
+    lower = run("SAME_LOWER")    # pad at start → y[i,j] = x[i-1,j-1]
+    np.testing.assert_array_equal(upper, x[0, 0])
+    np.testing.assert_array_equal(lower[1:, 1:], x[0, 0, :-1, :-1])
+    np.testing.assert_array_equal(lower[0], 0.0)
+
+
+def test_softmax_opset12_flatten_semantics():
+    """opset <13: default axis=1 with flatten-to-2D (normalize over ALL
+    trailing dims), not single-axis."""
+    graph = {"name": "g",
+             "node": [_node("Softmax", ["x"], ["y"])],
+             "initializer": [],
+             "input": [_vi("x", [2, 2, 3])], "output": [_vi("y", [2, 2, 3])]}
+    buf = wire.emit(wire.MODEL, {"ir_version": 7, "graph": graph,
+                                 "opset_import": [{"domain": "",
+                                                   "version": 12}]})
+    x = np.random.default_rng(3).normal(size=(2, 2, 3)).astype(np.float32)
+    got = np.asarray(import_onnx_model(buf)(x))
+    flat = x.reshape(2, 6)
+    e = np.exp(flat - flat.max(-1, keepdims=True))
+    ref = (e / e.sum(-1, keepdims=True)).reshape(2, 2, 3)
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+    # sums over the flattened trailing dims are 1, per-axis sums are not
+    np.testing.assert_allclose(got.reshape(2, 6).sum(-1), 1.0, atol=1e-5)
+
+
+def test_pool_auto_pad_same_upper():
+    """tf2onnx 'same' pooling exports carry auto_pad, not explicit pads."""
+    node = _node("MaxPool", ["x"], ["y"], kernel_shape=[2, 2],
+                 strides=[2, 2])
+    node["attribute"].append({"name": "auto_pad", "s": b"SAME_UPPER",
+                              "type": 3})
+    buf = _model_bytes(nodes=[node], initializers={},
+                       inputs={"x": [1, 1, 5, 5]}, outputs={"y": [1, 1, 3, 3]})
+    x = np.arange(25, dtype=np.float32).reshape(1, 1, 5, 5)
+    got = np.asarray(import_onnx_model(buf)(x))
+    assert got.shape == (1, 1, 3, 3)          # ceil(5/2), not floor
+    np.testing.assert_array_equal(got[0, 0], [[6, 8, 9], [16, 18, 19],
+                                              [21, 23, 24]])
+
+
+def test_reshape_zero_copies_input_dim():
+    shape = np.asarray([0, -1], np.int64)
+    buf = _model_bytes(
+        nodes=[_node("Reshape", ["x", "shape"], ["y"])],
+        initializers={"shape": shape},
+        inputs={"x": [2, 3, 4]}, outputs={"y": [2, 12]})
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    got = np.asarray(import_onnx_model(buf)(x))
+    assert got.shape == (2, 12)
+    np.testing.assert_array_equal(got, x.reshape(2, 12))
+
+
+def test_elementwise_and_shape_ops():
+    rng = np.random.default_rng(2)
+    a = rng.normal(size=(2, 3)).astype(np.float32)
+    buf = _model_bytes(
+        nodes=[_node("Unsqueeze", ["x"], ["u"], axes=[0]),
+               _node("Transpose", ["u"], ["t"], perm=[0, 2, 1]),
+               _node("Squeeze", ["t"], ["s"], axes=[0]),
+               _node("Mul", ["s", "s"], ["m"]),
+               _node("ReduceMean", ["m"], ["out"], axes=[1], keepdims=0)],
+        initializers={}, inputs={"x": [2, 3]}, outputs={"out": [3]})
+    model = import_onnx_model(buf)
+    got = np.asarray(model(a))
+    np.testing.assert_allclose(got, (a.T ** 2).mean(axis=1), atol=1e-6)

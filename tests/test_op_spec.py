@@ -1,0 +1,49 @@
+"""Op-spec / catalog tests (reference: contrib/codegen-tools — op metadata
+single-sourced, namespaces + docs generated from it)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.ops import spec
+
+
+# Pinned per-namespace op counts: dropping an op must fail here (the
+# regression guarantee the reference gets from diffing generated code).
+# Raising a count is fine — update the pin alongside the new op.
+MIN_COUNTS = {"math": 78, "nn": 23, "cnn": 7, "loss": 17, "rnn": 2,
+              "linalg": 30, "random": 18, "image": 9, "bitwise": 7}
+
+
+def test_counts_pinned():
+    got = spec.counts()
+    for ns, n in MIN_COUNTS.items():
+        assert got.get(ns, 0) >= n, f"{ns}: {got.get(ns, 0)} < pinned {n}"
+
+
+def test_every_spec_resolves_to_callable():
+    specs = spec.op_specs()
+    assert len(specs) >= sum(MIN_COUNTS.values())
+    for s in specs:
+        fn = spec.resolve(s.qualified())
+        assert callable(fn)
+
+
+def test_resolve_unknown_raises():
+    import pytest
+    with pytest.raises(KeyError):
+        spec.resolve("math.not_an_op")
+    with pytest.raises(KeyError):
+        spec.resolve("nope.exp")
+
+
+def test_sample_ops_execute():
+    x = np.asarray([1.0, 4.0], np.float32)
+    assert np.allclose(spec.resolve("math.sqrt")(x), [1.0, 2.0])
+    assert spec.resolve("bitwise.and_")(np.int32(6), np.int32(3)) == 2
+
+
+def test_markdown_catalog(tmp_path):
+    p = tmp_path / "OPS.md"
+    text = spec.generate_markdown(str(p))
+    assert p.exists()
+    assert "## `math`" in text and "| `sqrt` |" in text
+    assert f"{len(spec.op_specs())} ops" in text

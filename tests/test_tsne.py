@@ -1,0 +1,45 @@
+"""t-SNE tests (reference: deeplearning4j-manifold ``BarnesHutTsne``
+tests — embed clustered data, assert cluster structure survives)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.manifold import Tsne
+
+
+def _three_clusters(n_per=30, dim=10, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = np.array([[8.0] + [0.0] * (dim - 1),
+                        [0.0] * (dim - 1) + [8.0],
+                        [-8.0] + [0.0] * (dim - 1)])
+    x = np.concatenate([c + rng.normal(0, 0.5, (n_per, dim)) for c in centers])
+    labels = np.repeat(np.arange(3), n_per)
+    return x.astype(np.float32), labels
+
+
+def test_clusters_stay_separated():
+    x, labels = _three_clusters()
+    ts = Tsne(perplexity=10.0, n_iter=300, seed=1)
+    y = ts.fit_transform(x)
+    assert y.shape == (90, 2)
+    assert np.all(np.isfinite(y))
+    cents = np.stack([y[labels == k].mean(0) for k in range(3)])
+    intra = max(np.linalg.norm(y[labels == k] - cents[k], axis=1).mean()
+                for k in range(3))
+    inter = min(np.linalg.norm(cents[a] - cents[b])
+                for a in range(3) for b in range(a + 1, 3))
+    assert inter > 2 * intra, (inter, intra)
+
+
+def test_embedding_centered_and_deterministic():
+    x, _ = _three_clusters(n_per=15)
+    a = Tsne(perplexity=8.0, n_iter=50, seed=3).fit_transform(x)
+    b = Tsne(perplexity=8.0, n_iter=50, seed=3).fit_transform(x)
+    np.testing.assert_allclose(a, b, atol=1e-5)
+    np.testing.assert_allclose(a.mean(axis=0), 0.0, atol=1e-3)
+
+
+def test_perplexity_validation():
+    x = np.random.default_rng(0).normal(size=(20, 5)).astype(np.float32)
+    with pytest.raises(ValueError):
+        Tsne(perplexity=30.0).fit_transform(x)

@@ -1,0 +1,68 @@
+"""Live UI server tests (reference: deeplearning4j-ui ``UIServer`` /
+``VertxUIServer`` — attach a StatsStorage, serve the training dashboard)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from deeplearning4j_tpu.obs import InMemoryStatsStorage, UIServer
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+@pytest.fixture
+def server():
+    s = UIServer(port=0)
+    yield s
+    s.stop()
+
+
+def _storage_with_records():
+    st = InMemoryStatsStorage()
+    for i in range(5):
+        st.put({"type": "score", "iteration": i, "epoch": 0,
+                "score": 1.0 / (i + 1)})
+    st.put({"type": "stats", "iteration": 5, "epoch": 0, "score": 0.1,
+            "params": {"0": {"norm": 1.0, "mean": 0.0, "stdev": 0.1,
+                             "mean_magnitude": 0.05, "min": -1.0, "max": 1.0,
+                             "hist_counts": [1, 2, 3], "hist_min": -1.0,
+                             "hist_max": 1.0}}})
+    return st
+
+
+def test_dashboard_served_live(server):
+    status, body = _get(server.url)
+    assert status == 200 and "No StatsStorage attached" in body
+
+    st = _storage_with_records()
+    server.attach(st)
+    status, body = _get(server.url)
+    assert status == 200
+    assert "Score (loss)" in body and "polyline" in body
+    assert "http-equiv='refresh'" in body
+
+    # new records appear on next fetch without restart — the live part
+    st.put({"type": "score", "iteration": 6, "epoch": 0, "score": 0.01})
+    _, body2 = _get(server.url + "data/0.json")
+    assert any(r["iteration"] == 6 for r in json.loads(body2))
+
+
+def test_multiple_sessions_and_detach(server):
+    a, b = _storage_with_records(), InMemoryStatsStorage()
+    server.attach(a)
+    server.attach(b)
+    assert _get(server.url + "train/1")[0] == 200
+    server.detach(a)
+    status, body = _get(server.url + "data/0.json")
+    assert status == 200 and json.loads(body) == []   # b is now index 0
+
+
+def test_healthz_and_404(server):
+    assert json.loads(_get(server.url + "healthz")[1])["status"] == "ok"
+    server.attach(InMemoryStatsStorage())
+    with pytest.raises(urllib.error.HTTPError):
+        _get(server.url + "train/7")
