@@ -104,9 +104,23 @@ def _spatial_pads(attrs, x, k, strides, dil):
 
 
 def _pool_args(attrs, x):
+    """Returns (kernel, strides, explicit_pads, ceil_extra) — ceil_mode's
+    end-overhang is tracked separately because AveragePool's denominator
+    counts explicit pad cells (when count_include_pad=1) but NEVER the
+    ceil overhang."""
     k = attrs["kernel_shape"]
     s = attrs.get("strides", [1] * len(k))
-    return k, s, _spatial_pads(attrs, x, k, s, [1] * len(k))
+    pads = _spatial_pads(attrs, x, k, s, [1] * len(k))
+    ceil_extra = [0] * len(k)
+    if attrs.get("ceil_mode", 0):
+        # extend end-padding so the window count is ceil((size+p-k)/s)+1;
+        # reduce_window pads with the reduction identity, so the extra
+        # cells are inert for max and excluded from avg counts
+        for d in range(len(k)):
+            size = x.shape[2 + d] + pads[d][0] + pads[d][1]
+            out_ceil = -(-(size - k[d]) // s[d]) + 1
+            ceil_extra[d] = max((out_ceil - 1) * s[d] + k[d] - size, 0)
+    return k, s, pads, ceil_extra
 
 
 @onnx_op("Conv")
@@ -120,7 +134,11 @@ def _conv(inputs, attrs):
     dil = attrs.get("dilations", [1] * nd)
     groups = attrs.get("group", 1)
     padding = _spatial_pads(attrs, x, k, strides, dil)
-    spec = ("NCHW", "OIHW", "NCHW") if nd == 2 else ("NCW", "OIW", "NCW")
+    spec = {1: ("NCW", "OIW", "NCW"),
+            2: ("NCHW", "OIHW", "NCHW"),
+            3: ("NCDHW", "OIDHW", "NCDHW")}.get(nd)
+    if spec is None:
+        raise NotImplementedError(f"Conv with {nd} spatial dims")
     y = lax.conv_general_dilated(x, w, tuple(strides), padding,
                                  rhs_dilation=tuple(dil),
                                  dimension_numbers=spec,
@@ -166,10 +184,11 @@ def _bn(inputs, attrs):
 def _maxpool(inputs, attrs):
     from jax import lax
     x = inputs[0]
-    k, s, pads = _pool_args(attrs, x)
+    k, s, pads, extra = _pool_args(attrs, x)
+    window_pads = [(p[0], p[1] + e) for p, e in zip(pads, extra)]
     return lax.reduce_window(
         x, -np.inf, lax.max, (1, 1) + tuple(k), (1, 1) + tuple(s),
-        [(0, 0), (0, 0)] + pads)
+        [(0, 0), (0, 0)] + window_pads)
 
 
 @onnx_op("AveragePool")
@@ -177,16 +196,26 @@ def _avgpool(inputs, attrs):
     from jax import lax
     import jax.numpy as jnp
     x = inputs[0]
-    k, s, pads = _pool_args(attrs, x)
+    k, s, pads, extra = _pool_args(attrs, x)
+    window_pads = [(p[0], p[1] + e) for p, e in zip(pads, extra)]
     summed = lax.reduce_window(
         x, 0.0, lax.add, (1, 1) + tuple(k), (1, 1) + tuple(s),
-        [(0, 0), (0, 0)] + pads)
-    if attrs.get("count_include_pad", 0) or all(p == (0, 0) for p in pads):
+        [(0, 0), (0, 0)] + window_pads)
+    if all(p == (0, 0) for p in window_pads):
         return summed / np.prod(k)
-    ones = jnp.ones_like(x)
+    # denominator: data cells always; explicit pad cells only when
+    # count_include_pad=1; ceil-overhang cells never (ONNX semantics)
+    if attrs.get("count_include_pad", 0):
+        ones = jnp.pad(jnp.ones_like(x),
+                       [(0, 0), (0, 0)] + list(pads), constant_values=1.0)
+        count_pads = [(0, 0)] * len(k)
+    else:
+        ones = jnp.ones_like(x)
+        count_pads = pads
     counts = lax.reduce_window(
         ones, 0.0, lax.add, (1, 1) + tuple(k), (1, 1) + tuple(s),
-        [(0, 0), (0, 0)] + pads)
+        [(0, 0), (0, 0)] + [(cp[0], cp[1] + e)
+                            for cp, e in zip(count_pads, extra)])
     return summed / counts
 
 
@@ -355,6 +384,7 @@ class OnnxModel:
         self.input_names = [vi["name"] for vi in g.get("input", [])
                             if vi["name"] not in self.initializers]
         self.output_names = [vi["name"] for vi in g.get("output", [])]
+        self._device_inits = None   # populated lazily on first call
         unknown = {n["op_type"] for n in self.nodes} - set(_OPS)
         if unknown:
             raise NotImplementedError(
@@ -385,8 +415,18 @@ class OnnxModel:
         """Run the graph.  Positional args bind to graph inputs in
         declaration order; keyword args bind by name."""
         import jax.numpy as jnp
-        env: dict[str, Any] = {k: jnp.asarray(v)
-                               for k, v in self.initializers.items()}
+        import jax
+        if self._device_inits is not None:
+            env: dict[str, Any] = dict(self._device_inits)
+        else:
+            # convert weights once and reuse — re-doing it per eager call
+            # would re-transfer the whole model host→device every
+            # invocation.  If this first call is INSIDE a jit trace the
+            # conversions come back as tracers, which must not be cached
+            # (they die with the trace) — skip caching until an eager call.
+            env = {k: jnp.asarray(v) for k, v in self.initializers.items()}
+            if not any(isinstance(v, jax.core.Tracer) for v in env.values()):
+                self._device_inits = dict(env)
         for name, val in zip(self.input_names, args):
             env[name] = jnp.asarray(val)
         for name, val in feeds.items():

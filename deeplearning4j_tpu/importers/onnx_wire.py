@@ -71,10 +71,6 @@ def _zigzag_to_signed(v: int, bits: int = 64) -> int:
 # 'varint', 'string', 'bytes', 'float', 'packed_i64', 'packed_f32', or a
 # nested field map (dict).  'repeated' wraps any kind in a list.
 
-def _msg(fields: dict) -> dict:
-    return fields
-
-
 TENSOR = {
     1: ("dims", "repeated_i64"),
     2: ("data_type", "varint"),

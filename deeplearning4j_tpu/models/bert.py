@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+from functools import partial
 from typing import Any, Optional
 
 import jax
@@ -221,7 +222,9 @@ class BertForMaskedLM:
     def make_train_step(self, tx):
         config = self.config
 
-        @jax.jit
+        # params/opt_state buffers are donated (updated in place in HBM)
+        # — callers must rebind to the returned values, as fit() does
+        @partial(jax.jit, donate_argnums=(0, 1))
         def step(params, opt_state, input_ids, labels, label_weights,
                  attention_mask, rng):
             def loss_fn(p):

@@ -177,21 +177,31 @@ class VocabCache:
 # --------------------------------------------------------------------------
 
 def _encode_corpus(sentences: Iterable[str], tokenizer, vocab: VocabCache
-                   ) -> list[np.ndarray]:
-    out = []
-    for s in sentences:
+                   ) -> tuple[list[np.ndarray], np.ndarray]:
+    """Encode to id arrays, dropping docs with <2 in-vocab tokens.
+    Returns (docs, orig_index): ``orig_index[i]`` is the position of
+    ``docs[i]`` in the INPUT sequence — pair generators must emit that,
+    not the filtered position, so ParagraphVectors' doc vectors stay
+    aligned with the caller's documents/labels."""
+    out, orig = [], []
+    for i, s in enumerate(sentences):
         ids = [vocab.index[t] for t in tokenizer.create(s) if t in vocab.index]
         if len(ids) > 1:
             out.append(np.array(ids, np.int32))
-    return out
+            orig.append(i)
+    return out, np.array(orig, np.int32)
 
 
 def _skipgram_pairs(docs: list[np.ndarray], window: int, keep_prob: np.ndarray,
-                    rng: np.random.Generator) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+                    rng: np.random.Generator,
+                    doc_map: Optional[np.ndarray] = None
+                    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """(center, context, doc_id) with dynamic window + subsampling,
-    exactly the word2vec scheme the reference's ``SkipGram.java`` uses."""
+    exactly the word2vec scheme the reference's ``SkipGram.java`` uses.
+    ``doc_map`` maps the filtered doc position to the caller's doc id."""
     centers, contexts, doc_ids = [], [], []
-    for d, ids in enumerate(docs):
+    for pos, ids in enumerate(docs):
+        d = int(doc_map[pos]) if doc_map is not None else pos
         keep = rng.random(len(ids)) < keep_prob[ids]
         ids = ids[keep]
         n = len(ids)
@@ -210,12 +220,14 @@ def _skipgram_pairs(docs: list[np.ndarray], window: int, keep_prob: np.ndarray,
 
 
 def _cbow_batches(docs: list[np.ndarray], window: int, keep_prob: np.ndarray,
-                  rng: np.random.Generator
+                  rng: np.random.Generator,
+                  doc_map: Optional[np.ndarray] = None
                   ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """(context_ids[B, 2W], context_mask, center, doc_id) for CBOW."""
     ctxs, masks, centers, doc_ids = [], [], [], []
     width = 2 * window
-    for d, ids in enumerate(docs):
+    for pos, ids in enumerate(docs):
+        d = int(doc_map[pos]) if doc_map is not None else pos
         keep = rng.random(len(ids)) < keep_prob[ids]
         ids = ids[keep]
         n = len(ids)
@@ -289,7 +301,7 @@ class Word2Vec:
         if len(vocab) < 2:
             raise ValueError("need at least 2 vocabulary words to train")
         self.vocab = vocab
-        docs = _encode_corpus(sents, self.tokenizer, vocab)
+        docs, _ = _encode_corpus(sents, self.tokenizer, vocab)
         rng = np.random.default_rng(self.seed)
         self._init_params(rng)
         self._train_docs(docs, rng, doc_vecs=None)
@@ -319,7 +331,8 @@ class Word2Vec:
 
     def _train_docs(self, docs: list[np.ndarray], rng: np.random.Generator,
                     doc_vecs: Optional[np.ndarray], dbow: bool = False,
-                    freeze_words: bool = False) -> Optional[np.ndarray]:
+                    freeze_words: bool = False,
+                    doc_map: Optional[np.ndarray] = None) -> Optional[np.ndarray]:
         """Shared trainer for Word2Vec (doc_vecs=None) and ParagraphVectors."""
         import jax
         import jax.numpy as jnp
@@ -341,9 +354,9 @@ class Word2Vec:
             dynamic windows/subsampling, and only one epoch of pairs is
             ever resident on the host)."""
             if self.cbow and not dbow:
-                batch = _cbow_batches(docs, self.window, keep, rng)
+                batch = _cbow_batches(docs, self.window, keep, rng, doc_map)
                 return batch, len(batch[2])
-            batch = _skipgram_pairs(docs, self.window, keep, rng)
+            batch = _skipgram_pairs(docs, self.window, keep, rng, doc_map)
             return batch, len(batch[0])
 
         first = make_epoch()
@@ -534,13 +547,13 @@ class ParagraphVectors(Word2Vec):
         if len(vocab) < 2:
             raise ValueError("need at least 2 vocabulary words to train")
         self.vocab = vocab
-        docs = _encode_corpus(docs_raw, self.tokenizer, vocab)
+        docs, doc_map = _encode_corpus(docs_raw, self.tokenizer, vocab)
         rng = np.random.default_rng(self.seed)
         self._init_params(rng)
         dvecs = ((rng.random((len(docs_raw), self.vector_size)) - 0.5)
                  / self.vector_size).astype(np.float32)
         self.doc_vecs = self._train_docs(docs, rng, doc_vecs=dvecs,
-                                         dbow=not self.dm)
+                                         dbow=not self.dm, doc_map=doc_map)
         return self
 
     def doc_vector(self, label: str) -> np.ndarray:
@@ -611,7 +624,7 @@ class Glove:
         if len(vocab) < 2:
             raise ValueError("need at least 2 vocabulary words to train")
         self.vocab = vocab
-        docs = _encode_corpus(sents, self.tokenizer, vocab)
+        docs, _ = _encode_corpus(sents, self.tokenizer, vocab)
 
         cooc: dict[tuple[int, int], float] = {}
         for ids in docs:

@@ -87,6 +87,7 @@ from deeplearning4j_tpu.nn.layers.extra import (
     CapsuleLayer,
     CapsuleStrengthLayer,
     RecurrentAttentionLayer,
+    MixtureOfExperts,
 )
 
 __all__ = [
@@ -111,4 +112,5 @@ __all__ = [
     "MaskZeroLayer", "GravesBidirectionalLSTM", "CenterLossOutputLayer",
     "Yolo2OutputLayer", "VariationalAutoencoder", "PrimaryCapsules",
     "CapsuleLayer", "CapsuleStrengthLayer", "RecurrentAttentionLayer",
+    "MixtureOfExperts",
 ]

@@ -970,3 +970,50 @@ class RecurrentAttentionLayer(Layer):
         if mask is not None:
             y = y * mask[..., None].astype(y.dtype)
         return y, state
+
+
+@register_layer("mixture_of_experts")
+@dataclasses.dataclass
+class MixtureOfExperts(Layer):
+    """Sparsely-gated mixture-of-experts FFN (beyond-reference capability:
+    the reference is pre-MoE — SURVEY.md §2.7).  Output dim equals input
+    dim (residual-style FFN block); single-device forward here, with the
+    expert-parallel all_to_all execution provided by
+    :func:`deeplearning4j_tpu.parallel.expert_parallel.moe_ffn` over the
+    ``expert`` mesh axis."""
+
+    n_experts: int = 4
+    hidden: int = 0          # expert FFN hidden width (default 4x input)
+    top_k: int = 2
+    capacity_factor: float = 2.0
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind not in ("ff", "rnn"):
+            raise ValueError(
+                f"MixtureOfExperts expects feed-forward or recurrent input "
+                f"(tokens over the last axis), got {input_type.kind} — add "
+                f"a GlobalPoolingLayer or DenseLayer first")
+        return input_type
+
+    def init_params(self, key, input_type):
+        from deeplearning4j_tpu.parallel.expert_parallel import init_moe_params
+        d = input_type.size if input_type.kind == "rnn" else input_type.flat_size()
+        hidden = self.hidden or 4 * d
+        return init_moe_params(key, d, hidden, self.n_experts,
+                               dtype=self._param_dtype())
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        from deeplearning4j_tpu.parallel.expert_parallel import moe_ffn_dense
+        x = self._maybe_dropout(x, train, rng)
+        act = activations.get(self.activation or "gelu")
+        shape = x.shape
+        flat = x.reshape(-1, shape[-1])
+        # high-capacity during gradcheck-sized batches is fine; capacity
+        # stays static per shape under jit
+        y = moe_ffn_dense(params, flat, top_k=min(self.top_k, self.n_experts),
+                          capacity_factor=self.capacity_factor,
+                          activation=act)
+        y = y.reshape(shape)
+        if mask is not None and y.ndim == 3:
+            y = y * mask[..., None].astype(y.dtype)
+        return y, state

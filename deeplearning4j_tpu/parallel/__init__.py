@@ -20,6 +20,8 @@ over ICI/DCN inside jit-compiled programs:
                           reference — SURVEY.md §5.7).
 - ``pipeline``          — GPipe-style microbatched stage parallelism over
                           the ``stage`` axis (beyond reference).
+- ``expert_parallel``   — mixture-of-experts FFN with all_to_all dispatch
+                          over the ``expert`` axis (beyond reference).
 - ``compression``       — threshold/bitmap gradient codec + residual
                           accumulator (EncodedGradientsAccumulator +
                           encodeThresholdP1..P3/encodeBitmap parity) for the
@@ -34,12 +36,20 @@ from deeplearning4j_tpu.parallel.mesh import make_mesh, MeshSpec
 from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
 from deeplearning4j_tpu.parallel.compression import (
     threshold_encode, threshold_decode, bitmap_encode, bitmap_decode,
+    threshold_encode_device, threshold_decode_device,
+    bitmap_encode_device, bitmap_decode_device,
     EncodedGradientsAccumulator,
 )
 from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.parallel.expert_parallel import (
+    moe_ffn, moe_ffn_dense, init_moe_params, shard_moe_params,
+)
 
 __all__ = [
     "make_mesh", "MeshSpec", "ParallelWrapper",
     "threshold_encode", "threshold_decode", "bitmap_encode", "bitmap_decode",
+    "threshold_encode_device", "threshold_decode_device",
+    "bitmap_encode_device", "bitmap_decode_device",
     "EncodedGradientsAccumulator", "ParallelInference",
+    "moe_ffn", "moe_ffn_dense", "init_moe_params", "shard_moe_params",
 ]

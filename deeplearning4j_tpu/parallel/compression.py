@@ -169,3 +169,84 @@ class EncodedGradientsAccumulator:
     def apply_update(self, message: np.ndarray, target: np.ndarray) -> np.ndarray:
         """Decode ``message`` and add into ``target`` (UpdatesConsumer parity)."""
         return threshold_decode(message, self.shape, out=target)
+
+
+# ---------------------------------------------------------------- device side
+def threshold_encode_device(grad, threshold, capacity: int):
+    """jit-safe on-device threshold encode (same wire format, fixed
+    ``capacity``): int32 [3 + capacity] = [count, flag, τ_bits, ±(idx+1)…,
+    0-padding].  The numpy/C++ decoders accept it unchanged (they read
+    ``count`` entries and ignore padding).
+
+    TPU rationale: the host/C++ codec needs the full dense gradient
+    shipped device→host BEFORE encoding; this twin runs fused inside the
+    step program (mask → compaction via XLA's sized ``nonzero`` lowering)
+    so only the small message crosses to the host for DCN transport.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    flat = jnp.ravel(grad).astype(jnp.float32)
+    threshold = jnp.asarray(threshold, jnp.float32)
+    mask = jnp.abs(flat) >= threshold
+    idx = jnp.nonzero(mask, size=capacity, fill_value=0)[0]
+    count = jnp.minimum(jnp.sum(mask), capacity).astype(jnp.int32)
+    slot = jnp.arange(capacity)
+    signs = jnp.where(flat[idx] >= 0, 1, -1).astype(jnp.int32)
+    body = jnp.where(slot < count, signs * (idx.astype(jnp.int32) + 1), 0)
+    header = jnp.stack([count, jnp.int32(FLAG_SIGN_IDX),
+                        lax.bitcast_convert_type(threshold, jnp.int32)])
+    return jnp.concatenate([header, body])
+
+
+def threshold_decode_device(message, size: int, out=None):
+    """jit-safe decode twin: adds into ``out`` (or zeros) of ``size``."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    message = jnp.asarray(message, jnp.int32)
+    count = message[0]
+    threshold = lax.bitcast_convert_type(message[2], jnp.float32)
+    body = message[3:]
+    slot = jnp.arange(body.shape[0])
+    active = (slot < count) & (body != 0)
+    idx = jnp.clip(jnp.abs(body) - 1, 0, size - 1)
+    vals = jnp.where(active,
+                     jnp.where(body > 0, threshold, -threshold), 0.0)
+    base = jnp.zeros((size,), jnp.float32) if out is None else jnp.ravel(out)
+    return base.at[idx].add(vals)
+
+
+def bitmap_encode_device(grad, threshold):
+    """jit-safe bitmap encode: same 2-bit packing as ``bitmap_encode``."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    flat = jnp.ravel(grad).astype(jnp.float32)
+    threshold = jnp.asarray(threshold, jnp.float32)
+    codes = jnp.where(flat >= threshold, 1,
+                      jnp.where(flat <= -threshold, 2, 0)).astype(jnp.uint8)
+    pad = (-flat.size) % 4
+    codes = jnp.concatenate([codes, jnp.zeros((pad,), jnp.uint8)])
+    packed = (codes[0::4] | (codes[1::4] << 2) | (codes[2::4] << 4)
+              | (codes[3::4] << 6))
+    # header values fit int32; the numpy twin uses int64 only for
+    # reference-header parity — comparisons are by value
+    header = jnp.stack([jnp.int32(flat.size),
+                        lax.bitcast_convert_type(threshold, jnp.int32)])
+    return packed, header
+
+
+def bitmap_decode_device(packed, header, size: int, out=None):
+    import jax.numpy as jnp
+    from jax import lax
+
+    threshold = lax.bitcast_convert_type(header[1].astype(jnp.int32),
+                                         jnp.float32)
+    codes = jnp.stack([packed & 0x3, (packed >> 2) & 0x3,
+                       (packed >> 4) & 0x3, (packed >> 6) & 0x3],
+                      axis=1).reshape(-1)[:size]
+    vals = jnp.where(codes == 1, threshold,
+                     jnp.where(codes == 2, -threshold, 0.0))
+    base = jnp.zeros((size,), jnp.float32) if out is None else jnp.ravel(out)
+    return base + vals

@@ -5,10 +5,12 @@ Replaces the reference's ``MeshOrganizer`` (nd4j-parameter-server
 the runtime already knows the topology; we just lay axes over it.
 
 Axis conventions (SURVEY.md §7.7):
-- ``data``  — batch sharding (DP); gradients psum over this axis.
-- ``model`` — tensor-parallel sharding of weight matrices (TP).
-- ``seq``   — sequence/context parallelism (ring attention).
-- ``stage`` — pipeline stages.
+- ``data``   — batch sharding (DP); gradients psum over this axis.
+- ``model``  — tensor-parallel sharding of weight matrices (TP).
+- ``seq``    — sequence/context parallelism (ring attention).
+- ``stage``  — pipeline stages.
+- ``expert`` — expert parallelism (MoE all_to_all dispatch); absent in
+  the reference (pre-MoE era), provided beyond-parity.
 
 Multi-slice: when devices expose ``slice_index`` (multi-slice TPU pods),
 the ``data`` axis is laid out so that intra-slice neighbors ride ICI and
@@ -32,29 +34,33 @@ class MeshSpec:
     model: int = 1
     seq: int = 1
     stage: int = 1
+    expert: int = 1
 
     def total(self) -> int:
-        return self.data * self.model * self.seq * self.stage
+        return self.data * self.model * self.seq * self.stage * self.expert
 
 
 def make_mesh(data: Optional[int] = None, model: int = 1, seq: int = 1,
-              stage: int = 1, devices: Optional[Sequence] = None) -> Mesh:
-    """Build a Mesh with axes ('data','model','seq','stage').  ``data``
-    defaults to all remaining devices.  Axis order puts ``model``/``seq``
-    innermost (fastest-varying device index = densest ICI links — TP/CP
-    traffic per step ≫ DP traffic)."""
+              stage: int = 1, expert: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh with axes ('stage','data','seq','expert','model').
+    ``data`` defaults to all remaining devices.  Axis order puts
+    ``model``/``expert``/``seq`` innermost (fastest-varying device index
+    = densest ICI links — TP/EP-all_to_all/CP traffic per step ≫ DP
+    traffic)."""
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if data is None:
-        denom = model * seq * stage
+        denom = model * seq * stage * expert
         if n % denom:
-            raise ValueError(f"{n} devices not divisible by model*seq*stage={denom}")
+            raise ValueError(
+                f"{n} devices not divisible by model*seq*stage*expert={denom}")
         data = n // denom
-    spec = MeshSpec(data, model, seq, stage)
+    spec = MeshSpec(data, model, seq, stage, expert)
     if spec.total() != n:
         raise ValueError(f"mesh {spec} needs {spec.total()} devices, have {n}")
-    arr = np.asarray(devices).reshape(stage, data, seq, model)
-    return Mesh(arr, axis_names=("stage", "data", "seq", "model"))
+    arr = np.asarray(devices).reshape(stage, data, seq, expert, model)
+    return Mesh(arr, axis_names=("stage", "data", "seq", "expert", "model"))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -1,0 +1,99 @@
+"""Device-side (jit) codec twins vs the numpy/C++ oracle
+(reference: libnd4j encodeThreshold/encodeBitmap — SURVEY §2.1; the
+device twins let the DCN path encode before leaving the chip)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.parallel.compression import (
+    bitmap_decode, bitmap_decode_device, bitmap_encode, bitmap_encode_device,
+    threshold_decode, threshold_decode_device, threshold_encode,
+    threshold_encode_device)
+
+
+def _grad(n=512, seed=0):
+    return np.random.default_rng(seed).normal(0, 0.02, n).astype(np.float32)
+
+
+def test_threshold_encode_matches_numpy_oracle():
+    g, tau = _grad(), 0.03
+    ref = threshold_encode(g, tau)
+    dev = np.asarray(threshold_encode_device(jnp.asarray(g), tau, capacity=128))
+    count = ref[0]
+    assert dev[0] == count
+    np.testing.assert_array_equal(dev[2], ref[2])           # τ bits
+    np.testing.assert_array_equal(dev[3:3 + count], ref[3:3 + count])
+    assert np.all(dev[3 + count:] == 0)                     # padding
+
+
+def test_threshold_device_roundtrip_and_interop():
+    g, tau = _grad(seed=1), 0.025
+    msg_dev = threshold_encode_device(jnp.asarray(g), tau, capacity=256)
+    # device decode of device message
+    dec_dev = np.asarray(threshold_decode_device(msg_dev, g.size))
+    # numpy decode of the device message (wire interop)
+    dec_np = threshold_decode(np.asarray(msg_dev), (g.size,))
+    np.testing.assert_allclose(dec_dev, dec_np, atol=0)
+    # ±τ exactly at the hit positions
+    hits = np.abs(g) >= tau
+    np.testing.assert_allclose(dec_dev[hits], np.sign(g[hits]) * tau,
+                               atol=1e-7)
+    assert np.all(dec_dev[~hits] == 0)
+
+
+def test_threshold_capacity_truncates():
+    g = np.ones(64, np.float32)
+    msg = np.asarray(threshold_encode_device(jnp.asarray(g), 0.5, capacity=10))
+    assert msg[0] == 10
+    assert np.count_nonzero(msg[3:]) == 10
+
+
+def test_threshold_encode_decode_jit_fused():
+    g, tau = _grad(seed=2), 0.03
+
+    @jax.jit
+    def wire(g):
+        msg = threshold_encode_device(g, tau, capacity=128)
+        return threshold_decode_device(msg, g.size)
+
+    dec = np.asarray(wire(jnp.asarray(g)))
+    ref = threshold_decode(threshold_encode(g, tau), (g.size,))
+    np.testing.assert_allclose(dec, ref, atol=1e-7)
+
+
+def test_threshold_decode_accumulates_into_out():
+    g, tau = _grad(seed=3), 0.03
+    msg = threshold_encode_device(jnp.asarray(g), tau, capacity=128)
+    base = jnp.ones((g.size,), jnp.float32)
+    acc = np.asarray(threshold_decode_device(msg, g.size, out=base))
+    ref = 1.0 + threshold_decode(np.asarray(msg), (g.size,))
+    np.testing.assert_allclose(acc, ref, atol=1e-7)
+
+
+def test_bitmap_device_matches_numpy():
+    g, tau = _grad(seed=4), 0.02
+    p_ref, h_ref = bitmap_encode(g, tau)
+    p_dev, h_dev = bitmap_encode_device(jnp.asarray(g), tau)
+    np.testing.assert_array_equal(np.asarray(p_dev), p_ref)
+    np.testing.assert_array_equal(np.asarray(h_dev), h_ref)
+    dec_dev = np.asarray(bitmap_decode_device(p_dev, h_dev, g.size))
+    dec_ref = bitmap_decode(p_ref, h_ref)
+    np.testing.assert_allclose(dec_dev, dec_ref, atol=0)
+
+
+def test_bitmap_jit_roundtrip_unaligned_size():
+    g = _grad(n=509, seed=5)   # not a multiple of 4 — padding path
+    tau = 0.02
+
+    @jax.jit
+    def wire(g):
+        p, h = bitmap_encode_device(g, tau)
+        return bitmap_decode_device(p, h, g.size)
+
+    dec = np.asarray(wire(jnp.asarray(g)))
+    hits_pos = g >= tau
+    hits_neg = g <= -tau
+    np.testing.assert_allclose(dec[hits_pos], tau, atol=1e-7)
+    np.testing.assert_allclose(dec[hits_neg], -tau, atol=1e-7)
+    assert np.all(dec[~(hits_pos | hits_neg)] == 0)

@@ -138,6 +138,24 @@ def test_paragraph_vectors_doc_structure(dm):
     assert same > diff + 0.1, (same, diff)
 
 
+def test_paragraph_vectors_short_doc_keeps_label_alignment():
+    """Docs with <2 in-vocab tokens are skipped for training but must NOT
+    shift later documents' doc-vector rows."""
+    docs = ["cat dog cat dog cat dog"] * 6 + ["zzz"] + ["cpu gpu cpu gpu cpu gpu"] * 6
+    labels = [f"a{i}" for i in range(6)] + ["junk"] + [f"t{i}" for i in range(6)]
+    m = ParagraphVectors(dm=True, vector_size=12, window=2, epochs=10,
+                         seed=4, sample=0.0, min_count=2)
+    m.fit(docs, labels)
+    d = m.doc_vecs / np.linalg.norm(m.doc_vecs, axis=1, keepdims=True)
+    # tech doc rows (after the dropped doc) must cluster with each other,
+    # not with the animal docs — misalignment would mix them
+    tech = [labels.index(f"t{i}") for i in range(6)]
+    animal = [labels.index(f"a{i}") for i in range(6)]
+    t_sim = np.mean([d[i] @ d[j] for i in tech for j in tech if i != j])
+    cross = np.mean([d[i] @ d[j] for i in tech for j in animal])
+    assert t_sim > cross, (t_sim, cross)
+
+
 def test_paragraph_vectors_infer_vector():
     docs = _two_topic_corpus(60)
     model = ParagraphVectors(dm=True, vector_size=24, window=3, epochs=20,

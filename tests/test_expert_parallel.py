@@ -1,0 +1,150 @@
+"""Expert-parallel MoE tests.
+
+No reference anchor — the reference (pre-MoE era) ships DP only
+(SURVEY.md §2.7); expert parallelism is beyond-parity TPU capability.
+Tests mirror the strategy used for TP/PP/SP: sharded path must equal
+the dense single-device oracle on the 8-device virtual mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel.expert_parallel import (
+    init_moe_params, moe_ffn, moe_ffn_dense, shard_moe_params,
+    _dispatch_tensors, _top_k_gates)
+from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+
+D, H, E = 8, 16, 4
+
+
+def _params(seed=0, dtype=jnp.float32):
+    return init_moe_params(jax.random.key(seed), D, H, E, dtype)
+
+
+class TestGatingDispatch:
+    def test_top_k_weights_normalized(self):
+        logits = jnp.asarray(np.random.default_rng(0).normal(size=(10, E)),
+                             jnp.float32)
+        w, idx = _top_k_gates(logits, 2)
+        np.testing.assert_allclose(np.asarray(w.sum(-1)), 1.0, atol=1e-6)
+        assert np.all(np.asarray(idx) < E)
+        # the two selected experts are distinct
+        assert np.all(np.asarray(idx[:, 0] != idx[:, 1]))
+
+    def test_capacity_positions_unique(self):
+        """No two (token, slot) routings may share an (expert, position)
+        capacity cell — including across gate slots."""
+        rng = np.random.default_rng(1)
+        logits = jnp.asarray(rng.normal(size=(16, E)), jnp.float32)
+        gates, idx = _top_k_gates(logits, 2)
+        combine, dispatch = _dispatch_tensors(gates, idx, E, capacity=16)
+        # each capacity cell used at most once
+        cell_use = np.asarray(dispatch).sum(axis=0)        # [E, C]
+        assert cell_use.max() <= 1.0
+        # with ample capacity nothing is dropped: every token contributes
+        # weight 1 total
+        np.testing.assert_allclose(np.asarray(combine).sum(axis=(1, 2)),
+                                   1.0, atol=1e-5)
+
+    def test_capacity_drops_over_limit(self):
+        # all tokens route to expert 0 (logits force it)
+        logits = jnp.tile(jnp.asarray([[10.0, 0, 0, 0]]), (8, 1))
+        gates, idx = _top_k_gates(logits, 1)
+        combine, dispatch = _dispatch_tensors(gates, idx, E, capacity=3)
+        assert float(np.asarray(dispatch).sum()) == 3.0     # only 3 kept
+
+
+class TestDenseOracle:
+    def test_output_shape_and_finite(self):
+        params = _params()
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(24, D)),
+                        jnp.float32)
+        y = moe_ffn_dense(params, x, top_k=2, capacity_factor=float(E))
+        assert y.shape == (24, D)
+        assert np.all(np.isfinite(np.asarray(y)))
+
+    def test_matches_manual_expert_mix(self):
+        """With ample capacity, each token's output must equal the
+        gate-weighted sum of its top-k experts' FFNs."""
+        params = _params(3)
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.normal(size=(12, D)), jnp.float32)
+        y = np.asarray(moe_ffn_dense(params, x, top_k=2,
+                                     capacity_factor=float(E)))
+        logits = np.asarray(x @ params["gate"])
+        gates, idx = _top_k_gates(jnp.asarray(logits), 2)
+        gates, idx = np.asarray(gates), np.asarray(idx)
+
+        def expert(e, xi):
+            h = jax.nn.gelu(xi @ params["w_in"][e] + params["b_in"][e])
+            return np.asarray(h @ params["w_out"][e] + params["b_out"][e])
+
+        for t in range(12):
+            ref = sum(gates[t, s] * expert(idx[t, s], x[t]) for s in range(2))
+            np.testing.assert_allclose(y[t], ref, atol=1e-5)
+
+
+class TestExpertParallel:
+    def test_sharded_matches_dense(self):
+        params = _params(7)
+        mesh = make_mesh(data=1, expert=4,
+                         devices=jax.devices()[:4])
+        rng = np.random.default_rng(5)
+        x = jnp.asarray(rng.normal(size=(32, D)), jnp.float32)
+        dense = moe_ffn_dense(params, x, top_k=2, capacity_factor=float(E))
+        sharded_params = shard_moe_params(params, mesh)
+        with mesh:
+            ep = moe_ffn(sharded_params, x, mesh, top_k=2,
+                         capacity_factor=float(E))
+        np.testing.assert_allclose(np.asarray(ep), np.asarray(dense),
+                                   rtol=2e-4, atol=1e-5)
+
+    def test_dp_x_ep_matches_dense(self):
+        params = _params(9)
+        mesh = make_mesh(data=2, expert=4, devices=jax.devices()[:8])
+        rng = np.random.default_rng(6)
+        x = jnp.asarray(rng.normal(size=(48, D)), jnp.float32)
+        dense = moe_ffn_dense(params, x, top_k=1, capacity_factor=float(E))
+        sharded_params = shard_moe_params(params, mesh)
+        with mesh:
+            ep = moe_ffn(sharded_params, x, mesh, data_axis="data", top_k=1,
+                         capacity_factor=float(E))
+        np.testing.assert_allclose(np.asarray(ep), np.asarray(dense),
+                                   rtol=2e-4, atol=1e-5)
+
+    def test_gradients_flow_through_all_to_all(self):
+        params = _params(11)
+        mesh = make_mesh(data=1, expert=4, devices=jax.devices()[:4])
+        rng = np.random.default_rng(7)
+        x = jnp.asarray(rng.normal(size=(16, D)), jnp.float32)
+        sharded_params = shard_moe_params(params, mesh)
+
+        def loss(p):
+            y = moe_ffn(p, x, mesh, top_k=2, capacity_factor=float(E))
+            return jnp.mean(y * y)
+
+        with mesh:
+            g = jax.jit(jax.grad(loss))(sharded_params)
+        flat = jax.tree_util.tree_leaves(g)
+        assert all(np.all(np.isfinite(np.asarray(l))) for l in flat)
+        assert any(float(jnp.abs(l).max()) > 0 for l in flat)
+
+    def test_validation_errors(self):
+        params = _params()
+        mesh = make_mesh(data=1, expert=8, devices=jax.devices()[:8])
+        x = jnp.zeros((16, D))
+        with pytest.raises(ValueError, match="not divisible"):
+            with mesh:
+                moe_ffn(params, x, mesh)   # E=4 experts on ep=8
+
+    def test_mesh_without_expert_axis_falls_back_to_dense(self):
+        params = _params()
+        mesh = make_mesh(data=4, devices=jax.devices()[:4])
+        x = jnp.asarray(np.random.default_rng(1).normal(size=(8, D)),
+                        jnp.float32)
+        with mesh:
+            y = moe_ffn(params, x, mesh)
+        ref = moe_ffn_dense(params, x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-6)

@@ -310,6 +310,13 @@ LAYER_CASES = {
     "recurrent_attention": ([RecurrentAttentionLayer(n_out=4, activation="tanh"),
                              RNN_OUT()],
                             InputType.recurrent(3, 5), lambda: _rnn_batch(3, 3)),
+    # generous capacity: no token drops, so routing is locally constant
+    # and the loss is differentiable at the sampled inputs
+    "mixture_of_experts": ([MixtureOfExperts(n_experts=3, hidden=6, top_k=2,
+                                             capacity_factor=3.0,
+                                             activation="tanh"),
+                            FF_OUT()],
+                           InputType.feed_forward(4), lambda: _ff_batch(4, 3)),
 }
 
 

@@ -227,6 +227,42 @@ def test_pool_auto_pad_same_upper():
                                               [21, 23, 24]])
 
 
+def test_maxpool_ceil_mode():
+    """ceil_mode=1: output size is ceil((size-k)/s)+1 — 6→3 for k=3,s=2
+    (floor mode gives 2; the last window is partial)."""
+    buf = _model_bytes(
+        nodes=[_node("MaxPool", ["x"], ["y"], kernel_shape=[3, 3],
+                     strides=[2, 2], ceil_mode=1)],
+        initializers={}, inputs={"x": [1, 1, 6, 6]},
+        outputs={"y": [1, 1, 3, 3]})
+    x = np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6)
+    got = np.asarray(import_onnx_model(buf)(x))
+    assert got.shape == (1, 1, 3, 3)
+    assert got[0, 0, -1, -1] == 35.0    # partial corner window max
+    # floor mode on the same input: 2x2
+    buf2 = _model_bytes(
+        nodes=[_node("MaxPool", ["x"], ["y"], kernel_shape=[3, 3],
+                     strides=[2, 2])],
+        initializers={}, inputs={"x": [1, 1, 6, 6]},
+        outputs={"y": [1, 1, 2, 2]})
+    assert np.asarray(import_onnx_model(buf2)(x)).shape == (1, 1, 2, 2)
+
+
+def test_avgpool_count_include_pad_with_ceil():
+    """count_include_pad=1 counts explicit pad cells but not ceil
+    overhang: k=2,s=2,pads=[1,0],ceil on [1,1,4] → windows (pad,x0),
+    (x1,x2), (x3,ceil) with denominators 2,2,1."""
+    buf = _model_bytes(
+        nodes=[_node("AveragePool", ["x"], ["y"], kernel_shape=[2],
+                     strides=[2], pads=[1, 0], ceil_mode=1,
+                     count_include_pad=1)],
+        initializers={}, inputs={"x": [1, 1, 4]}, outputs={"y": [1, 1, 3]})
+    x = np.asarray([[[2.0, 4.0, 6.0, 8.0]]], np.float32)
+    got = np.asarray(import_onnx_model(buf)(x))
+    np.testing.assert_allclose(got[0, 0], [(0 + 2) / 2, (4 + 6) / 2, 8 / 1],
+                               atol=1e-6)
+
+
 def test_reshape_zero_copies_input_dim():
     shape = np.asarray([0, -1], np.int64)
     buf = _model_bytes(

@@ -45,7 +45,8 @@ def test_make_mesh_axes():
     mesh = make_mesh()
     assert mesh.shape["data"] == 8
     mesh2 = make_mesh(data=2, model=2, seq=2)
-    assert mesh2.shape == {"stage": 1, "data": 2, "seq": 2, "model": 2}
+    assert mesh2.shape == {"stage": 1, "data": 2, "seq": 2, "expert": 1,
+                           "model": 2}
     with pytest.raises(ValueError):
         make_mesh(data=3, model=3)
 
