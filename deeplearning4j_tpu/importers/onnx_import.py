@@ -119,6 +119,12 @@ def _pool_args(attrs, x):
         for d in range(len(k)):
             size = x.shape[2 + d] + pads[d][0] + pads[d][1]
             out_ceil = -(-(size - k[d]) // s[d]) + 1
+            # ONNX rule: the last window must START inside the
+            # data+explicit-pad extent — a window living entirely in the
+            # ceil overhang is dropped (onnxruntime parity; otherwise
+            # MaxPool emits -inf rows and AveragePool divides by zero)
+            if (out_ceil - 1) * s[d] >= size:
+                out_ceil -= 1
             ceil_extra[d] = max((out_ceil - 1) * s[d] + k[d] - size, 0)
     return k, s, pads, ceil_extra
 
@@ -201,8 +207,9 @@ def _avgpool(inputs, attrs):
     summed = lax.reduce_window(
         x, 0.0, lax.add, (1, 1) + tuple(k), (1, 1) + tuple(s),
         [(0, 0), (0, 0)] + window_pads)
-    if all(p == (0, 0) for p in window_pads):
-        return summed / np.prod(k)
+    if all(p == (0, 0) for p in window_pads) or (
+            attrs.get("count_include_pad", 0) and not any(extra)):
+        return summed / np.prod(k)   # constant denominator
     # denominator: data cells always; explicit pad cells only when
     # count_include_pad=1; ceil-overhang cells never (ONNX semantics)
     if attrs.get("count_include_pad", 0):

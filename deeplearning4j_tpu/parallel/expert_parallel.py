@@ -67,20 +67,25 @@ def _dispatch_tensors(gates, top_idx, n_experts, capacity):
     """
     n, k = top_idx.shape
     combine = jnp.zeros((n, n_experts, capacity), gates.dtype)
+    # Rank bookkeeping runs in int32 regardless of the activation dtype:
+    # under a bf16 policy a cumsum in gates.dtype would stop representing
+    # ranks past 256 and distinct tokens would silently collide in the
+    # same capacity cell.
     # per-expert slots already claimed by earlier gate slots — without
     # this offset a slot-0 token and a slot-1 token routed to the same
     # expert could collide in the same capacity position
-    claimed = jnp.zeros((n_experts,), gates.dtype)
+    claimed = jnp.zeros((n_experts,), jnp.int32)
     for slot in range(k):   # k is tiny (1 or 2) — unrolled at trace time
-        onehot = jax.nn.one_hot(top_idx[:, slot], n_experts,
-                                dtype=gates.dtype)          # [N, E]
-        rank = jnp.cumsum(onehot, axis=0) - onehot + claimed[None, :]
-        pos = jnp.sum(rank * onehot, axis=1).astype(jnp.int32)  # [N]
+        onehot_i = jax.nn.one_hot(top_idx[:, slot], n_experts,
+                                  dtype=jnp.int32)          # [N, E]
+        rank = jnp.cumsum(onehot_i, axis=0) - onehot_i + claimed[None, :]
+        pos = jnp.sum(rank * onehot_i, axis=1)              # [N] int32
         keep = (pos < capacity).astype(gates.dtype)
+        onehot = onehot_i.astype(gates.dtype)
         cap_onehot = jax.nn.one_hot(pos, capacity, dtype=gates.dtype)  # [N, C]
         combine = combine + (gates[:, slot:slot + 1] * keep[:, None]
                              )[:, :, None] * onehot[:, :, None] * cap_onehot[:, None, :]
-        claimed = claimed + onehot.sum(axis=0)
+        claimed = claimed + onehot_i.sum(axis=0)
     dispatch = (combine > 0).astype(gates.dtype)
     return combine, dispatch
 

@@ -1,0 +1,5 @@
+"""Runnable examples — parity with the reference's ``dl4j-examples``
+gallery: each script is a small end-to-end workflow on the public API,
+with fast synthetic-data defaults so they run anywhere (pass bigger
+sizes / real data roots for real runs).  Smoke-tested in
+``tests/test_examples.py``."""

@@ -1,0 +1,70 @@
+"""Character-level text generation with a GravesLSTM + tBPTT
+(dl4j-examples ``CharacterIterator`` / ``LSTMCharModellingExample``):
+train on a corpus, then sample with ``rnn_time_step`` streaming state."""
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.models import text_gen_lstm
+
+DEFAULT_CORPUS = (
+    "the quick brown fox jumps over the lazy dog. "
+    "pack my box with five dozen liquor jugs. "
+    "how vexingly quick daft zebras jump. "
+) * 20
+
+
+def _char_batches(text: str, seq_len: int, batch_size: int):
+    chars = sorted(set(text))
+    idx = {c: i for i, c in enumerate(chars)}
+    ids = np.array([idx[c] for c in text], np.int64)
+    v = len(chars)
+    n_seq = (len(ids) - 1) // seq_len
+    xs = np.zeros((n_seq, seq_len, v), np.float32)
+    ys = np.zeros((n_seq, seq_len, v), np.float32)
+    for s in range(n_seq):
+        seg = ids[s * seq_len:(s + 1) * seq_len + 1]
+        xs[s, np.arange(seq_len), seg[:-1]] = 1.0
+        ys[s, np.arange(seq_len), seg[1:]] = 1.0
+    batches = [DataSet(xs[i:i + batch_size], ys[i:i + batch_size])
+               for i in range(0, n_seq, batch_size)]
+    return ListDataSetIterator(batches), chars
+
+
+def sample(net, chars, prime: str = "the ", length: int = 80,
+           temperature: float = 0.8, seed: int = 0) -> str:
+    rng = np.random.default_rng(seed)
+    idx = {c: i for i, c in enumerate(chars)}
+    v = len(chars)
+    net.rnn_clear_previous_state()
+    out = list(prime)
+    x = np.zeros((1, len(prime), v), np.float32)
+    x[0, np.arange(len(prime)), [idx[c] for c in prime]] = 1.0
+    probs = np.asarray(net.rnn_time_step(x))[0, -1]
+    for _ in range(length):
+        logits = np.log(np.maximum(probs, 1e-9)) / temperature
+        p = np.exp(logits - logits.max())
+        p /= p.sum()
+        c = rng.choice(v, p=p)
+        out.append(chars[c])
+        step = np.zeros((1, v), np.float32)
+        step[0, c] = 1.0
+        probs = np.asarray(net.rnn_time_step(step))[0]
+    return "".join(out)
+
+
+def main(epochs: int = 3, seq_len: int = 32, batch_size: int = 16,
+         hidden: int = 64, corpus: str = DEFAULT_CORPUS, verbose: bool = True):
+    it, chars = _char_batches(corpus, seq_len, batch_size)
+    net = text_gen_lstm(vocab_size=len(chars), hidden=hidden,
+                        timesteps=seq_len, layers=1).init()
+    net.fit(it, epochs=epochs)
+    text = sample(net, chars, length=60)
+    if verbose:
+        print(repr(text))
+    return text
+
+
+if __name__ == "__main__":
+    main()

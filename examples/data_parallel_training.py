@@ -1,0 +1,49 @@
+"""Data-parallel training over a device mesh
+(dl4j-examples ``ParallelWrapper`` / Spark gradient-sharing examples —
+here the allreduce is a dense psum over the mesh's ``data`` axis).
+
+Runs on whatever devices jax sees: 1 TPU chip (mesh of 1), or the
+8-virtual-device CPU mesh used in tests
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu``).
+"""
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import ParallelWrapper, make_mesh
+from deeplearning4j_tpu.train import Adam
+
+
+def main(epochs: int = 2, global_batch: int = 64, verbose: bool = True):
+    n_dev = len(jax.devices())
+    mesh = make_mesh(data=n_dev)
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(5e-3)).list()
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(12)).build())
+    net = MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(512, 12)).astype(np.float32)
+    w = rng.normal(size=(12, 4)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[np.argmax(x @ w, -1)]
+    it = ListDataSetIterator(
+        [DataSet(x[i:i + global_batch], y[i:i + global_batch])
+         for i in range(0, 512, global_batch)])
+
+    trainer = ParallelWrapper(net, mesh=mesh)
+    trainer.fit(it, epochs=epochs)
+    acc = net.evaluate(it).accuracy()
+    if verbose:
+        print(f"dp={n_dev} devices, accuracy {acc:.3f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""Early stopping — stop on validation-score plateau and restore the best
+model (dl4j-examples ``EarlyStoppingMNIST``)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.train import Adam
+from deeplearning4j_tpu.train.early_stopping import (
+    DataSetLossCalculator, EarlyStoppingConfiguration, EarlyStoppingTrainer,
+    MaxEpochsTerminationCondition, ScoreImprovementEpochTerminationCondition)
+
+
+def _iter(n, seed, batch=32):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 10)).astype(np.float32)
+    w = rng.normal(size=(10, 3)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np.argmax(x @ w, -1)]
+    return ListDataSetIterator([DataSet(x[i:i + batch], y[i:i + batch])
+                                for i in range(0, n, batch)])
+
+
+def main(max_epochs: int = 20, patience: int = 3, verbose: bool = True):
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(5e-3)).list()
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(10)).build())
+    net = MultiLayerNetwork(conf).init()
+
+    es_conf = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(_iter(96, seed=1)),
+        epoch_termination_conditions=[
+            MaxEpochsTerminationCondition(max_epochs),
+            ScoreImprovementEpochTerminationCondition(patience)],
+    )
+    result = EarlyStoppingTrainer(es_conf, net, _iter(256, seed=0)).fit()
+    if verbose:
+        print(f"stopped at epoch {result.total_epochs} "
+              f"(best epoch {result.best_model_epoch}, "
+              f"best score {result.best_model_score:.4f}): "
+              f"{result.termination_reason}")
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""LeNet on CIFAR-10 — conv-net training
+(dl4j-examples ``LeNetMNIST`` / ``Cifar10Classification``)."""
+
+from deeplearning4j_tpu.data import datasets
+from deeplearning4j_tpu.models import lenet
+
+
+def main(epochs: int = 1, batch_size: int = 128, n_synthetic: int = 2000,
+         verbose: bool = True):
+    net = lenet(height=32, width=32, channels=3, num_classes=10).init()
+    train = datasets.cifar10(batch_size=batch_size, train=True,
+                             n_synthetic=n_synthetic)
+    test = datasets.cifar10(batch_size=256, train=False,
+                            n_synthetic=n_synthetic)
+    net.fit(train, epochs=epochs)
+    ev = net.evaluate(test)
+    if verbose:
+        print(ev.stats())
+    return ev.accuracy()
+
+
+if __name__ == "__main__":
+    main()

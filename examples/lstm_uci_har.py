@@ -1,0 +1,23 @@
+"""LSTM sequence classification on UCI-HAR
+(dl4j-examples ``UCISequenceClassification``)."""
+
+from deeplearning4j_tpu.data import datasets
+from deeplearning4j_tpu.models import lstm_classifier
+
+
+def main(epochs: int = 2, batch_size: int = 64, n_synthetic: int = 1200,
+         verbose: bool = True):
+    net = lstm_classifier().init()
+    train = datasets.uci_har(batch_size=batch_size, train=True,
+                             n_synthetic=n_synthetic)
+    test = datasets.uci_har(batch_size=128, train=False,
+                            n_synthetic=n_synthetic)
+    net.fit(train, epochs=epochs)
+    ev = net.evaluate(test)
+    if verbose:
+        print(ev.stats())
+    return ev.accuracy()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""MLP on MNIST — the canonical first example
+(dl4j-examples ``MLPMnistSingleLayerExample``)."""
+
+from deeplearning4j_tpu.data import datasets
+from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.obs import ScoreIterationListener
+from deeplearning4j_tpu.train import Adam
+
+
+def main(epochs: int = 2, batch_size: int = 128, hidden: int = 256,
+         n_synthetic: int = 6000, verbose: bool = True):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(42)
+            .updater(Adam(1e-3))
+            .list()
+            .layer(DenseLayer(n_out=hidden, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(784))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+
+    train = datasets.mnist(batch_size=batch_size, train=True,
+                           n_synthetic=n_synthetic)
+    test = datasets.mnist(batch_size=256, train=False,
+                          n_synthetic=n_synthetic)
+    listeners = [ScoreIterationListener(10)] if verbose else None
+    net.fit(train, epochs=epochs, listeners=listeners)
+
+    ev = net.evaluate(test)
+    if verbose:
+        print(ev.stats())
+    return ev.accuracy()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Training observability — per-layer stats into a storage, a static HTML
+report, and the live dashboard server
+(dl4j-examples ``UIExample``: ``UIServer.getInstance().attach(storage)``)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.obs import (InMemoryStatsStorage, StatsListener,
+                                    UIServer, render_html_report)
+from deeplearning4j_tpu.train import Adam
+
+
+def main(epochs: int = 3, report_path: str = "/tmp/training_report.html",
+         serve: bool = False, verbose: bool = True):
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(5e-3)).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    net = MultiLayerNetwork(conf).init()
+
+    storage = InMemoryStatsStorage()
+    server = None
+    if serve:
+        server = UIServer.get_instance()
+        server.attach(storage)
+        if verbose:
+            print("dashboard at", server.url)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 3)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np.argmax(x @ w, -1)]
+    it = ListDataSetIterator([DataSet(x[i:i + 32], y[i:i + 32])
+                              for i in range(0, 256, 32)])
+    net.fit(it, epochs=epochs, listeners=[StatsListener(storage, frequency=2)])
+
+    out = render_html_report(storage, report_path)
+    if verbose:
+        print("report written to", out)
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Transfer learning — pretrain a small conv net, then graft a new output
+head, freeze the feature extractor, and fine-tune on a new task
+(dl4j-examples ``TransferLearning`` / ``EditLastLayerOthersFrozen``)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.models import lenet
+from deeplearning4j_tpu.nn.layers import OutputLayer
+from deeplearning4j_tpu.nn.transfer import (FineTuneConfiguration,
+                                            TransferLearning)
+from deeplearning4j_tpu.train import Adam
+
+
+def _batches(n, classes, seed, batch=32):
+    rng = np.random.default_rng(seed)
+    xs = rng.normal(size=(n, 28, 28, 1)).astype(np.float32)
+    ys = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, n)]
+    return ListDataSetIterator(
+        [DataSet(xs[i:i + batch], ys[i:i + batch])
+         for i in range(0, n, batch)])
+
+
+def main(pretrain_epochs: int = 1, finetune_epochs: int = 1,
+         new_classes: int = 5, verbose: bool = True):
+    base = lenet(num_classes=10).init()
+    base.fit(_batches(128, 10, seed=0), epochs=pretrain_epochs)
+
+    new_net = (TransferLearning.builder(base)
+               .fine_tune_configuration(FineTuneConfiguration(updater=Adam(1e-3)))
+               .set_feature_extractor(3)          # freeze everything below
+               .remove_output_layer()
+               .add_layer(OutputLayer(n_out=new_classes, activation="softmax",
+                                      loss="mcxent"))
+               .build())
+    frozen_before = [np.asarray(p) for p in
+                     np.asarray(new_net.params_[0]["W"], dtype=np.float32)]
+    new_net.fit(_batches(128, new_classes, seed=1), epochs=finetune_epochs)
+    frozen_after = np.asarray(new_net.params_[0]["W"], dtype=np.float32)
+    if verbose:
+        unchanged = np.allclose(np.asarray(frozen_before), frozen_after)
+        print(f"feature extractor unchanged: {unchanged}")
+    return new_net
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Word2Vec embeddings — train skip-gram vectors and query neighbors
+(dl4j-examples ``Word2VecRawTextExample``)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp import Word2Vec
+
+
+def _corpus(n=120, seed=0):
+    rng = np.random.default_rng(seed)
+    animals = ["cat", "dog", "horse", "sheep", "cow"]
+    tech = ["cpu", "gpu", "tpu", "ram", "disk"]
+    return [" ".join(rng.choice(animals if i % 2 == 0 else tech, 6))
+            for i in range(n)]
+
+
+def main(epochs: int = 10, vector_size: int = 32, verbose: bool = True,
+         corpus=None):
+    model = Word2Vec(vector_size=vector_size, window=3, negative=5,
+                     epochs=epochs, sample=0.0, seed=1)
+    model.fit(corpus or _corpus())
+    if verbose:
+        print("nearest(cat):", model.words_nearest("cat", 4))
+        print("sim(cat,dog) =", round(model.similarity("cat", "dog"), 3),
+              " sim(cat,gpu) =", round(model.similarity("cat", "gpu"), 3))
+    return model
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Smoke tests for the examples gallery (dl4j-examples parity): every
+example must run end-to-end at tiny sizes on the test mesh."""
+
+import numpy as np
+import pytest
+
+from examples import (bert_mlm_finetune, char_rnn_textgen,
+                      data_parallel_training, early_stopping, lenet_cifar10,
+                      lstm_uci_har, mlp_mnist, training_dashboard,
+                      transfer_learning, word2vec_embeddings)
+
+
+def test_mlp_mnist_example():
+    acc = mlp_mnist.main(epochs=1, batch_size=64, hidden=32,
+                         n_synthetic=512, verbose=False)
+    assert acc > 0.5
+
+
+def test_lenet_cifar10_example():
+    acc = lenet_cifar10.main(epochs=1, batch_size=64, n_synthetic=256,
+                             verbose=False)
+    assert 0.0 <= acc <= 1.0
+
+
+def test_lstm_uci_har_example():
+    acc = lstm_uci_har.main(epochs=1, batch_size=32, n_synthetic=128,
+                            verbose=False)
+    assert 0.0 <= acc <= 1.0
+
+
+def test_char_rnn_example_generates_text():
+    text = char_rnn_textgen.main(epochs=1, seq_len=16, batch_size=8,
+                                 hidden=24, verbose=False)
+    assert isinstance(text, str) and len(text) > 60
+
+
+def test_bert_finetune_example_loss_decreases():
+    losses = bert_mlm_finetune.main(epochs=3, seq_len=16, batch_size=8,
+                                    verbose=False)
+    assert losses[-1] < losses[0]
+
+
+def test_transfer_learning_example_freezes_base():
+    net = transfer_learning.main(pretrain_epochs=1, finetune_epochs=1,
+                                 verbose=False)
+    assert net.conf.layers[-1].n_out == 5
+
+
+def test_early_stopping_example_stops_and_restores():
+    result = early_stopping.main(max_epochs=8, patience=2, verbose=False)
+    assert result.total_epochs <= 8
+    assert np.isfinite(result.best_model_score)
+
+
+def test_data_parallel_example():
+    acc = data_parallel_training.main(epochs=2, verbose=False)
+    assert acc > 0.5
+
+
+def test_word2vec_example():
+    model = word2vec_embeddings.main(epochs=8, vector_size=16, verbose=False)
+    assert model.similarity("cat", "dog") > model.similarity("cat", "gpu")
+
+
+def test_dashboard_example_writes_report(tmp_path):
+    out = training_dashboard.main(epochs=2,
+                                  report_path=str(tmp_path / "r.html"),
+                                  verbose=False)
+    html = open(out).read()
+    assert "Score (loss)" in html and "histogram" in html.lower()

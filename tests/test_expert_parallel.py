@@ -48,6 +48,19 @@ class TestGatingDispatch:
         np.testing.assert_allclose(np.asarray(combine).sum(axis=(1, 2)),
                                    1.0, atol=1e-5)
 
+    def test_ranks_exact_in_bf16_policy(self):
+        """Rank bookkeeping must be int even when gates are bf16 — a
+        bf16 cumsum cannot represent ranks past 256 and tokens would
+        collide in capacity cells."""
+        n = 600   # > 256 tokens all routed to one expert
+        logits = jnp.tile(jnp.asarray([[10.0, 0, 0, 0]], jnp.bfloat16),
+                          (n, 1))
+        gates, idx = _top_k_gates(logits, 1)
+        combine, dispatch = _dispatch_tensors(gates, idx, E, capacity=n)
+        cell_use = np.asarray(dispatch, np.float32).sum(axis=0)
+        assert cell_use.max() <= 1.0          # no collisions
+        assert float(np.asarray(dispatch, np.float32).sum()) == n
+
     def test_capacity_drops_over_limit(self):
         # all tokens route to expert 0 (logits force it)
         logits = jnp.tile(jnp.asarray([[10.0, 0, 0, 0]]), (8, 1))

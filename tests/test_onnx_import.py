@@ -248,6 +248,20 @@ def test_maxpool_ceil_mode():
     assert np.asarray(import_onnx_model(buf2)(x)).shape == (1, 1, 2, 2)
 
 
+def test_ceil_mode_drops_window_in_overhang():
+    """A window starting entirely past the input (stride > kernel) is
+    dropped, onnxruntime-style — not emitted as -inf."""
+    buf = _model_bytes(
+        nodes=[_node("MaxPool", ["x"], ["y"], kernel_shape=[1, 1],
+                     strides=[2, 2], ceil_mode=1)],
+        initializers={}, inputs={"x": [1, 1, 4, 4]},
+        outputs={"y": [1, 1, 2, 2]})
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    got = np.asarray(import_onnx_model(buf)(x))
+    assert got.shape == (1, 1, 2, 2)
+    assert np.all(np.isfinite(got))
+
+
 def test_avgpool_count_include_pad_with_ceil():
     """count_include_pad=1 counts explicit pad cells but not ceil
     overhang: k=2,s=2,pads=[1,0],ceil on [1,1,4] → windows (pad,x0),
