@@ -120,8 +120,107 @@ def _convert_layer(kcfg: dict):
                "GlobalAveragePooling1D", "GlobalMaxPooling1D"):
         return GlobalPoolingLayer(name=name,
                                   pooling_type="avg" if "Average" in cls else "max")
+    if cls == "Conv1D":
+        from deeplearning4j_tpu.nn.layers import Convolution1DLayer
+        if conf.get("padding") == "causal":
+            raise KeyError("unsupported Keras Conv1D padding='causal' "
+                           "(left-pad semantics not converted)")
+        return Convolution1DLayer(
+            name=name, n_out=conf["filters"],
+            kernel_size=(_one(conf["kernel_size"]),),
+            stride=(_one(conf.get("strides", 1)),),
+            convolution_mode="same" if conf.get("padding") == "same" else "truncate",
+            activation=_act(conf.get("activation")),
+            has_bias=conf.get("use_bias", True))
+    if cls in ("MaxPooling1D", "AveragePooling1D"):
+        from deeplearning4j_tpu.nn.layers import Subsampling1DLayer
+        return Subsampling1DLayer(
+            name=name, pooling_type="max" if cls == "MaxPooling1D" else "avg",
+            kernel_size=(_one(conf.get("pool_size", 2)),),
+            stride=(_one(conf.get("strides") or conf.get("pool_size", 2)),),
+            convolution_mode="same" if conf.get("padding") == "same" else "truncate")
+    if cls == "SeparableConv2D":
+        from deeplearning4j_tpu.nn.layers import SeparableConvolution2D
+        return SeparableConvolution2D(
+            name=name, n_out=conf["filters"],
+            kernel_size=tuple(conf["kernel_size"]),
+            stride=tuple(conf.get("strides", (1, 1))),
+            depth_multiplier=conf.get("depth_multiplier", 1),
+            convolution_mode="same" if conf.get("padding") == "same" else "truncate",
+            activation=_act(conf.get("activation")),
+            has_bias=conf.get("use_bias", True))
+    if cls == "DepthwiseConv2D":
+        from deeplearning4j_tpu.nn.layers import DepthwiseConvolution2D
+        return DepthwiseConvolution2D(
+            name=name, kernel_size=tuple(conf["kernel_size"]),
+            stride=tuple(conf.get("strides", (1, 1))),
+            depth_multiplier=conf.get("depth_multiplier", 1),
+            convolution_mode="same" if conf.get("padding") == "same" else "truncate",
+            activation=_act(conf.get("activation")),
+            has_bias=conf.get("use_bias", True))
+    if cls == "SimpleRNN":
+        from deeplearning4j_tpu.nn.layers import SimpleRnn
+        cell = SimpleRnn(name=name, n_out=conf["units"],
+                         activation=_act(conf.get("activation", "tanh")))
+        if not conf.get("return_sequences", False):
+            from deeplearning4j_tpu.nn.layers import LastTimeStep
+            return LastTimeStep(name=name, underlying=cell)
+        return cell
+    if cls == "GRU":
+        from deeplearning4j_tpu.nn.layers import GRU as GRULayer
+        if not conf.get("reset_after", True):
+            raise KeyError(
+                "unsupported Keras GRU reset_after=False (reset gate applied "
+                "before the recurrent matmul — different cell semantics)")
+        cell = GRULayer(name=name, n_out=conf["units"],
+                        activation=_act(conf.get("activation", "tanh")),
+                        gate_activation=_act(conf.get("recurrent_activation",
+                                                      "sigmoid")))
+        if not conf.get("return_sequences", False):
+            from deeplearning4j_tpu.nn.layers import LastTimeStep
+            return LastTimeStep(name=name, underlying=cell)
+        return cell
+    if cls == "LayerNormalization":
+        from deeplearning4j_tpu.nn.layers import LayerNormalization
+        return LayerNormalization(name=name, eps=conf.get("epsilon", 1e-3))
+    if cls == "PReLU":
+        from deeplearning4j_tpu.nn.layers import PReLULayer
+        return PReLULayer(name=name)
+    if cls == "LeakyReLU":
+        return ActivationLayer(name=name, activation="leakyrelu")
+    if cls == "ELU":
+        return ActivationLayer(name=name, activation="elu")
+    if cls == "UpSampling2D":
+        from deeplearning4j_tpu.nn.layers import UpsamplingLayer
+        return UpsamplingLayer(name=name, size=tuple(conf.get("size", (2, 2))))
+    if cls == "ZeroPadding2D":
+        from deeplearning4j_tpu.nn.layers import ZeroPaddingLayer
+        return ZeroPaddingLayer(name=name,
+                                padding=_pad2(conf.get("padding", (1, 1))))
+    if cls == "Cropping2D":
+        from deeplearning4j_tpu.nn.layers import CroppingLayer
+        return CroppingLayer(name=name,
+                             cropping=_pad2(conf.get("cropping", (0, 0))))
+    if cls in ("SpatialDropout2D", "SpatialDropout1D"):
+        from deeplearning4j_tpu.nn.layers import SpatialDropoutLayer
+        return SpatialDropoutLayer(name=name, p=1.0 - conf.get("rate", 0.5))
     raise KeyError(f"unsupported Keras layer class '{cls}' "
                    f"(KerasLayer converter missing — registry parity point)")
+
+
+def _one(v):
+    """Keras scalars arrive as int or 1-list."""
+    return v[0] if isinstance(v, (list, tuple)) else v
+
+
+def _pad2(v):
+    """Keras 2D padding/cropping: int, (h, w), or ((t,b),(l,r)) →
+    our flat (top, bottom, left, right)."""
+    if isinstance(v, int):
+        return (v, v, v, v)
+    if isinstance(v[0], (list, tuple)):
+        return (v[0][0], v[0][1], v[1][0], v[1][1])
+    return (v[0], v[0], v[1], v[1])
 
 
 def _infer_input_type(kmodel: dict) -> InputType:
@@ -150,18 +249,34 @@ def import_sequential(model_json: str,
         raise ValueError("not a Sequential model — use import_functional")
     layer_cfgs = kmodel["config"]["layers"]
     our_layers = []
+    flatten_pending = False
     for kcfg in layer_cfgs:
         layer = _convert_layer(kcfg)
-        if layer is not None:
-            our_layers.append(layer)
+        if layer is None:
+            # Keras Flatten is explicit; our framework flattens lazily via
+            # preprocessors only when a layer DEMANDS ff input.  A layer
+            # that accepts any rank (LayerNormalization, Dropout, …) after
+            # Flatten would otherwise see the unflattened CNN tensor and
+            # e.g. normalize the channel axis instead of all features —
+            # so pin the next layer's input kind.
+            if kcfg.get("class_name") == "Flatten":
+                flatten_pending = True
+            continue
+        if flatten_pending:
+            layer.INPUT_KIND = "ff"   # instance-level preprocessor hook
+            flatten_pending = False
+        our_layers.append(layer)
     # last Dense+softmax becomes OutputLayer so fit() works (DL4J does the
     # same when the Keras model ends with Dense+activation)
     if our_layers and isinstance(our_layers[-1], DenseLayer) \
             and not isinstance(our_layers[-1], OutputLayer):
         d = our_layers[-1]
-        our_layers[-1] = OutputLayer(name=d.name, n_out=d.n_out,
-                                     activation=d.activation, loss=loss,
-                                     has_bias=d.has_bias)
+        out = OutputLayer(name=d.name, n_out=d.n_out,
+                          activation=d.activation, loss=loss,
+                          has_bias=d.has_bias)
+        if hasattr(d, "INPUT_KIND"):   # keep a Flatten pin (see above)
+            out.INPUT_KIND = d.INPUT_KIND
+        our_layers[-1] = out
     builder = NeuralNetConfiguration.builder().list()
     for layer in our_layers:
         builder.layer(layer)
@@ -198,8 +313,56 @@ def load_weights(net: MultiLayerNetwork, weights: dict[str, list[np.ndarray]]) -
             gamma, beta, mean, var = arrays
             params["gamma"], params["beta"] = gamma, beta
             net.state_[i]["mean"], net.state_[i]["var"] = mean, var
+        elif _is(layer, "GRU"):
+            # keras (reset_after=True): kernel/recurrent [in,3H] gates
+            # z,r,h and bias [2,3H] (input + recurrent); ours: r,u(z),c
+            # with a single input-side bias
+            h = layer.n_out
+            w, u = arrays[0], arrays[1]
+            params["W"] = _zrh_to_ruc(np.asarray(w), h)
+            params["U"] = _zrh_to_ruc(np.asarray(u), h)
+            b = (np.asarray(arrays[2]) if len(arrays) > 2
+                 else np.zeros(3 * h, np.float32))
+            if b.ndim == 2:       # [2, 3H]: input bias + recurrent bias
+                # the z/r recurrent-bias slices add outside the reset
+                # product, so they fold exactly into the input bias; only
+                # the candidate slice is multiplied by r and cannot
+                rec = b[1].copy()
+                if not np.allclose(rec[2 * h:], 0.0, atol=1e-6):
+                    raise ValueError(
+                        "Keras GRU has a nonzero recurrent bias on the "
+                        "candidate gate — multiplied by r, it cannot be "
+                        "folded into the input bias exactly")
+                b = b[0].copy()
+                b[:2 * h] += rec[:2 * h]
+            params["b"] = _zrh_to_ruc(b[None, :], h)[0]
+        elif _is(layer, "SimpleRnn"):
+            w, u = arrays[0], arrays[1]
+            b = (np.asarray(arrays[2]) if len(arrays) > 2
+                 else np.zeros(layer.n_out, np.float32))
+            params["W"], params["U"], params["b"] = np.asarray(w), np.asarray(u), b
+        elif _is(layer, "SeparableConvolution2D"):
+            # keras: [depthwise (kh,kw,cin,mult), pointwise, bias];
+            # ours: depthW (kh,kw,1,cin*mult) — both flatten (cin,mult)
+            # channel-major, so a reshape is exact
+            depth = np.asarray(arrays[0])
+            kh, kw, cin, mult = depth.shape
+            params["depthW"] = depth.reshape(kh, kw, 1, cin * mult)
+            params["pointW"] = np.asarray(arrays[1])
+            if len(arrays) > 2:
+                params["b"] = np.asarray(arrays[2])
+        elif _is(layer, "DepthwiseConvolution2D"):
+            depth = np.asarray(arrays[0])
+            kh, kw, cin, mult = depth.shape
+            params["W"] = depth.reshape(kh, kw, 1, cin * mult)
+            if len(arrays) > 1:
+                params["b"] = np.asarray(arrays[1])
         else:
-            keys = [k for k in ("W", "b", "depthW", "pointW") if k in params]
+            # ordered candidates per layer family: conv/dense (W, b),
+            # separable (depthW, pointW, b — handled above), layer-norm
+            # (gamma, beta), PReLU (alpha) — keras array order matches
+            keys = [k for k in ("W", "b", "depthW", "pointW",
+                                "gamma", "beta", "alpha") if k in params]
             for key, arr in zip(keys, arrays):
                 if params[key].shape != arr.shape:
                     raise ValueError(
@@ -212,6 +375,19 @@ def _ifco_to_ifog(w: np.ndarray, h: int) -> np.ndarray:
     """Keras LSTM gate order i,f,c,o → ours i,f,o,g(c)."""
     i, f, c, o = (w[:, 0:h], w[:, h:2 * h], w[:, 2 * h:3 * h], w[:, 3 * h:4 * h])
     return np.concatenate([i, f, o, c], axis=1)
+
+
+def _zrh_to_ruc(w: np.ndarray, h: int) -> np.ndarray:
+    """Keras GRU gate order z,r,h → ours r,u(z),c(h)."""
+    z, r, hh = w[:, 0:h], w[:, h:2 * h], w[:, 2 * h:3 * h]
+    return np.concatenate([r, z, hh], axis=1)
+
+
+def _is(layer, cls_name: str) -> bool:
+    """Exact-class check by name (subclass-safe dispatch for weight
+    loading: e.g. SeparableConvolution2D extends ConvolutionLayer but
+    has a different keras weight layout)."""
+    return type(layer).__name__ == cls_name
 
 
 def load_weights_npz(net: MultiLayerNetwork, path: str) -> None:

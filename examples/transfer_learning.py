@@ -34,13 +34,12 @@ def main(pretrain_epochs: int = 1, finetune_epochs: int = 1,
                .add_layer(OutputLayer(n_out=new_classes, activation="softmax",
                                       loss="mcxent"))
                .build())
-    frozen_before = [np.asarray(p) for p in
-                     np.asarray(new_net.params_[0]["W"], dtype=np.float32)]
+    frozen_before = np.asarray(new_net.params_[0]["W"], dtype=np.float32)
     new_net.fit(_batches(128, new_classes, seed=1), epochs=finetune_epochs)
     frozen_after = np.asarray(new_net.params_[0]["W"], dtype=np.float32)
     if verbose:
-        unchanged = np.allclose(np.asarray(frozen_before), frozen_after)
-        print(f"feature extractor unchanged: {unchanged}")
+        print(f"feature extractor unchanged: "
+              f"{np.array_equal(frozen_before, frozen_after)}")
     return new_net
 
 

@@ -1,0 +1,83 @@
+"""Fixed-seed bitwise determinism (SURVEY §5.2: the race-detection/
+sanitizer discipline translated to TPU — XLA programs are data-race-free
+by construction, so the observable guarantee is bitwise reproducibility
+of a seeded run; this pins it)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (BatchNormalization, DenseLayer,
+                                          DropoutLayer, LSTM, OutputLayer,
+                                          RnnOutputLayer)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.train import Adam
+
+
+def _iter(seed=0, n=96, batch=32):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return ListDataSetIterator([DataSet(x[i:i + batch], y[i:i + batch])
+                                for i in range(0, n, batch)])
+
+
+def _train_once(with_dropout=True):
+    b = (NeuralNetConfiguration.builder().seed(777).updater(Adam(1e-2)).list()
+         .layer(DenseLayer(n_out=16, activation="relu")))
+    if with_dropout:
+        b = b.layer(DropoutLayer(dropout=0.7))
+    conf = (b.layer(BatchNormalization())
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(_iter(), epochs=2)
+    return net
+
+
+def _flat(net):
+    import jax
+    return np.concatenate([np.ravel(np.asarray(l))
+                           for l in jax.tree_util.tree_leaves(net.params_)])
+
+
+def test_training_bitwise_deterministic():
+    a, b = _train_once(), _train_once()
+    pa, pb = _flat(a), _flat(b)
+    np.testing.assert_array_equal(pa, pb)   # BITWISE, not allclose
+
+
+def test_rnn_training_bitwise_deterministic():
+    def run():
+        conf = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-2))
+                .list()
+                .layer(LSTM(n_out=12, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(4, 6)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(12, 6, 4)).astype(np.float32)
+        y = np.zeros((12, 6, 3), np.float32)
+        y[:, :, 0] = 1.0
+        net.fit(ListDataSetIterator([DataSet(x, y)]), epochs=2)
+        return _flat(net)
+
+    np.testing.assert_array_equal(run(), run())
+
+
+def test_different_seed_differs():
+    """The determinism test must not pass vacuously: changing the seed
+    must change the trained parameters."""
+    a = _train_once()
+    conf = (NeuralNetConfiguration.builder().seed(778).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(DropoutLayer(dropout=0.7))
+            .layer(BatchNormalization())
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    b = MultiLayerNetwork(conf).init()
+    b.fit(_iter(), epochs=2)
+    assert not np.array_equal(_flat(a), _flat(b))

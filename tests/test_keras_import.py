@@ -44,6 +44,33 @@ for l in spec["layers"]:
     elif kind == "bidi_lstm":
         layers.append(keras.layers.Bidirectional(keras.layers.LSTM(l["units"]),
                                                  name=l["name"]))
+    elif kind == "sepconv2d":
+        layers.append(keras.layers.SeparableConv2D(l["filters"], l["kernel"],
+                       activation=l["act"], padding=l["padding"], name=l["name"]))
+    elif kind == "dwconv2d":
+        layers.append(keras.layers.DepthwiseConv2D(l["kernel"], activation=l["act"],
+                       padding=l["padding"], name=l["name"]))
+    elif kind == "gru":
+        layers.append(keras.layers.GRU(l["units"], return_sequences=l.get("seq", False),
+                                       name=l["name"]))
+    elif kind == "simplernn":
+        layers.append(keras.layers.SimpleRNN(l["units"],
+                       return_sequences=l.get("seq", False), name=l["name"]))
+    elif kind == "conv1d":
+        layers.append(keras.layers.Conv1D(l["filters"], l["kernel"],
+                       activation=l["act"], padding=l["padding"], name=l["name"]))
+    elif kind == "maxpool1d":
+        layers.append(keras.layers.MaxPooling1D(l["pool"], name=l["name"]))
+    elif kind == "layernorm":
+        layers.append(keras.layers.LayerNormalization(name=l["name"]))
+    elif kind == "gap1d":
+        layers.append(keras.layers.GlobalAveragePooling1D(name=l["name"]))
+    elif kind == "upsampling":
+        layers.append(keras.layers.UpSampling2D(l["size"], name=l["name"]))
+    elif kind == "zeropad":
+        layers.append(keras.layers.ZeroPadding2D(tuple(l["pad"]), name=l["name"]))
+    elif kind == "cropping":
+        layers.append(keras.layers.Cropping2D(tuple(l["crop"]), name=l["name"]))
 model = keras.Sequential(layers)
 model.save(spec["h5"])
 rng = np.random.default_rng(spec["seed"])
@@ -118,6 +145,119 @@ class TestKerasH5Golden:
         net = import_keras_model_and_weights(h5)
         np.testing.assert_allclose(np.asarray(net.output(x)), golden,
                                    rtol=1e-4, atol=1e-5)
+
+    def test_separable_depthwise_conv_golden(self, tmp_path):
+        """Separable + depthwise convs: the keras (kh,kw,cin,mult)
+        depthwise kernel reshapes exactly to our grouped-conv layout."""
+        h5, x, golden = _make_fixture(tmp_path, [
+            {"kind": "input", "shape": [8, 8, 3]},
+            {"kind": "sepconv2d", "filters": 6, "kernel": 3, "act": "relu",
+             "padding": "same", "name": "sep"},
+            {"kind": "dwconv2d", "kernel": 3, "act": "linear",
+             "padding": "valid", "name": "dw"},
+            {"kind": "flatten", "name": "fl"},
+            {"kind": "dense", "units": 4, "act": "softmax", "name": "out"},
+        ], (3, 8, 8, 3), seed=5)
+        net = import_keras_model_and_weights(h5)
+        np.testing.assert_allclose(np.asarray(net.output(x)), golden,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_gru_simplernn_golden(self, tmp_path):
+        h5, x, golden = _make_fixture(tmp_path, [
+            {"kind": "input", "shape": [6, 4]},
+            {"kind": "gru", "units": 5, "seq": True, "name": "g"},
+            {"kind": "simplernn", "units": 4, "name": "r"},
+            {"kind": "dense", "units": 2, "act": "softmax", "name": "out"},
+        ], (4, 6, 4), seed=6)
+        net = import_keras_model_and_weights(h5)
+        np.testing.assert_allclose(np.asarray(net.output(x)), golden,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_conv1d_pool1d_gap_golden(self, tmp_path):
+        h5, x, golden = _make_fixture(tmp_path, [
+            {"kind": "input", "shape": [12, 3]},
+            {"kind": "conv1d", "filters": 5, "kernel": 3, "act": "relu",
+             "padding": "same", "name": "c1"},
+            {"kind": "maxpool1d", "pool": 2, "name": "p1"},
+            {"kind": "gap1d", "name": "gap"},
+            {"kind": "dense", "units": 2, "act": "softmax", "name": "out"},
+        ], (4, 12, 3), seed=7)
+        net = import_keras_model_and_weights(h5)
+        np.testing.assert_allclose(np.asarray(net.output(x)), golden,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_layernorm_geometry_golden(self, tmp_path):
+        h5, x, golden = _make_fixture(tmp_path, [
+            {"kind": "input", "shape": [6, 6, 2]},
+            {"kind": "zeropad", "pad": [1, 2], "name": "zp"},
+            {"kind": "upsampling", "size": 2, "name": "up"},
+            {"kind": "cropping", "crop": [2, 3], "name": "cr"},
+            {"kind": "flatten", "name": "fl"},
+            {"kind": "layernorm", "name": "ln"},
+            {"kind": "dense", "units": 3, "act": "softmax", "name": "out"},
+        ], (2, 6, 6, 2), seed=8)
+        net = import_keras_model_and_weights(h5)
+        np.testing.assert_allclose(np.asarray(net.output(x)), golden,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_layernorm_prelu_weights_actually_load(self):
+        """Untrained goldens mask non-loaded params (gamma=1/beta=0 both
+        sides) — assert the arrays land in the param tree."""
+        from deeplearning4j_tpu.importers.keras import load_weights
+        from deeplearning4j_tpu.nn import NeuralNetConfiguration, InputType
+        from deeplearning4j_tpu.nn.layers import (DenseLayer,
+                                                  LayerNormalization,
+                                                  OutputLayer, PReLULayer)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        conf = (NeuralNetConfiguration.builder().list()
+                .layer(DenseLayer(n_out=4, activation="identity", name="d"))
+                .layer(LayerNormalization(name="ln"))
+                .layer(PReLULayer(name="pr"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent", name="out"))
+                .set_input_type(InputType.feed_forward(3)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        gamma, beta = rng.normal(size=4).astype(np.float32), \
+            rng.normal(size=4).astype(np.float32)
+        alpha = rng.normal(size=4).astype(np.float32)
+        load_weights(net, {"ln": [gamma, beta], "pr": [alpha]})
+        np.testing.assert_array_equal(np.asarray(net.params_[1]["gamma"]), gamma)
+        np.testing.assert_array_equal(np.asarray(net.params_[1]["beta"]), beta)
+        np.testing.assert_array_equal(np.asarray(net.params_[2]["alpha"]), alpha)
+
+    def test_gru_recurrent_bias_folds_z_r_exactly(self):
+        """z/r recurrent-bias slices fold into the input bias; nonzero
+        candidate slice is rejected."""
+        from deeplearning4j_tpu.importers.keras import load_weights
+        from deeplearning4j_tpu.nn import NeuralNetConfiguration, InputType
+        from deeplearning4j_tpu.nn.layers import GRU, RnnOutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        h = 3
+        conf = (NeuralNetConfiguration.builder().list()
+                .layer(GRU(n_out=h, name="g"))
+                .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                      loss="mcxent", name="out"))
+                .set_input_type(InputType.recurrent(4, 5)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(1)
+        w = rng.normal(size=(4, 3 * h)).astype(np.float32)
+        u = rng.normal(size=(h, 3 * h)).astype(np.float32)
+        b = rng.normal(size=(2, 3 * h)).astype(np.float32)
+        b[1, 2 * h:] = 0.0      # candidate recurrent bias zero → foldable
+        load_weights(net, {"g": [w, u, b]})
+        got_b = np.asarray(net.params_[0]["b"])
+        # ours is r,u,c order: z/r slices carry the folded recurrent bias
+        np.testing.assert_allclose(got_b[0:h], b[0, h:2 * h] + b[1, h:2 * h],
+                                   atol=1e-6)   # r gate
+        np.testing.assert_allclose(got_b[h:2 * h], b[0, 0:h] + b[1, 0:h],
+                                   atol=1e-6)   # u(z) gate
+        np.testing.assert_allclose(got_b[2 * h:], b[0, 2 * h:], atol=1e-6)
+
+        b_bad = b.copy()
+        b_bad[1, 2 * h:] = 1.0
+        with pytest.raises(ValueError, match="candidate"):
+            load_weights(net, {"g": [w, u, b_bad]})
 
     def test_bidirectional_non_lstm_inner_rejected(self):
         """Bidirectional(GRU) must fail loudly, not import as LSTM
