@@ -182,16 +182,29 @@ def _convert_layer(kcfg: dict):
         return cell
     if cls == "LayerNormalization":
         from deeplearning4j_tpu.nn.layers import LayerNormalization
-        return LayerNormalization(name=name, eps=conf.get("epsilon", 1e-3))
+        if not conf.get("scale", True):
+            raise KeyError("unsupported Keras LayerNormalization scale=False "
+                           "(our LN always learns gamma — positional weight "
+                           "mapping would misassign beta)")
+        return LayerNormalization(name=name, eps=conf.get("epsilon", 1e-3),
+                                  use_bias=conf.get("center", True))
     if cls == "PReLU":
         from deeplearning4j_tpu.nn.layers import PReLULayer
         return PReLULayer(name=name)
     if cls == "LeakyReLU":
-        return ActivationLayer(name=name, activation="leakyrelu")
+        # keras default alpha is 0.3 (key 'alpha'; 'negative_slope' in
+        # keras-3); the "name:arg" form keeps the layer JSON-serializable
+        alpha = conf.get("negative_slope", conf.get("alpha", 0.3))
+        return ActivationLayer(name=name, activation=f"leakyrelu:{alpha}")
     if cls == "ELU":
-        return ActivationLayer(name=name, activation="elu")
+        return ActivationLayer(name=name,
+                               activation=f"elu:{conf.get('alpha', 1.0)}")
     if cls == "UpSampling2D":
         from deeplearning4j_tpu.nn.layers import UpsamplingLayer
+        if conf.get("interpolation", "nearest") != "nearest":
+            raise KeyError(
+                f"unsupported Keras UpSampling2D interpolation="
+                f"'{conf.get('interpolation')}' (only nearest is converted)")
         return UpsamplingLayer(name=name, size=tuple(conf.get("size", (2, 2))))
     if cls == "ZeroPadding2D":
         from deeplearning4j_tpu.nn.layers import ZeroPaddingLayer
@@ -213,6 +226,15 @@ def _one(v):
     return v[0] if isinstance(v, (list, tuple)) else v
 
 
+def _dense_to_output(d: DenseLayer, loss: str) -> OutputLayer:
+    """Terminal Dense → OutputLayer (keeps any Flatten INPUT_KIND pin)."""
+    out = OutputLayer(name=d.name, n_out=d.n_out, activation=d.activation,
+                      loss=loss, has_bias=d.has_bias)
+    if hasattr(d, "INPUT_KIND"):
+        out.INPUT_KIND = d.INPUT_KIND
+    return out
+
+
 def _pad2(v):
     """Keras 2D padding/cropping: int, (h, w), or ((t,b),(l,r)) →
     our flat (top, bottom, left, right)."""
@@ -230,14 +252,7 @@ def _infer_input_type(kmodel: dict) -> InputType:
              or first["config"].get("batch_shape"))
     if shape is None:
         raise ValueError("model JSON lacks batch_input_shape on the first layer")
-    dims = [d for d in shape[1:]]
-    if len(dims) == 1:
-        return InputType.feed_forward(dims[0])
-    if len(dims) == 2:
-        return InputType.recurrent(dims[1], dims[0])
-    if len(dims) == 3:
-        return InputType.convolutional(dims[0], dims[1], dims[2])
-    raise ValueError(f"unsupported input shape {shape}")
+    return _shape_to_input_type(shape)
 
 
 def import_sequential(model_json: str,
@@ -270,13 +285,7 @@ def import_sequential(model_json: str,
     # same when the Keras model ends with Dense+activation)
     if our_layers and isinstance(our_layers[-1], DenseLayer) \
             and not isinstance(our_layers[-1], OutputLayer):
-        d = our_layers[-1]
-        out = OutputLayer(name=d.name, n_out=d.n_out,
-                          activation=d.activation, loss=loss,
-                          has_bias=d.has_bias)
-        if hasattr(d, "INPUT_KIND"):   # keep a Flatten pin (see above)
-            out.INPUT_KIND = d.INPUT_KIND
-        our_layers[-1] = out
+        our_layers[-1] = _dense_to_output(our_layers[-1], loss)
     builder = NeuralNetConfiguration.builder().list()
     for layer in our_layers:
         builder.layer(layer)
@@ -434,6 +443,165 @@ def import_keras_model_and_weights(path: str, loss: str = "mcxent") -> MultiLaye
         if isinstance(model_config, bytes):
             model_config = model_config.decode()
         weights = _h5_weights(f)
+    cls = json.loads(model_config).get("class_name")
+    if cls in ("Functional", "Model"):
+        return import_functional(model_config, weights=weights, loss=loss)
     net = import_sequential(model_config, loss=loss)
     load_weights(net, weights)
     return net
+
+
+# --------------------------------------------------------------- functional
+_MERGE_CLASSES = {"Concatenate": None, "Add": "add", "Subtract": "subtract",
+                  "Multiply": "product", "Average": "average",
+                  "Maximum": "max"}
+
+
+def _shape_to_input_type(shape) -> InputType:
+    dims = list(shape[1:])
+    if len(dims) == 1:
+        return InputType.feed_forward(dims[0])
+    if len(dims) == 2:
+        return InputType.recurrent(dims[1], dims[0])
+    if len(dims) == 3:
+        return InputType.convolutional(dims[0], dims[1], dims[2])
+    raise ValueError(f"unsupported input shape {shape}")
+
+
+def _collect_keras_tensors(obj, out: list[str]) -> None:
+    """Recursively pull producer names from keras-3 ``__keras_tensor__``
+    arg structures (args may nest tensors in lists for multi-input)."""
+    if isinstance(obj, dict):
+        if obj.get("class_name") == "__keras_tensor__":
+            out.append(obj["config"]["keras_history"][0])
+        else:
+            for v in obj.values():
+                _collect_keras_tensors(v, out)
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            _collect_keras_tensors(v, out)
+
+
+def _inbound_names(kcfg: dict) -> list[str]:
+    """Names feeding this layer.  Two on-disk formats exist:
+    classic tf.keras ``[[[name, node_idx, tensor_idx, kwargs], ...]]``
+    and keras-3 ``[{"args": [...__keras_tensor__...], "kwargs": {}}]``."""
+    nodes = kcfg.get("inbound_nodes", [])
+    if not nodes:
+        return []
+    first = nodes[0]
+    out: list[str] = []
+    if isinstance(first, dict):        # keras-3
+        _collect_keras_tensors(first.get("args", []), out)
+        return out
+    for entry in first:                # classic
+        if isinstance(entry, (list, tuple)):
+            out.append(entry[0])
+    return out
+
+
+def _io_layer_names(spec) -> list[str]:
+    """``input_layers``/``output_layers``: [[name,0,0],...] (classic) or
+    [name,0,0] (keras-3 single IO)."""
+    if spec and isinstance(spec[0], str):
+        return [spec[0]]
+    return [s[0] for s in spec]
+
+
+def import_functional(model_json: str,
+                      weights: Optional[dict[str, list[np.ndarray]]] = None,
+                      loss: str = "mcxent") -> "ComputationGraph":
+    """Keras Functional model → ComputationGraph
+    (``KerasModelImport.importKerasModelAndWeights`` parity): layers become
+    named graph layers, Concatenate → MergeVertex, Add/Multiply/… →
+    ElementWiseVertex; structural layers (Flatten/InputLayer) collapse
+    into name remapping exactly as in the Sequential path."""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.vertices import ElementWiseVertex, MergeVertex
+
+    kmodel = json.loads(model_json)
+    if kmodel.get("class_name") not in ("Functional", "Model"):
+        raise ValueError("not a Functional model — use import_sequential")
+    cfg = kmodel["config"]
+
+    from deeplearning4j_tpu.nn.vertices import FlattenVertex
+
+    builder = NeuralNetConfiguration.builder().graph()
+    input_names, input_types = [], []
+    # effective graph name for each keras layer (structural layers alias
+    # to their input's name)
+    alias: dict[str, str] = {}
+    out_is_dense: dict[str, DenseLayer] = {}
+
+    for kcfg in cfg["layers"]:
+        cls = kcfg["class_name"]
+        name = kcfg.get("name") or kcfg["config"].get("name")
+        if len(kcfg.get("inbound_nodes", [])) > 1:
+            raise KeyError(
+                f"layer '{name}' is called on {len(kcfg['inbound_nodes'])} "
+                f"inputs (shared-layer/siamese topology) — weight-shared "
+                f"multi-call import is not supported")
+        inbound = [alias[n] for n in _inbound_names(kcfg)]
+        if cls == "InputLayer":
+            shape = (kcfg["config"].get("batch_input_shape")
+                     or kcfg["config"].get("batch_shape"))
+            input_names.append(name)
+            input_types.append(_shape_to_input_type(shape))
+            alias[name] = name
+            continue
+        if cls == "Flatten":
+            # explicit vertex, NOT an alias: downstream merge vertices
+            # accept any rank, so the lazy preprocessor would never fire
+            builder.add_vertex(name, FlattenVertex(), *inbound)
+            alias[name] = name
+            continue
+        if cls in _MERGE_CLASSES:
+            vertex = (MergeVertex() if cls == "Concatenate"
+                      else ElementWiseVertex(op=_MERGE_CLASSES[cls]))
+            builder.add_vertex(name, vertex, *inbound)
+            alias[name] = name
+            continue
+        layer = _convert_layer(kcfg)
+        if layer is None:
+            assert len(inbound) == 1
+            alias[name] = inbound[0]
+            continue
+        builder.add_layer(name, layer, *inbound)
+        alias[name] = name
+        if isinstance(layer, DenseLayer) and not isinstance(layer, OutputLayer):
+            out_is_dense[name] = layer
+
+    builder.add_inputs(*input_names)
+    builder.set_input_types(*input_types)
+    output_names = [alias[o] for o in _io_layer_names(cfg["output_layers"])]
+    # terminal Dense layers become OutputLayers so fit() works
+    for out_name in output_names:
+        d = out_is_dense.get(out_name)
+        if d is not None:
+            out = _dense_to_output(d, loss)
+            for spec in builder._vertices:
+                if spec.name == out_name:
+                    spec.obj = out
+    builder.set_outputs(*output_names)
+    net = ComputationGraph(builder.build()).init()
+    if weights is not None:
+        load_graph_weights(net, weights)
+    return net
+
+
+def load_graph_weights(net, weights: dict[str, list[np.ndarray]]) -> None:
+    """ComputationGraph twin of :func:`load_weights` — params are keyed by
+    vertex name instead of layer index."""
+    adapter = _GraphParamsAdapter(net)
+    load_weights(adapter, weights)
+
+
+class _GraphParamsAdapter:
+    """Presents a ComputationGraph as the (layers, params_, state_) triple
+    load_weights walks for MultiLayerNetwork."""
+
+    def __init__(self, net):
+        specs = [s for s in net._topo if s.kind == "layer"]
+        self.layers = [s.obj for s in specs]
+        self.params_ = [net.params_[s.name] for s in specs]
+        self.state_ = [net.state_[s.name] for s in specs]

@@ -36,6 +36,10 @@ def get(name) -> ActivationFn:
     if callable(name):
         return name
     key = str(name).lower()
+    if ":" in key:
+        base, _, arg = key.partition(":")
+        if base in _PARAMETERIZED:
+            return _PARAMETERIZED[base](float(arg))
     if key not in _REGISTRY:
         raise KeyError(f"unknown activation '{name}'; known: {sorted(_REGISTRY)}")
     return _REGISTRY[key]
@@ -92,3 +96,15 @@ def thresholded_relu(x: jnp.ndarray, theta: float = 1.0) -> jnp.ndarray:
 
 def leaky_relu_with(alpha: float) -> ActivationFn:
     return lambda x: jax.nn.leaky_relu(x, negative_slope=alpha)
+
+
+def elu_with(alpha: float) -> ActivationFn:
+    return lambda x: jax.nn.elu(x, alpha=alpha)
+
+
+# parameterized-by-name forms: "leakyrelu:0.3" — JSON-serializable (a
+# bare callable would be dropped by Layer.to_dict), used by the Keras
+# importer for non-default slopes
+_PARAMETERIZED = {"leakyrelu": leaky_relu_with, "elu": elu_with,
+                  "thresholdedrelu": lambda t: (
+                      lambda x: jnp.where(x > t, x, 0.0))}

@@ -214,6 +214,23 @@ class AttentionVertex(GraphVertex):
         return InputType.recurrent(v.size, q.timesteps)   # q steps, v width
 
 
+@register_vertex("flatten")
+@dataclasses.dataclass
+class FlattenVertex(GraphVertex):
+    """Flatten non-batch dims to a feed-forward vector (the explicit
+    twin of the lazy cnn→ff preprocessor — needed when a downstream
+    consumer like a merge vertex accepts any rank, so the implicit
+    adaptation would never fire; used by the Keras Functional importer
+    for explicit ``Flatten`` nodes)."""
+
+    def apply(self, inputs):
+        x = inputs[0]
+        return x.reshape(x.shape[0], -1)
+
+    def get_output_type(self, input_types):
+        return InputType.feed_forward(input_types[0].flat_size())
+
+
 @register_vertex("reshape")
 @dataclasses.dataclass
 class ReshapeVertex(GraphVertex):

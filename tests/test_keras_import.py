@@ -71,7 +71,31 @@ for l in spec["layers"]:
         layers.append(keras.layers.ZeroPadding2D(tuple(l["pad"]), name=l["name"]))
     elif kind == "cropping":
         layers.append(keras.layers.Cropping2D(tuple(l["crop"]), name=l["name"]))
-model = keras.Sequential(layers)
+if spec.get("functional") == "conv_branches":
+    # two conv branches, explicit Flatten per branch, Concatenate, head
+    inp = keras.layers.Input(shape=(6, 6, 2))
+    a = keras.layers.Conv2D(3, 3, activation="relu", padding="same",
+                            name="ca")(inp)
+    fa = keras.layers.Flatten(name="fla")(a)
+    b = keras.layers.Conv2D(4, 3, activation="tanh", padding="valid",
+                            name="cb")(inp)
+    fb = keras.layers.Flatten(name="flb")(b)
+    cat = keras.layers.Concatenate(name="fcat")([fa, fb])
+    lr = keras.layers.LeakyReLU(name="lre")(cat)   # default alpha 0.3
+    out = keras.layers.Dense(3, activation="softmax", name="fout")(lr)
+    model = keras.Model(inputs=inp, outputs=out)
+elif spec.get("functional"):
+    # fixed functional topology: dense branch + skip, concat, head
+    inp = keras.layers.Input(shape=tuple(spec["functional"]["shape"]))
+    a = keras.layers.Dense(8, activation="relu", name="fa")(inp)
+    b = keras.layers.Dense(8, activation="tanh", name="fb")(a)
+    add = keras.layers.Add(name="fadd")([a, b])
+    c = keras.layers.Dense(6, activation="relu", name="fc")(inp)
+    cat = keras.layers.Concatenate(name="fcat")([add, c])
+    out = keras.layers.Dense(3, activation="softmax", name="fout")(cat)
+    model = keras.Model(inputs=inp, outputs=out)
+else:
+    model = keras.Sequential(layers)
 model.save(spec["h5"])
 rng = np.random.default_rng(spec["seed"])
 x = rng.normal(size=tuple(spec["x_shape"])).astype(np.float32)
@@ -79,11 +103,11 @@ np.savez(spec["npz"], x=x, golden=model.predict(x, verbose=0))
 """
 
 
-def _make_fixture(tmp_path, spec_layers, x_shape, seed=0):
+def _make_fixture(tmp_path, spec_layers, x_shape, seed=0, functional=None):
     h5 = str(tmp_path / "model.h5")
     npz = str(tmp_path / "golden.npz")
     spec = {"layers": spec_layers, "h5": h5, "npz": npz,
-            "x_shape": list(x_shape), "seed": seed}
+            "x_shape": list(x_shape), "seed": seed, "functional": functional}
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = ""           # TF subprocess: no jax involved
     proc = subprocess.run([sys.executable, "-c", _GEN, json.dumps(spec)],
@@ -196,6 +220,28 @@ class TestKerasH5Golden:
             {"kind": "layernorm", "name": "ln"},
             {"kind": "dense", "units": 3, "act": "softmax", "name": "out"},
         ], (2, 6, 6, 2), seed=8)
+        net = import_keras_model_and_weights(h5)
+        np.testing.assert_allclose(np.asarray(net.output(x)), golden,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_functional_model_golden(self, tmp_path):
+        """Functional topology: two dense branches, Add skip, Concatenate,
+        dense head → ComputationGraph with vertices; golden activations
+        must match tf.keras."""
+        h5, x, golden = _make_fixture(tmp_path, [], (4, 12), seed=11,
+                                      functional={"shape": [12]})
+        net = import_keras_model_and_weights(h5)
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        assert isinstance(net, ComputationGraph)
+        np.testing.assert_allclose(np.asarray(net.output(x)), golden,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_functional_conv_flatten_concat_golden(self, tmp_path):
+        """Explicit Flatten feeding a Concatenate becomes a real vertex
+        (the flattened [N,108]+[N,64] concat, NOT a channel-axis concat
+        of 4-D conv maps) and LeakyReLU keeps Keras's alpha=0.3."""
+        h5, x, golden = _make_fixture(tmp_path, [], (3, 6, 6, 2), seed=12,
+                                      functional="conv_branches")
         net = import_keras_model_and_weights(h5)
         np.testing.assert_allclose(np.asarray(net.output(x)), golden,
                                    rtol=1e-4, atol=1e-5)
