@@ -430,10 +430,13 @@ def _h5_weights(h5file) -> dict[str, list[np.ndarray]]:
     return weights
 
 
-def import_keras_model_and_weights(path: str, loss: str = "mcxent") -> MultiLayerNetwork:
-    """Full .h5 import (``KerasModelImport.importKerasSequentialModelAndWeights``):
-    architecture from the file's ``model_config`` attribute + weights from
-    ``model_weights``.  Requires h5py (present in this environment)."""
+def import_keras_model_and_weights(path: str, loss: str = "mcxent"):
+    """Full .h5 import (``KerasModelImport.importKerasSequentialModelAndWeights``
+    / ``importKerasModelAndWeights``): architecture from the file's
+    ``model_config`` attribute + weights from ``model_weights``.  Returns a
+    :class:`MultiLayerNetwork` for Sequential models, a
+    :class:`~deeplearning4j_tpu.nn.graph.ComputationGraph` for Functional
+    ones — both expose the same fit/output/evaluate surface."""
     import h5py
     with h5py.File(path, "r") as f:
         model_config = f.attrs.get("model_config")
@@ -527,11 +530,13 @@ def import_functional(model_json: str,
     from deeplearning4j_tpu.nn.vertices import FlattenVertex
 
     builder = NeuralNetConfiguration.builder().graph()
-    input_names, input_types = [], []
+    input_shapes: dict[str, Any] = {}
     # effective graph name for each keras layer (structural layers alias
     # to their input's name)
     alias: dict[str, str] = {}
-    out_is_dense: dict[str, DenseLayer] = {}
+    # keras names of the graph outputs, known before the layer walk —
+    # terminal Dense layers convert to OutputLayer at add time
+    output_knames = set(_io_layer_names(cfg["output_layers"]))
 
     for kcfg in cfg["layers"]:
         cls = kcfg["class_name"]
@@ -543,10 +548,8 @@ def import_functional(model_json: str,
                 f"multi-call import is not supported")
         inbound = [alias[n] for n in _inbound_names(kcfg)]
         if cls == "InputLayer":
-            shape = (kcfg["config"].get("batch_input_shape")
-                     or kcfg["config"].get("batch_shape"))
-            input_names.append(name)
-            input_types.append(_shape_to_input_type(shape))
+            input_shapes[name] = (kcfg["config"].get("batch_input_shape")
+                                  or kcfg["config"].get("batch_shape"))
             alias[name] = name
             continue
         if cls == "Flatten":
@@ -566,23 +569,20 @@ def import_functional(model_json: str,
             assert len(inbound) == 1
             alias[name] = inbound[0]
             continue
+        if (name in output_knames and isinstance(layer, DenseLayer)
+                and not isinstance(layer, OutputLayer)):
+            layer = _dense_to_output(layer, loss)  # terminal → loss head
         builder.add_layer(name, layer, *inbound)
         alias[name] = name
-        if isinstance(layer, DenseLayer) and not isinstance(layer, OutputLayer):
-            out_is_dense[name] = layer
 
+    # graph inputs bound in the USER'S declared order (cfg['input_layers'])
+    # — the layers list is creation-ordered, which can differ for
+    # keras.Model(inputs=[b, a], ...)
+    input_names = _io_layer_names(cfg["input_layers"])
     builder.add_inputs(*input_names)
-    builder.set_input_types(*input_types)
-    output_names = [alias[o] for o in _io_layer_names(cfg["output_layers"])]
-    # terminal Dense layers become OutputLayers so fit() works
-    for out_name in output_names:
-        d = out_is_dense.get(out_name)
-        if d is not None:
-            out = _dense_to_output(d, loss)
-            for spec in builder._vertices:
-                if spec.name == out_name:
-                    spec.obj = out
-    builder.set_outputs(*output_names)
+    builder.set_input_types(*[_shape_to_input_type(input_shapes[n])
+                              for n in input_names])
+    builder.set_outputs(*[alias[o] for o in _io_layer_names(cfg["output_layers"])])
     net = ComputationGraph(builder.build()).init()
     if weights is not None:
         load_graph_weights(net, weights)

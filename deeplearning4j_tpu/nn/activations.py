@@ -13,6 +13,7 @@ Names are matched case-insensitively to the DL4J ``Activation`` enum.
 
 from __future__ import annotations
 
+import functools
 from typing import Callable
 
 import jax
@@ -106,5 +107,5 @@ def elu_with(alpha: float) -> ActivationFn:
 # bare callable would be dropped by Layer.to_dict), used by the Keras
 # importer for non-default slopes
 _PARAMETERIZED = {"leakyrelu": leaky_relu_with, "elu": elu_with,
-                  "thresholdedrelu": lambda t: (
-                      lambda x: jnp.where(x > t, x, 0.0))}
+                  "thresholdedrelu": lambda t: functools.partial(
+                      thresholded_relu, theta=t)}

@@ -15,9 +15,11 @@ over ICI/DCN inside jit-compiled programs:
                           threshold-encoded Aeron path per BASELINE.json).
 - ``tensor_parallel``   — NamedSharding rules for BERT-class models over
                           the ``model`` axis (capability beyond reference).
-- ``context_parallel``  — ring attention over the ``seq`` axis
-                          (shard_map + ppermute, online softmax; beyond
-                          reference — SURVEY.md §5.7).
+- ``context_parallel``  — sequence parallelism over the ``seq`` axis:
+                          ring attention (shard_map + ppermute, online
+                          softmax, optional Pallas flash inner kernel)
+                          and Ulysses all_to_all head-resharding — both
+                          beyond reference (SURVEY.md §5.7).
 - ``pipeline``          — GPipe-style microbatched stage parallelism over
                           the ``stage`` axis (beyond reference).
 - ``expert_parallel``   — mixture-of-experts FFN with all_to_all dispatch
@@ -44,6 +46,9 @@ from deeplearning4j_tpu.parallel.inference import ParallelInference
 from deeplearning4j_tpu.parallel.expert_parallel import (
     moe_ffn, moe_ffn_dense, init_moe_params, shard_moe_params,
 )
+from deeplearning4j_tpu.parallel.context_parallel import (
+    ring_attention, ulysses_attention, reference_attention,
+)
 
 __all__ = [
     "make_mesh", "MeshSpec", "ParallelWrapper",
@@ -52,4 +57,5 @@ __all__ = [
     "bitmap_encode_device", "bitmap_decode_device",
     "EncodedGradientsAccumulator", "ParallelInference",
     "moe_ffn", "moe_ffn_dense", "init_moe_params", "shard_moe_params",
+    "ring_attention", "ulysses_attention", "reference_attention",
 ]

@@ -140,3 +140,57 @@ def reference_attention(q, k, v, n_heads: int, causal: bool = False):
     """Single-device ground truth for ring_attention tests."""
     from deeplearning4j_tpu.ops.attention import multi_head_attention
     return multi_head_attention(q, k, v, n_heads=n_heads, causal=causal)
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      mesh: Mesh, axis: str = "seq", n_heads: int = 1,
+                      causal: bool = False,
+                      data_axis: str | None = None) -> jnp.ndarray:
+    """DeepSpeed-Ulysses-style sequence parallelism: two ``all_to_all``s
+    instead of a ring.  q/k/v: [B, T, H*D] globally, sharded over
+    ``axis`` on the token dim.  The first all_to_all re-shards from
+    token-sharded to HEAD-sharded (each device receives every token for
+    H/n of the heads), attention runs dense per local head group, and the
+    inverse all_to_all restores token sharding.
+
+    Complement to :func:`ring_attention` (SURVEY §5.7): Ulysses moves
+    activations twice through all-to-all (bandwidth ∝ T·H·D/n per
+    device) but runs each head's attention un-tiled, so it wins when
+    n ≪ heads and sequence blocks are small; the ring wins at pod scale
+    where neighbor-only ICI traffic matters.  Requires n_heads % n == 0.
+    """
+    n_dev = mesh.shape[axis]
+    if n_heads % n_dev:
+        raise ValueError(f"n_heads={n_heads} must be divisible by the "
+                         f"'{axis}' axis size {n_dev} for Ulysses SP")
+
+    def local(q, k, v):
+        b, t_local, dmodel = q.shape
+        dh = dmodel // n_heads
+
+        def scatter_heads(x):
+            xh = x.reshape(b, t_local, n_heads, dh)
+            # tokens gathered, heads scattered: [B, T, H/n, dh]
+            return lax.all_to_all(xh, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+        qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+        qh = qh.transpose(0, 2, 1, 3)     # [B, H/n, T, dh]
+        kh = kh.transpose(0, 2, 1, 3)
+        vh = vh.transpose(0, 2, 1, 3)
+        scale = 1.0 / math.sqrt(dh)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+        if causal:
+            t = scores.shape[-1]
+            mask = jnp.tril(jnp.ones((t, t), bool))
+            scores = jnp.where(mask[None, None], scores, NEG_INF)
+        out = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, -1), vh)
+        out = out.transpose(0, 2, 1, 3)   # [B, T, H/n, dh]
+        # inverse: tokens scattered back, heads gathered
+        out = lax.all_to_all(out, axis, split_axis=1, concat_axis=2,
+                             tiled=True)  # [B, T/n, H, dh]
+        return out.reshape(b, t_local, dmodel)
+
+    spec = P(data_axis, axis)
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)(q, k, v)

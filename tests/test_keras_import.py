@@ -84,6 +84,23 @@ if spec.get("functional") == "conv_branches":
     lr = keras.layers.LeakyReLU(name="lre")(cat)   # default alpha 0.3
     out = keras.layers.Dense(3, activation="softmax", name="fout")(lr)
     model = keras.Model(inputs=inp, outputs=out)
+elif spec.get("functional") == "two_inputs_reordered":
+    # inputs declared in REVERSE creation order: binds must follow
+    # config['input_layers'], not the layers list
+    ia = keras.layers.Input(shape=(5,), name="in_a")
+    ib = keras.layers.Input(shape=(7,), name="in_b")
+    da = keras.layers.Dense(4, activation="relu", name="da")(ia)
+    db = keras.layers.Dense(4, activation="tanh", name="db")(ib)
+    cat = keras.layers.Concatenate(name="cat")([da, db])
+    out = keras.layers.Dense(2, activation="softmax", name="fout")(cat)
+    model = keras.Model(inputs=[ib, ia], outputs=out)   # b FIRST
+    model.save(spec["h5"])
+    rng = np.random.default_rng(spec["seed"])
+    xb = rng.normal(size=(4, 7)).astype(np.float32)
+    xa = rng.normal(size=(4, 5)).astype(np.float32)
+    np.savez(spec["npz"], xb=xb, xa=xa,
+             golden=model.predict([xb, xa], verbose=0))
+    raise SystemExit(0)
 elif spec.get("functional"):
     # fixed functional topology: dense branch + skip, concat, head
     inp = keras.layers.Input(shape=tuple(spec["functional"]["shape"]))
@@ -235,6 +252,26 @@ class TestKerasH5Golden:
         assert isinstance(net, ComputationGraph)
         np.testing.assert_allclose(np.asarray(net.output(x)), golden,
                                    rtol=1e-4, atol=1e-5)
+
+    def test_functional_reordered_inputs_golden(self, tmp_path):
+        """keras.Model(inputs=[b, a]) with creation order (a, b): feature
+        binding must follow config['input_layers'] order."""
+        h5 = str(tmp_path / "model.h5")
+        npz = str(tmp_path / "golden.npz")
+        spec = {"layers": [], "h5": h5, "npz": npz, "x_shape": [1],
+                "seed": 13, "functional": "two_inputs_reordered"}
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = ""
+        proc = subprocess.run([sys.executable, "-c", _GEN, json.dumps(spec)],
+                              capture_output=True, timeout=300, env=env)
+        if proc.returncode != 0:
+            if b"No module named 'tensorflow'" in proc.stderr:
+                pytest.skip("tensorflow unavailable")
+            raise RuntimeError(proc.stderr.decode()[-1500:])
+        d = np.load(npz)
+        net = import_keras_model_and_weights(h5)
+        got = np.asarray(net.output([d["xb"], d["xa"]]))
+        np.testing.assert_allclose(got, d["golden"], rtol=1e-4, atol=1e-5)
 
     def test_functional_conv_flatten_concat_golden(self, tmp_path):
         """Explicit Flatten feeding a Concatenate becomes a real vertex
