@@ -15,6 +15,7 @@ reference's host-side NDArray scans.
 
 from __future__ import annotations
 
+import html as _html
 import json
 import math
 import os
@@ -113,11 +114,47 @@ def _host(stats_tree) -> dict:
     return jax.tree_util.tree_map(conv, stats_tree)
 
 
+def model_topology(model) -> Optional[dict]:
+    """Static model description for the UI's Model tab
+    (``StatsInitializationReport`` parity): node list + edges."""
+    conf = getattr(model, "conf", None)
+    if conf is None:
+        return None
+    if hasattr(conf, "vertices"):          # ComputationGraph
+        nodes, edges = [], []
+        for n in conf.inputs:
+            nodes.append({"name": n, "kind": "input"})
+        # topo order, not insertion order — the SVG layout computes node
+        # depth in one pass over the node list
+        for spec in conf.topo_order():
+            label = type(spec.obj).__name__
+            n_out = getattr(spec.obj, "n_out", None)
+            nodes.append({"name": spec.name, "kind": label,
+                          **({"n_out": n_out} if n_out else {})})
+            edges += [[src, spec.name] for src in spec.inputs]
+        return {"nodes": nodes, "edges": edges, "outputs": list(conf.outputs)}
+    if hasattr(conf, "layers"):            # MultiLayerNetwork
+        nodes = [{"name": "input", "kind": "input"}]
+        edges = []
+        prev = "input"
+        for i, layer in enumerate(conf.layers):
+            name = layer.name or f"layer_{i}"
+            n_out = getattr(layer, "n_out", None)
+            nodes.append({"name": name, "kind": type(layer).__name__,
+                          **({"n_out": n_out} if n_out else {})})
+            edges.append([prev, name])
+            prev = name
+        return {"nodes": nodes, "edges": edges, "outputs": [prev]}
+    return None
+
+
 class StatsListener(TrainingListener):
     """Samples model stats every N iterations into a StatsStorage
     (``StatsListener.java`` parity).  The Trainer detects this listener
     (``wants_model_stats``) and runs its stats-collecting train step on
-    sampling iterations, then dispatches ``stats_ready``."""
+    sampling iterations, then dispatches ``stats_ready``.  The first
+    record is a one-time static ``init`` record carrying the model
+    topology (``StatsInitializationReport`` parity) for the Model tab."""
 
     wants_model_stats = True
 
@@ -125,12 +162,22 @@ class StatsListener(TrainingListener):
         self.storage = storage
         self.frequency = max(frequency, 1)
         self._last_stats_iteration = -1
+        self._init_sent = False
+
+    def _maybe_send_init(self, model):
+        if self._init_sent:
+            return
+        self._init_sent = True
+        topo = model_topology(model)
+        if topo is not None:
+            self.storage.put({"type": "init", "model": topo})
 
     def wants_stats_now(self, iteration: int) -> bool:
         return iteration % self.frequency == 0
 
     def stats_ready(self, model, iteration: int, epoch: int, score: float,
                     stats: dict) -> None:
+        self._maybe_send_init(model)
         self._last_stats_iteration = iteration
         record = {"type": "stats", "iteration": iteration, "epoch": epoch,
                   "score": float(score)}
@@ -138,6 +185,7 @@ class StatsListener(TrainingListener):
         self.storage.put(record)
 
     def iteration_done(self, model, iteration, epoch, score):
+        self._maybe_send_init(model)
         # score-only record whenever stats_ready did NOT fire this
         # iteration (non-sampled iterations, and paths without a stats
         # step like tBPTT) — keeps the score chart dense
@@ -190,6 +238,54 @@ def _histogram_svg(counts, lo, hi, w=320, h=120, color="#ff7f0e"):
             f'</svg>')
 
 
+def _topology_svg(topo: dict) -> str:
+    """Model-tab rendering: topo-layered boxes with edges (the reference
+    web UI's graph view, server-side SVG here).  Node depth = longest
+    path from an input, nodes at equal depth spread horizontally."""
+    nodes = topo.get("nodes", [])
+    edges = topo.get("edges", [])
+    depth: dict[str, int] = {}
+    preds: dict[str, list] = {}
+    for src, dst in edges:
+        preds.setdefault(dst, []).append(src)
+    for n in nodes:                       # nodes arrive topo-sorted
+        name = n["name"]
+        depth[name] = 1 + max((depth.get(p, 0) for p in preds.get(name, [])),
+                              default=0) if preds.get(name) else 0
+    rows: dict[int, list] = {}
+    for n in nodes:
+        rows.setdefault(depth[n["name"]], []).append(n)
+    bw, bh, vgap, hgap = 150, 34, 26, 16
+    width = max((len(r) for r in rows.values()), default=1) * (bw + hgap) + hgap
+    height = (max(rows, default=0) + 1) * (bh + vgap) + vgap
+    pos: dict[str, tuple] = {}
+    boxes = []
+    for d, row in sorted(rows.items()):
+        total = len(row) * (bw + hgap) - hgap
+        x0 = (width - total) / 2
+        for j, n in enumerate(row):
+            x, y = x0 + j * (bw + hgap), vgap + d * (bh + vgap)
+            pos[n["name"]] = (x + bw / 2, y)
+            label = _html.escape(
+                n["name"] if n["kind"] == "input" else
+                f"{n['name']}: {n['kind']}"
+                + (f" ({n['n_out']})" if n.get("n_out") else ""))
+            fill = "#e8f0fe" if n["kind"] != "input" else "#e6f4ea"
+            boxes.append(
+                f'<rect x="{x:.0f}" y="{y:.0f}" width="{bw}" height="{bh}" '
+                f'rx="6" fill="{fill}" stroke="#888"/>'
+                f'<text x="{x + bw / 2:.0f}" y="{y + bh / 2 + 4:.0f}" '
+                f'font-size="10" text-anchor="middle">{label[:26]}</text>')
+    lines = []
+    for src, dst in edges:
+        if src in pos and dst in pos:
+            (x1, y1), (x2, y2) = pos[src], pos[dst]
+            lines.append(f'<line x1="{x1:.0f}" y1="{y1 + bh:.0f}" '
+                         f'x2="{x2:.0f}" y2="{y2:.0f}" stroke="#aaa"/>')
+    return (f'<svg width="{width:.0f}" height="{height:.0f}">'
+            + "".join(lines) + "".join(boxes) + "</svg>")
+
+
 def render_html_report(storage, out_path: str, title: str = "Training report") -> str:
     """StatsStorage → static self-contained HTML (UI-lite per SURVEY §2.8):
     score chart, per-layer param/grad/update norms and update:param
@@ -217,6 +313,11 @@ def render_html(storage, title: str = "Training report",
              "h2{border-bottom:1px solid #ccc} .row{display:flex;gap:24px;"
              "flex-wrap:wrap} .card{margin:8px}</style></head><body>",
              f"<h1>{title}</h1>"]
+
+    inits = [r for r in records if r.get("type") == "init"]
+    if inits:
+        parts.append("<h2>Model</h2>")
+        parts.append(_topology_svg(inits[0]["model"]))
 
     parts.append("<h2>Score (loss)</h2>")
     parts.append(_polyline([i for i, _ in scores], [s for _, s in scores]))

@@ -84,7 +84,10 @@ class TestStatsListener:
         storage = InMemoryStatsStorage()
         Trainer(net, listeners=[StatsListener(storage, frequency=1)]).fit(
             it, epochs=3)
-        assert len(storage.all()) == 3            # one record per iteration
+        records = storage.all()
+        # one static init record + one score record per iteration
+        assert [r["type"] for r in records].count("init") == 1
+        assert len(records) == 4
 
     def test_file_storage_replay(self, tmp_path):
         path = str(tmp_path / "stats.jsonl")
@@ -124,3 +127,41 @@ class TestHtmlReport:
         out = render_html_report(InMemoryStatsStorage(),
                                  str(tmp_path / "empty.html"))
         assert "<html>" in open(out).read()
+
+
+class TestModelTab:
+    def test_init_record_and_model_svg(self, tmp_path):
+        """StatsInitializationReport parity: one static topology record,
+        rendered as the Model section of the report."""
+        from deeplearning4j_tpu.obs.stats import model_topology, render_html
+        net = _net()
+        storage = InMemoryStatsStorage()
+        Trainer(net, listeners=[StatsListener(storage, frequency=2)]).fit(
+            _data(), epochs=1)
+        inits = [r for r in storage.all() if r["type"] == "init"]
+        assert len(inits) == 1
+        names = [n["name"] for n in inits[0]["model"]["nodes"]]
+        assert names[0] == "input" and len(names) == 3
+        html = render_html(storage)
+        assert "<h2>Model</h2>" in html and "DenseLayer" in html
+
+    def test_graph_topology(self):
+        from deeplearning4j_tpu.obs.stats import model_topology
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.vertices import ElementWiseVertex
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+                .graph()
+                .add_inputs("in")
+                .set_input_types(InputType.feed_forward(6))
+                .add_layer("d1", DenseLayer(n_out=8, activation="relu"), "in")
+                .add_layer("d2", DenseLayer(n_out=8, activation="relu"), "d1")
+                .add_vertex("skip", ElementWiseVertex(op="add"), "d1", "d2")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss="mcxent"), "skip")
+                .set_outputs("out").build())
+        topo = model_topology(ComputationGraph(conf).init())
+        kinds = {n["name"]: n["kind"] for n in topo["nodes"]}
+        assert kinds["in"] == "input"
+        assert kinds["skip"] == "ElementWiseVertex"
+        assert ["d1", "skip"] in topo["edges"] and ["d2", "skip"] in topo["edges"]
+        assert topo["outputs"] == ["out"]
