@@ -169,8 +169,16 @@ class StatsListener(TrainingListener):
             return
         self._init_sent = True
         topo = model_topology(model)
-        if topo is not None:
-            self.storage.put({"type": "init", "model": topo})
+        if topo is None:
+            return
+        # a replayed FileStatsStorage may already carry this topology from
+        # a prior run — don't append a duplicate
+        for r in reversed(self.storage.all()):
+            if r.get("type") == "init":
+                if r.get("model") == topo:
+                    return
+                break
+        self.storage.put({"type": "init", "model": topo})
 
     def wants_stats_now(self, iteration: int) -> bool:
         return iteration % self.frequency == 0
@@ -266,16 +274,18 @@ def _topology_svg(topo: dict) -> str:
         for j, n in enumerate(row):
             x, y = x0 + j * (bw + hgap), vgap + d * (bh + vgap)
             pos[n["name"]] = (x + bw / 2, y)
-            label = _html.escape(
-                n["name"] if n["kind"] == "input" else
-                f"{n['name']}: {n['kind']}"
-                + (f" ({n['n_out']})" if n.get("n_out") else ""))
+            raw = (n["name"] if n["kind"] == "input" else
+                   f"{n['name']}: {n['kind']}"
+                   + (f" ({n['n_out']})" if n.get("n_out") else ""))
+            # truncate BEFORE escaping — slicing an escaped string can
+            # split an entity like &amp; mid-sequence
+            label = _html.escape(raw[:26])
             fill = "#e8f0fe" if n["kind"] != "input" else "#e6f4ea"
             boxes.append(
                 f'<rect x="{x:.0f}" y="{y:.0f}" width="{bw}" height="{bh}" '
                 f'rx="6" fill="{fill}" stroke="#888"/>'
                 f'<text x="{x + bw / 2:.0f}" y="{y + bh / 2 + 4:.0f}" '
-                f'font-size="10" text-anchor="middle">{label[:26]}</text>')
+                f'font-size="10" text-anchor="middle">{label}</text>')
     lines = []
     for src, dst in edges:
         if src in pos and dst in pos:
@@ -317,7 +327,8 @@ def render_html(storage, title: str = "Training report",
     inits = [r for r in records if r.get("type") == "init"]
     if inits:
         parts.append("<h2>Model</h2>")
-        parts.append(_topology_svg(inits[0]["model"]))
+        # latest topology: a replayed storage may carry older runs' models
+        parts.append(_topology_svg(inits[-1]["model"]))
 
     parts.append("<h2>Score (loss)</h2>")
     parts.append(_polyline([i for i, _ in scores], [s for _, s in scores]))

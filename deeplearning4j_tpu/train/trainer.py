@@ -100,7 +100,7 @@ def make_loss_fn(net, with_carries: bool = False, train: bool = True):
     return loss_fn
 
 
-def make_tbptt_step(net, tx):
+def make_tbptt_step(net, tx, opt_state_shardings=None):
     """jit'd tBPTT segment step: like ``make_train_step`` but threads
     recurrent carries — forward state flows across segments, gradients
     truncate at segment boundaries (``stop_gradient`` inside
@@ -115,20 +115,29 @@ def make_tbptt_step(net, tx):
             loss_fn, has_aux=True)(params, state, carries, features, labels,
                                    features_mask, labels_mask, rng)
         updates, opt_state = tx.update(grads, opt_state, params)
+        if opt_state_shardings is not None:   # ZeRO-1 placement pin
+            opt_state = jax.lax.with_sharding_constraint(
+                opt_state, opt_state_shardings)
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
         return params, new_state, opt_state, new_carries, loss
 
     return step
 
 
-def make_train_step(net, tx, with_stats: bool = False):
+def make_train_step(net, tx, with_stats: bool = False,
+                    opt_state_shardings=None):
     """jit'd (params, state, opt_state, batch..., rng) → updated triple + loss.
 
     ``with_stats=True`` additionally returns per-layer parameter /
     gradient / update statistics (L2 norms, mean/stdev, 20-bin histograms)
     computed ON DEVICE inside the same program — the StatsListener samples
     this step at its frequency, so stats cost nothing on non-sampled
-    iterations and never round-trip full tensors to the host."""
+    iterations and never round-trip full tensors to the host.
+
+    ``opt_state_shardings`` (a pytree of NamedSharding matching the
+    opt_state) pins the updated optimizer state's placement — the
+    ZeRO-1 hook: GSPMD then keeps each updater-state shard resident on
+    its owning device instead of re-replicating it every step."""
     loss_fn = make_loss_fn(net)
 
     def _layer_stats(tree):
@@ -143,6 +152,9 @@ def make_train_step(net, tx, with_stats: bool = False):
         (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params, state, features, labels, features_mask, labels_mask, rng)
         updates, opt_state = tx.update(grads, opt_state, params)
+        if opt_state_shardings is not None:
+            opt_state = jax.lax.with_sharding_constraint(
+                opt_state, opt_state_shardings)
         new_params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
         if with_stats:
             stats = {"params": _layer_stats(new_params),
@@ -240,6 +252,10 @@ class Trainer:
                 lambda p: _optax.EmptyState(), mask_fn))
         return tx
 
+    # pytree of NamedSharding for the opt_state, set by subclasses BEFORE
+    # the first step is built (ParallelWrapper's ZeRO-1 mode)
+    _opt_state_shardings = None
+
     def _ensure_ready(self):
         net = self.net
         if net.params_ is None:
@@ -247,7 +263,8 @@ class Trainer:
         if net.opt_state is None:
             net.opt_state = self.tx.init(net.params_)
         if self._step is None:
-            self._step = make_train_step(net, self.tx)
+            self._step = make_train_step(
+                net, self.tx, opt_state_shardings=self._opt_state_shardings)
 
     def _prepare_batch(self, batch):
         """Hook for subclasses (ParallelWrapper shards the batch over the
@@ -289,7 +306,9 @@ class Trainer:
                 _as_device(fmask), _as_device(lmask), rng)
         if sampling:
             if self._stats_step is None:
-                self._stats_step = make_train_step(net, self.tx, with_stats=True)
+                self._stats_step = make_train_step(
+                    net, self.tx, with_stats=True,
+                    opt_state_shardings=self._opt_state_shardings)
             params, state, opt_state, loss, stats = self._stats_step(*args)
             # publish the fresh (non-donated) buffers BEFORE listeners run —
             # net.params_ still references donated inputs at this point
@@ -317,7 +336,8 @@ class Trainer:
         self._ensure_ready()
         net = self.net
         if self._tbptt_step is None:
-            self._tbptt_step = make_tbptt_step(net, self.tx)
+            self._tbptt_step = make_tbptt_step(
+                net, self.tx, opt_state_shardings=self._opt_state_shardings)
         b = batch.features.shape[0]
         dtype = jnp.asarray(batch.features).dtype
         carries = [layer.init_carry(b, dtype)
