@@ -1,0 +1,140 @@
+"""ctypes wrapper for the native CSV parser (``src/fast_io.cpp``).
+
+Same build discipline as :mod:`deeplearning4j_tpu.native.codec`: compiled
+by g++ on first use, content-hash staleness, never committed, optional
+ASan via ``DL4J_TPU_NATIVE_SANITIZE=1``.  ``available()`` gates callers;
+the python ``csv`` module is the fallback and the correctness oracle.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "src", "fast_io.cpp")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "src", "build")
+_LIB = os.path.join(_BUILD_DIR, "libfast_io.so")
+_HASH_FILE = _LIB + ".srchash"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _src_hash() -> str:
+    with open(_SRC, "rb") as f:
+        h = hashlib.sha256(f.read())
+    if os.environ.get("DL4J_TPU_NATIVE_SANITIZE"):
+        h.update(b"sanitize")
+    return h.hexdigest()
+
+
+def _build() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17"]
+    if os.environ.get("DL4J_TPU_NATIVE_SANITIZE"):
+        cmd += ["-fsanitize=address,undefined", "-fno-omit-frame-pointer", "-g"]
+    cmd += ["-o", _LIB, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            FileNotFoundError):
+        return False
+    try:
+        with open(_HASH_FILE, "w") as f:
+            f.write(_src_hash())
+    except OSError:
+        pass
+    return True
+
+
+def _stale() -> bool:
+    if not os.path.exists(_LIB):
+        return True
+    try:
+        with open(_HASH_FILE) as f:
+            return f.read().strip() != _src_hash()
+    except OSError:
+        return True
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.csv_dims.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_char,
+                             ctypes.c_int64,
+                             ctypes.POINTER(ctypes.c_int64),
+                             ctypes.POINTER(ctypes.c_int64)]
+    lib.csv_dims.restype = None
+    lib.csv_parse.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_char,
+                              ctypes.c_int64,
+                              np.ctypeslib.ndpointer(np.float32,
+                                                     flags="C_CONTIGUOUS"),
+                              ctypes.c_int64, ctypes.c_int64, ctypes.c_float]
+    lib.csv_parse.restype = ctypes.c_int64
+    return lib
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if _stale() and not _build():
+            _build_failed = True
+            return None
+        try:
+            _lib = _bind(ctypes.CDLL(_LIB))
+        except OSError:
+            # stale/incompatible binary: one rebuild attempt
+            if _build():
+                try:
+                    _lib = _bind(ctypes.CDLL(_LIB))
+                except OSError:
+                    _build_failed = True
+                    return None
+            else:
+                _build_failed = True
+                return None
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def read_csv_floats(path_or_bytes, delimiter: str = ",",
+                    skip_rows: int = 0, fill: float = float("nan")
+                    ) -> tuple[np.ndarray, int]:
+    """Parse a numeric CSV into a float32 [rows, cols] array.
+
+    Returns ``(array, n_errors)`` where errors are cells that failed to
+    parse (written as NaN).  Raises RuntimeError when the native library
+    is unavailable — callers gate on :func:`available`.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native fast_io unavailable")
+    if isinstance(path_or_bytes, (bytes, bytearray)):
+        buf = bytes(path_or_bytes)
+    else:
+        with open(path_or_bytes, "rb") as f:
+            buf = f.read()
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    d = delimiter.encode()[0:1]
+    lib.csv_dims(buf, len(buf), d, skip_rows, ctypes.byref(rows),
+                 ctypes.byref(cols))
+    out = np.empty((rows.value, cols.value), np.float32)
+    errors = 0
+    if out.size:
+        errors = lib.csv_parse(buf, len(buf), d, skip_rows, out,
+                               rows.value, cols.value,
+                               np.float32(fill))
+    return out, int(errors)

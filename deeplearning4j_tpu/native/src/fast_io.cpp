@@ -1,0 +1,111 @@
+// Fast host-side ETL kernels: CSV → float32 matrix.
+//
+// Parity role: the reference's record readers run on the JVM with
+// native-speed parsing underneath (datavec-api CSVRecordReader atop
+// Java's optimized IO); this module is the C++ twin for our python ETL —
+// the decode-side hot loop of RecordReaderDataSetIterator.  The python
+// csv module is the fallback and the correctness oracle.
+//
+// API (flat C ABI for ctypes):
+//   csv_dims(buf, len, delim, skip_rows, &rows, &cols)
+//       count data rows and columns of the widest row.
+//   csv_parse(buf, len, delim, skip_rows, out, rows, cols, fill)
+//       parse into a row-major float32 [rows, cols] buffer; short rows
+//       pad with `fill`; returns number of parse errors (cells that were
+//       not valid floats — written as NaN).
+//
+// Both are single pass over the mmap'd/posix-read buffer, no allocation.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <cmath>
+
+extern "C" {
+
+void csv_dims(const char* buf, int64_t len, char delim, int64_t skip_rows,
+              int64_t* rows, int64_t* cols) {
+    int64_t r = 0, c = 0, max_c = 0, line = 0;
+    bool in_row = false;
+    for (int64_t i = 0; i < len; ++i) {
+        char ch = buf[i];
+        if (ch == '\n') {
+            if (in_row && line >= skip_rows) {
+                ++r;
+                if (c + 1 > max_c) max_c = c + 1;
+            }
+            ++line;
+            c = 0;
+            in_row = false;
+        } else if (ch == delim) {
+            if (line >= skip_rows) ++c;
+            in_row = true;
+        } else if (ch != '\r') {
+            in_row = true;
+        }
+    }
+    if (in_row && line >= skip_rows) {   // last line without newline
+        ++r;
+        if (c + 1 > max_c) max_c = c + 1;
+    }
+    *rows = r;
+    *cols = max_c;
+}
+
+int64_t csv_parse(const char* buf, int64_t len, char delim,
+                  int64_t skip_rows, float* out, int64_t rows, int64_t cols,
+                  float fill) {
+    int64_t errors = 0;
+    int64_t line = 0, r = 0;
+    int64_t i = 0;
+    while (i < len && r < rows) {
+        // locate end of line
+        int64_t start = i;
+        while (i < len && buf[i] != '\n') ++i;
+        int64_t end = i;                 // [start, end)
+        ++i;                             // past '\n'
+        if (line++ < skip_rows) continue;
+        while (end > start && buf[end - 1] == '\r') --end;  // strip ALL CRs
+        if (end == start) continue;      // blank (or CR-only) line
+        float* row_out = out + r * cols;
+        int64_t c = 0;
+        int64_t p = start;
+        while (p <= end && c < cols) {
+            int64_t q = p;
+            while (q < end && buf[q] != delim) ++q;
+            // parse [p, q)
+            if (q > p) {
+                char tmp[64];
+                int64_t n = q - p;
+                if (n < 63) {
+                    std::memcpy(tmp, buf + p, n);
+                    tmp[n] = 0;
+                    char* endp = nullptr;
+                    float v = std::strtof(tmp, &endp);
+                    // allow surrounding spaces
+                    while (endp && *endp == ' ') ++endp;
+                    if (endp == tmp || (endp && *endp != 0)) {
+                        row_out[c] = NAN;
+                        ++errors;
+                    } else {
+                        row_out[c] = v;
+                    }
+                } else {
+                    row_out[c] = NAN;
+                    ++errors;
+                }
+            } else {
+                row_out[c] = NAN;        // empty cell
+                ++errors;
+            }
+            ++c;
+            if (q >= end) break;
+            p = q + 1;
+        }
+        for (; c < cols; ++c) row_out[c] = fill;   // short row padding
+        ++r;
+    }
+    return errors;
+}
+
+}  // extern "C"

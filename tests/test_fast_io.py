@@ -1,0 +1,95 @@
+"""Native CSV parser vs the python oracle (SURVEY §7.9: native code under
+round-trip properties; the ETL decode hot path)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.records import CSVRecordReader, FileSplit
+from deeplearning4j_tpu.native import fast_io
+
+
+needs_native = pytest.mark.skipif(not fast_io.available(),
+                                  reason="g++/native build unavailable")
+
+
+def _write(tmp_path, text, name="data.csv"):
+    p = str(tmp_path / name)
+    with open(p, "w", newline="") as f:
+        f.write(text)
+    return p
+
+
+@needs_native
+def test_simple_matrix(tmp_path):
+    p = _write(tmp_path, "1,2,3\n4,5,6\n")
+    arr, errs = fast_io.read_csv_floats(p)
+    np.testing.assert_array_equal(arr, [[1, 2, 3], [4, 5, 6]])
+    assert errs == 0
+
+
+@needs_native
+def test_crlf_skip_rows_and_no_trailing_newline(tmp_path):
+    p = _write(tmp_path, "h1,h2\r\n1.5,2.5\r\n-3,4e2")
+    arr, errs = fast_io.read_csv_floats(p, skip_rows=1)
+    np.testing.assert_allclose(arr, [[1.5, 2.5], [-3.0, 400.0]])
+    assert errs == 0
+
+
+@needs_native
+def test_bad_cells_and_short_rows(tmp_path):
+    p = _write(tmp_path, "1,x,3\n4,5\n")
+    arr, errs = fast_io.read_csv_floats(p)
+    assert arr.shape == (2, 3)
+    assert np.isnan(arr[0, 1]) and errs == 1
+    assert arr[1, 0] == 4 and arr[1, 1] == 5
+    assert np.isnan(arr[1, 2])     # short-row padding (fill=NaN, no error)
+
+
+@needs_native
+def test_matches_python_oracle_random(tmp_path):
+    rng = np.random.default_rng(0)
+    ref = rng.normal(size=(200, 7)).astype(np.float32)
+    lines = "\n".join(",".join(f"{v:.6g}" for v in row) for row in ref)
+    p = _write(tmp_path, lines + "\n")
+    arr, errs = fast_io.read_csv_floats(p)
+    assert errs == 0
+    # %.6g keeps ~6 significant digits; parse must match within that
+    np.testing.assert_allclose(arr, ref.astype(np.float32),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_load_array_native_equals_python(tmp_path):
+    """CSVRecordReader.load_array must give identical output whichever
+    backend runs."""
+    text = "a,b,c\n1,2,3\n4,,6\n7,8\n"
+    p = _write(tmp_path, text)
+    reader = CSVRecordReader(FileSplit(p), skip_lines=1)
+    got = reader.load_array()
+    assert got.shape == (3, 3)
+    np.testing.assert_array_equal(got[0], [1, 2, 3])
+    assert np.isnan(got[1, 1]) and got[1, 2] == 6
+    assert np.isnan(got[2, 2])
+
+    if fast_io.available():
+        # force the python path and compare elementwise (NaN == NaN)
+        native, fast_io._lib = fast_io._lib, None
+        failed = fast_io._build_failed
+        fast_io._build_failed = True
+        try:
+            py = reader.load_array()
+        finally:
+            fast_io._lib, fast_io._build_failed = native, failed
+        np.testing.assert_array_equal(np.isnan(got), np.isnan(py))
+        np.testing.assert_array_equal(got[~np.isnan(got)], py[~np.isnan(py)])
+
+
+@needs_native
+def test_empty_and_blank_lines(tmp_path):
+    p = _write(tmp_path, "\n1,2\n\n3,4\n")
+    arr, errs = fast_io.read_csv_floats(p)
+    np.testing.assert_array_equal(arr, [[1, 2], [3, 4]])
+    p2 = _write(tmp_path, "", name="empty.csv")
+    arr2, _ = fast_io.read_csv_floats(p2)
+    assert arr2.shape == (0, 0)
