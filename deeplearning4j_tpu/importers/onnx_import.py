@@ -335,7 +335,15 @@ def _gather(inputs, attrs):
 @onnx_op("ReduceMean")
 def _reduce_mean(inputs, attrs):
     import jax.numpy as jnp
-    axes = tuple(attrs.get("axes", range(inputs[0].ndim)))
+    # opset >= 18 moves `axes` from an attribute to an optional second input
+    if len(inputs) > 1 and inputs[1] is not None:
+        axes = tuple(int(v) for v in np.asarray(inputs[1]))
+    else:
+        axes = tuple(attrs.get("axes", ()))
+    if not axes:
+        if bool(attrs.get("noop_with_empty_axes", 0)):
+            return inputs[0]
+        axes = None  # default: reduce over all axes
     return jnp.mean(inputs[0], axis=axes,
                     keepdims=bool(attrs.get("keepdims", 1)))
 

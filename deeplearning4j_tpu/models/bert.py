@@ -220,10 +220,18 @@ class BertForMaskedLM:
         return param_count(self.params)
 
     def make_train_step(self, tx):
+        """Build the jit'd MLM train step.
+
+        DONATION CONTRACT: the returned step donates its ``params`` and
+        ``opt_state`` arguments (updated in place in HBM).  After calling
+        ``step(params, opt_state, ...)`` the arrays passed in are DELETED —
+        callers MUST rebind to the returned ``(params, opt_state, loss)``,
+        e.g. ``model.params, model.opt_state, loss = step(model.params, ...)``
+        exactly as :meth:`fit` does.  Reading ``model.params`` after a manual
+        step without rebinding raises a deleted-buffer error.
+        """
         config = self.config
 
-        # params/opt_state buffers are donated (updated in place in HBM)
-        # — callers must rebind to the returned values, as fit() does
         @partial(jax.jit, donate_argnums=(0, 1))
         def step(params, opt_state, input_ids, labels, label_weights,
                  attention_mask, rng):

@@ -14,7 +14,8 @@
 //       pad with `fill`; returns number of parse errors (cells that were
 //       not valid floats — written as NaN).
 //
-// Both are single pass over the mmap'd/posix-read buffer, no allocation.
+// Both are single pass over the mmap'd/posix-read buffer; the only
+// allocation is a per-cell heap buffer for cells >= 63 chars (rare).
 
 #include <cstdint>
 #include <cstdlib>
@@ -75,25 +76,33 @@ int64_t csv_parse(const char* buf, int64_t len, char delim,
             while (q < end && buf[q] != delim) ++q;
             // parse [p, q)
             if (q > p) {
+                // stack buffer for the common case; heap for long cells so
+                // the native path matches the python csv fallback exactly
                 char tmp[64];
                 int64_t n = q - p;
-                if (n < 63) {
-                    std::memcpy(tmp, buf + p, n);
-                    tmp[n] = 0;
-                    char* endp = nullptr;
-                    float v = std::strtof(tmp, &endp);
-                    // allow surrounding spaces
-                    while (endp && *endp == ' ') ++endp;
-                    if (endp == tmp || (endp && *endp != 0)) {
-                        row_out[c] = NAN;
-                        ++errors;
-                    } else {
-                        row_out[c] = v;
-                    }
-                } else {
+                char* cell = tmp;
+                if (n >= 63) cell = static_cast<char*>(std::malloc(n + 1));
+                if (cell == nullptr) {       // malloc failed: record as error
                     row_out[c] = NAN;
                     ++errors;
+                    ++c;
+                    if (q >= end) break;
+                    p = q + 1;
+                    continue;
                 }
+                std::memcpy(cell, buf + p, n);
+                cell[n] = 0;
+                char* endp = nullptr;
+                float v = std::strtof(cell, &endp);
+                // allow surrounding spaces
+                while (endp && *endp == ' ') ++endp;
+                if (endp == cell || (endp && *endp != 0)) {
+                    row_out[c] = NAN;
+                    ++errors;
+                } else {
+                    row_out[c] = v;
+                }
+                if (cell != tmp) std::free(cell);
             } else {
                 row_out[c] = NAN;        // empty cell
                 ++errors;

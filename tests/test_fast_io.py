@@ -48,6 +48,19 @@ def test_bad_cells_and_short_rows(tmp_path):
 
 
 @needs_native
+def test_long_cells_match_python_path(tmp_path):
+    # cells >= 63 chars used to hit the native stack-buffer cap and come
+    # back NaN; both paths must now parse them identically
+    long_num = "0." + "1" * 80            # 82-char valid float
+    long_junk = "z" * 100                 # 100-char invalid cell
+    p = _write(tmp_path, f"{long_num},2\n{long_junk},4\n")
+    arr, errs = fast_io.read_csv_floats(p)
+    np.testing.assert_allclose(arr[0], [float(long_num), 2.0])
+    assert np.isnan(arr[1, 0]) and arr[1, 1] == 4
+    assert errs == 1
+
+
+@needs_native
 def test_matches_python_oracle_random(tmp_path):
     rng = np.random.default_rng(0)
     ref = rng.normal(size=(200, 7)).astype(np.float32)

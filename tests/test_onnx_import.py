@@ -19,14 +19,14 @@ def _vi(name, shape):
                 "shape": {"dim": [{"dim_value": d} for d in shape]}}}}
 
 
-def _model_bytes(nodes, initializers, inputs, outputs):
+def _model_bytes(nodes, initializers, inputs, outputs, opset=17):
     graph = {"name": "g", "node": nodes,
              "initializer": [wire.array_to_tensor(n, a)
                              for n, a in initializers.items()],
              "input": [_vi(n, s) for n, s in inputs.items()],
              "output": [_vi(n, s) for n, s in outputs.items()]}
     model = {"ir_version": 8, "graph": graph,
-             "opset_import": [{"domain": "", "version": 17}]}
+             "opset_import": [{"domain": "", "version": opset}]}
     return wire.emit(wire.MODEL, model)
 
 
@@ -302,3 +302,16 @@ def test_elementwise_and_shape_ops():
     model = import_onnx_model(buf)
     got = np.asarray(model(a))
     np.testing.assert_allclose(got, (a.T ** 2).mean(axis=1), atol=1e-6)
+
+
+def test_reduce_mean_opset18_axes_input():
+    # opset >= 18 passes `axes` as a second input, not an attribute
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(2, 3, 4)).astype(np.float32)
+    axes = np.asarray([2], np.int64)
+    buf = _model_bytes(
+        nodes=[_node("ReduceMean", ["x", "axes"], ["y"], keepdims=0)],
+        initializers={"axes": axes},
+        inputs={"x": [2, 3, 4]}, outputs={"y": [2, 3]}, opset=18)
+    got = np.asarray(import_onnx_model(buf)(x))
+    np.testing.assert_allclose(got, x.mean(axis=2), atol=1e-6)
