@@ -106,6 +106,54 @@ def bench_bert_mlm(batch: int = 32, seq_len: int = 128, steps: int = 10,
             "batch": batch, "seq_len": seq_len}
 
 
+def bench_bert_long_seq(seq_len: int = 4096, batch: int = 2,
+                        steps: int = 5, warmup: int = 2) -> dict:
+    """Long-sequence BERT MLM train step: Pallas flash kernel (fwd+bwd)
+    vs the materializing einsum path (SURVEY §5.7 long-seq training)."""
+    import dataclasses
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.config import DTypePolicy, set_dtype_policy
+    from deeplearning4j_tpu.models import bert as bert_mod
+    from deeplearning4j_tpu.train import Adam
+
+    set_dtype_policy(DTypePolicy.bf16())
+    base = bert_mod.BertConfig(vocab_size=30522, hidden_size=768,
+                               num_layers=4, num_heads=12,
+                               intermediate_size=3072, max_position=seq_len)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, base.vocab_size, (batch, seq_len)),
+                      jnp.int32)
+    labels = jnp.asarray(rng.integers(0, base.vocab_size, (batch, seq_len)),
+                         jnp.int32)
+    weights = jnp.asarray((rng.random((batch, seq_len)) < 0.15), jnp.float32)
+    attn = jnp.ones((batch, seq_len), jnp.float32)
+    key = jax.random.key(0)
+
+    out = {"seq_len": seq_len, "batch": batch, "num_layers": base.num_layers}
+    for name, cfg in (("einsum", base),
+                      ("flash", dataclasses.replace(base, use_flash=True))):
+        model = bert_mod.BertForMaskedLM(cfg, seed=0)
+        tx = Adam(2e-5).to_optax()
+        opt = tx.init(model.params)
+        step = model.make_train_step(tx)
+        params = model.params
+        for _ in range(warmup):
+            params, opt, loss = step(params, opt, ids, labels, weights,
+                                     attn, key)
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt, loss = step(params, opt, ids, labels, weights,
+                                     attn, key)
+        jax.block_until_ready(loss)
+        out[f"{name}_step_ms"] = round(
+            (time.perf_counter() - t0) / steps * 1000, 2)
+    out["flash_speedup"] = round(out["einsum_step_ms"]
+                                 / out["flash_step_ms"], 2)
+    return out
+
+
 def _bench_net_step(net, features, labels, steps=10, warmup=2):
     """Steady-state fit_batch time for a workload net."""
     import jax
@@ -160,6 +208,10 @@ def main():
                 result["detail"]["workloads"] = bench_workload_steps()
             except Exception as e:
                 result["detail"]["workloads"] = {"error": str(e)[:200]}
+            try:  # long-seq BERT: flash (Pallas fwd+bwd) vs einsum
+                result["detail"]["bert_long_seq"] = bench_bert_long_seq()
+            except Exception as e:
+                result["detail"]["bert_long_seq"] = {"error": str(e)[:200]}
             print(json.dumps(result))
             return 0
         except Exception as e:  # OOM etc. → halve the batch and retry

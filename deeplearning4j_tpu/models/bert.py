@@ -43,6 +43,10 @@ class BertConfig:
     attention_dropout: float = 0.1
     layer_norm_eps: float = 1e-12
     initializer_range: float = 0.02
+    # long-sequence path: Pallas flash kernel (fwd + bwd) instead of the
+    # materialized [T,T] einsum chain — pays off at seq >= ~2-4k
+    use_flash: bool = False
+    flash_block: int = 0      # 0 = tuned default (512×1024 blocks)
 
     @staticmethod
     def base() -> "BertConfig":
@@ -158,7 +162,9 @@ def encode(params: dict, config: BertConfig, input_ids: jnp.ndarray,
         k = _dense(lp["attention"]["key"], x)
         v = _dense(lp["attention"]["value"], x)
         attn = multi_head_attention(q, k, v, n_heads=config.num_heads,
-                                    kv_mask=attention_mask)
+                                    kv_mask=attention_mask,
+                                    use_flash=config.use_flash,
+                                    flash_block=config.flash_block)
         attn = _dense(lp["attention"]["output"], attn)
         attn = _dropout(attn, config.hidden_dropout, train, layer_rng)
         x = _layer_norm(lp["attention"]["output_layer_norm"], x + attn,

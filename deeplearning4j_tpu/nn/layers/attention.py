@@ -31,6 +31,10 @@ class SelfAttentionLayer(Layer):
     n_heads: int = 1
     head_size: int = 0
     project_input: bool = True
+    # long-sequence path: route the inner product through the Pallas
+    # flash kernel (forward + backward, no [T,T] materialization)
+    use_flash: bool = False
+    flash_block: int = 0      # 0 = tuned default (512×1024 blocks)
 
     def get_output_type(self, input_type: InputType) -> InputType:
         if self.project_input:
@@ -64,7 +68,9 @@ class SelfAttentionLayer(Layer):
         else:
             q = k = v = x
         n_heads = self.n_heads if self.project_input else 1
-        y = multi_head_attention(q, k, v, n_heads=n_heads, mask=mask)
+        y = multi_head_attention(q, k, v, n_heads=n_heads, mask=mask,
+                                 use_flash=self.use_flash,
+                                 flash_block=self.flash_block)
         if self.project_input:
             y = jnp.einsum("btd,de->bte", y, params["Wo"])
         return y, state

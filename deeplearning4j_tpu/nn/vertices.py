@@ -197,6 +197,8 @@ class AttentionVertex(GraphVertex):
 
     n_heads: int = 1
     causal: bool = False
+    use_flash: bool = False     # Pallas blockwise kernel (long sequences)
+    flash_block: int = 0      # 0 = tuned default (512×1024 blocks)
 
     def apply(self, inputs):
         from deeplearning4j_tpu.ops.attention import multi_head_attention
@@ -207,7 +209,9 @@ class AttentionVertex(GraphVertex):
         else:
             raise ValueError("AttentionVertex takes 1 (self) or 3 (q,k,v) inputs")
         return multi_head_attention(q, k, v, n_heads=self.n_heads,
-                                    causal=self.causal)
+                                    causal=self.causal,
+                                    use_flash=self.use_flash,
+                                    flash_block=self.flash_block)
 
     def get_output_type(self, input_types):
         q, v = input_types[0], input_types[-1]

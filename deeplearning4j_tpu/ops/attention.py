@@ -38,14 +38,30 @@ def multi_head_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                          n_heads: int,
                          mask: Optional[jnp.ndarray] = None,
                          kv_mask: Optional[jnp.ndarray] = None,
-                         causal: bool = False) -> jnp.ndarray:
+                         causal: bool = False,
+                         use_flash: bool = False,
+                         flash_block: int = 0) -> jnp.ndarray:
     """Multi-head attention on pre-projected q/k/v of shape [B,T,H*Dh].
 
     ``mask``: [B,T] padding mask applied to keys (and zeroing masked query
     outputs, matching DL4J's masked-attention semantics); ``kv_mask`` masks
     keys only (cross-attention).  ``causal`` adds the autoregressive mask.
+    ``use_flash`` routes through the Pallas blockwise kernel (no [T,T]
+    materialization, differentiable) — the long-sequence path.
     """
     b, tq, d = q.shape
+    if use_flash:
+        from deeplearning4j_tpu.ops.pallas import flash_attention
+        key_mask = mask if mask is not None else kv_mask
+        # flash_block=0: tuned defaults (512×1024 — the measured optimum
+        # on v5e; 128-blocks are ~2× slower, see bench/PROFILE.md)
+        out = flash_attention(q, k, v, n_heads=n_heads, causal=causal,
+                              key_mask=key_mask,
+                              block_q=flash_block or 512,
+                              block_k=flash_block or 1024)
+        if mask is not None and tq == k.shape[1]:
+            out = out * mask[:, :, None].astype(out.dtype)
+        return out
     tk = k.shape[1]
     dh = d // n_heads
     qh = q.reshape(b, tq, n_heads, dh).transpose(0, 2, 1, 3)  # [B,H,Tq,Dh]
