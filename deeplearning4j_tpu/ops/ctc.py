@@ -1,0 +1,92 @@
+"""CTC loss (Connectionist Temporal Classification).
+
+Parity: libnd4j ``ops/declarable/generic/loss/ctcLoss.cpp`` (SURVEY §2.1
+names ctc_loss among the declarable-op families).
+
+TPU-native design: the forward (alpha) recursion over the
+blank-interleaved extended label sequence runs as one ``lax.scan`` over
+time in log space — static shapes, no data-dependent control flow, and
+the gradient is plain autodiff THROUGH the scan (no hand-written
+backward, unlike the reference's ctc_loss_grad declarable op).  The
+whole batch advances in lockstep on the VPU; variable logit/label
+lengths are handled by masking, so padded batches jit once.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG = -1e30
+
+
+def ctc_loss(logits, labels, logit_lengths, label_lengths, blank: int = 0):
+    """Negative log likelihood per batch element.
+
+    logits [B, T, C] (unnormalized; log_softmax applied internally),
+    labels [B, S] int (padded with anything), logit_lengths [B],
+    label_lengths [B].  Returns [B] f32.  Differentiable w.r.t. logits.
+    """
+    logits = jnp.asarray(logits)
+    labels = jnp.asarray(labels, jnp.int32)
+    logit_lengths = jnp.asarray(logit_lengths, jnp.int32)
+    label_lengths = jnp.asarray(label_lengths, jnp.int32)
+    b, t, c = logits.shape
+    s = labels.shape[1]
+    log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    # extended sequence l' = [blank, l1, blank, l2, ..., lS, blank]
+    ext = jnp.full((b, 2 * s + 1), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    length = 2 * s + 1
+
+    pos = jnp.arange(length)[None, :]                       # [1, L]
+    valid = pos < (2 * label_lengths[:, None] + 1)          # inside l'
+    # the skip transition alpha[s-2] -> alpha[s] is allowed only onto a
+    # non-blank that differs from the label two back
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :length]
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    emit_all = jnp.take_along_axis(                          # [B, T, L]
+        log_probs, jnp.broadcast_to(ext[:, None, :], (b, t, length)), axis=2)
+
+    alpha0 = jnp.full((b, length), _NEG)
+    alpha0 = alpha0.at[:, 0].set(emit_all[:, 0, 0])
+    if s > 0:
+        first = jnp.where(label_lengths > 0, emit_all[:, 0, 1], _NEG)
+        alpha0 = alpha0.at[:, 1].set(first)
+    alpha0 = jnp.where(valid, alpha0, _NEG)
+
+    def step(alpha, inputs):
+        emit, active = inputs                                # [B,L], [B,1]
+        a1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                     constant_values=_NEG)[:, :length]       # alpha[s-1]
+        a2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                     constant_values=_NEG)[:, :length]       # alpha[s-2]
+        a2 = jnp.where(can_skip, a2, _NEG)
+        m = jnp.maximum(jnp.maximum(alpha, a1), a2)
+        dead = m <= _NEG / 2            # all-unreachable: keep grads NaN-free
+        m_safe = jnp.where(dead, 0.0, m)
+        tot = m_safe + jnp.log(jnp.exp(alpha - m_safe)
+                               + jnp.exp(a1 - m_safe)
+                               + jnp.exp(a2 - m_safe))
+        new = jnp.where(valid & ~dead, tot + emit, _NEG)
+        # frozen once past this element's logit length
+        return jnp.where(active, new, alpha), None
+
+    steps = jnp.arange(1, t)
+    active = (steps[:, None, None] < logit_lengths[None, :, None])  # [T-1,B,1]
+    emits = jnp.moveaxis(emit_all[:, 1:, :], 1, 0)                  # [T-1,B,L]
+    alpha, _ = jax.lax.scan(step, alpha0, (emits, active))
+
+    idx_last = 2 * label_lengths                              # trailing blank
+    idx_prev = jnp.maximum(2 * label_lengths - 1, 0)          # last label
+    a_last = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(alpha, idx_prev[:, None], axis=1)[:, 0]
+    a_prev = jnp.where(label_lengths > 0, a_prev, _NEG)
+    m = jnp.maximum(a_last, a_prev)
+    dead = m <= _NEG / 2
+    m_safe = jnp.where(dead, 0.0, m)
+    ll = m_safe + jnp.log(jnp.exp(a_last - m_safe) + jnp.exp(a_prev - m_safe))
+    # infeasible alignment (e.g. label longer than logits): loss = +1e30
+    return jnp.where(dead, -jnp.float32(_NEG), -ll)

@@ -274,3 +274,39 @@ bitwise = SimpleNamespace(
     bits_hamming_distance=lambda a, b: jnp.sum(
         jnp.unpackbits(jnp.bitwise_xor(a, b).view(jnp.uint8))),
 )
+
+
+# ---------------------------------------------------------------- scatter
+# scatter/gather/segment families (libnd4j parity_ops — SURVEY §2.1);
+# implementations in ops/scatter.py
+from deeplearning4j_tpu.ops import scatter as _scatter_mod  # noqa: E402
+
+scatter = SimpleNamespace(
+    gather=_scatter_mod.gather,
+    gather_nd=_scatter_mod.gather_nd,
+    scatter_update=_scatter_mod.scatter_update,
+    scatter_add=_scatter_mod.scatter_add,
+    scatter_sub=_scatter_mod.scatter_sub,
+    scatter_mul=_scatter_mod.scatter_mul,
+    scatter_div=_scatter_mod.scatter_div,
+    scatter_max=_scatter_mod.scatter_max,
+    scatter_min=_scatter_mod.scatter_min,
+    scatter_nd=_scatter_mod.scatter_nd,
+    scatter_nd_add=_scatter_mod.scatter_nd_add,
+    scatter_nd_update=_scatter_mod.scatter_nd_update,
+    segment_sum=_scatter_mod.segment_sum,
+    segment_mean=_scatter_mod.segment_mean,
+    segment_prod=_scatter_mod.segment_prod,
+    segment_max=_scatter_mod.segment_max,
+    segment_min=_scatter_mod.segment_min,
+    unsorted_segment_sum=_scatter_mod.unsorted_segment_sum,
+    unsorted_segment_mean=_scatter_mod.unsorted_segment_mean,
+    unsorted_segment_prod=_scatter_mod.unsorted_segment_prod,
+    unsorted_segment_max=_scatter_mod.unsorted_segment_max,
+    unsorted_segment_min=_scatter_mod.unsorted_segment_min,
+    unsorted_segment_sqrt_n=_scatter_mod.unsorted_segment_sqrt_n,
+)
+
+# ctc_loss joins the loss namespace (libnd4j ctcLoss.cpp parity)
+from deeplearning4j_tpu.ops.ctc import ctc_loss as _ctc_loss  # noqa: E402
+loss.ctc_loss = _ctc_loss

@@ -1,0 +1,136 @@
+"""Scatter / gather / segment ops.
+
+Parity: libnd4j declarable ops under
+``include/ops/declarable/generic/parity_ops/`` (scatter_add, scatter_upd,
+scatter_max, ..., gather, gather_nd, scatter_nd) and
+``.../segment_*`` + ``unsorted_segment_*`` (SURVEY §2.1 declarable-ops
+row names these families explicitly).
+
+TPU-native mapping: every scatter is one XLA ``scatter`` HLO via jnp's
+indexed-update operators (``x.at[idx].op(updates)``) — batched, fusable,
+and differentiable; segment reductions ride ``jax.ops.segment_*`` which
+lower to sorted-scatter HLO.  ``num_segments`` is an explicit argument
+(static shape for jit) rather than data-derived like the reference's —
+the XLA contract requires static output shapes.
+
+Index semantics follow the reference: indices select along axis 0;
+out-of-range indices are dropped (XLA default), matching nd4j's checked
+mode off.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+# ------------------------------------------------------------- gather
+
+def gather(x, indices, axis: int = 0):
+    """Rows of ``x`` at ``indices`` along ``axis`` (nd4j ``gather``)."""
+    return jnp.take(x, jnp.asarray(indices, jnp.int32), axis=axis)
+
+
+def gather_nd(x, indices):
+    """N-d gather: ``indices [..., K]`` indexes the first K dims of ``x``
+    (nd4j ``gather_nd``)."""
+    indices = jnp.asarray(indices, jnp.int32)
+    k = indices.shape[-1]
+    return x[tuple(indices[..., i] for i in range(k))]
+
+
+# ------------------------------------------------------------ scatter
+
+def _rows_op(op_name):
+    def op(x, indices, updates):
+        ref = x.at[jnp.asarray(indices, jnp.int32)]
+        return getattr(ref, op_name)(updates)
+    return op
+
+
+scatter_update = _rows_op("set")
+scatter_add = _rows_op("add")
+scatter_mul = _rows_op("multiply")
+scatter_div = _rows_op("divide")
+scatter_max = _rows_op("max")
+scatter_min = _rows_op("min")
+
+
+def scatter_sub(x, indices, updates):
+    """x[indices] -= updates (nd4j ``scatter_sub``)."""
+    return x.at[jnp.asarray(indices, jnp.int32)].add(-updates)
+
+
+def scatter_nd(indices, updates, shape):
+    """Build a tensor of ``shape`` with ``updates`` summed at ``indices
+    [..., K]`` (nd4j/TF ``scatter_nd`` — duplicate indices add)."""
+    indices = jnp.asarray(indices, jnp.int32)
+    k = indices.shape[-1]
+    out = jnp.zeros(shape, dtype=jnp.asarray(updates).dtype)
+    return out.at[tuple(indices[..., i] for i in range(k))].add(updates)
+
+
+def scatter_nd_add(x, indices, updates):
+    indices = jnp.asarray(indices, jnp.int32)
+    k = indices.shape[-1]
+    return x.at[tuple(indices[..., i] for i in range(k))].add(updates)
+
+
+def scatter_nd_update(x, indices, updates):
+    indices = jnp.asarray(indices, jnp.int32)
+    k = indices.shape[-1]
+    return x.at[tuple(indices[..., i] for i in range(k))].set(updates)
+
+
+# ------------------------------------------------------------ segment
+
+def _segment(reducer, x, segment_ids, num_segments: int, sorted_ids: bool):
+    return reducer(x, jnp.asarray(segment_ids, jnp.int32),
+                   num_segments=num_segments,
+                   indices_are_sorted=sorted_ids)
+
+
+def _make_segment(reducer, sorted_ids):
+    def op(x, segment_ids, num_segments: int):
+        return _segment(reducer, x, segment_ids, num_segments, sorted_ids)
+    return op
+
+
+segment_sum = _make_segment(jax.ops.segment_sum, True)
+segment_prod = _make_segment(jax.ops.segment_prod, True)
+segment_max = _make_segment(jax.ops.segment_max, True)
+segment_min = _make_segment(jax.ops.segment_min, True)
+unsorted_segment_sum = _make_segment(jax.ops.segment_sum, False)
+unsorted_segment_prod = _make_segment(jax.ops.segment_prod, False)
+unsorted_segment_max = _make_segment(jax.ops.segment_max, False)
+unsorted_segment_min = _make_segment(jax.ops.segment_min, False)
+
+
+def _counts(segment_ids, num_segments):
+    return jax.ops.segment_sum(
+        jnp.ones(jnp.asarray(segment_ids).shape, jnp.float32),
+        jnp.asarray(segment_ids, jnp.int32), num_segments=num_segments)
+
+
+def _mean_from(sum_op):
+    def op(x, segment_ids, num_segments: int):
+        """Per-segment mean (empty segments → 0, matching nd4j)."""
+        s = sum_op(x, segment_ids, num_segments)
+        n = _counts(segment_ids, num_segments)
+        n = n.reshape(n.shape + (1,) * (s.ndim - n.ndim))
+        return s / jnp.maximum(n, 1.0)
+    return op
+
+
+segment_mean = _mean_from(segment_sum)
+unsorted_segment_mean = _mean_from(unsorted_segment_sum)
+
+
+def unsorted_segment_sqrt_n(x, segment_ids, num_segments: int):
+    """Segment sum scaled by 1/sqrt(count) (TF/nd4j parity op)."""
+    s = unsorted_segment_sum(x, segment_ids, num_segments)
+    n = _counts(segment_ids, num_segments)
+    n = n.reshape(n.shape + (1,) * (s.ndim - n.ndim))
+    return s / jnp.sqrt(jnp.maximum(n, 1.0))

@@ -334,6 +334,8 @@ def test_loss_ops_golden():
     # record the whole namespace as executed there + here
     for name in op_inventory()["loss"]:
         fn = getattr(ns.loss, name)
+        if name == "ctc_loss":
+            continue     # own signature; covered by test_ctc_loss_vs_torch
         if name == "mean_score":
             out = fn(jnp.asarray(np.abs(z[:, 0])), None)
         elif name == "sparse_mcxent":
@@ -385,6 +387,185 @@ def test_random_ops():
     kept = y[y != 0]
     np.testing.assert_allclose(kept, 1.0 / 0.75, rtol=1e-6)
     LEDGER.record("nn.dropout")
+
+
+def test_scatter_gather_ops():
+    """scatter/gather family vs a numpy loop oracle (libnd4j parity_ops:
+    scatter_add/upd/max/..., gather, gather_nd, scatter_nd)."""
+    x = R.normal(size=(6, 4)).astype(np.float32)
+    idx = np.asarray([1, 4, 1], np.int32)           # duplicate on purpose
+    upd = R.normal(size=(3, 4)).astype(np.float32)
+
+    got = np.asarray(ns.scatter.gather(jnp.asarray(x), idx))
+    np.testing.assert_array_equal(got, x[idx])
+    LEDGER.record("scatter.gather")
+
+    nd_idx = np.asarray([[0, 1], [5, 3], [2, 2]], np.int32)
+    got = np.asarray(ns.scatter.gather_nd(jnp.asarray(x), nd_idx))
+    np.testing.assert_array_equal(got, x[nd_idx[:, 0], nd_idx[:, 1]])
+    LEDGER.record("scatter.gather_nd")
+
+    def oracle(op):
+        out = x.copy()
+        for i, row in zip(idx, upd):
+            if op == "set":
+                out[i] = row
+            elif op == "add":
+                out[i] += row
+            elif op == "sub":
+                out[i] -= row
+            elif op == "mul":
+                out[i] *= row
+            elif op == "div":
+                out[i] /= row
+            elif op == "max":
+                out[i] = np.maximum(out[i], row)
+            elif op == "min":
+                out[i] = np.minimum(out[i], row)
+        return out
+
+    for name, op in [("scatter_add", "add"), ("scatter_sub", "sub"),
+                     ("scatter_mul", "mul"), ("scatter_div", "div"),
+                     ("scatter_max", "max"), ("scatter_min", "min")]:
+        got = np.asarray(getattr(ns.scatter, name)(
+            jnp.asarray(x), idx, jnp.asarray(upd)))
+        np.testing.assert_allclose(got, oracle(op), rtol=1e-5, atol=1e-6,
+                                   err_msg=name)
+        LEDGER.record(f"scatter.{name}")
+    # scatter_update: last duplicate wins in XLA; check non-dup rows exact
+    got = np.asarray(ns.scatter.scatter_update(
+        jnp.asarray(x), idx, jnp.asarray(upd)))
+    np.testing.assert_array_equal(got[4], upd[1])
+    np.testing.assert_array_equal(got[[0, 2, 3, 5]], x[[0, 2, 3, 5]])
+    LEDGER.record("scatter.scatter_update")
+
+    got = np.asarray(ns.scatter.scatter_nd(nd_idx, jnp.asarray([1., 2., 3.]),
+                                           (6, 4)))
+    want = np.zeros((6, 4), np.float32)
+    for (i, j), u in zip(nd_idx, [1., 2., 3.]):
+        want[i, j] += u
+    np.testing.assert_array_equal(got, want)
+    LEDGER.record("scatter.scatter_nd")
+
+    got = np.asarray(ns.scatter.scatter_nd_add(
+        jnp.asarray(x), nd_idx, jnp.asarray([1., 2., 3.])))
+    np.testing.assert_allclose(got, x + want, rtol=1e-6)
+    LEDGER.record("scatter.scatter_nd_add")
+    got = np.asarray(ns.scatter.scatter_nd_update(
+        jnp.asarray(x), nd_idx, jnp.asarray([1., 2., 3.])))
+    want2 = x.copy()
+    for (i, j), u in zip(nd_idx, [1., 2., 3.]):
+        want2[i, j] = u
+    np.testing.assert_array_equal(got, want2)
+    LEDGER.record("scatter.scatter_nd_update")
+
+
+def test_segment_ops():
+    """segment_* / unsorted_segment_* vs numpy oracles + grad smoke."""
+    x = R.normal(size=(8, 3)).astype(np.float32)
+    sorted_ids = np.asarray([0, 0, 1, 1, 1, 2, 3, 3], np.int32)
+    unsorted_ids = np.asarray([3, 0, 1, 0, 2, 1, 0, 3], np.int32)
+    n = 4
+
+    def oracle(ids, red, init):
+        out = np.full((n, 3), init, np.float32)
+        for i, row in zip(ids, x):
+            out[i] = red(out[i], row)
+        return out
+
+    cases = [("sum", lambda a, b: a + b, 0.0),
+             ("prod", lambda a, b: a * b, 1.0),
+             ("max", np.maximum, -np.inf),
+             ("min", np.minimum, np.inf)]
+    for name, red, init in cases:
+        got = np.asarray(getattr(ns.scatter, f"segment_{name}")(
+            jnp.asarray(x), sorted_ids, n))
+        np.testing.assert_allclose(got, oracle(sorted_ids, red, init),
+                                   rtol=1e-5, err_msg=f"segment_{name}")
+        LEDGER.record(f"scatter.segment_{name}")
+        got = np.asarray(getattr(ns.scatter, f"unsorted_segment_{name}")(
+            jnp.asarray(x), unsorted_ids, n))
+        np.testing.assert_allclose(got, oracle(unsorted_ids, red, init),
+                                   rtol=1e-5,
+                                   err_msg=f"unsorted_segment_{name}")
+        LEDGER.record(f"scatter.unsorted_segment_{name}")
+
+    for name, ids in [("segment_mean", sorted_ids),
+                      ("unsorted_segment_mean", unsorted_ids)]:
+        got = np.asarray(getattr(ns.scatter, name)(jnp.asarray(x), ids, n))
+        want = np.stack([x[ids == i].mean(0) if np.any(ids == i)
+                         else np.zeros(3) for i in range(n)])
+        np.testing.assert_allclose(got, want, rtol=1e-5, err_msg=name)
+        LEDGER.record(f"scatter.{name}")
+
+    got = np.asarray(ns.scatter.unsorted_segment_sqrt_n(
+        jnp.asarray(x), unsorted_ids, n))
+    want = np.stack([x[unsorted_ids == i].sum(0)
+                     / max(np.sqrt((unsorted_ids == i).sum()), 1.0)
+                     for i in range(n)])
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    LEDGER.record("scatter.unsorted_segment_sqrt_n")
+
+    # differentiability through a segment reduction
+    g = jax.grad(lambda a: float(0) + jnp.sum(
+        ns.scatter.unsorted_segment_sum(a, unsorted_ids, n) ** 2))(
+            jnp.asarray(x))
+    assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_ctc_loss_vs_torch():
+    """ctc_loss vs torch.nn.functional.ctc_loss (cross-framework golden)
+    + NaN-free gradient (review regression: dead-path log(0) grads)."""
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+    rng = np.random.default_rng(5)
+    b, t, c, s = 3, 12, 6, 4
+    logits = rng.normal(size=(b, t, c)).astype(np.float32)
+    labels = rng.integers(1, c, size=(b, s)).astype(np.int32)  # no blanks
+    logit_lens = np.asarray([12, 10, 7], np.int64)
+    label_lens = np.asarray([4, 3, 1], np.int64)
+
+    got = np.asarray(ns.loss.ctc_loss(jnp.asarray(logits),
+                                      jnp.asarray(labels),
+                                      logit_lens, label_lens, blank=0))
+    lp = torch.log_softmax(torch.tensor(logits), dim=-1).permute(1, 0, 2)
+    want = F.ctc_loss(lp, torch.tensor(labels.astype(np.int64)),
+                      torch.tensor(logit_lens), torch.tensor(label_lens),
+                      blank=0, reduction="none").numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    LEDGER.record("loss.ctc_loss")
+
+    g = jax.grad(lambda lg: jnp.sum(ns.loss.ctc_loss(
+        lg, jnp.asarray(labels), logit_lens, label_lens)))(
+            jnp.asarray(logits))
+    assert np.all(np.isfinite(np.asarray(g)))
+    # grad vs torch autograd
+    lt = torch.tensor(logits, requires_grad=True)
+    lp = torch.log_softmax(lt, dim=-1).permute(1, 0, 2)
+    F.ctc_loss(lp, torch.tensor(labels.astype(np.int64)),
+               torch.tensor(logit_lens), torch.tensor(label_lens),
+               blank=0, reduction="sum").backward()
+    np.testing.assert_allclose(np.asarray(g), lt.grad.numpy(),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_ctc_loss_zero_and_repeated_labels():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+    rng = np.random.default_rng(6)
+    b, t, c = 2, 8, 5
+    logits = rng.normal(size=(b, t, c)).astype(np.float32)
+    labels = np.asarray([[2, 2, 3], [1, 0, 0]], np.int32)  # repeat + short
+    logit_lens = np.asarray([8, 8], np.int64)
+    label_lens = np.asarray([3, 1], np.int64)
+    got = np.asarray(ns.loss.ctc_loss(jnp.asarray(logits),
+                                      jnp.asarray(labels),
+                                      logit_lens, label_lens))
+    lp = torch.log_softmax(torch.tensor(logits), dim=-1).permute(1, 0, 2)
+    want = F.ctc_loss(lp, torch.tensor(labels.astype(np.int64)),
+                      torch.tensor(logit_lens), torch.tensor(label_lens),
+                      blank=0, reduction="none").numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
 
 def test_grad_smoke_differentiable_ops():
