@@ -194,10 +194,15 @@ def _bottleneck(gb, name, in_name, filters, stride, project):
 
 
 def resnet50(seed: int = 123, num_classes: int = 1000, height: int = 224,
-             width: int = 224, channels: int = 3, updater=None) -> ComputationGraph:
+             width: int = 224, channels: int = 3, updater=None,
+             fused: bool = False) -> ComputationGraph:
     """ResNet50.java parity: [3, 4, 6, 3] bottleneck stages — the BASELINE
     headline model.  NHWC + channels-last BN; stride-2 downsampling in the
-    first block of stages 3-5 (v1)."""
+    first block of stages 3-5 (v1).
+
+    ``fused=True`` swaps each bottleneck for the single
+    :class:`~deeplearning4j_tpu.nn.layers.fused.FusedBottleneck` layer
+    (Pallas conv+BN kernels — the cuDNN-platform-engine analog)."""
     gb = (NeuralNetConfiguration.builder()
           .seed(seed)
           .updater(updater or Nesterovs(1e-1, 0.9))
@@ -219,10 +224,20 @@ def resnet50(seed: int = 123, num_classes: int = 1000, height: int = 224,
         ("res4", [256, 256, 1024], 6, (2, 2)),
         ("res5", [512, 512, 2048], 3, (2, 2)),
     ]
+    if fused:
+        from deeplearning4j_tpu.nn.layers.fused import FusedBottleneck
     for stage_name, filters, blocks, first_stride in stages:
         for i in range(blocks):
-            x = _bottleneck(gb, f"{stage_name}_{i}", x, filters,
-                            first_stride if i == 0 else (1, 1), project=i == 0)
+            stride = first_stride if i == 0 else (1, 1)
+            if fused:
+                gb.add_layer(f"{stage_name}_{i}",
+                             FusedBottleneck(filters=tuple(filters),
+                                             stride=stride, project=i == 0),
+                             x)
+                x = f"{stage_name}_{i}"
+            else:
+                x = _bottleneck(gb, f"{stage_name}_{i}", x, filters,
+                                stride, project=i == 0)
     gb.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), x)
     gb.add_layer("out", OutputLayer(n_out=num_classes, activation="softmax",
                                     loss="mcxent"), "avgpool")

@@ -1,0 +1,160 @@
+"""Fused ResNet bottleneck layer — the Pallas conv+BN path.
+
+Parity: the reference's cuDNN platform engines fuse conv+BN(+ReLU) for
+exactly this block (libnd4j ``ops/declarable/platform/cudnn/``, SURVEY
+§2.1); DL4J assembles the bottleneck from ConvolutionLayer +
+BatchNormalization graph nodes.  Here the whole v1 bottleneck
+(1x1 reduce → 3x3 → 1x1 expand, + optional projection shortcut) is ONE
+layer so the 1x1 convs can run through
+:func:`deeplearning4j_tpu.ops.pallas.conv_bn.matmul_bn_act`:
+
+  * each 1x1 conv emits its BN statistics from the kernel epilogue
+    (no separate stats read pass);
+  * the 3x3's BN+ReLU is applied inside the following 1x1's prologue
+    (no separate normalize read+write pass);
+  * the expand/projection BNs fold into the final residual-add+ReLU
+    (one XLA elementwise pass).
+
+The 3x3 itself stays on XLA's conv (its BN stats are one extra fused
+reduce).  Running mean/var live in layer state exactly like
+``BatchNormalization`` (decay 0.9, biased variance), so checkpoints and
+inference behave identically to the unfused graph.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.config import dtype_policy
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+from deeplearning4j_tpu.ops.pallas.conv_bn import matmul_bn_act
+
+
+def _fold(mean, var, gamma, beta, eps):
+    """(mean, var, gamma, beta) → per-channel (a, b): bn(x) = x*a + b."""
+    a = gamma * jax.lax.rsqrt(var + eps)
+    return a, beta - mean * a
+
+
+@register_layer("fused_bottleneck")
+@dataclasses.dataclass
+class FusedBottleneck(Layer):
+    """ResNet v1 bottleneck with Pallas-fused 1x1 conv+BN kernels."""
+
+    filters: Tuple[int, int, int] = (64, 64, 256)
+    stride: Tuple[int, int] = (1, 1)
+    project: bool = False
+    decay: float = 0.9
+    eps: float = 1e-5
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        sh, sw = self.stride
+        h = -(-input_type.height // sh)
+        w = -(-input_type.width // sw)
+        return InputType.convolutional(h, w, self.filters[2])
+
+    def has_params(self) -> bool:
+        return True
+
+    def _branches(self, c_in):
+        f1, f2, f3 = self.filters
+        out = [("a", (c_in, f1)), ("b3", (3, 3, f1, f2)), ("c", (f2, f3))]
+        if self.project:
+            out.append(("proj", (c_in, f3)))
+        return out
+
+    def init_params(self, key, input_type):
+        c_in = input_type.channels
+        params: dict[str, Any] = {}
+        for i, (name, shape) in enumerate(self._branches(c_in)):
+            k = jax.random.fold_in(key, i)
+            fan_in = shape[0] if len(shape) == 2 else shape[0] * shape[1] * shape[2]
+            fan_out = shape[-1]
+            params[f"W_{name}"] = self._init_weight(k, shape, fan_in, fan_out)
+            params[f"gamma_{name}"] = jnp.ones((shape[-1],), self._param_dtype())
+            params[f"beta_{name}"] = jnp.zeros((shape[-1],), self._param_dtype())
+        return params
+
+    def init_state(self, input_type):
+        state = {}
+        for name, shape in self._branches(input_type.channels):
+            n = shape[-1]
+            state[f"mean_{name}"] = jnp.zeros((n,), self._param_dtype())
+            state[f"var_{name}"] = jnp.ones((n,), self._param_dtype())
+        return state
+
+    def _stats(self, name, s1, s2, m, state, new_state, train):
+        """Batch (train) or running (eval) mean/var; update running."""
+        if train:
+            mean = s1 / m
+            # one-pass E[y²]−E[y]² can go slightly negative from f32
+            # cancellation on near-constant channels → rsqrt NaN; clamp
+            var = jnp.maximum(s2 / m - mean * mean, 0.0)
+            new_state[f"mean_{name}"] = (self.decay * state[f"mean_{name}"]
+                                         + (1.0 - self.decay) * mean)
+            new_state[f"var_{name}"] = (self.decay * state[f"var_{name}"]
+                                        + (1.0 - self.decay) * var)
+            return mean, var
+        return state[f"mean_{name}"], state[f"var_{name}"]
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        policy = dtype_policy()
+        cdt = policy.compute_dtype
+        eps = self.eps
+        new_state = dict(state)
+        n, h, w, c_in = x.shape
+        sh, sw = self.stride
+        xs = x[:, ::sh, ::sw, :] if (sh, sw) != (1, 1) else x
+        hb, wb = xs.shape[1], xs.shape[2]
+        m = n * hb * wb
+        x2d = xs.reshape(m, c_in).astype(cdt)
+
+        def W(name):
+            return params[f"W_{name}"].astype(cdt)
+
+        def gb(name):
+            return (params[f"gamma_{name}"].astype(jnp.float32),
+                    params[f"beta_{name}"].astype(jnp.float32))
+
+        # ---- 1x1 reduce (stats from the kernel epilogue)
+        y1, s1a, s2a = matmul_bn_act(x2d, W("a"))
+        mean_a, var_a = self._stats("a", s1a, s2a, m, state, new_state, train)
+        a1, b1 = _fold(mean_a, var_a, *gb("a"), eps)
+        # the 3x3 consumer is an XLA conv → one explicit normalize pass
+        z1 = jnp.maximum(y1 * a1.astype(cdt) + b1.astype(cdt), 0)
+        z1 = z1.reshape(n, hb, wb, self.filters[0])
+
+        # ---- 3x3 (XLA conv; stats via fused reduce)
+        y2 = jax.lax.conv_general_dilated(
+            z1, W("b3"), window_strides=(1, 1), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        y2f = y2.astype(jnp.float32)       # fused convert+reduce (one read)
+        s1b = jnp.sum(y2f, axis=(0, 1, 2))
+        s2b = jnp.sum(y2f * y2f, axis=(0, 1, 2))
+        mean_b, var_b = self._stats("b3", s1b, s2b, m, state, new_state, train)
+        a2, b2 = _fold(mean_b, var_b, *gb("b3"), eps)
+
+        # ---- 1x1 expand: the 3x3's BN+ReLU rides the kernel prologue
+        y3, s1c, s2c = matmul_bn_act(y2.reshape(m, self.filters[1]).astype(cdt),
+                                     W("c"), a2, b2, relu_in=True)
+        mean_c, var_c = self._stats("c", s1c, s2c, m, state, new_state, train)
+        a3, b3 = _fold(mean_c, var_c, *gb("c"), eps)
+
+        # ---- shortcut
+        if self.project:
+            yp, s1p, s2p = matmul_bn_act(x2d, W("proj"))
+            mean_p, var_p = self._stats("proj", s1p, s2p, m, state,
+                                        new_state, train)
+            ap, bp = _fold(mean_p, var_p, *gb("proj"), eps)
+            sc = yp * ap.astype(cdt) + bp.astype(cdt)
+        else:
+            sc = x2d
+        # expand/proj BNs + residual add + ReLU: one fused elementwise pass
+        out = jnp.maximum(y3 * a3.astype(cdt) + b3.astype(cdt) + sc, 0)
+        out = out.reshape(n, hb, wb, self.filters[2])
+        return out.astype(policy.output_dtype), new_state

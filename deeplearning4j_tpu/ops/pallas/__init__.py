@@ -10,6 +10,8 @@ the pure-jnp implementations kept as numerical oracles.
 """
 
 from deeplearning4j_tpu.ops.pallas.flash_attention import (
-    flash_attention_block, flash_attention)
+    flash_attention_block, flash_attention_block_bwd, flash_attention)
+from deeplearning4j_tpu.ops.pallas.conv_bn import matmul_bn_act
 
-__all__ = ["flash_attention_block", "flash_attention"]
+__all__ = ["flash_attention_block", "flash_attention_block_bwd",
+           "flash_attention", "matmul_bn_act"]
