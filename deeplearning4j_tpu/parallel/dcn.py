@@ -13,14 +13,19 @@ allreduce:
     → transport exchange between slice leaders (DCN)
     → decode-and-sum peers' messages → apply
 
-``InProcessTransport`` is the DummyTransport-parity test fake; a real
-deployment exchanges the same byte payloads over jax.distributed's
-host network (one leader per slice).
+``InProcessTransport`` is the DummyTransport-parity test fake;
+``SocketTransport`` moves the same byte payloads over real TCP between
+slice-leader PROCESSES (the AeronUdpTransport translation, SURVEY §2.7)
+— star topology through the rank-0 relay, length-prefixed frames, round
+tagging so a fast rank can never consume a stale payload.
 """
 
 from __future__ import annotations
 
+import socket
+import struct
 import threading
+import time
 from typing import Optional, Sequence
 
 import jax
@@ -93,6 +98,141 @@ class InProcessTransport:
             for g in [g for g in self._rounds if g < oldest_active - 1]:
                 del self._rounds[g]
             return result
+
+
+_FRAME = struct.Struct("<qqqq")    # round, rank, dtype code, element count
+_DTYPES = {0: np.dtype(np.float32), 1: np.dtype(np.int32),
+           2: np.dtype(np.float64), 3: np.dtype(np.int64)}
+_DTYPE_CODES = {v: k for k, v in _DTYPES.items()}
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed during frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _send_frame(sock: socket.socket, rnd: int, rank: int,
+                payload: np.ndarray) -> None:
+    payload = np.ascontiguousarray(payload)
+    code = _DTYPE_CODES[payload.dtype]   # bit-exact: dtype preserved
+    sock.sendall(_FRAME.pack(rnd, rank, code, payload.size)
+                 + payload.tobytes())
+
+
+def _recv_frame(sock: socket.socket):
+    rnd, rank, code, count = _FRAME.unpack(_recv_exact(sock, _FRAME.size))
+    dt = _DTYPES[code]
+    data = np.frombuffer(_recv_exact(sock, count * dt.itemsize), dtype=dt)
+    return rnd, rank, data
+
+
+class _RelayServer:
+    """Rank-0 side of :class:`SocketTransport`: accepts one TCP
+    connection per rank, gathers each round's frames, and answers every
+    rank with its peers' same-round payloads."""
+
+    def __init__(self, n_ranks: int, port: int, host: str, timeout: float):
+        self.n_ranks = n_ranks
+        self.timeout = timeout
+        self._cond = threading.Condition()
+        self._rounds: dict[int, dict[int, np.ndarray]] = {}
+        self._served: dict[int, set] = {}
+        self._listener = socket.create_server((host, port), backlog=n_ranks)
+        self._listener.settimeout(timeout)
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        for _ in range(self.n_ranks):
+            conn, _ = self._listener.accept()
+            conn.settimeout(self.timeout)
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+        self._listener.close()
+
+    def _serve(self, conn: socket.socket):
+        try:
+            while True:
+                rnd, rank, payload = _recv_frame(conn)
+                with self._cond:
+                    bucket = self._rounds.setdefault(rnd, {})
+                    bucket[rank] = payload
+                    if len(bucket) == self.n_ranks:
+                        self._cond.notify_all()
+                    else:
+                        deadline = time.monotonic() + self.timeout
+                        while len(self._rounds[rnd]) < self.n_ranks:
+                            remaining = deadline - time.monotonic()
+                            if remaining <= 0 or not self._cond.wait(remaining):
+                                raise TimeoutError(
+                                    f"relay round {rnd}: only "
+                                    f"{sorted(self._rounds[rnd])} arrived")
+                    peers = [(r, self._rounds[rnd][r])
+                             for r in range(self.n_ranks) if r != rank]
+                # respond outside the lock; TCP buffering decouples ranks
+                for r, data in peers:
+                    _send_frame(conn, rnd, r, data)
+                with self._cond:
+                    served = self._served.setdefault(rnd, set())
+                    served.add(rank)
+                    if len(served) == self.n_ranks:    # round fully drained
+                        self._rounds.pop(rnd, None)
+                        self._served.pop(rnd, None)
+        except (ConnectionError, OSError):
+            conn.close()      # rank done (or died — peers see a timeout)
+
+
+class SocketTransport:
+    """Real-bytes transport between slice-leader processes over TCP
+    (loopback in tests, any reachable host in deployment).  Same
+    ``exchange`` contract as :class:`InProcessTransport`; every payload
+    crosses a process boundary through the rank-0 relay."""
+
+    def __init__(self, rank: int, n_ranks: int, port: int,
+                 host: str = "127.0.0.1", timeout: float = 60.0):
+        self.rank = rank
+        self.n_ranks = n_ranks
+        self._round = 0
+        if rank == 0:
+            self._server = _RelayServer(n_ranks, port, host, timeout)
+        # every rank (rank 0 included) talks to the relay as a client
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=timeout)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.1)
+        self._sock.settimeout(timeout)
+
+    def exchange(self, rank: int, message: np.ndarray) -> list[np.ndarray]:
+        if rank != self.rank:
+            raise ValueError(f"transport bound to rank {self.rank}, "
+                             f"got {rank}")
+        rnd = self._round
+        self._round += 1
+        _send_frame(self._sock, rnd, rank, message)
+        peers: dict[int, np.ndarray] = {}
+        for _ in range(self.n_ranks - 1):
+            got_rnd, peer, data = _recv_frame(self._sock)
+            if got_rnd != rnd:
+                raise RuntimeError(f"round mismatch: sent {rnd}, "
+                                   f"received {got_rnd}")
+            peers[peer] = data
+        return [peers[r] for r in sorted(peers)]
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
 
 
 # ======================================================= compressed allreduce

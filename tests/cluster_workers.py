@@ -119,3 +119,27 @@ def fault_tolerant_train_worker(pid, n, phase="full", workdir="/tmp"):
     return {"pid": pid, "params": flat,
             "all_equal": bool(np.allclose(gathered, gathered[0:1], atol=0)),
             "batches_seen": iterator.batch_index - start}
+
+
+def dcn_socket_allreduce_worker(pid, n, port=23401, steps=8):
+    """Slice-leader role: compressed cross-slice allreduce with REAL
+    bytes over the loopback SocketTransport (AeronUdpTransport parity).
+    Each rank contributes deterministic per-rank gradients; returns the
+    per-step sums so the test can check cross-rank agreement and the
+    error-feedback convergence property."""
+    import numpy as np
+    from deeplearning4j_tpu.parallel.dcn import (CompressedAllReducer,
+                                                 SocketTransport)
+
+    size = 384
+    transport = SocketTransport(pid, n, port=port)
+    reducer = CompressedAllReducer(pid, size, transport)
+    rng = np.random.default_rng(100 + pid)
+    grads = [rng.normal(0, 0.05, size).astype(np.float32)
+             for _ in range(steps)]
+    sums = [reducer.allreduce(g) for g in grads]
+    transport.close()
+    return {"pid": pid,
+            "sums": np.stack(sums),
+            "grads": np.stack(grads),
+            "residual": np.asarray(reducer.accumulator.residual)}
