@@ -182,6 +182,119 @@ class CollectionRecordReader(RecordReader):
         return iter(self.collection)
 
 
+class RegexLineRecordReader(RecordReader):
+    """``org/datavec/api/records/reader/impl/regex/RegexLineRecordReader``:
+    every line must match ``regex``; the record is the list of capture
+    groups (numerics parsed).  Non-matching lines raise, matching the
+    reference's strict behavior."""
+
+    def __init__(self, split, regex: str, skip_lines: int = 0):
+        import re
+        self.split = split
+        self.pattern = re.compile(regex)
+        self.skip_lines = skip_lines
+
+    def records(self):
+        for path in self.split.locations():
+            with open(path) as f:
+                for i, line in enumerate(f):
+                    if i < self.skip_lines:
+                        continue
+                    line = line.rstrip("\n")
+                    if not line:
+                        continue
+                    m = self.pattern.fullmatch(line)
+                    if m is None:
+                        raise ValueError(
+                            f"{path}:{i + 1}: line does not match regex "
+                            f"{self.pattern.pattern!r}: {line!r}")
+                    yield [_parse(g) for g in m.groups()]
+
+
+class RegexSequenceRecordReader(RecordReader):
+    """``RegexSequenceRecordReader``: one FILE per sequence, each line a
+    regex-grouped timestep."""
+
+    def __init__(self, split, regex: str, skip_lines: int = 0):
+        self.split = split
+        self.regex = regex
+        self.skip_lines = skip_lines
+
+    def records(self):
+        for path in self.split.locations():
+            line_reader = RegexLineRecordReader(
+                FileSplit(path), self.regex, self.skip_lines)
+            yield list(line_reader.records())
+
+
+class JsonLineRecordReader(RecordReader):
+    """JSON-lines reader (``JacksonLineRecordReader`` + FieldSelection
+    parity): one JSON object per line; ``fields`` fixes the column order
+    (dotted paths reach into nested objects), ``defaults`` fills missing
+    fields (FieldSelection's valueIfMissing)."""
+
+    def __init__(self, split, fields: Sequence[str],
+                 defaults: Optional[dict] = None):
+        self.split = split
+        self.fields = list(fields)
+        self.defaults = defaults or {}
+
+    def _lookup(self, doc, path):
+        cur = doc
+        for part in path.split("."):
+            if not isinstance(cur, dict) or part not in cur:
+                return self.defaults.get(path)
+            cur = cur[part]
+        return cur
+
+    def records(self):
+        import json as jsonlib
+        for path in self.split.locations():
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    doc = jsonlib.loads(line)
+                    yield [self._lookup(doc, field) for field in self.fields]
+
+
+class SVMLightRecordReader(RecordReader):
+    """``SVMLightRecordReader``: ``label idx:val idx:val ...`` sparse
+    lines → dense feature vector of ``num_features`` with the label
+    APPENDED as the last column (the reference's record layout, so
+    ``RecordReaderDataSetIterator(reader, label_index=num_features)``
+    works unchanged).  Indices are 1-based unless ``zero_based``."""
+
+    def __init__(self, split, num_features: int, zero_based: bool = False):
+        self.split = split
+        self.num_features = num_features
+        self.zero_based = zero_based
+
+    def records(self):
+        for path in self.split.locations():
+            with open(path) as f:
+                for line in f:
+                    line = line.split("#", 1)[0].strip()   # strip comments
+                    if not line:
+                        continue
+                    parts = line.split()
+                    label = _parse(parts[0])
+                    features = [0.0] * self.num_features
+                    for tok in parts[1:]:
+                        if tok.startswith("qid:"):
+                            continue                        # ranking qid
+                        idx_s, val_s = tok.split(":", 1)
+                        idx = int(idx_s) - (0 if self.zero_based else 1)
+                        if not 0 <= idx < self.num_features:
+                            raise ValueError(
+                                f"feature index {idx_s} outside "
+                                f"[{'0' if self.zero_based else '1'}, "
+                                f"{self.num_features}] in {path!r}")
+                        features[idx] = float(val_s)
+                    yield features + [label]
+
+
 class RecordReaderDataSetIterator(DataSetIterator):
     """DataVec→DataSet bridge (``RecordReaderDataSetIterator.java``):
     label column extraction + one-hot for classification, regression mode,

@@ -1,0 +1,103 @@
+// ASan/UBSan exercise driver (SURVEY §5.2: sanitizer builds of the
+// native code as a CI check, mirroring the reference's libnd4j
+// sanitizer lane).  Compiled by tests/test_native_sanitize.py together
+// with threshold_codec.cpp and fast_io.cpp under
+// -fsanitize=address,undefined into a standalone binary — loading an
+// ASan .so into a non-ASan python would need LD_PRELOAD games; a
+// dedicated process does not.  Exit 0 = round trips correct AND no
+// sanitizer report (ASan aborts non-zero on any violation).
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+int64_t threshold_count(const float*, int64_t, float);
+int64_t threshold_encode(const float*, int64_t, float, int32_t*, int64_t);
+void threshold_decode(const int32_t*, float*, int64_t);
+int64_t bitmap_encode(const float*, int64_t, float, uint8_t*);
+void bitmap_decode(const uint8_t*, int64_t, float, float*);
+void csv_dims(const char*, int64_t, char, int64_t, int64_t*, int64_t*);
+int64_t csv_parse(const char*, int64_t, char, int64_t, float*, int64_t,
+                  int64_t, float);
+}
+
+static int failures = 0;
+
+static void check(bool ok, const char* what) {
+    if (!ok) {
+        std::fprintf(stderr, "FAIL: %s\n", what);
+        ++failures;
+    }
+}
+
+static void exercise_threshold_codec() {
+    const int64_t n = 4099;                    // odd size: edge chunking
+    std::vector<float> grad(n);
+    for (int64_t i = 0; i < n; ++i)
+        grad[i] = 0.002f * std::sin(0.37f * static_cast<float>(i));
+    const float thr = 1e-3f;
+    int64_t count = threshold_count(grad.data(), n, thr);
+    check(count > 0 && count < n, "threshold_count in range");
+
+    std::vector<int32_t> message(3 + count);
+    int64_t wrote = threshold_encode(grad.data(), n, thr, message.data(),
+                                     count);
+    check(wrote == count, "threshold_encode count");
+    std::vector<float> out(n, 0.0f);
+    threshold_decode(message.data(), out.data(), n);
+    for (int64_t i = 0; i < n; ++i) {
+        if (std::fabs(grad[i]) >= thr)
+            check(std::fabs(std::fabs(out[i]) - thr) < 1e-7f,
+                  "decoded magnitude == threshold");
+        else
+            check(out[i] == 0.0f, "sub-threshold decodes to zero");
+    }
+
+    std::vector<uint8_t> packed((n + 3) / 4, 0);
+    int64_t nbits = bitmap_encode(grad.data(), n, thr, packed.data());
+    check(nbits == count, "bitmap_encode count matches threshold_count");
+    std::vector<float> bout(n, 0.0f);
+    bitmap_decode(packed.data(), n, thr, bout.data());
+    for (int64_t i = 0; i < n; ++i)
+        check(bout[i] == out[i], "bitmap decode == threshold decode");
+}
+
+static void exercise_fast_io() {
+    const char* csv = "h1,h2,h3\n1.5,2.5,3\n-4,x,6e1\n7,8\n";
+    int64_t len = static_cast<int64_t>(std::strlen(csv));
+    int64_t rows = 0, cols = 0;
+    csv_dims(csv, len, ',', 1, &rows, &cols);
+    check(rows == 3 && cols == 3, "csv_dims");
+    std::vector<float> out(static_cast<size_t>(rows * cols), 0.0f);
+    int64_t errs = csv_parse(csv, len, ',', 1, out.data(), rows, cols,
+                             -1.0f);
+    check(errs == 1, "csv_parse error count");
+    check(out[0] == 1.5f && out[2] == 3.0f, "csv values row0");
+    check(std::isnan(out[4]), "bad cell is NaN");
+    check(out[8] == -1.0f, "short-row fill");
+    // long cell (heap path added round 3)
+    std::string long_cell(80, '1');
+    std::string doc = "0." + long_cell + ",2\n";
+    csv_dims(doc.c_str(), static_cast<int64_t>(doc.size()), ',', 0,
+             &rows, &cols);
+    std::vector<float> out2(static_cast<size_t>(rows * cols));
+    errs = csv_parse(doc.c_str(), static_cast<int64_t>(doc.size()), ',', 0,
+                     out2.data(), rows, cols, 0.0f);
+    check(errs == 0 && out2[1] == 2.0f, "long-cell parse");
+}
+
+int main() {
+    exercise_threshold_codec();
+    exercise_fast_io();
+    if (failures) {
+        std::fprintf(stderr, "%d failures\n", failures);
+        return 1;
+    }
+    std::printf("sanitize-exercise OK\n");
+    return 0;
+}

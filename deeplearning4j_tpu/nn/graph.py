@@ -289,8 +289,11 @@ class ComputationGraph:
                         params[spec.name], state[spec.name], x,
                         label_list[out_idx], train=train, rng=layer_rng,
                         mask=in_mask))
-                y, s = spec.obj.apply(params[spec.name], state[spec.name], x,
-                                      train=train, rng=layer_rng, mask=in_mask)
+                y, s = spec.obj.apply(
+                    spec.obj.noised_params(params[spec.name], train,
+                                           layer_rng),
+                    state[spec.name], x,
+                    train=train, rng=layer_rng, mask=in_mask)
                 new_state[spec.name] = s
                 known_types[spec.name] = spec.obj.get_output_type(
                     preprocessors.adapt_type(itype, spec.obj))

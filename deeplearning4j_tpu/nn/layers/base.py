@@ -51,6 +51,9 @@ def layer_from_dict(d: dict) -> "Layer":
         raise KeyError(f"unknown layer type '{type_name}'; registered: {sorted(_LAYER_REGISTRY)}")
     if isinstance(d.get("updater"), dict):
         d["updater"] = updater_mod.from_dict(d["updater"])
+    if isinstance(d.get("weight_noise"), dict):
+        from deeplearning4j_tpu.nn import weight_noise as wn_mod
+        d["weight_noise"] = wn_mod.from_dict(d["weight_noise"])
     known = {f.name for f in dataclasses.fields(cls)}
     return cls(**{k: v for k, v in d.items() if k in known})
 
@@ -78,6 +81,9 @@ class Layer:
     l2_bias: Optional[float] = None
     updater: Optional[Any] = None   # per-layer updater override (DL4J allows it)
     frozen: bool = False            # FrozenLayer parity: excluded from updates
+    # IWeightNoise parity: DropConnect / WeightNoise applied to the
+    # weights on training forward passes (nn/weight_noise.py)
+    weight_noise: Optional[Any] = None
 
     # ---- conf API ----------------------------------------------------
     def inherit_defaults(self, defaults: dict) -> None:
@@ -93,6 +99,7 @@ class Layer:
 
     def to_dict(self) -> dict:
         from deeplearning4j_tpu.train import updaters as updater_mod
+        from deeplearning4j_tpu.nn import weight_noise as wn_mod
         out = {"type": self.TYPE_NAME}
         for f in dataclasses.fields(self):
             v = getattr(self, f.name)
@@ -100,6 +107,8 @@ class Layer:
                 continue
             if f.name == "updater":
                 v = updater_mod.to_dict(v)
+            elif f.name == "weight_noise":
+                v = wn_mod.to_dict(v)
             out[f.name] = v
         return out
 
@@ -140,6 +149,17 @@ class Layer:
         if dtype is None:
             dtype = self._param_dtype()
         return jnp.full(shape, self.bias_init if self.bias_init is not None else 0.0, dtype)
+
+    def noised_params(self, params: dict, train: bool, rng) -> dict:
+        """Weight-noise hook (IWeightNoise parity): on training passes
+        with ``weight_noise`` configured, return a transformed COPY of
+        the params; inference and noise-free layers pass through."""
+        if (not train or self.weight_noise is None or rng is None
+                or not params):
+            return params
+        from deeplearning4j_tpu.nn import weight_noise as wn_mod
+        return wn_mod.apply_noise(self.weight_noise, params,
+                                  jax.random.fold_in(rng, 0x5EED))
 
     def _maybe_dropout(self, x, train, rng):
         """Input dropout with DL4J retain-probability semantics."""

@@ -101,11 +101,14 @@ class MultiLayerNetwork:
                 if carry is not None:
                     carry = jax.lax.stop_gradient(carry)
                 y, s, new_carries[i] = layer.apply_with_carry(
-                    params[i], state[i], x, carry, train=train, rng=layer_rng,
+                    layer.noised_params(params[i], train, layer_rng),
+                    state[i], x, carry, train=train, rng=layer_rng,
                     mask=current_mask)
             else:
-                y, s = layer.apply(params[i], state[i], x, train=train,
-                                   rng=layer_rng, mask=current_mask)
+                y, s = layer.apply(
+                    layer.noised_params(params[i], train, layer_rng),
+                    state[i], x, train=train,
+                    rng=layer_rng, mask=current_mask)
             new_state.append(s)
             x = y
             # time-geometry layers reshape the [B,T] mask alongside the data

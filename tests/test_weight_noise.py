@@ -1,0 +1,145 @@
+"""Weight-noise family tests (VERDICT r2 missing #7: IWeightNoise /
+DropConnect — DL4J ``nn/conf/weightnoise/``)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import NeuralNetConfiguration, InputType
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.weight_noise import (DropConnect, WeightNoise,
+                                                apply_noise, from_dict,
+                                                to_dict)
+
+
+def _net(noise):
+    conf = (NeuralNetConfiguration.builder().seed(3).list()
+            .layer(DenseLayer(n_out=16, activation="tanh",
+                              weight_noise=noise))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestTransforms:
+    def test_drop_connect_zeros_and_rescales(self):
+        w = jnp.ones((64, 64))
+        out = DropConnect(p=0.8).transform(w, jax.random.key(0))
+        vals = np.unique(np.asarray(out).round(6))
+        assert set(vals) <= {0.0, np.float32(1 / 0.8).round(6)}
+        frac = float((np.asarray(out) == 0).mean())
+        assert 0.1 < frac < 0.3            # ~1-p dropped
+        # inverted scaling keeps the expectation ~unchanged
+        assert abs(float(jnp.mean(out)) - 1.0) < 0.05
+
+    def test_weight_noise_additive_and_multiplicative(self):
+        w = jnp.full((32, 32), 2.0)
+        add = WeightNoise(stddev=0.1).transform(w, jax.random.key(1))
+        assert abs(float(jnp.mean(add)) - 2.0) < 0.05
+        assert float(jnp.std(add)) > 0.05
+        mul = WeightNoise(mean=1.0, stddev=0.1,
+                          additive=False).transform(w, jax.random.key(1))
+        assert abs(float(jnp.mean(mul)) - 2.0) < 0.1
+
+    def test_bias_excluded_by_default(self):
+        params = {"W": jnp.ones((4, 4)), "b": jnp.ones((4,))}
+        out = apply_noise(DropConnect(p=0.5), params, jax.random.key(0))
+        np.testing.assert_array_equal(np.asarray(out["b"]), 1.0)
+        assert float(jnp.sum(out["W"] == 0)) > 0
+        out2 = apply_noise(DropConnect(p=0.5, apply_to_bias=True), params,
+                           jax.random.key(0))
+        assert float(jnp.sum(out2["b"] == 0)) >= 0  # transformed stream
+
+
+class TestSerde:
+    def test_round_trip(self):
+        for noise in (DropConnect(p=0.7),
+                      WeightNoise(mean=0.1, stddev=0.2, additive=False,
+                                  apply_to_bias=True)):
+            back = from_dict(to_dict(noise))
+            assert back == noise
+
+    def test_layer_json_round_trip(self):
+        net = _net(DropConnect(p=0.9))
+        d = net.conf.to_dict()
+        from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+        import json
+        conf2 = MultiLayerConfiguration.from_dict(
+            json.loads(json.dumps(d)))
+        assert conf2.layers[0].weight_noise == DropConnect(p=0.9)
+
+
+class TestInNetwork:
+    def test_train_noisy_eval_clean(self):
+        net = _net(DropConnect(p=0.6))
+        x = jnp.asarray(np.random.default_rng(0)
+                        .normal(size=(4, 8)).astype(np.float32))
+        clean = net._forward(net.params_, net.state_, x, train=False)[0]
+        noisy = net._forward(net.params_, net.state_, x, train=True,
+                             rng=jax.random.key(5))[0]
+        clean2 = net._forward(net.params_, net.state_, x, train=False)[0]
+        np.testing.assert_array_equal(np.asarray(clean), np.asarray(clean2))
+        assert not np.allclose(np.asarray(clean), np.asarray(noisy))
+
+    def test_noise_is_rng_deterministic(self):
+        net = _net(WeightNoise(stddev=0.05))
+        x = jnp.ones((2, 8))
+        a = net._forward(net.params_, net.state_, x, train=True,
+                         rng=jax.random.key(7))[0]
+        b = net._forward(net.params_, net.state_, x, train=True,
+                         rng=jax.random.key(7))[0]
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    @pytest.mark.parametrize("noise", [DropConnect(p=0.8),
+                                       WeightNoise(stddev=0.05)])
+    def test_gradcheck_through_noise(self, noise):
+        """Fixed rng → the noised forward is deterministic and (a.e.)
+        differentiable; grads must match finite differences (f64, same
+        rig as test_gradchecks)."""
+        from deeplearning4j_tpu.autodiff.gradcheck import check_gradients
+        from deeplearning4j_tpu.config import DTypePolicy, set_dtype_policy
+        jax.config.update("jax_enable_x64", True)
+        set_dtype_policy(DTypePolicy(param_dtype=jnp.float64,
+                                     compute_dtype=jnp.float64,
+                                     output_dtype=jnp.float64))
+        try:
+            net = _net(noise)
+            rng = jax.random.key(11)
+            x = jnp.asarray(np.random.default_rng(1)
+                            .normal(size=(4, 8)).astype(np.float64))
+            labels = jnp.asarray(np.eye(4, dtype=np.float64)[[0, 1, 2, 3]])
+            params64 = jax.tree_util.tree_map(
+                lambda a: jnp.asarray(a, jnp.float64), net.params_)
+
+            def loss(params):
+                out, _, score = net._forward(params, net.state_, x,
+                                             train=True, rng=rng,
+                                             labels=labels)
+                return jnp.mean(score)
+
+            report = check_gradients(loss, params64, eps=1e-5,
+                                     max_rel_error=2e-2)
+            assert report["checked"] > 0
+        finally:
+            set_dtype_policy(DTypePolicy.f32())
+            jax.config.update("jax_enable_x64", False)
+
+    def test_fit_decreases_loss(self):
+        from deeplearning4j_tpu.train.trainer import Trainer
+        from deeplearning4j_tpu.data.dataset import DataSet
+        rng = np.random.default_rng(2)
+        net = _net(DropConnect(p=0.9))
+        trainer = Trainer(net)
+        x = rng.normal(size=(32, 8)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 32)]
+        ds = DataSet(jnp.asarray(x), jnp.asarray(y))
+        key = jax.random.key(0)
+        losses = []
+        for i in range(25):
+            key, sub = jax.random.split(key)
+            losses.append(float(trainer.fit_batch(ds, sub)))
+        assert np.mean(losses[-5:]) < np.mean(losses[:5])
