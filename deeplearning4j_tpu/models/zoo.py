@@ -245,6 +245,61 @@ def resnet50(seed: int = 123, num_classes: int = 1000, height: int = 224,
     return ComputationGraph(gb.build())
 
 
+# fused-branch suffix → unfused node suffix inside one bottleneck
+_BOTTLENECK_BRANCHES = {"a": "a", "b3": "b", "c": "c", "proj": "proj"}
+
+
+def remap_bottleneck_params(params: dict, state: dict, *, to_fused: bool):
+    """Convert resnet50 param/state dicts between the unfused
+    (ConvolutionLayer+BatchNormalization per branch) and fused
+    (:class:`FusedBottleneck`) layouts, so checkpoints from either graph
+    load into the other.  1x1 conv kernels reshape between HWIO
+    ``(1, 1, Cin, Cout)`` and the fused matmul's ``(Cin, Cout)``."""
+    params, state = dict(params), dict(state)
+    if to_fused:
+        names = sorted(k[:-len("_a_conv")] for k in params
+                       if k.endswith("_a_conv") and not k.startswith("stem"))
+        for n in names:
+            fp, fs = {}, {}
+            for fb, ub in _BOTTLENECK_BRANCHES.items():
+                ck, bk = f"{n}_{ub}_conv", f"{n}_{ub}_bn"
+                if ck not in params:
+                    continue
+                W = params.pop(ck)["W"]
+                if fb != "b3":
+                    W = W.reshape(W.shape[-2], W.shape[-1])
+                bn = params.pop(bk)
+                st = state.pop(bk)
+                state.pop(ck, None)
+                fp[f"W_{fb}"] = W
+                fp[f"gamma_{fb}"], fp[f"beta_{fb}"] = bn["gamma"], bn["beta"]
+                fs[f"mean_{fb}"], fs[f"var_{fb}"] = st["mean"], st["var"]
+            for suffix in ("_add", "_out"):
+                params.pop(n + suffix, None)
+                state.pop(n + suffix, None)
+            params[n], state[n] = fp, fs
+    else:
+        names = sorted(k for k, v in params.items()
+                       if isinstance(v, dict) and "W_a" in v)
+        for n in names:
+            fp, fs = params.pop(n), state.pop(n)
+            for fb, ub in _BOTTLENECK_BRANCHES.items():
+                if f"W_{fb}" not in fp:
+                    continue
+                W = fp[f"W_{fb}"]
+                if fb != "b3":
+                    W = W.reshape(1, 1, *W.shape)
+                params[f"{n}_{ub}_conv"] = {"W": W}
+                params[f"{n}_{ub}_bn"] = {"gamma": fp[f"gamma_{fb}"],
+                                          "beta": fp[f"beta_{fb}"]}
+                state[f"{n}_{ub}_conv"] = {}
+                state[f"{n}_{ub}_bn"] = {"mean": fs[f"mean_{fb}"],
+                                         "var": fs[f"var_{fb}"]}
+            params[f"{n}_add"], state[f"{n}_add"] = {}, {}
+            params[f"{n}_out"], state[f"{n}_out"] = {}, {}
+    return params, state
+
+
 # ------------------------------------------------------------------ RNN zoo
 def lstm_classifier(seed: int = 123, n_in: int = 9, n_classes: int = 6,
                     timesteps: Optional[int] = 128, hidden: int = 128,

@@ -285,8 +285,12 @@ class ComputationGraph:
                 if (labels is not None and spec.name in self.conf.outputs
                         and hasattr(spec.obj, "compute_score_array")):
                     out_idx = self.conf.outputs.index(spec.name)
+                    # same noised weights as apply(): IWeightNoise applies
+                    # to the loss path too (DL4J BaseLayer.getParamWithNoise)
                     score_arrays.append(spec.obj.compute_score_array(
-                        params[spec.name], state[spec.name], x,
+                        spec.obj.noised_params(params[spec.name], train,
+                                               layer_rng),
+                        state[spec.name], x,
                         label_list[out_idx], train=train, rng=layer_rng,
                         mask=in_mask))
                 y, s = spec.obj.apply(

@@ -62,6 +62,7 @@ from deeplearning4j_tpu.nn.layers.attention import (
     LearnedSelfAttentionLayer,
 )
 from deeplearning4j_tpu.nn.layers.norm import LayerNormalization, PReLULayer
+from deeplearning4j_tpu.nn.layers.fused import FusedBottleneck
 from deeplearning4j_tpu.nn.layers.extra import (
     ZeroPadding1DLayer,
     Cropping1DLayer,
@@ -112,5 +113,5 @@ __all__ = [
     "MaskZeroLayer", "GravesBidirectionalLSTM", "CenterLossOutputLayer",
     "Yolo2OutputLayer", "VariationalAutoencoder", "PrimaryCapsules",
     "CapsuleLayer", "CapsuleStrengthLayer", "RecurrentAttentionLayer",
-    "MixtureOfExperts",
+    "MixtureOfExperts", "FusedBottleneck",
 ]

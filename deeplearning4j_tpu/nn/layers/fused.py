@@ -17,8 +17,13 @@ layer so the 1x1 convs can run through
 
 The 3x3 itself stays on XLA's conv (its BN stats are one extra fused
 reduce).  Running mean/var live in layer state exactly like
-``BatchNormalization`` (decay 0.9, biased variance), so checkpoints and
-inference behave identically to the unfused graph.
+``BatchNormalization`` (decay 0.9, biased variance), so train/eval
+numerics match the unfused graph.  Param/state KEYS differ from the
+unfused three-layer block (``W_a``/``gamma_a``/... vs per-layer
+``*_conv_W``/``*_bn_*``), so fused and unfused resnet50 checkpoints are
+not directly interchangeable; use
+:func:`deeplearning4j_tpu.models.zoo.remap_bottleneck_params` to
+convert.
 """
 
 from __future__ import annotations
@@ -114,12 +119,16 @@ class FusedBottleneck(Layer):
         m = n * hb * wb
         x2d = xs.reshape(m, c_in).astype(cdt)
 
+        # stats/scale dtype: f64 when gradchecking (f32 rounding is
+        # gradcheck noise), f32 otherwise — shared by gb() and the 3x3
+        sdt = jnp.float64 if cdt == jnp.float64 else jnp.float32
+
         def W(name):
             return params[f"W_{name}"].astype(cdt)
 
         def gb(name):
-            return (params[f"gamma_{name}"].astype(jnp.float32),
-                    params[f"beta_{name}"].astype(jnp.float32))
+            return (params[f"gamma_{name}"].astype(sdt),
+                    params[f"beta_{name}"].astype(sdt))
 
         # ---- 1x1 reduce (stats from the kernel epilogue)
         y1, s1a, s2a = matmul_bn_act(x2d, W("a"))
@@ -133,7 +142,7 @@ class FusedBottleneck(Layer):
         y2 = jax.lax.conv_general_dilated(
             z1, W("b3"), window_strides=(1, 1), padding="SAME",
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
-        y2f = y2.astype(jnp.float32)       # fused convert+reduce (one read)
+        y2f = y2.astype(sdt)               # fused convert+reduce (one read)
         s1b = jnp.sum(y2f, axis=(0, 1, 2))
         s2b = jnp.sum(y2f * y2f, axis=(0, 1, 2))
         mean_b, var_b = self._stats("b3", s1b, s2b, m, state, new_state, train)

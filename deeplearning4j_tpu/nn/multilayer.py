@@ -93,8 +93,11 @@ class MultiLayerNetwork:
             layer_rng = jax.random.fold_in(rng, i) if rng is not None else None
             is_last = i == len(self.layers) - 1
             if is_last and labels is not None and hasattr(layer, "compute_score_array"):
+                # same noised weights as apply(): IWeightNoise applies to
+                # the loss path too (DL4J BaseLayer.getParamWithNoise)
                 score_array = layer.compute_score_array(
-                    params[i], state[i], x, labels, train=train, rng=layer_rng,
+                    layer.noised_params(params[i], train, layer_rng),
+                    state[i], x, labels, train=train, rng=layer_rng,
                     mask=current_mask)
             if carries is not None and isinstance(layer, BaseRecurrentLayer):
                 carry = carries[i]

@@ -56,6 +56,8 @@ def ctc_loss(logits, labels, logit_lengths, label_lengths, blank: int = 0):
         first = jnp.where(label_lengths > 0, emit_all[:, 0, 1], _NEG)
         alpha0 = alpha0.at[:, 1].set(first)
     alpha0 = jnp.where(valid, alpha0, _NEG)
+    # logit_lengths == 0: no emissions at all — every path is infeasible
+    alpha0 = jnp.where(logit_lengths[:, None] > 0, alpha0, _NEG)
 
     def step(alpha, inputs):
         emit, active = inputs                                # [B,L], [B,1]
@@ -89,4 +91,8 @@ def ctc_loss(logits, labels, logit_lengths, label_lengths, blank: int = 0):
     m_safe = jnp.where(dead, 0.0, m)
     ll = m_safe + jnp.log(jnp.exp(a_last - m_safe) + jnp.exp(a_prev - m_safe))
     # infeasible alignment (e.g. label longer than logits): loss = +1e30
-    return jnp.where(dead, -jnp.float32(_NEG), -ll)
+    loss = jnp.where(dead, -jnp.float32(_NEG), -ll)
+    # empty/empty: the empty alignment has probability 1 → loss 0
+    # (torch.nn.functional.ctc_loss parity)
+    return jnp.where((logit_lengths == 0) & (label_lengths == 0),
+                     0.0, loss)

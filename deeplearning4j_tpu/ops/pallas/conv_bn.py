@@ -296,6 +296,18 @@ def matmul_bn_act(x, w, a=None, b=None, *, relu_in: bool = True,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     has_prologue = a is not None
+    if jnp.dtype(x.dtype) == jnp.float64:
+        # exact reference path: the Pallas kernel accumulates stats in
+        # f32, too noisy for f64 gradchecks; autodiff handles the vjp
+        xh = x
+        if has_prologue:
+            xh = x * a.astype(x.dtype) + b.astype(x.dtype)
+            if relu_in:
+                xh = jnp.maximum(xh, 0.0)
+        y = jax.lax.dot_general(xh, w.astype(x.dtype),
+                                (((1,), (0,)), ((), ())),
+                                precision=jax.lax.Precision.HIGHEST)
+        return y, jnp.sum(y, axis=0), jnp.sum(y * y, axis=0)
     if a is None:
         a = jnp.ones((x.shape[1],), jnp.float32)
     if b is None:

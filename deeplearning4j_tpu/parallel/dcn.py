@@ -16,8 +16,12 @@ allreduce:
 ``InProcessTransport`` is the DummyTransport-parity test fake;
 ``SocketTransport`` moves the same byte payloads over real TCP between
 slice-leader PROCESSES (the AeronUdpTransport translation, SURVEY §2.7)
-— star topology through the rank-0 relay, length-prefixed frames, round
-tagging so a fast rank can never consume a stale payload.
+— a RING all-gather (rank r listens for r-1, sends to r+1; messages
+circulate n-1 hops with origin tags), so no rank is an O(n) bottleneck
+the way a star relay would be.  Length-prefixed frames + round tagging
+mean a fast rank can never consume a stale payload, and a dead peer
+surfaces as a socket timeout at its neighbours (fail-fast, no silent
+hang).
 """
 
 from __future__ import annotations
@@ -131,86 +135,67 @@ def _recv_frame(sock: socket.socket):
     return rnd, rank, data
 
 
-class _RelayServer:
-    """Rank-0 side of :class:`SocketTransport`: accepts one TCP
-    connection per rank, gathers each round's frames, and answers every
-    rank with its peers' same-round payloads."""
-
-    def __init__(self, n_ranks: int, port: int, host: str, timeout: float):
-        self.n_ranks = n_ranks
-        self.timeout = timeout
-        self._cond = threading.Condition()
-        self._rounds: dict[int, dict[int, np.ndarray]] = {}
-        self._served: dict[int, set] = {}
-        self._listener = socket.create_server((host, port), backlog=n_ranks)
-        self._listener.settimeout(timeout)
-        threading.Thread(target=self._accept_loop, daemon=True).start()
-
-    def _accept_loop(self):
-        for _ in range(self.n_ranks):
-            conn, _ = self._listener.accept()
-            conn.settimeout(self.timeout)
-            threading.Thread(target=self._serve, args=(conn,),
-                             daemon=True).start()
-        self._listener.close()
-
-    def _serve(self, conn: socket.socket):
-        try:
-            while True:
-                rnd, rank, payload = _recv_frame(conn)
-                with self._cond:
-                    bucket = self._rounds.setdefault(rnd, {})
-                    bucket[rank] = payload
-                    if len(bucket) == self.n_ranks:
-                        self._cond.notify_all()
-                    else:
-                        deadline = time.monotonic() + self.timeout
-                        while len(self._rounds[rnd]) < self.n_ranks:
-                            remaining = deadline - time.monotonic()
-                            if remaining <= 0 or not self._cond.wait(remaining):
-                                raise TimeoutError(
-                                    f"relay round {rnd}: only "
-                                    f"{sorted(self._rounds[rnd])} arrived")
-                    peers = [(r, self._rounds[rnd][r])
-                             for r in range(self.n_ranks) if r != rank]
-                # respond outside the lock; TCP buffering decouples ranks
-                for r, data in peers:
-                    _send_frame(conn, rnd, r, data)
-                with self._cond:
-                    served = self._served.setdefault(rnd, set())
-                    served.add(rank)
-                    if len(served) == self.n_ranks:    # round fully drained
-                        self._rounds.pop(rnd, None)
-                        self._served.pop(rnd, None)
-        except (ConnectionError, OSError):
-            conn.close()      # rank done (or died — peers see a timeout)
-
-
 class SocketTransport:
-    """Real-bytes transport between slice-leader processes over TCP
+    """Real-bytes ring transport between slice-leader processes over TCP
     (loopback in tests, any reachable host in deployment).  Same
-    ``exchange`` contract as :class:`InProcessTransport`; every payload
-    crosses a process boundary through the rank-0 relay."""
+    ``exchange`` contract as :class:`InProcessTransport`.
+
+    Topology: rank r binds ``port + r`` and accepts ONE connection from
+    its left neighbour ``(r-1) % n``; it connects out to its right
+    neighbour's port.  ``exchange`` is a ring all-gather: at hop s the
+    rank forwards the message that originated ``s-1`` hops upstream and
+    receives the one from ``s`` hops upstream, so after ``n-1`` hops
+    every rank holds every origin's payload.  Per-rank traffic is
+    ``(n-1) * msg`` in each direction regardless of n — no relay
+    bottleneck (SURVEY §2.7 transport row; replaces the round-3 star).
+
+    Failure semantics: a dead peer stalls its neighbours' ``recv``,
+    which raises ``socket.timeout`` (an OSError) out of ``exchange`` —
+    the caller sees the failure on the next step rather than hanging.
+    """
 
     def __init__(self, rank: int, n_ranks: int, port: int,
-                 host: str = "127.0.0.1", timeout: float = 60.0):
+                 host: str = "127.0.0.1", timeout: float = 60.0,
+                 hosts: Optional[Sequence[str]] = None,
+                 bind_host: str = ""):
+        """``host`` is the single-machine shortcut (bind + connect on one
+        address, loopback tests).  For a real multi-host ring pass
+        ``hosts`` — one reachable address per rank — and optionally
+        ``bind_host`` (default: all interfaces)."""
         self.rank = rank
         self.n_ranks = n_ranks
         self._round = 0
-        if rank == 0:
-            self._server = _RelayServer(n_ranks, port, host, timeout)
-        # every rank (rank 0 included) talks to the relay as a client
+        self.bytes_sent = 0
+        self.bytes_received = 0
+        right = (rank + 1) % n_ranks
+        if hosts is None:
+            hosts = [host] * n_ranks
+            bind_host = bind_host or host
+        if len(hosts) != n_ranks:
+            raise ValueError(f"hosts must list all {n_ranks} ranks")
+        self._listener = socket.create_server((bind_host, port + rank),
+                                              backlog=1)
+        self._listener.settimeout(timeout)
+        # connect out to the right neighbour while it is (maybe) still
+        # binding; accept the left neighbour in parallel via the backlog
         deadline = time.monotonic() + timeout
         while True:
             try:
-                self._sock = socket.create_connection((host, port),
-                                                      timeout=timeout)
+                self._send_sock = socket.create_connection(
+                    (hosts[right], port + right), timeout=timeout)
                 break
             except OSError:
                 if time.monotonic() > deadline:
                     raise
-                time.sleep(0.1)
-        self._sock.settimeout(timeout)
+                time.sleep(0.05)
+        self._send_sock.settimeout(timeout)
+        self._recv_sock, _ = self._listener.accept()
+        self._recv_sock.settimeout(timeout)
+        self._listener.close()
+
+    def _send(self, rnd: int, origin: int, payload: np.ndarray) -> None:
+        _send_frame(self._send_sock, rnd, origin, payload)
+        self.bytes_sent += _FRAME.size + payload.nbytes
 
     def exchange(self, rank: int, message: np.ndarray) -> list[np.ndarray]:
         if rank != self.rank:
@@ -218,21 +203,48 @@ class SocketTransport:
                              f"got {rank}")
         rnd = self._round
         self._round += 1
-        _send_frame(self._sock, rnd, rank, message)
-        peers: dict[int, np.ndarray] = {}
-        for _ in range(self.n_ranks - 1):
-            got_rnd, peer, data = _recv_frame(self._sock)
+        n = self.n_ranks
+        have: dict[int, np.ndarray] = {rank: np.ascontiguousarray(message)}
+        forward = have[rank]
+        forward_origin = rank
+        for hop in range(1, n):
+            # send on a helper thread while this thread drains recv:
+            # with everyone in blocking sendall, a payload larger than
+            # the kernel socket buffers would deadlock the whole ring
+            send_err: list[BaseException] = []
+
+            def _send_guarded(rnd=rnd, origin=forward_origin, data=forward):
+                try:
+                    self._send(rnd, origin, data)
+                except BaseException as e:   # re-raised on the caller
+                    send_err.append(e)
+
+            sender = threading.Thread(target=_send_guarded)
+            sender.start()
+            try:
+                got_rnd, origin, data = _recv_frame(self._recv_sock)
+            finally:
+                sender.join()
+            if send_err:
+                raise send_err[0]
             if got_rnd != rnd:
-                raise RuntimeError(f"round mismatch: sent {rnd}, "
+                raise RuntimeError(f"round mismatch: at {rnd}, "
                                    f"received {got_rnd}")
-            peers[peer] = data
-        return [peers[r] for r in sorted(peers)]
+            expected = (rank - hop) % n
+            if origin != expected:
+                raise RuntimeError(f"ring order violated: expected origin "
+                                   f"{expected}, got {origin}")
+            self.bytes_received += _FRAME.size + data.nbytes
+            have[origin] = data
+            forward, forward_origin = data, origin
+        return [have[r] for r in range(n) if r != rank]
 
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        for s in (self._send_sock, self._recv_sock):
+            try:
+                s.close()
+            except OSError:
+                pass
 
 
 # ======================================================= compressed allreduce
@@ -261,12 +273,14 @@ class CompressedAllReducer:
         if flat_grad.size != self.size:
             raise ValueError(f"gradient size {flat_grad.size} != {self.size}")
         message = self.accumulator.store_update(flat_grad)
+        peers = self.transport.exchange(self.rank, message)
         # own contribution = what actually went on the wire (decode of our
-        # message), NOT the raw gradient — keeps all ranks byte-identical
-        own = threshold_decode(message, (self.size,))
-        total = np.array(own)
-        for peer_message in self.transport.exchange(self.rank, message):
-            threshold_decode(peer_message, (self.size,), out=total)
+        # message), NOT the raw gradient; accumulate in GLOBAL RANK ORDER
+        # so every rank performs the identical f32 sum → bitwise equality
+        ordered = peers[:self.rank] + [message] + peers[self.rank:]
+        total = np.zeros(self.size, np.float32)
+        for msg in ordered:
+            threshold_decode(msg, (self.size,), out=total)
         return total
 
     def wire_stats(self, message: np.ndarray) -> dict:

@@ -138,8 +138,11 @@ def dcn_socket_allreduce_worker(pid, n, port=23401, steps=8):
     grads = [rng.normal(0, 0.05, size).astype(np.float32)
              for _ in range(steps)]
     sums = [reducer.allreduce(g) for g in grads]
+    stats = {"bytes_sent": transport.bytes_sent,
+             "bytes_received": transport.bytes_received}
     transport.close()
     return {"pid": pid,
             "sums": np.stack(sums),
             "grads": np.stack(grads),
-            "residual": np.asarray(reducer.accumulator.residual)}
+            "residual": np.asarray(reducer.accumulator.residual),
+            **stats}

@@ -149,3 +149,32 @@ class TestFusedBottleneck:
         out = net.output(x)
         assert np.asarray(out).shape == (2, 10)
         assert np.all(np.isfinite(np.asarray(out)))
+
+    def test_checkpoint_remap_fused_unfused(self):
+        """Unfused checkpoint → fused graph (and back) is numerically the
+        same network in eval mode."""
+        from deeplearning4j_tpu.models import resnet50
+        from deeplearning4j_tpu.models.zoo import remap_bottleneck_params
+        rng = np.random.default_rng(3)
+        net_u = resnet50(height=32, width=32, num_classes=10).init()
+        net_f = resnet50(height=32, width=32, num_classes=10, fused=True).init()
+        x = jnp.asarray(rng.normal(size=(2, 32, 32, 3)).astype(np.float32))
+        # train one step worth of stats so running mean/var are non-trivial
+        _, net_u.state_, _ = net_u._forward(net_u.params_, net_u.state_, x,
+                                            train=True,
+                                            rng=jax.random.key(0))
+
+        pf, sf = remap_bottleneck_params(net_u.params_, net_u.state_,
+                                         to_fused=True)
+        assert set(pf) == set(net_f.params_), "fused key sets must match"
+        net_f.params_, net_f.state_ = pf, sf
+        out_u = net_u.output(x)
+        out_f = net_f.output(x)
+        np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_u),
+                                   rtol=2e-4, atol=2e-4)
+
+        pu, su = remap_bottleneck_params(pf, sf, to_fused=False)
+        assert set(pu) == set(net_u.params_)
+        for k in pu:
+            jax.tree.map(np.testing.assert_array_equal,
+                         pu[k], net_u.params_[k])

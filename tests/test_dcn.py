@@ -128,24 +128,24 @@ class TestSocketTransport:
     """VERDICT r2 missing #5: real bytes must cross a process boundary."""
 
     def test_single_process_loopback(self):
-        """Smoke: N thread-ranks through the TCP relay (real sockets,
+        """Smoke: N thread-ranks around the TCP ring (real sockets,
         one process) agree byte-for-byte with InProcessTransport."""
         from deeplearning4j_tpu.parallel.dcn import SocketTransport
-        n, size, steps = 3, 256, 5
+        n, size, steps = 4, 256, 5
         port = 23311
         transports = {}
 
         def make(rank):
             transports[rank] = SocketTransport(rank, n, port=port)
 
-        # rank 0 must bind first (it hosts the relay)
-        make(0)
+        # ring handshake: every rank binds + connects concurrently
         threads = [threading.Thread(target=make, args=(r,))
-                   for r in range(1, n)]
+                   for r in range(n)]
         for t in threads:
             t.start()
         for t in threads:
             t.join()
+        assert sorted(transports) == list(range(n))
         reducers = [CompressedAllReducer(r, size, transports[r])
                     for r in range(n)]
         ref_transport = InProcessTransport(n)
@@ -173,6 +173,15 @@ class TestSocketTransport:
             for r in range(n):
                 np.testing.assert_array_equal(out[s][r], out[s][0])
                 np.testing.assert_array_equal(out[s][r], ref[s][r])
+        # ring property: every rank moved (n-1) frames each way per
+        # exchange — traffic is per-neighbour, not through one relay
+        for r in range(n):
+            assert transports[r].bytes_sent > 0
+            assert transports[r].bytes_received > 0
+        total_sent = sum(transports[r].bytes_sent for r in range(n))
+        for r in range(n):
+            # no rank carries more than ~(2/n) of total traffic
+            assert transports[r].bytes_sent < total_sent * 2 / n
         for t in transports.values():
             t.close()
 
@@ -182,11 +191,19 @@ class TestSocketTransport:
         convergence property holds across the wire."""
         from deeplearning4j_tpu.parallel.launcher import spawn_local_cluster
         from tests.cluster_workers import dcn_socket_allreduce_worker
-        n, steps = 3, 8
+        n, steps = 4, 8
         results = spawn_local_cluster(dcn_socket_allreduce_worker,
                                       n_processes=n, port=12675)
         assert len(results) == n
         by_pid = {r["pid"]: r for r in results}
+        # per-rank bytes-on-wire: every rank sent AND received its
+        # (n-1)-hop share; sums agree despite no central relay
+        for pid in range(n):
+            assert by_pid[pid]["bytes_sent"] > 0
+            assert by_pid[pid]["bytes_received"] > 0
+        total = sum(by_pid[p]["bytes_sent"] for p in range(n))
+        for pid in range(n):
+            assert by_pid[pid]["bytes_sent"] < total * 2 / n
         # every rank computed identical sums every step
         for pid in range(1, n):
             np.testing.assert_array_equal(by_pid[pid]["sums"],

@@ -568,6 +568,26 @@ def test_ctc_loss_zero_and_repeated_labels():
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
 
+def test_ctc_loss_empty_logit_lengths():
+    """logit_lengths == 0: empty/empty alignment has probability 1
+    (loss 0, torch parity); empty logits with a non-empty label is an
+    infeasible path (loss +1e30)."""
+    rng = np.random.default_rng(9)
+    logits = rng.normal(size=(2, 6, 4)).astype(np.float32)
+    labels = np.asarray([[0, 0], [1, 2]], np.int32)
+    got = np.asarray(ns.loss.ctc_loss(jnp.asarray(logits),
+                                      jnp.asarray(labels),
+                                      np.asarray([0, 0], np.int64),
+                                      np.asarray([0, 2], np.int64)))
+    assert got[0] == 0.0
+    assert got[1] >= 1e29
+    # grads stay finite through the infeasible-path branch
+    g = np.asarray(jax.grad(lambda lg: jnp.sum(ns.loss.ctc_loss(
+        lg, jnp.asarray(labels), np.asarray([0, 0], np.int64),
+        np.asarray([0, 2], np.int64))))(jnp.asarray(logits)))
+    assert np.all(np.isfinite(g))
+
+
 def test_grad_smoke_differentiable_ops():
     """check_grads over a representative differentiable subset (the
     OpValidation gradient leg for namespace ops; layer-level grads are
