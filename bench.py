@@ -26,6 +26,25 @@ A100_PROXY_IMG_PER_SEC = 2750.0  # public MLPerf-era proxy, see BASELINE.md
 V5E_PEAK_BF16_TFLOPS = 197.0
 V5E_HBM_GBPS = 819.0
 
+def _timed_region(run, sync, steps, repeats=3):
+    """Best-of-``repeats`` steady-state seconds/step.
+
+    ``run()`` dispatches one step and returns a handle; ``sync`` forces a
+    device→host transfer of that handle.  This is the one trustworthy
+    fence on the experimental tunnel platform — ``block_until_ready``
+    there measured dispatch-only and produced the phantom r2→r3 BERT
+    "regression".  Best-of filters tunnel hiccups."""
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        handle = None
+        for _ in range(steps):
+            handle = run()
+        sync(handle)
+        best = min(best, (time.perf_counter() - t0) / steps)
+    return best
+
+
 # ResNet-50 224x224 training FLOPs/image, from XLA cost_analysis of the
 # full donated train step at batch 256 (5.72 TFLOP / 256 images; includes
 # fwd+bwd+Nesterov update) — see bench/PROFILE.md round-2 roofline
@@ -58,12 +77,9 @@ def bench_resnet50(batch: int = 256, image: int = 224, steps: int = 12,
 
     for _ in range(warmup):  # first call compiles
         float(trainer.fit_batch(batch_ds, key))
-    t0 = time.perf_counter()
-    loss = None
-    for _ in range(steps):
-        loss = trainer.fit_batch(batch_ds, key)  # async dispatch, pipelined
-    final_loss = float(loss)  # one sync closes the timed region
-    dt = time.perf_counter() - t0
+    step_s = _timed_region(lambda: trainer.fit_batch(batch_ds, key),
+                           float, steps)
+    dt = step_s * steps
     img_per_sec = batch * steps / dt
     n_chips = max(len(jax.devices()), 1)
     per_chip = img_per_sec / n_chips
@@ -87,10 +103,12 @@ def bench_resnet50(batch: int = 256, image: int = 224, steps: int = 12,
     }
 
 
-def bench_bert_mlm(batch: int = 32, seq_len: int = 128, steps: int = 10,
-                   warmup: int = 2) -> dict:
+def bench_bert_mlm(batch: int = 32, seq_len: int = 128, steps: int = 30,
+                   warmup: int = 3, repeats: int = 3) -> dict:
     """BERT-base MLM fine-tune step time — the second headline metric
-    (BASELINE.json config #4: SameDiff TF-import BERT-base MLM)."""
+    (BASELINE.json config #4: SameDiff TF-import BERT-base MLM).
+
+    Timing discipline: see ``_timed_region``."""
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.config import DTypePolicy, set_dtype_policy
@@ -109,19 +127,22 @@ def bench_bert_mlm(batch: int = 32, seq_len: int = 128, steps: int = 10,
     labels = jnp.asarray(rng.integers(0, config.vocab_size, (batch, seq_len)), jnp.int32)
     weights = jnp.asarray((rng.random((batch, seq_len)) < 0.15), jnp.float32)
     attn = jnp.ones((batch, seq_len), jnp.float32)
-    key = jax.random.key(0)
+    # rbg = the TPU-accelerated generator the model uses for dropout
+    key = jax.random.key(0, impl="rbg")
 
     params, opt = model.params, opt_state
     n_params = model.num_params()
     for _ in range(warmup):
         params, opt, loss = step(params, opt, ids, labels, weights, attn, key)
-    jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt, loss = step(params, opt, ids, labels, weights, attn, key)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-    step_s = dt / steps
+    jax.device_get(loss)
+    state = [params, opt]
+
+    def run():
+        state[0], state[1], loss = step(state[0], state[1], ids, labels,
+                                        weights, attn, key)
+        return loss
+
+    step_s = _timed_region(run, jax.device_get, steps, repeats)
     # transformer train FLOPs ≈ 6·P·tokens + attention 12·L·T²·H·Dh·3
     # (fwd+bwd); the 6PT term dominates at seq 128
     tokens = batch * seq_len
@@ -156,7 +177,7 @@ def bench_bert_long_seq(seq_len: int = 4096, batch: int = 2,
                          jnp.int32)
     weights = jnp.asarray((rng.random((batch, seq_len)) < 0.15), jnp.float32)
     attn = jnp.ones((batch, seq_len), jnp.float32)
-    key = jax.random.key(0)
+    key = jax.random.key(0, impl="rbg")
 
     out = {"seq_len": seq_len, "batch": batch, "num_layers": base.num_layers}
     n_params = None
@@ -171,14 +192,16 @@ def bench_bert_long_seq(seq_len: int = 4096, batch: int = 2,
         for _ in range(warmup):
             params, opt, loss = step(params, opt, ids, labels, weights,
                                      attn, key)
-        jax.block_until_ready(loss)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            params, opt, loss = step(params, opt, ids, labels, weights,
-                                     attn, key)
-        jax.block_until_ready(loss)
+        jax.device_get(loss)
+        state = [params, opt]
+
+        def run():
+            state[0], state[1], loss = step(state[0], state[1], ids, labels,
+                                            weights, attn, key)
+            return loss
+
         out[f"{name}_step_ms"] = round(
-            (time.perf_counter() - t0) / steps * 1000, 2)
+            _timed_region(run, jax.device_get, steps) * 1000, 2)
     out["flash_speedup"] = round(out["einsum_step_ms"]
                                  / out["flash_step_ms"], 2)
     flops = (6.0 * n_params * batch * seq_len
@@ -234,8 +257,9 @@ def bench_dp_scaling(measured_img_per_sec: float = 2242.0,
     }
 
 
-def _bench_net_step(net, features, labels, steps=10, warmup=2):
-    """Steady-state fit_batch time for a workload net."""
+def _bench_net_step(net, features, labels, steps=20, warmup=3, repeats=3):
+    """Steady-state fit_batch time for a workload net (``_timed_region``
+    discipline)."""
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.data.dataset import DataSet
@@ -246,11 +270,8 @@ def _bench_net_step(net, features, labels, steps=10, warmup=2):
     for _ in range(warmup):
         loss = trainer.fit_batch(batch, key)
     float(loss)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = trainer.fit_batch(batch, key)
-    float(loss)
-    return round((time.perf_counter() - t0) / steps * 1000, 2)
+    return round(_timed_region(lambda: trainer.fit_batch(batch, key),
+                               float, steps, repeats) * 1000, 2)
 
 
 def bench_workload_steps() -> dict:
