@@ -28,7 +28,8 @@ from typing import Optional
 
 import numpy as np
 
-FLAG_SIGN_IDX = 0  # format marker (reserved, matches reference header slot use)
+FLAG_SIGN_IDX = 0      # 1-bit ±τ format (reference encodeThreshold parity)
+FLAG_VALUE_SPARSE = 1  # sparse index+VALUE format (top-τ sparsification)
 
 
 def threshold_encode(grad: np.ndarray, threshold: float,
@@ -48,20 +49,47 @@ def threshold_encode(grad: np.ndarray, threshold: float,
     return np.concatenate([header, encoded])
 
 
+def threshold_encode_values(grad: np.ndarray, threshold: float,
+                            max_elements: Optional[int] = None) -> np.ndarray:
+    """Top-τ VALUE sparsification: same wire dtype/header as
+    :func:`threshold_encode` (format flag 1) but the message carries the
+    actual f32 values (bitcast into the int32 body) after the index run.
+    2× the bytes of the 1-bit form per entry, but the decoded update is
+    EXACT at transmitted coordinates — the residual keeps only the
+    sub-τ tail, so training tracks dense allreduce tightly (beyond-
+    reference mode; the reference's ±τ form is kept for parity)."""
+    flat = np.ravel(np.asarray(grad, dtype=np.float32))
+    hits = np.nonzero(np.abs(flat) >= threshold)[0]
+    if max_elements is not None and hits.size > max_elements:
+        hits = hits[:max_elements]
+    header = np.array([hits.size, FLAG_VALUE_SPARSE,
+                       np.float32(threshold).view(np.int32)], dtype=np.int32)
+    return np.concatenate([header, (hits + 1).astype(np.int32),
+                           flat[hits].view(np.int32)])
+
+
 def threshold_decode(message: np.ndarray, shape: tuple,
                      out: Optional[np.ndarray] = None) -> np.ndarray:
-    """Decode into a dense array of ``shape`` (adds into ``out`` when given,
-    matching decodeThreshold's accumulate-into-target semantics)."""
+    """Decode either wire format (header flag) into a dense array of
+    ``shape`` (adds into ``out`` when given, matching decodeThreshold's
+    accumulate-into-target semantics)."""
     message = np.asarray(message, dtype=np.int32)
     count = int(message[0])
+    flag = int(message[1])
     threshold = message[2:3].view(np.float32)[0]
-    body = message[3:3 + count].astype(np.int64)
     if out is None:
         out = np.zeros(int(np.prod(shape)), dtype=np.float32)
     else:
         out = np.ravel(out)
-    idx = np.abs(body) - 1
-    np.add.at(out, idx, np.where(body > 0, threshold, -threshold).astype(np.float32))
+    if flag == FLAG_VALUE_SPARSE:
+        idx = message[3:3 + count].astype(np.int64) - 1
+        vals = message[3 + count:3 + 2 * count].view(np.float32)
+        np.add.at(out, idx, vals)
+    else:
+        body = message[3:3 + count].astype(np.int64)
+        idx = np.abs(body) - 1
+        np.add.at(out, idx,
+                  np.where(body > 0, threshold, -threshold).astype(np.float32))
     return out.reshape(shape)
 
 
@@ -141,12 +169,18 @@ class EncodedGradientsAccumulator:
 
     def __init__(self, shape: tuple,
                  algorithm: Optional[AdaptiveThresholdAlgorithm] = None,
-                 use_native: bool = True):
+                 use_native: bool = True, value_coded: bool = False):
+        """``value_coded`` switches the wire format from the reference's
+        1-bit ±τ quantization to top-τ value sparsification
+        (:func:`threshold_encode_values`) — exact at transmitted
+        coordinates, residual = sub-τ tail only.  The native C++ codec
+        implements only the 1-bit form, so value mode encodes in numpy."""
         self.shape = tuple(shape)
         self.residual = np.zeros(int(np.prod(shape)), dtype=np.float32)
         self.algorithm = algorithm or AdaptiveThresholdAlgorithm()
+        self.value_coded = value_coded
         self._codec = None
-        if use_native:
+        if use_native and not value_coded:
             try:
                 from deeplearning4j_tpu.native import codec as native_codec
                 self._codec = native_codec if native_codec.available() else None
@@ -158,6 +192,8 @@ class EncodedGradientsAccumulator:
         threshold = self.algorithm.current()
         if self._codec is not None:
             message = self._codec.threshold_encode(self.residual, threshold)
+        elif self.value_coded:
+            message = threshold_encode_values(self.residual, threshold)
         else:
             message = threshold_encode(self.residual, threshold)
         n_encoded = int(message[0])

@@ -261,18 +261,21 @@ class CompressedAllReducer:
 
     def __init__(self, rank: int, size: int, transport,
                  algorithm: Optional[AdaptiveThresholdAlgorithm] = None,
-                 use_native: bool = True):
+                 use_native: bool = True, value_coded: bool = False):
         self.rank = rank
         self.size = int(size)
         self.transport = transport
         self.accumulator = EncodedGradientsAccumulator(
-            (self.size,), algorithm=algorithm, use_native=use_native)
+            (self.size,), algorithm=algorithm, use_native=use_native,
+            value_coded=value_coded)
+        self.last_message: Optional[np.ndarray] = None
 
     def allreduce(self, flat_grad: np.ndarray) -> np.ndarray:
         flat_grad = np.ravel(np.asarray(flat_grad, dtype=np.float32))
         if flat_grad.size != self.size:
             raise ValueError(f"gradient size {flat_grad.size} != {self.size}")
         message = self.accumulator.store_update(flat_grad)
+        self.last_message = message
         peers = self.transport.exchange(self.rank, message)
         # own contribution = what actually went on the wire (decode of our
         # message), NOT the raw gradient; accumulate in GLOBAL RANK ORDER
