@@ -137,44 +137,57 @@ def _dropout(x, rate, train, rng):
     return jnp.where(keep, x / (1.0 - rate), 0.0)
 
 
-def encode(params: dict, config: BertConfig, input_ids: jnp.ndarray,
-           token_type_ids: Optional[jnp.ndarray] = None,
-           attention_mask: Optional[jnp.ndarray] = None,
-           *, train: bool = False, rng: Optional[jax.Array] = None) -> jnp.ndarray:
-    """input_ids [B,T] int32 → hidden states [B,T,H]."""
-    b, t = input_ids.shape
+def encoder_layer(lp: dict, config: BertConfig, x: jnp.ndarray,
+                  attention_mask: Optional[jnp.ndarray] = None,
+                  *, train: bool = False,
+                  rng: Optional[jax.Array] = None) -> jnp.ndarray:
+    """One transformer encoder block (bert/encoder/layer_N) — the single
+    source for both :func:`encode` and :func:`pipeline_stages`."""
+    q = _dense(lp["attention"]["query"], x)
+    k = _dense(lp["attention"]["key"], x)
+    v = _dense(lp["attention"]["value"], x)
+    attn = multi_head_attention(q, k, v, n_heads=config.num_heads,
+                                kv_mask=attention_mask,
+                                use_flash=config.use_flash,
+                                flash_block=config.flash_block)
+    attn = _dense(lp["attention"]["output"], attn)
+    attn = _dropout(attn, config.hidden_dropout, train, rng)
+    x = _layer_norm(lp["attention"]["output_layer_norm"], x + attn,
+                    config.layer_norm_eps)
+    inter = jax.nn.gelu(_dense(lp["intermediate"], x))
+    out = _dense(lp["output"], inter)
+    out = _dropout(out, config.hidden_dropout, train,
+                   jax.random.fold_in(rng, 7) if rng is not None else None)
+    return _layer_norm(lp["output_layer_norm"], x + out, config.layer_norm_eps)
+
+
+def embed(params: dict, config: BertConfig, input_ids: jnp.ndarray,
+          token_type_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Embedding sum + layernorm (bert/embeddings)."""
+    t = input_ids.shape[1]
     emb = params["embeddings"]
     x = jnp.take(emb["word_embeddings"], input_ids.astype(jnp.int32), axis=0)
     x = x + emb["position_embeddings"][None, :t, :]
     if token_type_ids is None:
         token_type_ids = jnp.zeros_like(input_ids)
-    x = x + jnp.take(emb["token_type_embeddings"], token_type_ids.astype(jnp.int32), axis=0)
-    x = _layer_norm(emb["layer_norm"], x, config.layer_norm_eps)
+    x = x + jnp.take(emb["token_type_embeddings"],
+                     token_type_ids.astype(jnp.int32), axis=0)
+    return _layer_norm(emb["layer_norm"], x, config.layer_norm_eps)
+
+
+def encode(params: dict, config: BertConfig, input_ids: jnp.ndarray,
+           token_type_ids: Optional[jnp.ndarray] = None,
+           attention_mask: Optional[jnp.ndarray] = None,
+           *, train: bool = False, rng: Optional[jax.Array] = None) -> jnp.ndarray:
+    """input_ids [B,T] int32 → hidden states [B,T,H]."""
+    x = embed(params, config, input_ids, token_type_ids)
     if rng is not None:
         rng = jax.random.fold_in(rng, 0)
     x = _dropout(x, config.hidden_dropout, train, rng)
-
     for i in range(config.num_layers):
-        lp = params["encoder"][f"layer_{i}"]
         layer_rng = jax.random.fold_in(rng, i + 1) if rng is not None else None
-        # self-attention
-        q = _dense(lp["attention"]["query"], x)
-        k = _dense(lp["attention"]["key"], x)
-        v = _dense(lp["attention"]["value"], x)
-        attn = multi_head_attention(q, k, v, n_heads=config.num_heads,
-                                    kv_mask=attention_mask,
-                                    use_flash=config.use_flash,
-                                    flash_block=config.flash_block)
-        attn = _dense(lp["attention"]["output"], attn)
-        attn = _dropout(attn, config.hidden_dropout, train, layer_rng)
-        x = _layer_norm(lp["attention"]["output_layer_norm"], x + attn,
-                        config.layer_norm_eps)
-        # FFN
-        inter = jax.nn.gelu(_dense(lp["intermediate"], x))
-        out = _dense(lp["output"], inter)
-        out = _dropout(out, config.hidden_dropout, train,
-                       jax.random.fold_in(layer_rng, 7) if layer_rng is not None else None)
-        x = _layer_norm(lp["output_layer_norm"], x + out, config.layer_norm_eps)
+        x = encoder_layer(params["encoder"][f"layer_{i}"], config, x,
+                          attention_mask, train=train, rng=layer_rng)
     return x
 
 
@@ -196,6 +209,16 @@ def mlm_logits(params: dict, config: BertConfig, hidden: jnp.ndarray) -> jnp.nda
     return logits.astype(jnp.promote_types(policy.output_dtype, jnp.float32))
 
 
+def _weighted_mlm_ce(logits, labels, label_weights):
+    """Weighted-mean cross-entropy over the masked positions — shared by
+    :func:`mlm_loss` and :func:`mlm_loss_from_logits`."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(
+        logp, labels.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+    weights = label_weights.astype(logp.dtype)
+    return -jnp.sum(picked * weights) / jnp.maximum(jnp.sum(weights), 1.0)
+
+
 def mlm_loss(params: dict, config: BertConfig, input_ids, labels, label_weights,
              token_type_ids=None, attention_mask=None, *, train=True, rng=None):
     """Masked-LM loss: mean cross-entropy over positions with
@@ -203,10 +226,67 @@ def mlm_loss(params: dict, config: BertConfig, input_ids, labels, label_weights,
     hidden = encode(params, config, input_ids, token_type_ids, attention_mask,
                     train=train, rng=rng)
     logits = mlm_logits(params, config, hidden)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    picked = jnp.take_along_axis(logp, labels.astype(jnp.int32)[..., None], axis=-1)[..., 0]
-    weights = label_weights.astype(logp.dtype)
-    return -jnp.sum(picked * weights) / jnp.maximum(jnp.sum(weights), 1.0)
+    return _weighted_mlm_ce(logits, labels, label_weights)
+
+
+def pipeline_stages(config: BertConfig, params: dict, n_stages: int):
+    """Split the BERT MLM model into ``n_stages`` pipeline stages for
+    :func:`deeplearning4j_tpu.parallel.pipeline_stages.pipeline_train_step`.
+
+    Stage 0 owns embeddings (+ first encoder layers), middle stages own
+    encoder layers, the last stage owns its layers + the MLM head (tied
+    decode uses a COPY of the word embeddings in the last stage's params;
+    its gradient contribution is accounted to that copy).  Returns
+    ``(stage_fns, stage_params)``; the pipeline input is
+    ``input_ids.astype(float32)`` ([B, T]) and the last stage's output is
+    the MLM logits ([B, T, V]).
+    """
+    L = config.num_layers
+    if n_stages < 2 or L % n_stages:
+        raise ValueError(f"{L} layers not divisible into {n_stages} stages")
+    per = L // n_stages
+    eps = config.layer_norm_eps
+    stage_params = []
+    stage_fns = []
+    for s in range(n_stages):
+        layers = {f"layer_{i}": params["encoder"][f"layer_{i}"]
+                  for i in range(s * per, (s + 1) * per)}
+        sp = {"layers": layers}
+        if s == 0:
+            sp["embeddings"] = params["embeddings"]
+        if s == n_stages - 1:
+            sp["mlm"] = params["mlm"]
+            sp["decode_embeddings"] = params["embeddings"]["word_embeddings"]
+        stage_params.append(sp)
+
+        def fn(p, h, s=s):
+            if s == 0:
+                ids = jax.lax.stop_gradient(h).astype(jnp.int32)
+                x = embed(p, config, ids)
+            else:
+                x = h
+            for i in range(s * per, (s + 1) * per):
+                x = encoder_layer(p["layers"][f"layer_{i}"], config, x)
+            if s == n_stages - 1:
+                y = jax.nn.gelu(_dense(p["mlm"]["transform"], x))
+                y = _layer_norm(p["mlm"]["transform_layer_norm"], y, eps)
+                policy = dtype_policy()
+                logits = jnp.einsum(
+                    "bth,vh->btv", y.astype(policy.compute_dtype),
+                    p["decode_embeddings"].astype(policy.compute_dtype))
+                logits = logits + p["mlm"]["output_bias"].astype(logits.dtype)
+                return logits.astype(jnp.float32)
+            return x
+
+        stage_fns.append(fn)
+    return stage_fns, stage_params
+
+
+def mlm_loss_from_logits(logits, packed_labels):
+    """Loss head for the pipelined model: ``packed_labels`` [B, T, 2] =
+    (labels, label_weights) stacked on the last axis."""
+    return _weighted_mlm_ce(logits, packed_labels[..., 0],
+                            packed_labels[..., 1])
 
 
 class BertForMaskedLM:

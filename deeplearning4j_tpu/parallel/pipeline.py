@@ -11,8 +11,11 @@ backward for free — no hand-written 1F1B needed for correctness (1F1B
 memory scheduling is a later optimization).
 
 Usage: stage_fn(stage_params, x) must be shape-preserving [B_micro, ...] →
-[B_micro, ...] (equal widths between stages — the classic homogeneous-
-pipeline restriction; heterogeneous stages go through padding).
+[B_micro, ...] (the homogeneous fast path — one switch-free program).
+Heterogeneous stages (per-stage param pytrees, non-uniform widths) and
+the memory-bounded 1F1B schedule live in
+:mod:`deeplearning4j_tpu.parallel.pipeline_stages`, which pipelines real
+models (BERT as embeddings/encoder/head stages).
 """
 
 from __future__ import annotations
