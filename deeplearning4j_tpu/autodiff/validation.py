@@ -21,7 +21,7 @@ def op_inventory() -> dict[str, list[str]]:
     from deeplearning4j_tpu.ops import namespaces as ns
     inventory = {}
     for name in ("math", "nn", "cnn", "rnn", "loss", "linalg", "random",
-                 "image", "bitwise", "scatter"):
+                 "image", "bitwise", "scatter", "base"):
         space = getattr(ns, name)
         ops = [k for k, v in vars(space).items()
                if not k.startswith("_") and callable(v)]

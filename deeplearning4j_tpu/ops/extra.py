@@ -1,0 +1,373 @@
+"""Op families beyond the round-3 catalog: sequence/shape utilities
+(ND4J ``NDBase``), SRU/LSTM/GRU functional cells (libnd4j ``sru``,
+``lstmBlock``, ``gruCell``), image color-space + box ops (libnd4j
+``image`` declarables), and special-function math.
+
+Reference anchors (SURVEY §2.1 declarable-ops row,
+``libnd4j/include/ops/declarable/headers/parity_ops.h`` /
+``recurrent.h`` / ``image`` [unverified]): each function mirrors one
+declarable op's contract; XLA supplies the kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+# ------------------------------------------------------------- sequence
+def reverse_sequence(x, seq_lengths, seq_axis: int = 1, batch_axis: int = 0):
+    """Reverse the first ``seq_lengths[b]`` elements along ``seq_axis``
+    per batch element (TF/libnd4j ``reverse_sequence``)."""
+    x = jnp.asarray(x)
+    t = x.shape[seq_axis]
+    idx = jnp.arange(t)
+    lengths = jnp.asarray(seq_lengths)
+
+    def one(xb, n):
+        rev = jnp.where(idx < n, n - 1 - idx, idx)
+        return jnp.take(xb, rev, axis=seq_axis - 1 if seq_axis > batch_axis
+                        else seq_axis)
+
+    return jax.vmap(one, in_axes=(batch_axis, 0), out_axes=batch_axis)(
+        x, lengths)
+
+
+def sequence_mask(lengths, maxlen: int, dtype=jnp.bool_):
+    """[..., maxlen] mask: True where position < length (TF parity)."""
+    return (jnp.arange(maxlen) < jnp.asarray(lengths)[..., None]).astype(dtype)
+
+
+def dynamic_partition(data, partitions, num_partitions: int):
+    """Split ``data`` rows into ``num_partitions`` lists by partition id.
+    Output sizes are data-dependent → eager-only (host op in the
+    reference too; Spark-side utility)."""
+    data = np.asarray(data)
+    partitions = np.asarray(partitions)
+    return [jnp.asarray(data[partitions == i]) for i in range(num_partitions)]
+
+
+def dynamic_stitch(indices, data):
+    """Inverse of dynamic_partition: interleave ``data[i]`` rows at
+    ``indices[i]`` positions."""
+    indices = [jnp.ravel(jnp.asarray(i)) for i in indices]
+    data = [jnp.asarray(d) for d in data]
+    n = int(max(jnp.max(i) for i in indices if i.size) + 1)
+    inner = data[0].shape[1:]
+    out = jnp.zeros((n,) + inner, data[0].dtype)
+    for idx, d in zip(indices, data):
+        out = out.at[idx].set(d.reshape((-1,) + inner))
+    return out
+
+
+def confusion_matrix(labels, predictions, num_classes: int, weights=None):
+    """[C, C] counts: rows = true label, cols = prediction."""
+    labels = jnp.ravel(jnp.asarray(labels)).astype(jnp.int32)
+    preds = jnp.ravel(jnp.asarray(predictions)).astype(jnp.int32)
+    w = (jnp.ones_like(labels, jnp.float32) if weights is None
+         else jnp.ravel(jnp.asarray(weights)).astype(jnp.float32))
+    flat = labels * num_classes + preds
+    counts = jnp.zeros((num_classes * num_classes,), w.dtype).at[flat].add(w)
+    return counts.reshape(num_classes, num_classes)
+
+
+def top_k(x, k: int, sorted: bool = True):  # noqa: A002 - TF name
+    return lax.top_k(jnp.asarray(x), k)
+
+
+def in_top_k(predictions, targets, k: int):
+    """[B] bool: is targets[b] among the top-k predictions of row b."""
+    predictions = jnp.asarray(predictions)
+    targets = jnp.asarray(targets).astype(jnp.int32)
+    target_scores = jnp.take_along_axis(
+        predictions, targets[:, None], axis=-1)[:, 0]
+    rank = jnp.sum(predictions > target_scores[:, None], axis=-1)
+    return rank < k
+
+
+def unique(x):
+    """Sorted unique values (eager: output size is data-dependent)."""
+    return jnp.asarray(np.unique(np.asarray(x)))
+
+
+def unique_with_counts(x):
+    vals, counts = np.unique(np.asarray(x), return_counts=True)
+    return jnp.asarray(vals), jnp.asarray(counts)
+
+
+def boolean_mask(x, mask):
+    """Rows of ``x`` where ``mask`` (eager: data-dependent size)."""
+    return jnp.asarray(np.asarray(x)[np.asarray(mask).astype(bool)])
+
+
+def match_condition_count(x, predicate):
+    """Count of elements satisfying ``predicate`` (MatchCondition op)."""
+    return jnp.sum(predicate(jnp.asarray(x)))
+
+
+# ------------------------------------------------------------------ rnn
+def lstm_cell(x_t, h_prev, c_prev, w, u, b):
+    """One LSTM step, IFOG packing (libnd4j ``lstmBlockCell`` parity:
+    same cell math; the block variant fuses all gates — as does XLA)."""
+    from deeplearning4j_tpu.nn.layers.recurrent import LSTM
+    layer = LSTM(n_out=u.shape[0])
+    (h, c), _ = layer.step({"W": w, "U": u, "b": b}, (h_prev, c_prev), x_t)
+    return h, c
+
+
+def lstm_block(x, w, u, b, h0=None, c0=None):
+    """Whole-sequence LSTM returning per-step (h, c) — ``lstmBlock``
+    returns all intermediate cell states, unlike ``lstmLayer``."""
+    from deeplearning4j_tpu.nn.layers.recurrent import LSTM
+    hsz = u.shape[0]
+    layer = LSTM(n_out=hsz)
+    params = {"W": w, "U": u, "b": b}
+    carry = (h0 if h0 is not None else jnp.zeros((x.shape[0], hsz), x.dtype),
+             c0 if c0 is not None else jnp.zeros((x.shape[0], hsz), x.dtype))
+    pre = layer.precompute_inputs(params, x)
+
+    def body(carry, pre_t):
+        new_carry, h = layer.step_pre(params, carry, pre_t)
+        return new_carry, new_carry
+
+    _, (hs, cs) = lax.scan(body, carry, jnp.swapaxes(pre, 0, 1))
+    return jnp.swapaxes(hs, 0, 1), jnp.swapaxes(cs, 0, 1)
+
+
+def gru(x, w, u, b, h0=None):
+    """Whole-sequence GRU (r/u/c packing — ``gruCell`` scanned)."""
+    from deeplearning4j_tpu.nn.layers.recurrent import GRU
+    hsz = u.shape[0]
+    layer = GRU(n_out=hsz)
+    carry = h0 if h0 is not None else jnp.zeros((x.shape[0], hsz), x.dtype)
+    y, h = layer._scan({"W": w, "U": u, "b": b}, x, None, carry)
+    return y, h
+
+
+def sru_cell(x_t, c_prev, w, b):
+    """One SRU step (Lei et al. 2017; libnd4j ``sruCell``): packed
+    w [C, 3H] → (x̃, f-gate, r-gate); b [2H] → (bf, br)."""
+    h = w.shape[1] // 3
+    z = jnp.dot(x_t, w)
+    x_tilde = z[:, :h]
+    f = jax.nn.sigmoid(z[:, h:2 * h] + b[:h])
+    r = jax.nn.sigmoid(z[:, 2 * h:] + b[h:])
+    c = f * c_prev + (1.0 - f) * x_tilde
+    out = r * jnp.tanh(c) + (1.0 - r) * x_t[:, :h] if x_t.shape[1] == h \
+        else r * jnp.tanh(c)
+    return out, c
+
+
+def sru(x, w, b, c0=None):
+    """Whole-sequence SRU — the recurrence is elementwise, so the big
+    matmul hoists out of the scan entirely (the SRU design point; maps
+    perfectly onto MXU + VPU)."""
+    h = w.shape[1] // 3
+    carry = c0 if c0 is not None else jnp.zeros((x.shape[0], h), x.dtype)
+    z = jnp.einsum("btc,ch->bth", x, w)
+    same_width = x.shape[-1] == h
+
+    def body(c_prev, inp):
+        z_t, x_t = inp
+        x_tilde = z_t[:, :h]
+        f = jax.nn.sigmoid(z_t[:, h:2 * h] + b[:h])
+        r = jax.nn.sigmoid(z_t[:, 2 * h:] + b[h:])
+        c = f * c_prev + (1.0 - f) * x_tilde
+        out = r * jnp.tanh(c) + ((1.0 - r) * x_t[:, :h] if same_width
+                                 else 0.0)
+        return c, out
+
+    c_last, ys = lax.scan(body, carry,
+                          (jnp.swapaxes(z, 0, 1), jnp.swapaxes(x, 0, 1)))
+    return jnp.swapaxes(ys, 0, 1), c_last
+
+
+def simple_rnn(x, w, u, b, h0=None):
+    """Whole-sequence vanilla RNN (tanh)."""
+    from deeplearning4j_tpu.nn.layers.recurrent import SimpleRnn
+    hsz = u.shape[0]
+    layer = SimpleRnn(n_out=hsz)
+    carry = h0 if h0 is not None else jnp.zeros((x.shape[0], hsz), x.dtype)
+    y, h = layer._scan({"W": w, "U": u, "b": b}, x, None, carry)
+    return y, h
+
+
+# ---------------------------------------------------------------- image
+_YUV = np.array([[0.299, 0.587, 0.114],
+                 [-0.14714119, -0.28886916, 0.43601035],
+                 [0.61497538, -0.51496512, -0.10001026]], np.float32)
+
+
+def rgb_to_yuv(x):
+    return jnp.einsum("...c,rc->...r", x, jnp.asarray(_YUV))
+
+
+def yuv_to_rgb(x):
+    return jnp.einsum("...c,rc->...r", x, jnp.asarray(np.linalg.inv(_YUV)))
+
+
+def rgb_to_hsv(x):
+    """Per-pixel RGB→HSV, channels-last, values in [0, 1]."""
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    mx = jnp.max(x, axis=-1)
+    mn = jnp.min(x, axis=-1)
+    d = mx - mn
+    safe = jnp.where(d > 0, d, 1.0)
+    hr = jnp.mod((g - b) / safe, 6.0)
+    hg = (b - r) / safe + 2.0
+    hb = (r - g) / safe + 4.0
+    h = jnp.where(mx == r, hr, jnp.where(mx == g, hg, hb)) / 6.0
+    h = jnp.where(d > 0, h, 0.0)
+    s = jnp.where(mx > 0, d / jnp.where(mx > 0, mx, 1.0), 0.0)
+    return jnp.stack([h, s, mx], axis=-1)
+
+
+def hsv_to_rgb(x):
+    h, s, v = x[..., 0] * 6.0, x[..., 1], x[..., 2]
+    i = jnp.floor(h)
+    f = h - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(jnp.int32) % 6
+    r = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [v, q, p, p, t, v])
+    g = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [t, v, v, q, p, p])
+    b = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [p, p, t, v, v, q])
+    return jnp.stack([r, g, b], axis=-1)
+
+
+def adjust_hue(x, delta):
+    hsv = rgb_to_hsv(x)
+    h = jnp.mod(hsv[..., 0] + delta, 1.0)
+    return hsv_to_rgb(jnp.stack([h, hsv[..., 1], hsv[..., 2]], axis=-1))
+
+
+def adjust_saturation(x, factor):
+    hsv = rgb_to_hsv(x)
+    s = jnp.clip(hsv[..., 1] * factor, 0.0, 1.0)
+    return hsv_to_rgb(jnp.stack([hsv[..., 0], s, hsv[..., 2]], axis=-1))
+
+
+def resize_bicubic(img, out_h: int, out_w: int):
+    shape = img.shape[:-3] + (out_h, out_w, img.shape[-1])
+    return jax.image.resize(img, shape, method="cubic")
+
+
+def _area_weights(n_in: int, n_out: int) -> np.ndarray:
+    """[n_out, n_in] box-filter weights: output j averages the source
+    span [j*n_in/n_out, (j+1)*n_in/n_out) with fractional-overlap
+    weighting (TF ResizeArea semantics)."""
+    w = np.zeros((n_out, n_in), np.float32)
+    scale = n_in / n_out
+    for j in range(n_out):
+        lo, hi = j * scale, (j + 1) * scale
+        for i in range(int(np.floor(lo)), int(np.ceil(hi))):
+            w[j, i] = min(hi, i + 1) - max(lo, i)
+    return w / scale
+
+
+def resize_area(img, out_h: int, out_w: int):
+    """True area (box-filter) resampling — one einsum per axis, exact
+    for any integer or fractional scale."""
+    wh = jnp.asarray(_area_weights(img.shape[-3], out_h))
+    ww = jnp.asarray(_area_weights(img.shape[-2], out_w))
+    return jnp.einsum("oh,...hwc,pw->...opc", wh, img, ww)
+
+
+def extract_image_patches(x, kh: int, kw: int, sh: int = 1, sw: int = 1,
+                          padding: str = "VALID"):
+    """[N,H,W,C] → [N,oh,ow,kh*kw*C] sliding patches (TF parity, incl.
+    TF's asymmetric SAME pad split for even kernels)."""
+    from deeplearning4j_tpu.ops.namespaces import _im2col
+    if padding == "SAME":
+        h, w = x.shape[1], x.shape[2]
+        oh, ow = -(-h // sh), -(-w // sw)
+        pad_h = max((oh - 1) * sh + kh - h, 0)
+        pad_w = max((ow - 1) * sw + kw - w, 0)
+        x = jnp.pad(x, ((0, 0), (pad_h // 2, pad_h - pad_h // 2),
+                        (pad_w // 2, pad_w - pad_w // 2), (0, 0)))
+    return _im2col(x, kh, kw, sh, sw, 0, 0)
+
+
+def iou(boxes_a, boxes_b):
+    """Pairwise IoU of [N,4] and [M,4] boxes (y1, x1, y2, x2)."""
+    a = jnp.asarray(boxes_a)[:, None, :]
+    b = jnp.asarray(boxes_b)[None, :, :]
+    inter_h = jnp.clip(jnp.minimum(a[..., 2], b[..., 2])
+                       - jnp.maximum(a[..., 0], b[..., 0]), 0.0)
+    inter_w = jnp.clip(jnp.minimum(a[..., 3], b[..., 3])
+                       - jnp.maximum(a[..., 1], b[..., 1]), 0.0)
+    inter = inter_h * inter_w
+    area_a = (a[..., 2] - a[..., 0]) * (a[..., 3] - a[..., 1])
+    area_b = (b[..., 2] - b[..., 0]) * (b[..., 3] - b[..., 1])
+    return inter / jnp.clip(area_a + area_b - inter, 1e-9)
+
+
+def non_max_suppression(boxes, scores, max_output: int,
+                        iou_threshold: float = 0.5,
+                        score_threshold: float = -jnp.inf):
+    """Greedy NMS → selected indices padded with -1 to ``max_output``
+    (libnd4j ``non_max_suppression`` / TF ``image.non_max_suppression``).
+    Static output size keeps it jit-compatible."""
+    boxes = jnp.asarray(boxes)
+    scores0 = jnp.asarray(scores)
+    pair_iou = iou(boxes, boxes)
+
+    def body(state, _):
+        scores, out, k = state
+        best = jnp.argmax(scores)
+        valid = scores[best] > jnp.maximum(score_threshold, -jnp.inf)
+        out = out.at[k].set(jnp.where(valid, best, -1))
+        # suppress the chosen box and its high-IoU neighbours
+        suppress = (pair_iou[best] >= iou_threshold) | (
+            jnp.arange(scores.shape[0]) == best)
+        scores = jnp.where(valid & suppress, -jnp.inf, scores)
+        return (scores, out, k + 1), None
+
+    out0 = jnp.full((max_output,), -1, jnp.int32)
+    (_, out, _), _ = lax.scan(body, (scores0, out0, 0), None,
+                              length=max_output)
+    return out
+
+
+def crop_and_resize(img, boxes, box_indices, crop_h: int, crop_w: int):
+    """[N,H,W,C] + normalized [M,4] boxes (y1,x1,y2,x2) → [M,crop_h,crop_w,C]
+    bilinear crops (TF ``crop_and_resize``)."""
+    img = jnp.asarray(img)
+    h, w = img.shape[1], img.shape[2]
+
+    def one(box, bi):
+        y1, x1, y2, x2 = box
+        # TF semantics: >=2 samples span the box corners (align-corners);
+        # a single sample sits at the box CENTER
+        if crop_h > 1:
+            ys = y1 * (h - 1) + jnp.arange(crop_h) / (crop_h - 1) \
+                * (y2 - y1) * (h - 1)
+        else:
+            ys = 0.5 * (y1 + y2) * (h - 1) * jnp.ones((1,))
+        if crop_w > 1:
+            xs = x1 * (w - 1) + jnp.arange(crop_w) / (crop_w - 1) \
+                * (x2 - x1) * (w - 1)
+        else:
+            xs = 0.5 * (x1 + x2) * (w - 1) * jnp.ones((1,))
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        wy = (ys - y0)[:, None, None]
+        wx = (xs - x0)[None, :, None]
+        im = img[bi]
+        tl = im[y0][:, x0]
+        tr = im[y0][:, x1i]
+        bl = im[y1i][:, x0]
+        br = im[y1i][:, x1i]
+        return (tl * (1 - wy) * (1 - wx) + tr * (1 - wy) * wx
+                + bl * wy * (1 - wx) + br * wy * wx)
+
+    return jax.vmap(one)(jnp.asarray(boxes),
+                         jnp.asarray(box_indices).astype(jnp.int32))

@@ -76,6 +76,23 @@ math = SimpleNamespace(
     hamming_distance=lambda a, b, axis=-1: jnp.sum(a != b, axis=axis),
     jaccard_distance=lambda a, b, axis=-1: 1.0
     - jnp.sum(jnp.minimum(a, b), axis=axis) / jnp.clip(jnp.sum(jnp.maximum(a, b), axis=axis), 1e-12),
+    # comparisons / predicates (libnd4j pairwise bool ops)
+    eq=jnp.equal, neq=jnp.not_equal,
+    gt=jnp.greater, gte=jnp.greater_equal,
+    lt=jnp.less, lte=jnp.less_equal,
+    logical_and=jnp.logical_and, logical_or=jnp.logical_or,
+    logical_xor=jnp.logical_xor, logical_not=jnp.logical_not,
+    is_close=jnp.isclose,
+    where=jnp.where,
+    # rounding / cleanup
+    trunc=jnp.trunc, rint=jnp.rint, nan_to_num=jnp.nan_to_num,
+    # special functions (libnd4j transforms — XLA intrinsics)
+    lgamma=lax.lgamma, digamma=lax.digamma,
+    igamma=lax.igamma, igammac=lax.igammac,
+    betainc=lax.betainc,
+    log_sum_exp=jax.scipy.special.logsumexp,
+    sort=jnp.sort, argsort=jnp.argsort,
+    reverse=lambda x, axis=0: jnp.flip(x, axis=axis),
 )
 
 
@@ -106,7 +123,29 @@ nn = SimpleNamespace(
         * (gamma if gamma is not None else 1.0)
         + (beta if beta is not None else 0.0)),
     pad=jnp.pad,
+    # DL4J IActivation family beyond jax.nn (linalg/activations/impl/)
+    prelu=lambda x, alpha: jnp.where(x >= 0, x, alpha * x),
+    mish=jax.nn.mish,
+    hard_swish=jax.nn.hard_swish,
+    rational_tanh=lambda x: 1.7159 * jnp.tanh(2.0 * x / 3.0),
+    rectified_tanh=lambda x: jnp.maximum(jnp.tanh(x), 0.0),
+    hard_shrink=lambda x, lam=0.5: jnp.where(jnp.abs(x) > lam, x, 0.0),
+    soft_shrink=lambda x, lam=0.5: jnp.sign(x) * jnp.maximum(jnp.abs(x) - lam, 0.0),
+    thresholded_relu=lambda x, theta=1.0: jnp.where(x > theta, x, 0.0),
+    crelu=lambda x: jnp.concatenate([jax.nn.relu(x), jax.nn.relu(-x)], axis=-1),
+    glu=jax.nn.glu,
+    moments=lambda x, axis=None: (jnp.mean(x, axis=axis), jnp.var(x, axis=axis)),
+    l2_normalize=lambda x, axis=-1, eps=1e-12: x * lax.rsqrt(
+        jnp.maximum(jnp.sum(x * x, axis=axis, keepdims=True), eps)),
+    embedding_lookup=lambda table, ids: jnp.take(table, ids.astype(jnp.int32), axis=0),
 )
+
+
+# attention ops join nn (libnd4j dot_product_attention /
+# multi_head_dot_product_attention declarables)
+from deeplearning4j_tpu.ops import attention as _attention  # noqa: E402
+nn.dot_product_attention = _attention.dot_product_attention
+nn.multi_head_dot_product_attention = _attention.multi_head_attention
 
 
 # ---------------------------------------------------------------- cnn
@@ -149,17 +188,151 @@ def _im2col(x, kh, kw, sh=1, sw=1, ph=0, pw=0):
     return cols.reshape(n, oh, ow, kh * kw * c)
 
 
+def _conv1d(x, w, stride=1, padding="SAME", dilation=1, groups=1,
+            precision=None):
+    """[B,T,C] @ [K,C,Cout] (NWC/WIO) — libnd4j ``conv1d``."""
+    return lax.conv_general_dilated(
+        x, w, (stride,), padding, rhs_dilation=(dilation,),
+        dimension_numbers=("NWC", "WIO", "NWC"), feature_group_count=groups,
+        precision=precision)
+
+
+def _conv3d(x, w, stride=(1, 1, 1), padding="SAME", dilation=(1, 1, 1),
+            groups=1, precision=None):
+    """[B,D,H,W,C] @ [Kd,Kh,Kw,C,Cout] — libnd4j ``conv3dnew``."""
+    return lax.conv_general_dilated(
+        x, w, stride, padding, rhs_dilation=dilation,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+        feature_group_count=groups, precision=precision)
+
+
+def _depthwise_conv2d(x, w, stride=(1, 1), padding="SAME", dilation=(1, 1),
+                      precision=None):
+    """w [Kh,Kw,C,mult] — libnd4j ``depthwise_conv2d``."""
+    c = x.shape[-1]
+    w = w.reshape(w.shape[0], w.shape[1], 1, -1)
+    return lax.conv_general_dilated(
+        x, w, stride, padding, rhs_dilation=dilation,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"), feature_group_count=c,
+        precision=precision)
+
+
+def _separable_conv2d(x, depth_w, point_w, stride=(1, 1), padding="SAME",
+                      dilation=(1, 1), precision=None):
+    """Depthwise then 1x1 pointwise — libnd4j ``sconv2d``."""
+    y = _depthwise_conv2d(x, depth_w, stride, padding, dilation,
+                          precision=precision)
+    return _conv2d(y, point_w, (1, 1), "SAME", precision=precision)
+
+
+def _deconv2d(x, w, stride=(2, 2), padding="SAME", precision=None):
+    """Transposed conv (libnd4j ``deconv2d``); w [Kh,Kw,Cin,Cout]."""
+    return lax.conv_transpose(
+        x, w, stride, padding, dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        precision=precision)
+
+
+def _deconv3d(x, w, stride=(2, 2, 2), padding="SAME", precision=None):
+    return lax.conv_transpose(
+        x, w, stride, padding,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"), precision=precision)
+
+
+def _pool_nd(x, k, s, padding, op, init):
+    window = (1,) + tuple(k) + (1,)
+    strides = (1,) + tuple(s) + (1,)
+    return lax.reduce_window(x, init, op, window, strides, padding)
+
+
+def _max_pool1d(x, k=2, s=None, padding="VALID"):
+    return _pool_nd(x, (k,), (s or k,), padding, lax.max, -jnp.inf)
+
+
+def _avg_pool1d(x, k=2, s=None, padding="VALID"):
+    return _pool_nd(x, (k,), (s or k,), padding, lax.add, 0.0) / k
+
+
+def _max_pool3d(x, k=(2, 2, 2), s=None, padding="VALID"):
+    return _pool_nd(x, k, s or k, padding, lax.max, -jnp.inf)
+
+
+def _avg_pool3d(x, k=(2, 2, 2), s=None, padding="VALID"):
+    return _pool_nd(x, k, s or k, padding, lax.add, 0.0) / _pymath.prod(k)
+
+
+def _col2im(cols, h, w, kh, kw, sh=1, sw=1, ph=0, pw=0):
+    """Inverse of :func:`_im2col`: scatter-add patches back to the
+    [N, H, W, C] image (libnd4j ``col2im`` — the conv backward lowering)."""
+    n, oh, ow, _ = cols.shape
+    c = cols.shape[3] // (kh * kw)
+    cols = cols.reshape(n, oh, ow, kh, kw, c)
+    img = jnp.zeros((n, h + 2 * ph, w + 2 * pw, c), cols.dtype)
+    idx_h = (jnp.arange(oh)[:, None] * sh + jnp.arange(kh)[None, :])  # [oh,kh]
+    idx_w = (jnp.arange(ow)[:, None] * sw + jnp.arange(kw)[None, :])  # [ow,kw]
+    hh = jnp.broadcast_to(idx_h[:, None, :, None], (oh, ow, kh, kw)).ravel()
+    ww = jnp.broadcast_to(idx_w[None, :, None, :], (oh, ow, kh, kw)).ravel()
+    vals = cols.reshape(n, -1, c)
+    img = img.at[:, hh, ww, :].add(vals)
+    return img[:, ph:ph + h, pw:pw + w, :]
+
+
+def _local_response_normalization(x, depth_radius=5, bias=1.0, alpha=1.0,
+                                  beta=0.5):
+    """TF-style LRN over the channel axis (libnd4j ``lrn``)."""
+    sq = x * x
+    c = x.shape[-1]
+    pad = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(depth_radius, depth_radius)])
+    window = sum(pad[..., i:i + c] for i in range(2 * depth_radius + 1))
+    return x / jnp.power(bias + alpha * window, beta)
+
+
+def _batch_to_space(x, block, crops=((0, 0), (0, 0))):
+    n, h, w, c = x.shape
+    out = x.reshape(block, block, n // block ** 2, h, w, c)
+    out = out.transpose(2, 3, 0, 4, 1, 5).reshape(
+        n // block ** 2, h * block, w * block, c)
+    (ct, cb), (cl, cr) = crops
+    return out[:, ct:h * block - cb, cl:w * block - cr, :]
+
+
+def _space_to_batch(x, block, pads=((0, 0), (0, 0))):
+    x = jnp.pad(x, ((0, 0), tuple(pads[0]), tuple(pads[1]), (0, 0)))
+    n, h, w, c = x.shape
+    out = x.reshape(n, h // block, block, w // block, block, c)
+    return out.transpose(2, 4, 0, 1, 3, 5).reshape(
+        n * block ** 2, h // block, w // block, c)
+
+
 cnn = SimpleNamespace(
+    conv1d=_conv1d,
     conv2d=_conv2d,
+    conv3d=_conv3d,
+    depthwise_conv2d=_depthwise_conv2d,
+    separable_conv2d=_separable_conv2d,
+    deconv2d=_deconv2d,
+    deconv3d=_deconv3d,
+    max_pooling1d=_max_pool1d,
+    avg_pooling1d=_avg_pool1d,
     max_pooling2d=_max_pool2d,
     avg_pooling2d=_avg_pool2d,
+    max_pooling3d=_max_pool3d,
+    avg_pooling3d=_avg_pool3d,
+    global_max_pooling=lambda x: jnp.max(x, axis=tuple(range(1, x.ndim - 1))),
+    global_avg_pooling=lambda x: jnp.mean(x, axis=tuple(range(1, x.ndim - 1))),
     im2col=_im2col,
+    col2im=_col2im,
+    local_response_normalization=_local_response_normalization,
+    batch_to_space=_batch_to_space,
+    space_to_batch=_space_to_batch,
     space_to_depth=lambda x, s: x.reshape(x.shape[0], x.shape[1] // s, s,
                                           x.shape[2] // s, s, x.shape[3])
     .transpose(0, 1, 3, 2, 4, 5).reshape(x.shape[0], x.shape[1] // s, x.shape[2] // s, -1),
     depth_to_space=lambda x, s: x.reshape(x.shape[0], x.shape[1], x.shape[2], s, s, -1)
     .transpose(0, 1, 3, 2, 4, 5).reshape(x.shape[0], x.shape[1] * s, x.shape[2] * s, -1),
+    upsampling1d=lambda x, s: jnp.repeat(x, s, axis=1),
     upsampling2d=lambda x, s: jnp.repeat(jnp.repeat(x, s, axis=1), s, axis=2),
+    upsampling3d=lambda x, s: jnp.repeat(jnp.repeat(jnp.repeat(
+        x, s, axis=1), s, axis=2), s, axis=3),
 )
 
 # ---------------------------------------------------------------- rnn / loss
@@ -198,6 +371,15 @@ def _gru_cell(x_t, h_prev, w, u, b):
 
 rnn.lstm_layer = _lstm_layer
 rnn.gru_cell = _gru_cell
+
+from deeplearning4j_tpu.ops import extra as _extra  # noqa: E402
+
+rnn.lstm_cell = _extra.lstm_cell
+rnn.lstm_block = _extra.lstm_block
+rnn.gru = _extra.gru
+rnn.sru = _extra.sru
+rnn.sru_cell = _extra.sru_cell
+rnn.simple_rnn = _extra.simple_rnn
 
 
 # ---------------------------------------------------------------- linalg
@@ -250,19 +432,32 @@ def _resize_nearest(img, out_h, out_w):
     return jax.image.resize(img, shape, method="nearest")
 
 
+from deeplearning4j_tpu.ops import extra as _extra_img  # noqa: E402
+
 image = SimpleNamespace(
     resize_bilinear=_resize_bilinear,
     resize_nearest=_resize_nearest,
+    resize_bicubic=_extra_img.resize_bicubic,
+    resize_area=_extra_img.resize_area,
     flip_left_right=lambda x: jnp.flip(x, axis=-2),
     flip_up_down=lambda x: jnp.flip(x, axis=-3),
     rot90=lambda x, k=1: jnp.rot90(x, k, axes=(-3, -2)),
     adjust_brightness=lambda x, delta: x + delta,
     adjust_contrast=lambda x, factor: (x - jnp.mean(x, axis=(-3, -2), keepdims=True)) * factor
     + jnp.mean(x, axis=(-3, -2), keepdims=True),
+    adjust_hue=_extra_img.adjust_hue,
+    adjust_saturation=_extra_img.adjust_saturation,
     crop=lambda x, top, left, h, w: x[..., top:top + h, left:left + w, :],
-    hsv_to_rgb=None,  # gated: provided by data.image when needed
+    rgb_to_hsv=_extra_img.rgb_to_hsv,
+    hsv_to_rgb=_extra_img.hsv_to_rgb,
+    rgb_to_yuv=_extra_img.rgb_to_yuv,
+    yuv_to_rgb=_extra_img.yuv_to_rgb,
     rgb_to_grayscale=lambda x: jnp.sum(
         x * jnp.array([0.2989, 0.5870, 0.1140]), axis=-1, keepdims=True),
+    extract_image_patches=_extra_img.extract_image_patches,
+    iou=_extra_img.iou,
+    non_max_suppression=_extra_img.non_max_suppression,
+    crop_and_resize=_extra_img.crop_and_resize,
 )
 
 
@@ -310,3 +505,52 @@ scatter = SimpleNamespace(
 # ctc_loss joins the loss namespace (libnd4j ctcLoss.cpp parity)
 from deeplearning4j_tpu.ops.ctc import ctc_loss as _ctc_loss  # noqa: E402
 loss.ctc_loss = _ctc_loss
+
+
+# ---------------------------------------------------------------- base
+# ND4J NDBase parity (org/nd4j/linalg/factory/ops/NDBase.java): shape,
+# sequence, indexing and host-side set utilities.  Data-dependent-size
+# ops (unique, boolean_mask, dynamic_partition) are eager-only, like the
+# reference's host-side implementations.
+base = SimpleNamespace(
+    concat=jnp.concatenate,
+    stack=jnp.stack,
+    unstack=lambda x, axis=0: [jnp.squeeze(s, axis) for s in
+                               jnp.split(x, x.shape[axis], axis)],
+    split=jnp.split,
+    tile=jnp.tile,
+    repeat=jnp.repeat,
+    squeeze=jnp.squeeze,
+    expand_dims=jnp.expand_dims,
+    transpose=jnp.transpose,
+    permute=lambda x, *axes: jnp.transpose(x, axes if axes else None),
+    reshape=jnp.reshape,
+    slice=lax.slice,
+    strided_slice=lambda x, begin, end, strides: x[tuple(
+        slice(b, e, s) for b, e, s in zip(begin, end, strides))],
+    gather=lambda x, indices, axis=0: jnp.take(x, indices, axis=axis),
+    reverse=lambda x, axis=0: jnp.flip(x, axis=axis),
+    reverse_sequence=_extra.reverse_sequence,
+    sequence_mask=_extra.sequence_mask,
+    dynamic_partition=_extra.dynamic_partition,
+    dynamic_stitch=_extra.dynamic_stitch,
+    confusion_matrix=_extra.confusion_matrix,
+    eye=jnp.eye,
+    linspace=jnp.linspace,
+    arange=jnp.arange,
+    meshgrid=jnp.meshgrid,
+    zeros_like=jnp.zeros_like,
+    ones_like=jnp.ones_like,
+    full_like=jnp.full_like,
+    fill=jnp.full,
+    cast=lambda x, dtype: jnp.asarray(x).astype(dtype),
+    shape_of=lambda x: jnp.asarray(jnp.asarray(x).shape),
+    size_of=lambda x: jnp.asarray(jnp.asarray(x).size),
+    rank=lambda x: jnp.asarray(jnp.asarray(x).ndim),
+    top_k=_extra.top_k,
+    in_top_k=_extra.in_top_k,
+    unique=_extra.unique,
+    unique_with_counts=_extra.unique_with_counts,
+    boolean_mask=_extra.boolean_mask,
+    match_condition_count=_extra.match_condition_count,
+)
