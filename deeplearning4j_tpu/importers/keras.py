@@ -3,17 +3,25 @@
 Parity with ``deeplearning4j-modelimport``
 (``org/deeplearning4j/nn/modelimport/keras/KerasModelImport.java``,
 ``KerasModel``, per-layer converters in ``layers/``): Sequential and
-Functional architectures, the common layer set (Dense, Conv2D,
-MaxPooling2D/AveragePooling2D, BatchNormalization, Dropout, Flatten,
-Activation, Embedding, LSTM, Bidirectional, GlobalAvg/MaxPooling).
+Functional architectures with ~45 layer converters (Dense, the full
+Conv1D/2D/3D + transpose/depthwise/separable family, pooling 1D/2D/3D,
+BatchNormalization/LayerNormalization, recurrent LSTM/GRU/SimpleRNN/
+Bidirectional, MultiHeadAttention, padding/cropping/upsampling 1D/2D/3D,
+RepeatVector/TimeDistributed, the dropout/noise family, activation
+layers) plus the custom-converter and Lambda registries
+(``register_custom_converter`` / ``register_lambda_layer`` —
+KerasLambdaLayer parity).
 
-Input: the model-config JSON (``model.to_json()`` in Keras) and a
-``{layer_name: [arrays...]}`` weight dict (``np.savez`` of
-``layer.get_weights()`` — conversion from .h5 runs where h5py exists; no
-h5py in this image).  Layout conversion: Keras Dense/Conv kernels are
-already [in, out] / HWIO — matching our NHWC/[in,out] convention, so
-weights transfer without transposition; LSTM gate order converts
-IFCO(keras) → IFOG(ours).
+Input: either a ``.h5`` file directly (h5py IS available in this image —
+``import_keras_model_and_weights``), or the model-config JSON
+(``model.to_json()``) plus a ``{layer_name: [arrays...]}`` weight dict.
+Layout conversion: Keras Dense/Conv kernels are already [in, out] / HWIO
+— matching our NHWC/[in,out] convention, so most weights transfer
+without transposition; LSTM gate order converts IFCO(keras) → IFOG
+(ours), GRU z,r,h → r,u,c, Conv2DTranspose kernels flip+swap, and
+MultiHeadAttention per-head kernels reshape to flat projections.
+tf.keras golden tests in ``tests/test_keras_import.py`` pin the
+numerics (TF is also installed).
 """
 
 from __future__ import annotations
@@ -45,12 +53,54 @@ def _act(keras_act: Optional[str]) -> str:
     return _ACTIVATION_MAP.get(keras_act or "linear", keras_act or "identity")
 
 
+# --------------------------------------------------------- custom SPI
+# KerasLayer custom-converter registry (reference:
+# deeplearning4j-modelimport KerasLayerUtils.registerCustomLayer +
+# KerasLambdaLayer): users register a converter per Keras class name,
+# and concrete layer objects per Lambda layer NAME (Keras serializes a
+# Lambda's python body as opaque bytecode — the reference requires a
+# registered SameDiffLambdaLayer the same way).
+_CUSTOM_CONVERTERS: dict = {}
+_LAMBDA_LAYERS: dict = {}
+
+
+def register_custom_converter(class_name: str, converter) -> None:
+    """``converter(kcfg: dict) -> Layer`` handles Keras class
+    ``class_name`` (takes precedence over the built-in table)."""
+    _CUSTOM_CONVERTERS[class_name] = converter
+
+
+def register_lambda_layer(layer_name: str, layer) -> None:
+    """Map the Keras ``Lambda`` layer named ``layer_name`` to a concrete
+    layer instance (or zero-arg factory)."""
+    _LAMBDA_LAYERS[layer_name] = layer
+
+
 def _convert_layer(kcfg: dict):
     """One Keras layer config → our layer (or None for structural layers
     handled implicitly, e.g. Flatten/InputLayer)."""
     cls = kcfg["class_name"]
     conf = kcfg["config"]
     name = conf.get("name")
+    if cls in _CUSTOM_CONVERTERS:
+        return _CUSTOM_CONVERTERS[cls](kcfg)
+    if cls == "Lambda":
+        entry = _LAMBDA_LAYERS.get(name)
+        if entry is None:
+            raise KeyError(
+                f"Keras Lambda layer '{name}': python lambdas do not "
+                f"survive serialization — register an equivalent layer "
+                f"with register_lambda_layer('{name}', layer) "
+                f"(KerasLambdaLayer parity)")
+        from deeplearning4j_tpu.nn.layers.base import Layer as _Layer
+        layer = entry if isinstance(entry, _Layer) else entry()
+        if not isinstance(layer, _Layer):
+            raise TypeError(f"register_lambda_layer('{name}', ...) must "
+                            f"give a Layer or a Layer factory, got "
+                            f"{type(layer).__name__}")
+        if layer.name is None:
+            layer.name = name
+        return layer
     if cls in ("InputLayer", "Flatten"):
         return None
     if cls == "Dense":
@@ -217,8 +267,135 @@ def _convert_layer(kcfg: dict):
     if cls in ("SpatialDropout2D", "SpatialDropout1D"):
         from deeplearning4j_tpu.nn.layers import SpatialDropoutLayer
         return SpatialDropoutLayer(name=name, p=1.0 - conf.get("rate", 0.5))
+    if cls == "Conv3D":
+        from deeplearning4j_tpu.nn.layers import Convolution3DLayer
+        return Convolution3DLayer(
+            name=name, n_out=conf["filters"],
+            kernel_size=tuple(conf["kernel_size"]),
+            stride=tuple(conf.get("strides", (1, 1, 1))),
+            convolution_mode="same" if conf.get("padding") == "same" else "truncate",
+            activation=_act(conf.get("activation")),
+            has_bias=conf.get("use_bias", True))
+    if cls == "Conv2DTranspose":
+        from deeplearning4j_tpu.nn.layers import Deconvolution2D
+        return Deconvolution2D(
+            name=name, n_out=conf["filters"],
+            kernel_size=tuple(conf["kernel_size"]),
+            stride=tuple(conf.get("strides", (1, 1))),
+            convolution_mode="same" if conf.get("padding") == "same" else "truncate",
+            activation=_act(conf.get("activation")),
+            has_bias=conf.get("use_bias", True))
+    if cls in ("MaxPooling3D", "AveragePooling3D"):
+        from deeplearning4j_tpu.nn.layers import Subsampling3DLayer
+        return Subsampling3DLayer(
+            name=name, pooling_type="max" if cls == "MaxPooling3D" else "avg",
+            kernel_size=tuple(conf.get("pool_size", (2, 2, 2))),
+            stride=tuple(conf.get("strides") or conf.get("pool_size", (2, 2, 2))),
+            convolution_mode="same" if conf.get("padding") == "same" else "truncate")
+    if cls == "ZeroPadding1D":
+        from deeplearning4j_tpu.nn.layers import ZeroPadding1DLayer
+        p = conf.get("padding", 1)
+        return ZeroPadding1DLayer(name=name, padding=tuple(p)
+                                  if isinstance(p, (list, tuple)) else (p, p))
+    if cls == "Cropping1D":
+        from deeplearning4j_tpu.nn.layers import Cropping1DLayer
+        c = conf.get("cropping", (1, 1))
+        return Cropping1DLayer(name=name, cropping=tuple(c)
+                               if isinstance(c, (list, tuple)) else (c, c))
+    if cls == "ZeroPadding3D":
+        from deeplearning4j_tpu.nn.layers import ZeroPadding3DLayer
+        return ZeroPadding3DLayer(name=name,
+                                  padding=_pad3(conf.get("padding", (1, 1, 1))))
+    if cls == "Cropping3D":
+        from deeplearning4j_tpu.nn.layers import Cropping3DLayer
+        return Cropping3DLayer(name=name,
+                               cropping=_pad3(conf.get("cropping", (0, 0, 0))))
+    if cls == "UpSampling1D":
+        from deeplearning4j_tpu.nn.layers import Upsampling1DLayer
+        return Upsampling1DLayer(name=name, size=_one(conf.get("size", 2)))
+    if cls == "UpSampling3D":
+        from deeplearning4j_tpu.nn.layers import Upsampling3DLayer
+        return Upsampling3DLayer(name=name,
+                                 size=tuple(conf.get("size", (2, 2, 2))))
+    if cls == "RepeatVector":
+        from deeplearning4j_tpu.nn.layers import RepeatVector
+        return RepeatVector(name=name, n=conf["n"])
+    if cls == "GaussianDropout":
+        from deeplearning4j_tpu.nn.layers import GaussianDropoutLayer
+        return GaussianDropoutLayer(name=name, rate=conf.get("rate", 0.5))
+    if cls == "GaussianNoise":
+        from deeplearning4j_tpu.nn.layers import GaussianNoiseLayer
+        return GaussianNoiseLayer(name=name, stddev=conf.get("stddev", 0.1))
+    if cls == "AlphaDropout":
+        from deeplearning4j_tpu.nn.layers import AlphaDropoutLayer
+        # keras rate = drop prob; ours p = retain prob
+        return AlphaDropoutLayer(name=name, p=1.0 - conf.get("rate", 0.05))
+    if cls == "ReLU":
+        if conf.get("threshold"):
+            raise KeyError(f"unsupported Keras ReLU threshold="
+                           f"{conf['threshold']} (only 0 converts)")
+        slope = conf.get("negative_slope", 0.0) or 0.0
+        if conf.get("max_value") == 6.0 and not slope:
+            return ActivationLayer(name=name, activation="relu6")
+        if conf.get("max_value") is not None:
+            raise KeyError(
+                f"unsupported Keras ReLU max_value={conf['max_value']} "
+                f"with negative_slope={slope} (only plain relu, "
+                f"leaky relu, and relu6 convert)")
+        if slope:
+            return ActivationLayer(name=name, activation=f"leakyrelu:{slope}")
+        return ActivationLayer(name=name, activation="relu")
+    if cls == "Softmax":
+        if conf.get("axis", -1) != -1:
+            raise KeyError(f"unsupported Keras Softmax axis="
+                           f"{conf['axis']} (only the last axis converts)")
+        return ActivationLayer(name=name, activation="softmax")
+    if cls == "TimeDistributed":
+        from deeplearning4j_tpu.nn.layers import TimeDistributed
+        inner = _convert_layer(conf["layer"])
+        return TimeDistributed(name=name, underlying=inner)
+    if cls == "MultiHeadAttention":
+        # handled specially in import_functional (multi-input layer);
+        # reaching here means a Sequential placement, which Keras itself
+        # does not support
+        raise KeyError("MultiHeadAttention requires the Functional "
+                       "importer (multi-input layer)")
     raise KeyError(f"unsupported Keras layer class '{cls}' "
-                   f"(KerasLayer converter missing — registry parity point)")
+                   f"(register_custom_converter(class_name, fn) to extend)")
+
+
+def _mha_layer(kcfg: dict):
+    """Keras MultiHeadAttention (self-attention form) →
+    :class:`SelfAttentionLayer` with per-head projections + biases.
+
+    Restrictions (SelfAttentionLayer's Wo is square [proj, proj]):
+    ``value_dim`` must equal ``key_dim``, ``output_shape`` must be unset,
+    and ``num_heads * key_dim`` must equal the model width — a weight
+    mismatch at load time names this constraint."""
+    from deeplearning4j_tpu.nn.layers import SelfAttentionLayer
+    conf = kcfg["config"]
+    if conf.get("value_dim") not in (None, conf["key_dim"]):
+        raise KeyError(
+            f"unsupported Keras MultiHeadAttention value_dim="
+            f"{conf['value_dim']} != key_dim={conf['key_dim']}")
+    if conf.get("output_shape") is not None:
+        raise KeyError("unsupported Keras MultiHeadAttention output_shape "
+                       "(output must project back to the model width)")
+    return SelfAttentionLayer(
+        name=conf.get("name"), n_heads=conf["num_heads"],
+        head_size=conf["key_dim"], project_input=True,
+        has_bias=conf.get("use_bias", True))
+
+
+def _pad3(v):
+    """Keras 3-D padding/cropping: int | (a,b,c) | ((a,a),(b,b),(c,c))."""
+    if isinstance(v, int):
+        return (v, v, v)
+    if isinstance(v, (list, tuple)) and v and isinstance(v[0], (list, tuple)):
+        if any(p[0] != p[1] for p in v):
+            raise KeyError("asymmetric 3-D padding/cropping not supported")
+        return tuple(p[0] for p in v)
+    return tuple(v)
 
 
 def _one(v):
@@ -366,6 +543,38 @@ def load_weights(net: MultiLayerNetwork, weights: dict[str, list[np.ndarray]]) -
             params["W"] = depth.reshape(kh, kw, 1, cin * mult)
             if len(arrays) > 1:
                 params["b"] = np.asarray(arrays[1])
+        elif _is(layer, "Deconvolution2D"):
+            # keras Conv2DTranspose kernel [kh,kw,OUT,IN] computes the
+            # conv GRADIENT (spatially flipped); lax.conv_transpose uses
+            # the HWIO kernel as-is → flip spatial + swap channel axes
+            w = np.asarray(arrays[0])
+            params["W"] = np.flip(w, (0, 1)).transpose(0, 1, 3, 2).copy()
+            if len(arrays) > 1:
+                params["b"] = np.asarray(arrays[1])
+        elif _is(layer, "SelfAttentionLayer"):
+            # keras MultiHeadAttention: q/k/v kernels [D,H,dh] (+bias
+            # [H,dh]), output kernel [H,dh,D] (+bias [D])
+            it = iter(arrays)
+            named = {}
+            for part in ("q", "k", "v"):
+                kern = np.asarray(next(it))
+                d = kern.shape[0]
+                named[f"W{part}"] = kern.reshape(d, -1)
+                if layer.has_bias:
+                    named[f"b{part}"] = np.asarray(next(it)).reshape(-1)
+            kern = np.asarray(next(it))
+            named["Wo"] = kern.reshape(-1, kern.shape[-1])
+            if layer.has_bias:
+                named["bo"] = np.asarray(next(it)).reshape(-1)
+            for key, arr in named.items():
+                if params[key].shape != arr.shape:
+                    raise ValueError(
+                        f"MultiHeadAttention '{layer.name}' param {key}: "
+                        f"shape {arr.shape} != expected "
+                        f"{params[key].shape} — num_heads*key_dim must "
+                        f"equal the model width (SelfAttentionLayer's "
+                        f"output projection is square)")
+                params[key] = arr
         else:
             # ordered candidates per layer family: conv/dense (W, b),
             # separable (depthW, pointW, b — handled above), layer-norm
@@ -468,6 +677,8 @@ def _shape_to_input_type(shape) -> InputType:
         return InputType.recurrent(dims[1], dims[0])
     if len(dims) == 3:
         return InputType.convolutional(dims[0], dims[1], dims[2])
+    if len(dims) == 4:
+        return InputType.convolutional3d(dims[0], dims[1], dims[2], dims[3])
     raise ValueError(f"unsupported input shape {shape}")
 
 
@@ -500,6 +711,15 @@ def _inbound_names(kcfg: dict) -> list[str]:
     for entry in first:                # classic
         if isinstance(entry, (list, tuple)):
             out.append(entry[0])
+            # kwargs-nested tensors (e.g. MultiHeadAttention's value=)
+            # serialize as [name, node, tensor] triples inside the
+            # 4th slot's dict — missing them would make cross-attention
+            # look like self-attention
+            if len(entry) > 3 and isinstance(entry[3], dict):
+                for v in entry[3].values():
+                    if (isinstance(v, (list, tuple)) and len(v) >= 3
+                            and isinstance(v[0], str)):
+                        out.append(v[0])
     return out
 
 
@@ -562,6 +782,18 @@ def import_functional(model_json: str,
             vertex = (MergeVertex() if cls == "Concatenate"
                       else ElementWiseVertex(op=_MERGE_CLASSES[cls]))
             builder.add_vertex(name, vertex, *inbound)
+            alias[name] = name
+            continue
+        if cls == "MultiHeadAttention":
+            # self-attention form only: query/value(/key) must be the
+            # same tensor (cross-attention needs an AttentionVertex with
+            # distinct inputs — not a KerasLayer conversion)
+            if len(set(inbound)) != 1:
+                raise KeyError(
+                    f"MultiHeadAttention '{name}' is cross-attention "
+                    f"(distinct query/value inputs) — only the "
+                    f"self-attention form is converted")
+            builder.add_layer(name, _mha_layer(kcfg), inbound[0])
             alias[name] = name
             continue
         layer = _convert_layer(kcfg)

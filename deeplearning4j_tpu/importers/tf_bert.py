@@ -6,10 +6,10 @@ variable-name mapping for BERT-base (google-research/bert checkpoints,
 ``bert/encoder/layer_N/...`` naming).
 
 Input: a ``{tf_variable_name: np.ndarray}`` dict — from an npz conversion
-of the checkpoint (``tf.train.load_checkpoint`` one-liner wherever TF
-exists; no TF/protobuf in this image).  Output: the parameter pytree of
-``deeplearning4j_tpu.models.bert`` with numerics verified by golden
-fixtures in tests.
+of the checkpoint (``tf.train.load_checkpoint``; TF IS installed in this
+image, so the conversion can run in-process).  Output: the parameter
+pytree of ``deeplearning4j_tpu.models.bert`` with numerics verified by
+golden fixtures in tests.
 
 TF kernel layout is [in, out], same as ours — no transposes needed; the
 only structural work is the name mapping + config inference.

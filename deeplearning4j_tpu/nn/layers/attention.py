@@ -31,6 +31,9 @@ class SelfAttentionLayer(Layer):
     n_heads: int = 1
     head_size: int = 0
     project_input: bool = True
+    # projection biases (off in DL4J's SelfAttentionLayer; on for Keras
+    # MultiHeadAttention import parity)
+    has_bias: bool = False
     # long-sequence path: route the inner product through the Pallas
     # flash kernel (forward + backward, no [T,T] materialization)
     use_flash: bool = False
@@ -50,12 +53,17 @@ class SelfAttentionLayer(Layer):
         hs = self.head_size or d // self.n_heads
         proj = self.n_heads * hs
         k1, k2, k3, k4 = jax.random.split(key, 4)
-        return {
+        params = {
             "Wq": self._init_weight(k1, (d, proj), d, proj),
             "Wk": self._init_weight(k2, (d, proj), d, proj),
             "Wv": self._init_weight(k3, (d, proj), d, proj),
             "Wo": self._init_weight(k4, (proj, proj), proj, proj),
         }
+        if self.has_bias:
+            dt = self._param_dtype()
+            for n in ("bq", "bk", "bv", "bo"):
+                params[n] = jnp.zeros((proj,), dt)
+        return params
 
     def has_params(self) -> bool:
         return self.project_input
@@ -65,6 +73,8 @@ class SelfAttentionLayer(Layer):
             q = jnp.einsum("btc,cd->btd", x, params["Wq"])
             k = jnp.einsum("btc,cd->btd", x, params["Wk"])
             v = jnp.einsum("btc,cd->btd", x, params["Wv"])
+            if self.has_bias:
+                q, k, v = q + params["bq"], k + params["bk"], v + params["bv"]
         else:
             q = k = v = x
         n_heads = self.n_heads if self.project_input else 1
@@ -73,6 +83,8 @@ class SelfAttentionLayer(Layer):
                                  flash_block=self.flash_block)
         if self.project_input:
             y = jnp.einsum("btd,de->bte", y, params["Wo"])
+            if self.has_bias:
+                y = y + params["bo"]
         return y, state
 
 
@@ -106,10 +118,14 @@ class LearnedSelfAttentionLayer(SelfAttentionLayer):
             q = jnp.einsum("btc,cd->btd", queries, params["Wq"])
             k = jnp.einsum("btc,cd->btd", x, params["Wk"])
             v = jnp.einsum("btc,cd->btd", x, params["Wv"])
+            if self.has_bias:
+                q, k, v = q + params["bq"], k + params["bk"], v + params["bv"]
         else:
             q, k, v = queries, x, x
         n_heads = self.n_heads if self.project_input else 1
         y = multi_head_attention(q, k, v, n_heads=n_heads, kv_mask=mask)
         if self.project_input:
             y = jnp.einsum("btd,de->bte", y, params["Wo"])
+            if self.has_bias:
+                y = y + params["bo"]
         return y, state

@@ -71,6 +71,33 @@ for l in spec["layers"]:
         layers.append(keras.layers.ZeroPadding2D(tuple(l["pad"]), name=l["name"]))
     elif kind == "cropping":
         layers.append(keras.layers.Cropping2D(tuple(l["crop"]), name=l["name"]))
+    elif kind == "conv2dtranspose":
+        layers.append(keras.layers.Conv2DTranspose(
+            l["filters"], l["kernel"], strides=l.get("strides", 1),
+            activation=l["act"], padding=l["padding"], name=l["name"]))
+    elif kind == "conv3d":
+        layers.append(keras.layers.Conv3D(l["filters"], l["kernel"],
+                       activation=l["act"], padding=l["padding"], name=l["name"]))
+    elif kind == "maxpool3d":
+        layers.append(keras.layers.MaxPooling3D(l["pool"], name=l["name"]))
+    elif kind == "zeropad1d":
+        layers.append(keras.layers.ZeroPadding1D(l["pad"], name=l["name"]))
+    elif kind == "cropping1d":
+        layers.append(keras.layers.Cropping1D(l["crop"], name=l["name"]))
+    elif kind == "upsampling1d":
+        layers.append(keras.layers.UpSampling1D(l["size"], name=l["name"]))
+    elif kind == "repeatvector":
+        layers.append(keras.layers.RepeatVector(l["n"], name=l["name"]))
+    elif kind == "timedist_dense":
+        layers.append(keras.layers.TimeDistributed(
+            keras.layers.Dense(l["units"], activation=l["act"]), name=l["name"]))
+    elif kind == "relu_layer":
+        layers.append(keras.layers.ReLU(negative_slope=l.get("slope", 0.0),
+                                        name=l["name"]))
+    elif kind == "softmax_layer":
+        layers.append(keras.layers.Softmax(name=l["name"]))
+    elif kind == "lambda_double":
+        layers.append(keras.layers.Lambda(lambda t: t * 2.0, name=l["name"]))
 if spec.get("functional") == "conv_branches":
     # two conv branches, explicit Flatten per branch, Concatenate, head
     inp = keras.layers.Input(shape=(6, 6, 2))
@@ -83,6 +110,13 @@ if spec.get("functional") == "conv_branches":
     cat = keras.layers.Concatenate(name="fcat")([fa, fb])
     lr = keras.layers.LeakyReLU(name="lre")(cat)   # default alpha 0.3
     out = keras.layers.Dense(3, activation="softmax", name="fout")(lr)
+    model = keras.Model(inputs=inp, outputs=out)
+elif spec.get("functional") == "mha":
+    inp = keras.layers.Input(shape=(6, 8))
+    att = keras.layers.MultiHeadAttention(num_heads=2, key_dim=4,
+                                          name="mha")(inp, inp)
+    gp = keras.layers.GlobalAveragePooling1D(name="gp")(att)
+    out = keras.layers.Dense(3, activation="softmax", name="fout")(gp)
     model = keras.Model(inputs=inp, outputs=out)
 elif spec.get("functional") == "two_inputs_reordered":
     # inputs declared in REVERSE creation order: binds must follow
@@ -226,6 +260,135 @@ class TestKerasH5Golden:
         net = import_keras_model_and_weights(h5)
         np.testing.assert_allclose(np.asarray(net.output(x)), golden,
                                    rtol=1e-4, atol=1e-5)
+
+    def test_conv2dtranspose_golden(self, tmp_path):
+        """Conv2DTranspose: the keras (kh,kw,out,in) gradient-kernel maps
+        to our conv_transpose layout by spatial flip + channel swap."""
+        h5, x, golden = _make_fixture(tmp_path, [
+            {"kind": "input", "shape": [5, 5, 2]},
+            {"kind": "conv2dtranspose", "filters": 4, "kernel": 3,
+             "strides": 2, "act": "relu", "padding": "same", "name": "dc"},
+            {"kind": "flatten", "name": "fl"},
+            {"kind": "dense", "units": 3, "act": "softmax", "name": "out"},
+        ], (3, 5, 5, 2), seed=11)
+        net = import_keras_model_and_weights(h5)
+        np.testing.assert_allclose(np.asarray(net.output(x)), golden,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_conv3d_pool3d_golden(self, tmp_path):
+        h5, x, golden = _make_fixture(tmp_path, [
+            {"kind": "input", "shape": [6, 6, 6, 2]},
+            {"kind": "conv3d", "filters": 3, "kernel": 2, "act": "relu",
+             "padding": "valid", "name": "c3"},
+            {"kind": "maxpool3d", "pool": 2, "name": "p3"},
+            {"kind": "flatten", "name": "fl"},
+            {"kind": "dense", "units": 3, "act": "softmax", "name": "out"},
+        ], (2, 6, 6, 6, 2), seed=12)
+        net = import_keras_model_and_weights(h5)
+        np.testing.assert_allclose(np.asarray(net.output(x)), golden,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_pad_crop_upsample_1d_golden(self, tmp_path):
+        h5, x, golden = _make_fixture(tmp_path, [
+            {"kind": "input", "shape": [8, 3]},
+            {"kind": "zeropad1d", "pad": 2, "name": "zp"},
+            {"kind": "conv1d", "filters": 4, "kernel": 3, "act": "relu",
+             "padding": "valid", "name": "c1"},
+            {"kind": "cropping1d", "crop": 1, "name": "cr"},
+            {"kind": "upsampling1d", "size": 2, "name": "up"},
+            {"kind": "gap1d", "name": "gap"},
+            {"kind": "dense", "units": 2, "act": "softmax", "name": "out"},
+        ], (4, 8, 3), seed=13)
+        net = import_keras_model_and_weights(h5)
+        np.testing.assert_allclose(np.asarray(net.output(x)), golden,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_repeatvector_timedistributed_golden(self, tmp_path):
+        h5, x, golden = _make_fixture(tmp_path, [
+            {"kind": "input", "shape": [5]},
+            {"kind": "dense", "units": 4, "act": "tanh", "name": "d1"},
+            {"kind": "repeatvector", "n": 3, "name": "rv"},
+            {"kind": "timedist_dense", "units": 2, "act": "linear",
+             "name": "td"},
+            {"kind": "softmax_layer", "name": "sm"},
+        ], (4, 5), seed=14)
+        net = import_keras_model_and_weights(h5)
+        np.testing.assert_allclose(np.asarray(net.output(x)), golden,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_relu_layer_negative_slope_golden(self, tmp_path):
+        h5, x, golden = _make_fixture(tmp_path, [
+            {"kind": "input", "shape": [6]},
+            {"kind": "dense", "units": 5, "act": "linear", "name": "d1"},
+            {"kind": "relu_layer", "slope": 0.25, "name": "rl"},
+            {"kind": "dense", "units": 2, "act": "softmax", "name": "out"},
+        ], (4, 6), seed=15)
+        net = import_keras_model_and_weights(h5)
+        np.testing.assert_allclose(np.asarray(net.output(x)), golden,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_multi_head_attention_functional_golden(self, tmp_path):
+        """Keras MultiHeadAttention (self-attention) → SelfAttentionLayer
+        with per-head q/k/v/o kernels+biases reshaped exactly."""
+        h5, x, golden = _make_fixture(tmp_path, [], (4, 6, 8), seed=16,
+                                      functional="mha")
+        net = import_keras_model_and_weights(h5)
+        np.testing.assert_allclose(np.asarray(net.output(x)), golden,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_lambda_registry_golden(self, tmp_path):
+        """Lambda layers import through the registered-layer SPI
+        (KerasLambdaLayer parity): unregistered → clear error; registered
+        equivalent layer → golden parity."""
+        import dataclasses as _dc
+        from deeplearning4j_tpu.importers.keras import (
+            register_lambda_layer, _LAMBDA_LAYERS)
+        from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+        h5, x, golden = _make_fixture(tmp_path, [
+            {"kind": "input", "shape": [6]},
+            {"kind": "dense", "units": 4, "act": "tanh", "name": "d1"},
+            {"kind": "lambda_double", "name": "dbl"},
+            {"kind": "dense", "units": 2, "act": "softmax", "name": "out"},
+        ], (4, 6), seed=17)
+        with pytest.raises(KeyError, match="register_lambda_layer"):
+            import_keras_model_and_weights(h5)
+
+        @register_layer("test_times_two")
+        @_dc.dataclass
+        class TimesTwo(Layer):
+            def get_output_type(self, t):
+                return t
+
+            def has_params(self):
+                return False
+
+            def apply(self, params, state, x, *, train=False, rng=None,
+                      mask=None):
+                return 2.0 * x, state
+
+        register_lambda_layer("dbl", TimesTwo())
+        try:
+            net = import_keras_model_and_weights(h5)
+            np.testing.assert_allclose(np.asarray(net.output(x)), golden,
+                                       rtol=1e-4, atol=1e-5)
+        finally:
+            _LAMBDA_LAYERS.pop("dbl", None)
+
+    def test_custom_converter_registry(self):
+        """register_custom_converter takes precedence over built-ins."""
+        from deeplearning4j_tpu.importers.keras import (
+            _convert_layer, register_custom_converter, _CUSTOM_CONVERTERS)
+        from deeplearning4j_tpu.nn.layers import DenseLayer
+        marker = DenseLayer(n_out=9, activation="identity")
+        register_custom_converter("MyLayer", lambda kcfg: marker)
+        try:
+            out = _convert_layer({"class_name": "MyLayer", "config": {}})
+            assert out is marker
+        finally:
+            _CUSTOM_CONVERTERS.pop("MyLayer", None)
+        with pytest.raises(KeyError, match="register_custom_converter"):
+            _convert_layer({"class_name": "NopeLayer", "config": {}})
 
     def test_layernorm_geometry_golden(self, tmp_path):
         h5, x, golden = _make_fixture(tmp_path, [
