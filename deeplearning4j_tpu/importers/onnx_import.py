@@ -12,8 +12,13 @@ grads, and shards like native code.  ONNX's NCHW/OIHW conventions are
 executed natively via ``lax.conv_general_dilated`` dimension numbers
 (XLA:TPU re-lays-out internally; no host-side transposes).
 
-Scope: the inference op set covering MLP/CNN classifier exports
-(the same scope the reference ships converters for first).
+Scope: ~95 ops — the inference set for MLP/CNN/transformer classifier
+exports: the conv/pool/norm families (Conv, ConvTranspose, LRN,
+Instance/Layer/BatchNormalization), the activation catalog, variadic
+and comparison arithmetic, the Reduce* family (attr- and input-axes
+forms), and shape/structure ops (Slice/Split/Pad/Expand/Tile/TopK/
+CumSum/Trilu/Einsum/...).  Unsupported node types fail at import with
+the full supported-op list.
 """
 
 from __future__ import annotations
@@ -332,20 +337,8 @@ def _gather(inputs, attrs):
                     axis=attrs.get("axis", 0))
 
 
-@onnx_op("ReduceMean")
-def _reduce_mean(inputs, attrs):
-    import jax.numpy as jnp
-    # opset >= 18 moves `axes` from an attribute to an optional second input
-    if len(inputs) > 1 and inputs[1] is not None:
-        axes = tuple(int(v) for v in np.asarray(inputs[1]))
-    else:
-        axes = tuple(attrs.get("axes", ()))
-    if not axes:
-        if bool(attrs.get("noop_with_empty_axes", 0)):
-            return inputs[0]
-        axes = None  # default: reduce over all axes
-    return jnp.mean(inputs[0], axis=axes,
-                    keepdims=bool(attrs.get("keepdims", 1)))
+# ReduceMean rides the shared _reduce framework (defined below with the
+# rest of the Reduce* family)
 
 
 @onnx_op("Squeeze")
@@ -379,6 +372,507 @@ def _binary(jnp_name):
 for _name, _fn in (("Add", "add"), ("Sub", "subtract"), ("Mul", "multiply"),
                    ("Div", "divide"), ("Pow", "power")):
     _OPS[_name] = _binary(_fn)
+
+
+# ------------------------------------------------- round-4 opset breadth
+def _unary2(jax_path):
+    def impl(inputs, attrs):
+        import jax
+        import jax.numpy as jnp
+        mod = {"jnp": jnp, "nn": jax.nn, "lax": jax.lax}[jax_path[0]]
+        return getattr(mod, jax_path[1])(inputs[0])
+    return impl
+
+
+for _name, _path in (
+        ("Ceil", ("jnp", "ceil")), ("Floor", ("jnp", "floor")),
+        ("Round", ("jnp", "rint")), ("Sign", ("jnp", "sign")),
+        ("Sin", ("jnp", "sin")), ("Cos", ("jnp", "cos")),
+        ("Tan", ("jnp", "tan")), ("Asin", ("jnp", "arcsin")),
+        ("Acos", ("jnp", "arccos")), ("Atan", ("jnp", "arctan")),
+        ("Sinh", ("jnp", "sinh")), ("Cosh", ("jnp", "cosh")),
+        ("Asinh", ("jnp", "arcsinh")), ("Acosh", ("jnp", "arccosh")),
+        ("Atanh", ("jnp", "arctanh")), ("Reciprocal", ("jnp", "reciprocal")),
+        ("Softplus", ("nn", "softplus")), ("Softsign", ("nn", "soft_sign")),
+        ("Not", ("jnp", "logical_not")), ("IsNaN", ("jnp", "isnan")),
+        ("HardSwish", ("nn", "hard_swish")), ("Mish", ("nn", "mish"))):
+    _OPS[_name] = _unary2(_path)
+
+
+@onnx_op("Elu")
+def _elu(inputs, attrs):
+    import jax
+    return jax.nn.elu(inputs[0], attrs.get("alpha", 1.0))
+
+
+@onnx_op("Selu")
+def _selu(inputs, attrs):
+    import jax.numpy as jnp
+    a = attrs.get("alpha", 1.6732632423543772)
+    g = attrs.get("gamma", 1.0507009873554805)
+    x = inputs[0]
+    return g * jnp.where(x > 0, x, a * (jnp.exp(x) - 1.0))
+
+
+@onnx_op("HardSigmoid")
+def _hard_sigmoid(inputs, attrs):
+    import jax.numpy as jnp
+    return jnp.clip(attrs.get("alpha", 0.2) * inputs[0]
+                    + attrs.get("beta", 0.5), 0.0, 1.0)
+
+
+@onnx_op("Gelu")
+def _gelu(inputs, attrs):
+    import jax
+    return jax.nn.gelu(inputs[0],
+                       approximate=attrs.get("approximate", "none") == "tanh")
+
+
+@onnx_op("PRelu")
+def _prelu(inputs, attrs):
+    import jax.numpy as jnp
+    x, slope = inputs
+    return jnp.where(x >= 0, x, slope * x)
+
+
+@onnx_op("ThresholdedRelu")
+def _thresholded_relu(inputs, attrs):
+    import jax.numpy as jnp
+    alpha = attrs.get("alpha", 1.0)
+    return jnp.where(inputs[0] > alpha, inputs[0], 0.0)
+
+
+@onnx_op("LogSoftmax")
+def _log_softmax(inputs, attrs):
+    import jax
+    import jax.numpy as jnp
+    x = inputs[0]
+    if _opset_var.get() >= 13:
+        return jax.nn.log_softmax(x, axis=attrs.get("axis", -1))
+    axis = attrs.get("axis", 1) % max(x.ndim, 1)
+    lead = int(np.prod(x.shape[:axis])) if axis else 1
+    flat = jnp.reshape(x, (lead, -1))
+    return jnp.reshape(jax.nn.log_softmax(flat, axis=-1), x.shape)
+
+
+def _variadic(jnp_name):
+    def impl(inputs, attrs):
+        import functools
+        import jax.numpy as jnp
+        fn = getattr(jnp, jnp_name)
+        return functools.reduce(fn, inputs[1:], inputs[0])
+    return impl
+
+
+_OPS["Min"] = _variadic("minimum")
+_OPS["Max"] = _variadic("maximum")
+_OPS["Sum"] = _variadic("add")
+
+
+@onnx_op("Mean")
+def _mean_op(inputs, attrs):
+    import functools
+    import jax.numpy as jnp
+    return functools.reduce(jnp.add, inputs[1:], inputs[0]) / len(inputs)
+
+
+@onnx_op("Mod")
+def _mod(inputs, attrs):
+    import jax.numpy as jnp
+    if attrs.get("fmod", 0):
+        return jnp.fmod(inputs[0], inputs[1])
+    return jnp.mod(inputs[0], inputs[1])
+
+
+for _name, _fn in (("Equal", "equal"), ("Greater", "greater"),
+                   ("GreaterOrEqual", "greater_equal"), ("Less", "less"),
+                   ("LessOrEqual", "less_equal"), ("And", "logical_and"),
+                   ("Or", "logical_or"), ("Xor", "logical_xor")):
+    _OPS[_name] = _binary(_fn)
+
+
+@onnx_op("Where")
+def _where(inputs, attrs):
+    import jax.numpy as jnp
+    return jnp.where(inputs[0], inputs[1], inputs[2])
+
+
+# ---- reductions (axes attr, or input from opset 13/18 on)
+def _reduce_axes(inputs, attrs):
+    if len(inputs) > 1 and inputs[1] is not None:
+        axes = tuple(int(v) for v in np.asarray(inputs[1]))
+    else:
+        axes = tuple(attrs.get("axes", ()))
+    if not axes:
+        if bool(attrs.get("noop_with_empty_axes", 0)):
+            return "noop"
+        return None
+    return axes
+
+
+def _reduce(agg):
+    def impl(inputs, attrs):
+        import jax.numpy as jnp
+        axes = _reduce_axes(inputs, attrs)
+        if axes == "noop":
+            return inputs[0]
+        keep = bool(attrs.get("keepdims", 1))
+        return agg(jnp, inputs[0], axes, keep)
+    return impl
+
+
+_OPS["ReduceMean"] = _reduce(lambda jnp, x, a, k: jnp.mean(x, axis=a, keepdims=k))
+_OPS["ReduceSum"] = _reduce(lambda jnp, x, a, k: jnp.sum(x, axis=a, keepdims=k))
+_OPS["ReduceMax"] = _reduce(lambda jnp, x, a, k: jnp.max(x, axis=a, keepdims=k))
+_OPS["ReduceMin"] = _reduce(lambda jnp, x, a, k: jnp.min(x, axis=a, keepdims=k))
+_OPS["ReduceProd"] = _reduce(lambda jnp, x, a, k: jnp.prod(x, axis=a, keepdims=k))
+_OPS["ReduceL1"] = _reduce(lambda jnp, x, a, k: jnp.sum(jnp.abs(x), axis=a, keepdims=k))
+_OPS["ReduceL2"] = _reduce(lambda jnp, x, a, k: jnp.sqrt(jnp.sum(x * x, axis=a, keepdims=k)))
+_OPS["ReduceSumSquare"] = _reduce(lambda jnp, x, a, k: jnp.sum(x * x, axis=a, keepdims=k))
+_OPS["ReduceLogSum"] = _reduce(lambda jnp, x, a, k: jnp.log(jnp.sum(x, axis=a, keepdims=k)))
+
+
+@onnx_op("ReduceLogSumExp")
+def _reduce_lse(inputs, attrs):
+    import jax
+    axes = _reduce_axes(inputs, attrs)
+    if axes == "noop":
+        return inputs[0]
+    return jax.scipy.special.logsumexp(inputs[0], axis=axes,
+                                       keepdims=bool(attrs.get("keepdims", 1)))
+
+
+def _arg_reduce(jnp_name):
+    def impl(inputs, attrs):
+        import jax.numpy as jnp
+        x = inputs[0]
+        axis = attrs.get("axis", 0)
+        if attrs.get("select_last_index", 0):
+            # ties resolve to the LAST occurrence: argreduce the
+            # reversed axis, then mirror the index
+            rev = getattr(jnp, jnp_name)(jnp.flip(x, axis), axis=axis)
+            out = x.shape[axis] - 1 - rev
+        else:
+            out = getattr(jnp, jnp_name)(x, axis=axis)
+        out = out.astype(jnp.int64)
+        if attrs.get("keepdims", 1):
+            out = jnp.expand_dims(out, axis)
+        return out
+    return impl
+
+
+_OPS["ArgMax"] = _arg_reduce("argmax")
+_OPS["ArgMin"] = _arg_reduce("argmin")
+
+
+# ---- shape / structure
+#  TensorProto dtype enum → numpy (public onnx.proto values)
+_ONNX_DTYPES = {1: np.float32, 2: np.uint8, 3: np.int8, 4: np.uint16,
+                5: np.int16, 6: np.int32, 7: np.int64, 9: np.bool_,
+                10: np.float16, 11: np.float64, 12: np.uint32,
+                13: np.uint64}
+
+
+@onnx_op("Cast")
+def _cast(inputs, attrs):
+    import jax.numpy as jnp
+    to = int(attrs["to"])
+    if to == 16:       # bfloat16 has no numpy twin
+        return inputs[0].astype(jnp.bfloat16)
+    return inputs[0].astype(_ONNX_DTYPES[to])
+
+
+@onnx_op("Shape")
+def _shape(inputs, attrs):
+    shape = np.shape(inputs[0])
+    start = attrs.get("start", 0)
+    end = attrs.get("end", len(shape))
+    return np.asarray(shape[start:end], np.int64)
+
+
+@onnx_op("Size")
+def _size(inputs, attrs):
+    return np.asarray(int(np.prod(np.shape(inputs[0]))), np.int64)
+
+
+@onnx_op("Expand")
+def _expand(inputs, attrs):
+    import jax.numpy as jnp
+    shape = [int(v) for v in np.asarray(inputs[1])]
+    x = inputs[0]
+    # ONNX Expand is bidirectional broadcast: dims of 1 in `shape` keep
+    # the input's dim
+    shape = list(jnp.broadcast_shapes(tuple(x.shape), tuple(shape)))
+    return jnp.broadcast_to(x, shape)
+
+
+@onnx_op("Tile")
+def _tile(inputs, attrs):
+    import jax.numpy as jnp
+    return jnp.tile(inputs[0], [int(v) for v in np.asarray(inputs[1])])
+
+
+@onnx_op("Range")
+def _range(inputs, attrs):
+    import jax.numpy as jnp
+    start, limit, delta = (np.asarray(v).item() for v in inputs[:3])
+    return jnp.arange(start, limit, delta)
+
+
+@onnx_op("ConstantOfShape")
+def _constant_of_shape(inputs, attrs):
+    import jax.numpy as jnp
+    shape = [int(v) for v in np.asarray(inputs[0])]
+    value = attrs.get("value")
+    if value is None:
+        return jnp.zeros(shape, jnp.float32)
+    value = np.asarray(value)
+    return jnp.full(shape, value.ravel()[0], value.dtype)
+
+
+@onnx_op("Slice")
+def _slice(inputs, attrs):
+    import jax.numpy as jnp
+    x = inputs[0]
+    if len(inputs) > 1:        # opset >= 10: starts/ends/axes/steps inputs
+        starts = [int(v) for v in np.asarray(inputs[1])]
+        ends = [int(v) for v in np.asarray(inputs[2])]
+        axes = ([int(v) for v in np.asarray(inputs[3])]
+                if len(inputs) > 3 and inputs[3] is not None
+                else list(range(len(starts))))
+        steps = ([int(v) for v in np.asarray(inputs[4])]
+                 if len(inputs) > 4 and inputs[4] is not None
+                 else [1] * len(starts))
+    else:                      # opset 1: attributes
+        starts = list(attrs["starts"])
+        ends = list(attrs["ends"])
+        axes = list(attrs.get("axes", range(len(starts))))
+        steps = [1] * len(starts)
+    slices = [slice(None)] * x.ndim
+    for st, en, ax, sp in zip(starts, ends, axes, steps):
+        dim = x.shape[ax]
+        # ONNX clamps out-of-range ends (INT_MAX/INT_MIN convention)
+        if (sp > 0 and en >= dim) or (sp < 0 and en < -dim):
+            en = None
+        slices[ax] = slice(st, en, sp)
+    return x[tuple(slices)]
+
+
+@onnx_op("Split")
+def _split(inputs, attrs):
+    import jax.numpy as jnp
+    x = inputs[0]
+    axis = attrs.get("axis", 0)
+    if len(inputs) > 1 and inputs[1] is not None:
+        sizes = [int(v) for v in np.asarray(inputs[1])]
+    elif "split" in attrs:
+        sizes = list(attrs["split"])
+    else:
+        # spec: n chunks of ceil(d/n), the LAST one smaller (possibly 0);
+        # _n_outputs is injected by the executor from the node's arity
+        n = int(attrs.get("num_outputs", attrs.get("_n_outputs", 2)))
+        d = x.shape[axis]
+        base = -(-d // n)
+        sizes = [base] * (n - 1) + [d - base * (n - 1)]
+    offs = np.cumsum([0] + sizes[:-1])
+    return tuple(jnp.take(x, jnp.arange(o, o + s), axis=axis)
+                 for o, s in zip(offs, sizes))
+
+
+@onnx_op("Pad")
+def _pad(inputs, attrs):
+    import jax.numpy as jnp
+    x = inputs[0]
+    axes = None
+    if len(inputs) > 1 and inputs[1] is not None:   # opset >= 11
+        pads = [int(v) for v in np.asarray(inputs[1])]
+        cval = (np.asarray(inputs[2]).item()
+                if len(inputs) > 2 and inputs[2] is not None else 0.0)
+        if len(inputs) > 3 and inputs[3] is not None:   # opset >= 18
+            axes = [int(v) % x.ndim for v in np.asarray(inputs[3])]
+    else:
+        pads = list(attrs.get("pads", []))
+        cval = attrs.get("value", 0.0)
+    if axes is None:
+        axes = list(range(x.ndim))
+    n = len(axes)
+    pad_width = [(0, 0)] * x.ndim
+    for i, ax in enumerate(axes):
+        pad_width[ax] = (pads[i], pads[n + i])
+    mode = attrs.get("mode", "constant")
+    if mode == "constant":
+        return jnp.pad(x, pad_width, constant_values=cval)
+    return jnp.pad(x, pad_width,
+                   mode={"reflect": "reflect", "edge": "edge"}[mode])
+
+
+@onnx_op("DepthToSpace")
+def _depth_to_space(inputs, attrs):
+    import jax.numpy as jnp
+    x = inputs[0]
+    s = int(attrs["blocksize"])
+    n, c, h, w = x.shape
+    if attrs.get("mode", "DCR") == "DCR":
+        y = x.reshape(n, s, s, c // (s * s), h, w)
+        y = y.transpose(0, 3, 4, 1, 5, 2)
+    else:  # CRD
+        y = x.reshape(n, c // (s * s), s, s, h, w)
+        y = y.transpose(0, 1, 4, 2, 5, 3)
+    return y.reshape(n, c // (s * s), h * s, w * s)
+
+
+@onnx_op("SpaceToDepth")
+def _space_to_depth(inputs, attrs):
+    x = inputs[0]
+    s = int(attrs["blocksize"])
+    n, c, h, w = x.shape
+    y = x.reshape(n, c, h // s, s, w // s, s)
+    y = y.transpose(0, 3, 5, 1, 2, 4)
+    return y.reshape(n, c * s * s, h // s, w // s)
+
+
+@onnx_op("Trilu")
+def _trilu(inputs, attrs):
+    import jax.numpy as jnp
+    k = (int(np.asarray(inputs[1]).item())
+         if len(inputs) > 1 and inputs[1] is not None else 0)
+    if attrs.get("upper", 1):
+        return jnp.triu(inputs[0], k)
+    return jnp.tril(inputs[0], k)
+
+
+@onnx_op("CumSum")
+def _cumsum(inputs, attrs):
+    import jax.numpy as jnp
+    axis = int(np.asarray(inputs[1]).item())
+    x = inputs[0]
+    if attrs.get("reverse", 0):
+        x = jnp.flip(x, axis)
+    y = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", 0):
+        y = jnp.roll(y, 1, axis)
+        idx = [slice(None)] * y.ndim
+        idx[axis] = slice(0, 1)
+        y = y.at[tuple(idx)].set(0)
+    if attrs.get("reverse", 0):
+        y = jnp.flip(y, axis)
+    return y
+
+
+@onnx_op("TopK")
+def _topk(inputs, attrs):
+    import jax
+    import jax.numpy as jnp
+    x = inputs[0]
+    k = int(np.asarray(inputs[1]).item())
+    axis = attrs.get("axis", -1)
+    if not attrs.get("largest", 1):
+        vals, idx = jax.lax.top_k(jnp.moveaxis(-x, axis, -1), k)
+        vals = -vals
+    else:
+        vals, idx = jax.lax.top_k(jnp.moveaxis(x, axis, -1), k)
+    return (jnp.moveaxis(vals, -1, axis),
+            jnp.moveaxis(idx.astype(jnp.int64), -1, axis))
+
+
+@onnx_op("GatherElements")
+def _gather_elements(inputs, attrs):
+    import jax.numpy as jnp
+    return jnp.take_along_axis(inputs[0], inputs[1].astype(jnp.int32),
+                               axis=attrs.get("axis", 0))
+
+
+@onnx_op("Einsum")
+def _einsum(inputs, attrs):
+    import jax.numpy as jnp
+    return jnp.einsum(attrs["equation"], *inputs, precision=_precision())
+
+
+# ---- nn extras
+@onnx_op("GlobalMaxPool")
+def _gmp(inputs, attrs):
+    import jax.numpy as jnp
+    x = inputs[0]
+    return jnp.max(x, axis=tuple(range(2, x.ndim)), keepdims=True)
+
+
+@onnx_op("ConvTranspose")
+def _conv_transpose(inputs, attrs):
+    import jax.numpy as jnp
+    from jax import lax
+    x, w = inputs[0], inputs[1]
+    nd = x.ndim - 2
+    strides = attrs.get("strides", [1] * nd)
+    dil = attrs.get("dilations", [1] * nd)
+    if attrs.get("group", 1) != 1:
+        raise NotImplementedError("grouped ConvTranspose")
+    if attrs.get("output_shape") or attrs.get("auto_pad", "NOTSET") not in \
+            ("NOTSET", ""):
+        raise NotImplementedError(
+            "ConvTranspose with output_shape/auto_pad (only explicit "
+            "pads are converted)")
+    k = attrs.get("kernel_shape", list(np.shape(w)[2:]))
+    pads = attrs.get("pads", [0] * (2 * nd))
+    out_pad = attrs.get("output_padding", [0] * nd)
+    # ONNX ConvTranspose == gradient of Conv: spatially flip the IOHW
+    # kernel and swap I/O, then conv with lhs_dilation
+    wf = jnp.flip(w, axis=tuple(range(2, w.ndim))).swapaxes(0, 1)
+    spec = {1: ("NCW", "OIW", "NCW"), 2: ("NCHW", "OIHW", "NCHW"),
+            3: ("NCDHW", "OIDHW", "NCDHW")}[nd]
+    padding = [((k[d] - 1) * dil[d] - pads[d],
+                (k[d] - 1) * dil[d] - pads[nd + d] + out_pad[d])
+               for d in range(nd)]
+    y = lax.conv_general_dilated(
+        x, wf, (1,) * nd, padding, lhs_dilation=tuple(strides),
+        rhs_dilation=tuple(dil), dimension_numbers=spec,
+        precision=_precision())
+    if len(inputs) > 2 and inputs[2] is not None:
+        y = y + inputs[2].reshape((1, -1) + (1,) * nd)
+    return y
+
+
+@onnx_op("LRN")
+def _lrn(inputs, attrs):
+    import jax.numpy as jnp
+    x = inputs[0]
+    size = int(attrs["size"])
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    bias = attrs.get("bias", 1.0)
+    half = (size - 1) // 2
+    upper = size - 1 - half
+    sq = x * x
+    pad = jnp.pad(sq, ((0, 0), (half, upper), (0, 0), (0, 0)))
+    c = x.shape[1]
+    window = sum(pad[:, i:i + c] for i in range(size))
+    return x / jnp.power(bias + alpha / size * window, beta)
+
+
+@onnx_op("InstanceNormalization")
+def _instance_norm(inputs, attrs):
+    import jax.numpy as jnp
+    x, scale, bias = inputs[:3]
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return (x - mean) / jnp.sqrt(var + eps) * scale.reshape(shape) \
+        + bias.reshape(shape)
+
+
+@onnx_op("LayerNormalization")
+def _layer_norm_op(inputs, attrs):
+    import jax.numpy as jnp
+    x, scale = inputs[0], inputs[1]
+    bias = inputs[2] if len(inputs) > 2 else None
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(axis % x.ndim, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + eps) * scale
+    return y + bias if bias is not None else y
 
 
 # ------------------------------------------------------------------ graph
@@ -454,7 +948,11 @@ class OnnxModel:
         try:
             for node in self.nodes:  # ONNX graphs are topologically sorted
                 ins = [env[n] if n else None for n in node.get("input", [])]
-                out = _OPS[node["op_type"]](ins, _attrs(node))
+                attrs = _attrs(node)
+                # arity-dependent ops (Split) need the declared output
+                # count, which lives on the node, not in its attributes
+                attrs["_n_outputs"] = len(node.get("output", []))
+                out = _OPS[node["op_type"]](ins, attrs)
                 outs = out if isinstance(out, (tuple, list)) else (out,)
                 for name, val in zip(node.get("output", []), outs):
                     env[name] = val

@@ -315,3 +315,266 @@ def test_reduce_mean_opset18_axes_input():
         inputs={"x": [2, 3, 4]}, outputs={"y": [2, 3]}, opset=18)
     got = np.asarray(import_onnx_model(buf)(x))
     np.testing.assert_allclose(got, x.mean(axis=2), atol=1e-6)
+
+
+# ===================== round-4 opset breadth =====================
+def _snode(op, ins, outs, strings=None, tensors=None, **attrs):
+    """_node + string/tensor attributes."""
+    node = _node(op, ins, outs, **attrs)
+    alist = node.setdefault("attribute", [])
+    for k, v in (strings or {}).items():
+        alist.append({"name": k, "s": v.encode(), "type": 3})
+    for k, (tn, ta) in (tensors or {}).items():
+        alist.append({"name": k, "t": wire.array_to_tensor(tn, ta), "type": 4})
+    return node
+
+
+def _run1(node, feeds, outputs, opset=17, extra_inits=None):
+    inputs = {k: list(np.shape(v)) for k, v in feeds.items()}
+    buf = _model_bytes([node], extra_inits or {}, inputs, outputs, opset=opset)
+    return import_onnx_model(buf)(**feeds)
+
+
+class TestRound4Ops:
+    def test_unary_family(self):
+        rng = np.random.default_rng(0)
+        x = rng.uniform(0.1, 0.9, (2, 5)).astype(np.float32)
+        for op, ref in [("Ceil", np.ceil), ("Floor", np.floor),
+                        ("Round", np.rint), ("Sign", np.sign),
+                        ("Sin", np.sin), ("Cos", np.cos),
+                        ("Atan", np.arctan), ("Asin", np.arcsin),
+                        ("Reciprocal", np.reciprocal),
+                        ("Softplus", lambda v: np.log1p(np.exp(v)))]:
+            got = np.asarray(_run1(_node(op, ["x"], ["y"]), {"x": x},
+                                   {"y": list(x.shape)}))
+            np.testing.assert_allclose(got, ref(x), rtol=1e-5, atol=1e-6,
+                                       err_msg=op)
+
+    def test_activations(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(3, 4)).astype(np.float32)
+        got = np.asarray(_run1(_node("Elu", ["x"], ["y"], alpha=0.7),
+                               {"x": x}, {"y": [3, 4]}))
+        np.testing.assert_allclose(got, np.where(x > 0, x, 0.7 * (np.exp(x) - 1)),
+                                   rtol=1e-5, atol=1e-6)
+        got = np.asarray(_run1(_node("HardSigmoid", ["x"], ["y"],
+                                     alpha=0.25, beta=0.4),
+                               {"x": x}, {"y": [3, 4]}))
+        np.testing.assert_allclose(got, np.clip(0.25 * x + 0.4, 0, 1),
+                                   rtol=1e-5)
+        got = np.asarray(_run1(_node("ThresholdedRelu", ["x"], ["y"], alpha=0.3),
+                               {"x": x}, {"y": [3, 4]}))
+        np.testing.assert_allclose(got, np.where(x > 0.3, x, 0))
+        slope = np.asarray([0.1, 0.2, 0.3, 0.4], np.float32)
+        got = np.asarray(_run1(_node("PRelu", ["x", "s"], ["y"]),
+                               {"x": x, "s": slope}, {"y": [3, 4]}))
+        np.testing.assert_allclose(got, np.where(x >= 0, x, slope * x),
+                                   rtol=1e-6)
+        got = np.asarray(_run1(_node("LogSoftmax", ["x"], ["y"], axis=-1),
+                               {"x": x}, {"y": [3, 4]}))
+        e = np.exp(x - x.max(-1, keepdims=True))
+        np.testing.assert_allclose(got, np.log(e / e.sum(-1, keepdims=True)),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_variadic_and_compare(self):
+        rng = np.random.default_rng(2)
+        a, b, c = (rng.normal(size=(2, 3)).astype(np.float32) for _ in range(3))
+        inputs = {"a": [2, 3], "b": [2, 3], "c": [2, 3]}
+        buf = _model_bytes([_node("Sum", ["a", "b", "c"], ["y"])], {},
+                           inputs, {"y": [2, 3]})
+        np.testing.assert_allclose(np.asarray(import_onnx_model(buf)(a, b, c)),
+                                   a + b + c, rtol=1e-6)
+        buf = _model_bytes([_node("Mean", ["a", "b", "c"], ["y"])], {},
+                           inputs, {"y": [2, 3]})
+        np.testing.assert_allclose(np.asarray(import_onnx_model(buf)(a, b, c)),
+                                   (a + b + c) / 3, rtol=1e-6)
+        buf = _model_bytes([_node("Max", ["a", "b", "c"], ["y"])], {},
+                           inputs, {"y": [2, 3]})
+        np.testing.assert_allclose(np.asarray(import_onnx_model(buf)(a, b, c)),
+                                   np.maximum(np.maximum(a, b), c))
+        got = np.asarray(_run1(_node("Less", ["x", "z"], ["y"]),
+                               {"x": a, "z": b}, {"y": [2, 3]}))
+        np.testing.assert_array_equal(got, a < b)
+        got = np.asarray(_run1(_node("Where", ["m", "x", "z"], ["y"]),
+                               {"m": a > 0, "x": a, "z": b}, {"y": [2, 3]}))
+        np.testing.assert_allclose(got, np.where(a > 0, a, b))
+
+    def test_reductions_axes_input_opset18(self):
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(2, 3, 4)).astype(np.float32)
+        axes = np.asarray([1], np.int64)
+        node = _node("ReduceSum", ["x", "axes"], ["y"], keepdims=0)
+        got = np.asarray(_run1(node, {"x": x}, {"y": [2, 4]}, opset=18,
+                               extra_inits={"axes": axes}))
+        np.testing.assert_allclose(got, x.sum(1), rtol=1e-5)
+        node = _node("ReduceL2", ["x"], ["y"], axes=[0, 2], keepdims=1)
+        got = np.asarray(_run1(node, {"x": x}, {"y": [1, 3, 1]}))
+        np.testing.assert_allclose(got, np.sqrt((x * x).sum((0, 2),
+                                                            keepdims=True)),
+                                   rtol=1e-5)
+        node = _node("ReduceLogSumExp", ["x"], ["y"], axes=[2], keepdims=0)
+        got = np.asarray(_run1(node, {"x": x}, {"y": [2, 3]}))
+        np.testing.assert_allclose(
+            got, np.log(np.exp(x).sum(2)), rtol=1e-5)
+        node = _node("ArgMax", ["x"], ["y"], axis=2, keepdims=0)
+        got = np.asarray(_run1(node, {"x": x}, {"y": [2, 3]}))
+        np.testing.assert_array_equal(got, x.argmax(2))
+
+    def test_shape_structure_ops(self):
+        rng = np.random.default_rng(4)
+        x = rng.normal(size=(2, 3, 4)).astype(np.float32)
+        got = np.asarray(_run1(_node("Shape", ["x"], ["y"]), {"x": x},
+                               {"y": [3]}))
+        np.testing.assert_array_equal(got, [2, 3, 4])
+        got = np.asarray(_run1(_node("Cast", ["x"], ["y"], to=7), {"x": x},
+                               {"y": [2, 3, 4]}))
+        # int64 target; jax demotes to int32 when x64 is off
+        assert got.dtype in (np.int32, np.int64)
+        got = np.asarray(_run1(_node("Expand", ["x", "s"], ["y"]),
+                               {"x": x[:1]}, {"y": [2, 3, 4]},
+                               extra_inits={"s": np.asarray([2, 1, 4],
+                                                            np.int64)}))
+        np.testing.assert_allclose(got, np.broadcast_to(x[:1], (2, 3, 4)))
+        got = np.asarray(_run1(_node("Tile", ["x", "r"], ["y"]),
+                               {"x": x}, {"y": [2, 6, 4]},
+                               extra_inits={"r": np.asarray([1, 2, 1],
+                                                            np.int64)}))
+        np.testing.assert_allclose(got, np.tile(x, (1, 2, 1)))
+        got = np.asarray(_run1(
+            _snode("ConstantOfShape", ["s"], ["y"],
+                   tensors={"value": ("v", np.asarray([2.5], np.float32))}),
+            {}, {"y": [2, 2]},
+            extra_inits={"s": np.asarray([2, 2], np.int64)}))
+        np.testing.assert_allclose(got, np.full((2, 2), 2.5))
+
+    def test_slice_split_pad(self):
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(4, 6)).astype(np.float32)
+        got = np.asarray(_run1(
+            _node("Slice", ["x", "st", "en", "ax", "sp"], ["y"]),
+            {"x": x}, {"y": [2, 3]},
+            extra_inits={"st": np.asarray([1, 0], np.int64),
+                         "en": np.asarray([3, 2 ** 31 - 1], np.int64),
+                         "ax": np.asarray([0, 1], np.int64),
+                         "sp": np.asarray([1, 2], np.int64)}))
+        np.testing.assert_allclose(got, x[1:3, ::2])
+        buf = _model_bytes(
+            [_node("Split", ["x"], ["a", "b", "c"], axis=1, split=[1, 2, 3])],
+            {}, {"x": [4, 6]}, {"a": [4, 1], "b": [4, 2], "c": [4, 3]})
+        a, b, c = import_onnx_model(buf)(x)
+        np.testing.assert_allclose(np.asarray(a), x[:, :1])
+        np.testing.assert_allclose(np.asarray(c), x[:, 3:])
+        got = np.asarray(_run1(
+            _node("Pad", ["x", "p", "v"], ["y"]),
+            {"x": x}, {"y": [6, 8]},
+            extra_inits={"p": np.asarray([1, 1, 1, 1], np.int64),
+                         "v": np.asarray(7.0, np.float32)}))
+        want = np.pad(x, ((1, 1), (1, 1)), constant_values=7.0)
+        np.testing.assert_allclose(got, want)
+        got = np.asarray(_run1(
+            _snode("Pad", ["x", "p"], ["y"], strings={"mode": "reflect"}),
+            {"x": x}, {"y": [6, 6]},
+            extra_inits={"p": np.asarray([1, 0, 1, 0], np.int64)}))
+        np.testing.assert_allclose(got, np.pad(x, ((1, 1), (0, 0)),
+                                               mode="reflect"))
+
+    def test_cumsum_topk_trilu(self):
+        x = np.asarray([[3.0, 1.0, 2.0, 5.0], [4.0, 0.0, 6.0, 1.0]],
+                       np.float32)
+        got = np.asarray(_run1(_node("CumSum", ["x", "ax"], ["y"]),
+                               {"x": x}, {"y": [2, 4]},
+                               extra_inits={"ax": np.asarray(1, np.int64)}))
+        np.testing.assert_allclose(got, np.cumsum(x, 1))
+        got = np.asarray(_run1(
+            _node("CumSum", ["x", "ax"], ["y"], exclusive=1),
+            {"x": x}, {"y": [2, 4]},
+            extra_inits={"ax": np.asarray(1, np.int64)}))
+        want = np.concatenate([np.zeros((2, 1)), np.cumsum(x, 1)[:, :-1]], 1)
+        np.testing.assert_allclose(got, want)
+        buf = _model_bytes([_node("TopK", ["x", "k"], ["v", "i"], axis=1)],
+                           {"k": np.asarray([2], np.int64)},
+                           {"x": [2, 4]}, {"v": [2, 2], "i": [2, 2]})
+        v, i = import_onnx_model(buf)(x)
+        np.testing.assert_allclose(np.asarray(v), np.sort(x, 1)[:, ::-1][:, :2])
+        sq = np.arange(16, dtype=np.float32).reshape(4, 4)
+        got = np.asarray(_run1(_node("Trilu", ["x"], ["y"], upper=0),
+                               {"x": sq}, {"y": [4, 4]}))
+        np.testing.assert_allclose(got, np.tril(sq))
+
+    def test_conv_transpose_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        import torch.nn.functional as F
+        rng = np.random.default_rng(6)
+        x = rng.normal(size=(2, 3, 5, 5)).astype(np.float32)
+        w = rng.normal(0, 0.4, (3, 4, 3, 3)).astype(np.float32)  # [in,out,kh,kw]
+        b = rng.normal(0, 0.1, (4,)).astype(np.float32)
+        node = _node("ConvTranspose", ["x", "w", "b"], ["y"],
+                     strides=[2, 2], pads=[1, 1, 1, 1])
+        got = np.asarray(_run1(node, {"x": x}, {"y": [2, 4, 9, 9]},
+                               extra_inits={"w": w, "b": b}))
+        want = F.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                  torch.tensor(b), stride=2,
+                                  padding=1).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_norms_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        import torch.nn.functional as F
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=(2, 6, 4, 4)).astype(np.float32)
+        scale = rng.uniform(0.5, 1.5, (6,)).astype(np.float32)
+        bias = rng.normal(0, 0.2, (6,)).astype(np.float32)
+        got = np.asarray(_run1(
+            _node("InstanceNormalization", ["x", "s", "b"], ["y"],
+                  epsilon=1e-5),
+            {"x": x}, {"y": [2, 6, 4, 4]},
+            extra_inits={"s": scale, "b": bias}))
+        want = F.instance_norm(torch.tensor(x), weight=torch.tensor(scale),
+                               bias=torch.tensor(bias), eps=1e-5).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+        got = np.asarray(_run1(
+            _node("LRN", ["x"], ["y"], size=3, alpha=1e-3, beta=0.75,
+                  bias=1.0),
+            {"x": x}, {"y": [2, 6, 4, 4]}))
+        want = F.local_response_norm(torch.tensor(x), 3, alpha=1e-3,
+                                     beta=0.75, k=1.0).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+        xt = rng.normal(size=(2, 5, 8)).astype(np.float32)
+        g = rng.uniform(0.5, 1.5, (8,)).astype(np.float32)
+        bt = rng.normal(0, 0.2, (8,)).astype(np.float32)
+        got = np.asarray(_run1(
+            _node("LayerNormalization", ["x", "s", "b"], ["y"], axis=-1),
+            {"x": xt}, {"y": [2, 5, 8]}, extra_inits={"s": g, "b": bt}))
+        want = F.layer_norm(torch.tensor(xt), (8,), torch.tensor(g),
+                            torch.tensor(bt)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_depth_space_roundtrip_and_einsum(self):
+        rng = np.random.default_rng(8)
+        x = rng.normal(size=(1, 8, 2, 2)).astype(np.float32)
+        d2s = _run1(_node("DepthToSpace", ["x"], ["y"], blocksize=2),
+                    {"x": x}, {"y": [1, 2, 4, 4]})
+        back = np.asarray(_run1(_node("SpaceToDepth", ["x"], ["y"],
+                                      blocksize=2),
+                                {"x": np.asarray(d2s)}, {"y": [1, 8, 2, 2]}))
+        np.testing.assert_allclose(back, x)   # DCR d2s ∘ s2d == identity
+        a = rng.normal(size=(2, 3)).astype(np.float32)
+        bm = rng.normal(size=(3, 4)).astype(np.float32)
+        got = np.asarray(_run1(
+            _snode("Einsum", ["a", "b"], ["y"], strings={"equation": "ij,jk->ik"}),
+            {"a": a, "b": bm}, {"y": [2, 4]}))
+        np.testing.assert_allclose(got, a @ bm, rtol=1e-5, atol=1e-5)
+
+    def test_gather_elements_and_global_max(self):
+        rng = np.random.default_rng(9)
+        x = rng.normal(size=(3, 4)).astype(np.float32)
+        idx = np.asarray([[0, 1, 1, 0]], np.int64)
+        got = np.asarray(_run1(_node("GatherElements", ["x", "i"], ["y"],
+                                     axis=0),
+                               {"x": x}, {"y": [1, 4]},
+                               extra_inits={"i": idx}))
+        np.testing.assert_allclose(got, np.take_along_axis(x, idx, 0))
+        xc = rng.normal(size=(2, 3, 4, 4)).astype(np.float32)
+        got = np.asarray(_run1(_node("GlobalMaxPool", ["x"], ["y"]),
+                               {"x": xc}, {"y": [2, 3, 1, 1]}))
+        np.testing.assert_allclose(got, xc.max((2, 3), keepdims=True))
