@@ -51,7 +51,7 @@ def main():
         v = jnp.asarray(rng.normal(size=(2, t, h * d)).astype(np.float32)
                         ).astype(jnp.bfloat16)
         f = _chained(lambda a, b, c: flash_attention(
-            a, b, c, n_heads=h, causal=True, block_q=512, block_k=1024))
+            a, b, c, n_heads=h, causal=True))   # flash_block=0 default path
         flash_ms = bench(f, (q, k, v))
         try:
             r = _chained(lambda a, b, c: reference_attention(

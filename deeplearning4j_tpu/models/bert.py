@@ -46,7 +46,7 @@ class BertConfig:
     # long-sequence path: Pallas flash kernel (fwd + bwd) instead of the
     # materialized [T,T] einsum chain — pays off at seq >= ~2-4k
     use_flash: bool = False
-    flash_block: int = 0      # 0 = tuned default (512×1024 blocks)
+    flash_block: int = 0      # 0 = tuned default (1024×1024 blocks)
 
     @staticmethod
     def base() -> "BertConfig":

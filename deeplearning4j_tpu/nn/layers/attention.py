@@ -37,7 +37,7 @@ class SelfAttentionLayer(Layer):
     # long-sequence path: route the inner product through the Pallas
     # flash kernel (forward + backward, no [T,T] materialization)
     use_flash: bool = False
-    flash_block: int = 0      # 0 = tuned default (512×1024 blocks)
+    flash_block: int = 0      # 0 = tuned default (1024×1024 blocks)
 
     def get_output_type(self, input_type: InputType) -> InputType:
         if self.project_input:

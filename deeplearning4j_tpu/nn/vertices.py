@@ -198,7 +198,7 @@ class AttentionVertex(GraphVertex):
     n_heads: int = 1
     causal: bool = False
     use_flash: bool = False     # Pallas blockwise kernel (long sequences)
-    flash_block: int = 0      # 0 = tuned default (512×1024 blocks)
+    flash_block: int = 0      # 0 = tuned default (1024×1024 blocks)
 
     def apply(self, inputs):
         from deeplearning4j_tpu.ops.attention import multi_head_attention

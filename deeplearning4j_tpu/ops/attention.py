@@ -53,11 +53,12 @@ def multi_head_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if use_flash:
         from deeplearning4j_tpu.ops.pallas import flash_attention
         key_mask = mask if mask is not None else kv_mask
-        # flash_block=0: tuned defaults (512×1024 — the measured optimum
-        # on v5e; 128-blocks are ~2× slower, see bench/PROFILE.md)
+        # flash_block=0: tuned defaults (1024×1024 — the round-4 measured
+        # optimum on v5e at both narrow and BERT-base widths; the round-3
+        # 512×1024 default was 1.35-1.5× slower, see bench/PROFILE.md)
         out = flash_attention(q, k, v, n_heads=n_heads, causal=causal,
                               key_mask=key_mask,
-                              block_q=flash_block or 512,
+                              block_q=flash_block or 1024,
                               block_k=flash_block or 1024)
         if mask is not None and tq == k.shape[1]:
             out = out * mask[:, :, None].astype(out.dtype)

@@ -518,7 +518,7 @@ _mha_core.defvjp(_mha_fwd, _mha_bwd)
 
 
 def flash_attention(q, k, v, *, n_heads: int, causal: bool = False,
-                    key_mask=None, block_q: int = 512, block_k: int = 1024,
+                    key_mask=None, block_q: int = 1024, block_k: int = 1024,
                     interpret: bool | None = None):
     """Full single-device flash attention: [B, T, H*D] → [B, T, H*D].
     Normalized output (softmax(QKᵀ/√d)·V) with no [T,T] materialization —
