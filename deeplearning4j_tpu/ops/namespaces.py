@@ -32,6 +32,24 @@ def _standardize(x, axis=-1, eps=0.0):
     return (x - mean) / jnp.where(std > eps, std, 1.0)
 
 
+def _clip_by_global_norm(xs, n):
+    gn = jnp.sqrt(sum(jnp.sum(v * v) for v in xs))   # one pass over the tree
+    scale = jnp.minimum(1.0, n / jnp.maximum(gn, 1e-12))
+    return [x * scale for x in xs]
+
+
+def _bincount(x, length, weights=None):
+    """Out-of-range ids (negative or >= length) are DROPPED — jax's
+    negative-index wrap would silently count padding/ignore labels."""
+    idx = jnp.ravel(x).astype(jnp.int32)
+    valid = (idx >= 0) & (idx < length)
+    dtype = jnp.int32 if weights is None else jnp.asarray(weights).dtype
+    w = (jnp.ones(idx.shape, dtype) if weights is None
+         else jnp.ravel(jnp.asarray(weights)))
+    return jnp.zeros((length,), dtype).at[jnp.where(valid, idx, 0)].add(
+        jnp.where(valid, w, 0).astype(dtype))
+
+
 math = SimpleNamespace(
     abs=jnp.abs, ceil=jnp.ceil, floor=jnp.floor, round=jnp.round,
     exp=jnp.exp, expm1=jnp.expm1, log=jnp.log, log1p=jnp.log1p,
@@ -90,9 +108,29 @@ math = SimpleNamespace(
     lgamma=lax.lgamma, digamma=lax.digamma,
     igamma=lax.igamma, igammac=lax.igammac,
     betainc=lax.betainc,
+    zeta=jax.scipy.special.zeta,
+    polygamma=lax.polygamma,
     log_sum_exp=jax.scipy.special.logsumexp,
+    logaddexp=jnp.logaddexp,
     sort=jnp.sort, argsort=jnp.argsort,
     reverse=lambda x, axis=0: jnp.flip(x, axis=axis),
+    # merge family (libnd4j mergemax/mergeavg/mergeadd — variadic)
+    merge_max=lambda xs: jnp.max(jnp.stack(xs), axis=0),
+    merge_avg=lambda xs: jnp.mean(jnp.stack(xs), axis=0),
+    merge_add=lambda xs: jnp.sum(jnp.stack(xs), axis=0),
+    # clip family beyond value/norm
+    clip_by_avg_norm=lambda x, n: x * jnp.minimum(
+        1.0, n / jnp.maximum(_norm2(x) / jnp.sqrt(float(jnp.size(x))), 1e-12)),
+    clip_by_global_norm=_clip_by_global_norm,
+    percentile=lambda x, q, axis=None: jnp.percentile(x, q, axis=axis),
+    nth_element=lambda x, n, reverse=False: (
+        jnp.sort(x, axis=-1)[..., -(n + 1)] if reverse
+        else jnp.sort(x, axis=-1)[..., n]),
+    bincount=_bincount,
+    histogram_fixed_width=lambda x, lo, hi, nbins: jnp.zeros(
+        (nbins,), jnp.int32).at[jnp.clip(
+            ((x - lo) / jnp.maximum(hi - lo, 1e-12) * nbins).astype(jnp.int32),
+            0, nbins - 1)].add(1),
 )
 
 
@@ -402,6 +440,12 @@ linalg = SimpleNamespace(
         x, 0),
     tri=jnp.tri, tril=jnp.tril, triu=jnp.triu,
     cross=jnp.cross, kron=jnp.kron,
+    matrix_power=jnp.linalg.matrix_power,
+    matrix_diag=lambda v: jnp.zeros(v.shape + (v.shape[-1],), v.dtype)
+    .at[..., jnp.arange(v.shape[-1]), jnp.arange(v.shape[-1])].set(v),
+    matrix_set_diag=lambda x, v: x.at[..., jnp.arange(min(x.shape[-2:])),
+                                      jnp.arange(min(x.shape[-2:]))].set(v),
+    lu=jax.scipy.linalg.lu,
 )
 
 
@@ -547,6 +591,9 @@ base = SimpleNamespace(
     shape_of=lambda x: jnp.asarray(jnp.asarray(x).shape),
     size_of=lambda x: jnp.asarray(jnp.asarray(x).size),
     rank=lambda x: jnp.asarray(jnp.asarray(x).ndim),
+    broadcast_to=jnp.broadcast_to,
+    split_v=lambda x, sizes, axis=0: jnp.split(
+        x, [sum(sizes[:i + 1]) for i in range(len(sizes) - 1)], axis=axis),
     top_k=_extra.top_k,
     in_top_k=_extra.in_top_k,
     unique=_extra.unique,

@@ -1092,23 +1092,107 @@ def test_base_ops():
                   "base.boolean_mask", "base.match_condition_count")
 
 
+def test_math_merge_clip_percentile_family():
+    from scipy import special as sps
+    xs = [jnp.asarray(R.normal(size=(3, 4)).astype(np.float32))
+          for _ in range(3)]
+    stack = np.stack([np.asarray(v) for v in xs])
+    np.testing.assert_allclose(np.asarray(ns.math.merge_max(xs)),
+                               stack.max(0))
+    np.testing.assert_allclose(np.asarray(ns.math.merge_avg(xs)),
+                               stack.mean(0), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(ns.math.merge_add(xs)),
+                               stack.sum(0), rtol=1e-6)
+    LEDGER.record("math.merge_max", "math.merge_avg", "math.merge_add")
+    x = jnp.asarray(A)
+    got = np.asarray(ns.math.clip_by_avg_norm(x, 0.01))
+    avg_norm = np.linalg.norm(A) / np.sqrt(A.size)
+    np.testing.assert_allclose(got, A * min(1.0, 0.01 / avg_norm),
+                               rtol=1e-5)
+    clipped = ns.math.clip_by_global_norm([x, 2 * x], 1.0)
+    gn = np.sqrt((A * A).sum() + (2 * A * 2 * A).sum())
+    np.testing.assert_allclose(np.asarray(clipped[0]),
+                               A * min(1.0, 1.0 / gn), rtol=1e-5)
+    LEDGER.record("math.clip_by_avg_norm", "math.clip_by_global_norm")
+    np.testing.assert_allclose(float(ns.math.percentile(x, 50)),
+                               np.percentile(A, 50), rtol=1e-5)
+    row = jnp.asarray(np.asarray([5.0, 1.0, 3.0, 2.0], np.float32))
+    assert float(ns.math.nth_element(row, 1)) == 2.0
+    assert float(ns.math.nth_element(row, 1, reverse=True)) == 3.0
+    LEDGER.record("math.percentile", "math.nth_element")
+    ints = jnp.asarray([0, 2, 2, 3, 0], jnp.int32)
+    np.testing.assert_array_equal(np.asarray(ns.math.bincount(ints, 5)),
+                                  np.bincount(np.asarray(ints), minlength=5))
+    hist = np.asarray(ns.math.histogram_fixed_width(
+        jnp.asarray([0.0, 0.1, 0.5, 0.9, 1.0], jnp.float32), 0.0, 1.0, 2))
+    np.testing.assert_array_equal(hist, [2, 3])   # 0.5 lands in the upper bin
+    LEDGER.record("math.bincount", "math.histogram_fixed_width")
+    pv = np.asarray(P)
+    np.testing.assert_allclose(np.asarray(ns.math.zeta(jnp.asarray(1.5 + pv),
+                                                       jnp.asarray(pv))),
+                               sps.zeta(1.5 + pv, pv), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(ns.math.polygamma(1.0, jnp.asarray(pv))),
+                               sps.polygamma(1, pv), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(ns.math.logaddexp(x, jnp.asarray(B))),
+                               np.logaddexp(A, B), rtol=1e-5)
+    LEDGER.record("math.zeta", "math.polygamma", "math.logaddexp")
+
+
+def test_linalg_matrix_family():
+    v = jnp.asarray(np.asarray([1.0, 2.0, 3.0], np.float32))
+    np.testing.assert_allclose(np.asarray(ns.linalg.matrix_diag(v)),
+                               np.diag([1.0, 2.0, 3.0]))
+    m = jnp.asarray(SQ)
+    got = np.asarray(ns.linalg.matrix_set_diag(m, v=jnp.zeros(4)))
+    np.testing.assert_allclose(np.diag(got), np.zeros(4))
+    np.testing.assert_allclose(got - np.diag(np.diag(got)),
+                               SQ - np.diag(np.diag(SQ)), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(ns.linalg.matrix_power(m, 3)),
+                               np.linalg.matrix_power(SQ, 3), rtol=1e-3,
+                               atol=1e-3)
+    p_mat, l_mat, u_mat = ns.linalg.lu(jnp.asarray(SPD))
+    np.testing.assert_allclose(np.asarray(p_mat) @ np.asarray(l_mat)
+                               @ np.asarray(u_mat), SPD, rtol=1e-4, atol=1e-4)
+    LEDGER.record("linalg.matrix_diag", "linalg.matrix_set_diag",
+                  "linalg.matrix_power", "linalg.lu")
+
+
+def test_base_broadcast_split_v():
+    x = jnp.asarray(A)
+    np.testing.assert_allclose(
+        np.asarray(ns.base.broadcast_to(x[:1], (3, 4))),
+        np.broadcast_to(A[:1], (3, 4)))
+    parts = ns.base.split_v(x, [1, 3], axis=1)
+    assert [p.shape[1] for p in parts] == [1, 3]
+    np.testing.assert_allclose(np.asarray(parts[1]), A[:, 1:])
+    LEDGER.record("base.broadcast_to", "base.split_v")
+
+
 def test_new_op_grad_smoke():
-    """check_grads over the differentiable round-4 additions."""
+    """check_grads over the differentiable round-4 additions.  Runs in
+    x64 with its own rng: at f32 the finite-difference tolerance is
+    stream-dependent (flaky against the module-shared ``R``)."""
     from jax.test_util import check_grads
-    x = jnp.asarray(R.normal(size=(6,)).astype(np.float64)) * 0.5 + 1.5
-    for fn in (ns.nn.mish, ns.nn.hard_swish, ns.nn.rational_tanh,
-               lambda v: ns.nn.l2_normalize(v, axis=0),
-               lambda v: ns.math.log_sum_exp(v)):
-        check_grads(fn, (x,), order=1, modes=("rev",), atol=1e-3, rtol=1e-3)
-    xc = jnp.asarray(R.normal(size=(2, 6, 3)).astype(np.float64))
-    wc = jnp.asarray(R.normal(0, 0.3, (3, 3, 4)).astype(np.float64))
-    check_grads(lambda a, b: jnp.sum(ns.cnn.conv1d(
-        a, b, padding="VALID", precision="highest") ** 2),
-                (xc, wc), order=1, modes=("rev",), atol=1e-3, rtol=1e-3)
-    ws = jnp.asarray(R.normal(0, 0.3, (3, 12)).astype(np.float64))
-    bs = jnp.asarray(R.normal(0, 0.1, (8,)).astype(np.float64))
-    check_grads(lambda a: jnp.sum(ns.rnn.sru(a, ws, bs)[0] ** 2), (xc,),
-                order=1, modes=("rev",), atol=1e-3, rtol=1e-3)
+    rng = np.random.default_rng(123)
+    jax.config.update("jax_enable_x64", True)
+    try:
+        x = jnp.asarray(rng.normal(size=(6,)).astype(np.float64)) * 0.5 + 1.5
+        for fn in (ns.nn.mish, ns.nn.hard_swish, ns.nn.rational_tanh,
+                   lambda v: ns.nn.l2_normalize(v, axis=0),
+                   lambda v: ns.math.log_sum_exp(v)):
+            check_grads(fn, (x,), order=1, modes=("rev",), atol=1e-3,
+                        rtol=1e-3)
+        xc = jnp.asarray(rng.normal(size=(2, 6, 3)).astype(np.float64))
+        wc = jnp.asarray(rng.normal(0, 0.3, (3, 3, 4)).astype(np.float64))
+        check_grads(lambda a, b: jnp.sum(ns.cnn.conv1d(
+            a, b, padding="VALID", precision="highest") ** 2),
+                    (xc, wc), order=1, modes=("rev",), atol=1e-3, rtol=1e-3)
+        ws = jnp.asarray(rng.normal(0, 0.3, (3, 12)).astype(np.float64))
+        bs = jnp.asarray(rng.normal(0, 0.1, (8,)).astype(np.float64))
+        check_grads(lambda a: jnp.sum(ns.rnn.sru(a, ws, bs)[0] ** 2), (xc,),
+                    order=1, modes=("rev",), atol=1e-3, rtol=1e-3)
+    finally:
+        jax.config.update("jax_enable_x64", False)
 
 
 def test_zz_coverage_ledger():
