@@ -1,0 +1,57 @@
+"""Pipeline-parallel BERT training with the 1F1B schedule: the model
+splits into heterogeneous stages (embeddings / encoder blocks / encoder+
+MLM head), each stage owned by one device on the ``stage`` mesh axis;
+activations ride a ring ppermute and backward ticks start as soon as
+their cotangents exist (at most S-s microbatches stashed per stage).
+
+Needs >= 4 devices (see multislice example for the virtual-mesh flags).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models import bert
+from deeplearning4j_tpu.parallel.mesh import make_mesh
+from deeplearning4j_tpu.parallel.pipeline_stages import pipeline_train_step
+
+
+def main(steps: int = 3, n_stages: int = 4, verbose: bool = True):
+    if len(jax.devices()) < n_stages:
+        raise SystemExit(f"need {n_stages} devices")
+    config = dataclasses.replace(bert.BertConfig.tiny(vocab_size=256),
+                                 num_layers=n_stages)
+    params = bert.init_params(config, jax.random.key(0))
+    stage_fns, stage_params = bert.pipeline_stages(config, params, n_stages)
+    mesh = make_mesh(data=1, stage=n_stages,
+                     devices=jax.devices()[:n_stages])
+
+    rng = np.random.default_rng(0)
+    b, t = 8, 16
+    ids_np = rng.integers(5, 256, (b, t)).astype(np.float32)
+    ids = jnp.asarray(ids_np)
+    # MLM objective: reconstruct the input tokens at every position
+    # (a full-visibility denoising toy; bert_mlm_finetune.py shows real
+    # 15%-masked batches)
+    packed = jnp.asarray(np.stack(
+        [ids_np, np.ones((b, t), np.float32)], axis=-1))
+
+    lr = 1e-2
+    losses = []
+    for step in range(steps):
+        with mesh:
+            loss, grads = pipeline_train_step(
+                stage_fns, stage_params, ids, packed,
+                bert.mlm_loss_from_logits, mesh, n_microbatches=4)
+        stage_params = [jax.tree_util.tree_map(lambda p, g: p - lr * g, sp, g)
+                        for sp, g in zip(stage_params, grads)]
+        losses.append(float(loss))
+        if verbose:
+            print(f"step {step}: pipelined MLM loss {losses[-1]:.4f}")
+    return losses
+
+
+if __name__ == "__main__":
+    main()

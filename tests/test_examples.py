@@ -6,7 +6,8 @@ import pytest
 
 from examples import (bert_mlm_finetune, char_rnn_textgen,
                       data_parallel_training, early_stopping, lenet_cifar10,
-                      lstm_uci_har, mlp_mnist, training_dashboard,
+                      lstm_uci_har, mlp_mnist, multislice_dcn_training,
+                      pipeline_parallel_bert, training_dashboard,
                       transfer_learning, word2vec_embeddings)
 
 
@@ -68,3 +69,14 @@ def test_dashboard_example_writes_report(tmp_path):
                                   verbose=False)
     html = open(out).read()
     assert "Score (loss)" in html and "histogram" in html.lower()
+
+
+def test_multislice_dcn_example():
+    losses = multislice_dcn_training.main(steps=6, verbose=False)
+    assert losses[-1] < losses[0]
+
+
+def test_pipeline_parallel_bert_example():
+    losses = pipeline_parallel_bert.main(steps=2, verbose=False)
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[1] < losses[0]
