@@ -220,3 +220,69 @@ def fmeasure(labels, pre_output, activation="sigmoid", mask=None, weights=None, 
     score = 1.0 - f
     lead = pre_output.shape[0] if pre_output.ndim > 0 else 1
     return jnp.full((lead,), score)
+
+
+@register("huber")
+def huber(labels, pre_output, activation="identity", mask=None, weights=None,
+          delta: float = 1.0):
+    """NDLoss ``huberLoss``: quadratic within ±delta, linear outside."""
+    out = _activate(pre_output, activation)
+    err = jnp.abs(labels - out)
+    quad = jnp.minimum(err, delta)
+    per_elem = 0.5 * quad * quad + delta * (err - quad)
+    return jnp.mean(per_elem, axis=-1)
+
+
+@register("log_poisson")
+def log_poisson(labels, pre_output, activation="identity", mask=None,
+                weights=None, full: bool = False):
+    """NDLoss ``logPoisson``: exp(log_pred) - labels*log_pred (+ Stirling
+    approximation of log(labels!) when ``full``; zeroed for labels <= 1
+    where log 0! = log 1! = 0 — TF semantics)."""
+    log_pred = _activate(pre_output, activation)
+    per_elem = jnp.exp(log_pred) - labels * log_pred
+    if full:
+        safe = jnp.maximum(labels, 1.0)
+        stirling = (safe * jnp.log(safe) - safe
+                    + 0.5 * jnp.log(2.0 * jnp.pi * safe))
+        per_elem = per_elem + jnp.where(labels > 1.0, stirling, 0.0)
+    return jnp.mean(per_elem, axis=-1)
+
+
+@register("log_poisson_full")
+def log_poisson_full(labels, pre_output, activation="identity", mask=None,
+                     weights=None):
+    """``log_poisson`` with the Stirling term — its own registration so
+    name-configured layers get the full variant."""
+    return log_poisson(labels, pre_output, activation, mask, weights,
+                       full=True)
+
+
+@register("weighted_cross_entropy_with_logits")
+def weighted_cross_entropy_with_logits(labels, pre_output,
+                                       activation="identity", mask=None,
+                                       weights=None, pos_weight: float = 1.0):
+    """NDLoss ``weightedCrossEntropyWithLogits`` (TF semantics): the
+    positive class's log-term scaled by ``pos_weight``; activation is
+    ignored — the input is logits by contract."""
+    z = pre_output
+    log_w = 1.0 + (pos_weight - 1.0) * labels
+    per_elem = ((1.0 - labels) * z
+                + log_w * (jnp.log1p(jnp.exp(-jnp.abs(z)))
+                           + jnp.maximum(-z, 0.0)))
+    return jnp.mean(per_elem, axis=-1)
+
+
+@register("mean_pairwise_squared_error")
+def mean_pairwise_squared_error(labels, pre_output, activation="identity",
+                                mask=None, weights=None):
+    """NDLoss ``meanPairwiseSquaredError``: mean over ordered pairs (i,j)
+    of ((d_i - d_j)^2)/2 where d = pred - label, computed per example via
+    the variance identity sum_{ij}(d_i-d_j)^2 = 2n*sum d^2 - 2(sum d)^2."""
+    out = _activate(pre_output, activation)
+    d = out - labels
+    n = d.shape[-1]
+    sum_sq = jnp.sum(d * d, axis=-1)
+    sq_sum = jnp.sum(d, axis=-1) ** 2
+    pairs = max(n * (n - 1), 1)
+    return (n * sum_sq - sq_sum) / pairs

@@ -94,6 +94,13 @@ math = SimpleNamespace(
     hamming_distance=lambda a, b, axis=-1: jnp.sum(a != b, axis=axis),
     jaccard_distance=lambda a, b, axis=-1: 1.0
     - jnp.sum(jnp.minimum(a, b), axis=axis) / jnp.clip(jnp.sum(jnp.maximum(a, b), axis=axis), 1e-12),
+    # libnd4j reversed/compound pairwise ops
+    rsub=lambda x, y: y - x,
+    rdiv=lambda x, y: y / x,
+    squared_difference=lambda x, y: (x - y) ** 2,
+    axpy=lambda a, x, y: a * x + y,
+    all=jnp.all, any=jnp.any,
+    is_max=lambda x: x == jnp.max(x),
     # comparisons / predicates (libnd4j pairwise bool ops)
     eq=jnp.equal, neq=jnp.not_equal,
     gt=jnp.greater, gte=jnp.greater_equal,
@@ -176,6 +183,10 @@ nn = SimpleNamespace(
     l2_normalize=lambda x, axis=-1, eps=1e-12: x * lax.rsqrt(
         jnp.maximum(jnp.sum(x * x, axis=axis, keepdims=True), eps)),
     embedding_lookup=lambda table, ids: jnp.take(table, ids.astype(jnp.int32), axis=0),
+    # libnd4j fused-affine declarables
+    bias_add=lambda x, b: x + b,
+    xw_plus_b=lambda x, w, b: jnp.dot(x, w) + b,
+    relu_layer=lambda x, w, b: jax.nn.relu(jnp.dot(x, w) + b),
 )
 
 
@@ -298,6 +309,17 @@ def _avg_pool3d(x, k=(2, 2, 2), s=None, padding="VALID"):
     return _pool_nd(x, k, s or k, padding, lax.add, 0.0) / _pymath.prod(k)
 
 
+def _pnorm_pool2d(x, p=2.0, k=(2, 2), s=None, padding="VALID"):
+    """DL4J PNORM pooling.  |x|**p overflows f32 at moderate p, so scale
+    by the global max first: gmax * (Σ (|x|/gmax)^p)^(1/p) is the same
+    value with every intermediate in [0, 1] (ratios that underflow to 0
+    contribute negligibly to the p-norm by construction)."""
+    ax = jnp.abs(x)
+    gmax = jnp.maximum(jnp.max(ax), 1e-30)
+    scaled = _pool_nd((ax / gmax) ** p, k, s or k, padding, lax.add, 0.0)
+    return gmax * scaled ** (1.0 / p)
+
+
 def _col2im(cols, h, w, kh, kw, sh=1, sw=1, ph=0, pw=0):
     """Inverse of :func:`_im2col`: scatter-add patches back to the
     [N, H, W, C] image (libnd4j ``col2im`` — the conv backward lowering)."""
@@ -355,6 +377,7 @@ cnn = SimpleNamespace(
     avg_pooling2d=_avg_pool2d,
     max_pooling3d=_max_pool3d,
     avg_pooling3d=_avg_pool3d,
+    pnorm_pooling2d=_pnorm_pool2d,
     global_max_pooling=lambda x: jnp.max(x, axis=tuple(range(1, x.ndim - 1))),
     global_avg_pooling=lambda x: jnp.mean(x, axis=tuple(range(1, x.ndim - 1))),
     im2col=_im2col,
@@ -380,7 +403,9 @@ loss = SimpleNamespace(
     **{name: _losses.get(name) for name in
        ("mcxent", "mse", "mae", "l1", "l2", "binary_xent", "hinge",
         "squared_hinge", "poisson", "kl_divergence", "cosine_proximity",
-        "mape", "msle", "sparse_mcxent", "wasserstein", "fmeasure")},
+        "mape", "msle", "sparse_mcxent", "wasserstein", "fmeasure",
+        "huber", "log_poisson", "weighted_cross_entropy_with_logits",
+        "mean_pairwise_squared_error")},
     mean_score=_losses.mean_score,
 )
 
@@ -592,6 +617,7 @@ base = SimpleNamespace(
     size_of=lambda x: jnp.asarray(jnp.asarray(x).size),
     rank=lambda x: jnp.asarray(jnp.asarray(x).ndim),
     broadcast_to=jnp.broadcast_to,
+    roll=jnp.roll,
     split_v=lambda x, sizes, axis=0: jnp.split(
         x, [sum(sizes[:i + 1]) for i in range(len(sizes) - 1)], axis=axis),
     top_k=_extra.top_k,

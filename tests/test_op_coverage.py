@@ -1168,6 +1168,79 @@ def test_base_broadcast_split_v():
     LEDGER.record("base.broadcast_to", "base.split_v")
 
 
+def test_pairwise_compound_and_fused_affine_ops():
+    x, y = jnp.asarray(A), jnp.asarray(P)
+    np.testing.assert_allclose(np.asarray(ns.math.rsub(x, y)), P - A)
+    np.testing.assert_allclose(np.asarray(ns.math.rdiv(y, x)), A / P)
+    np.testing.assert_allclose(np.asarray(ns.math.squared_difference(x, y)),
+                               (A - P) ** 2, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(ns.math.axpy(2.5, x, y)),
+                               2.5 * A + P, rtol=1e-6)
+    assert bool(ns.math.all(x < 100)) and bool(ns.math.any(x > 0))
+    im = np.asarray(ns.math.is_max(x))
+    assert im.sum() == 1 and A[np.unravel_index(im.argmax(), A.shape)] == A.max()
+    LEDGER.record("math.rsub", "math.rdiv", "math.squared_difference",
+                  "math.axpy", "math.all", "math.any", "math.is_max")
+    w = jnp.asarray(R.normal(0, 0.4, (4, 5)).astype(np.float32))
+    b = jnp.asarray(R.normal(0, 0.1, (5,)).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(ns.nn.bias_add(x, jnp.asarray(B[0]))),
+                               A + B[0], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(ns.nn.xw_plus_b(x, w, b)),
+                               A @ np.asarray(w) + np.asarray(b),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ns.nn.relu_layer(x, w, b)),
+                               np.maximum(A @ np.asarray(w) + np.asarray(b), 0),
+                               rtol=1e-4, atol=1e-5)
+    LEDGER.record("nn.bias_add", "nn.xw_plus_b", "nn.relu_layer")
+    np.testing.assert_allclose(np.asarray(ns.base.roll(x, 1, axis=1)),
+                               np.roll(A, 1, 1))
+    LEDGER.record("base.roll")
+    # p-norm pooling: p→large approaches max pooling; p=1 is abs-sum
+    xp = jnp.asarray(np.abs(R.normal(size=(1, 4, 4, 2))).astype(np.float32))
+    p1 = np.asarray(ns.cnn.pnorm_pooling2d(xp, p=1.0, k=(2, 2)))
+    want = np.asarray(ns.cnn.avg_pooling2d(jnp.abs(xp), (2, 2))) * 4.0
+    np.testing.assert_allclose(p1, want, rtol=1e-5)
+    p_big = np.asarray(ns.cnn.pnorm_pooling2d(xp, p=64.0, k=(2, 2)))
+    np.testing.assert_allclose(p_big,
+                               np.asarray(ns.cnn.max_pooling2d(xp, (2, 2))),
+                               rtol=2e-2)
+    LEDGER.record("cnn.pnorm_pooling2d")
+
+
+def test_ndloss_extras_vs_torch():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+    rng = np.random.default_rng(13)
+    labels = rng.normal(size=(4, 6)).astype(np.float32)
+    pred = rng.normal(size=(4, 6)).astype(np.float32)
+    got = np.asarray(ns.loss.huber(jnp.asarray(labels), jnp.asarray(pred)))
+    want = F.huber_loss(torch.tensor(pred), torch.tensor(labels),
+                        reduction="none", delta=1.0).numpy().mean(-1)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # weighted sigmoid CE vs torch BCEWithLogits pos_weight
+    yb = rng.integers(0, 2, (4, 6)).astype(np.float32)
+    got = np.asarray(ns.loss.weighted_cross_entropy_with_logits(
+        jnp.asarray(yb), jnp.asarray(pred), pos_weight=2.0))
+    want = F.binary_cross_entropy_with_logits(
+        torch.tensor(pred), torch.tensor(yb),
+        pos_weight=torch.tensor(2.0), reduction="none").numpy().mean(-1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    # log-poisson vs manual exp(log_pred) - y*log_pred
+    ylp = rng.uniform(0, 4, (4, 6)).astype(np.float32)
+    got = np.asarray(ns.loss.log_poisson(jnp.asarray(ylp), jnp.asarray(pred)))
+    np.testing.assert_allclose(got, (np.exp(pred) - ylp * pred).mean(-1),
+                               rtol=1e-5)
+    # pairwise squared error vs explicit O(n^2) reference
+    d = pred - labels
+    want = np.stack([
+        np.mean([0.5 * (d[i, a] - d[i, b]) ** 2
+                 for a in range(6) for b in range(6) if a != b])
+        for i in range(4)])
+    got = np.asarray(ns.loss.mean_pairwise_squared_error(
+        jnp.asarray(labels), jnp.asarray(pred)))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
 def test_new_op_grad_smoke():
     """check_grads over the differentiable round-4 additions.  Runs in
     x64 with its own rng: at f32 the finite-difference tolerance is
