@@ -230,6 +230,8 @@ def huber(labels, pre_output, activation="identity", mask=None, weights=None,
     err = jnp.abs(labels - out)
     quad = jnp.minimum(err, delta)
     per_elem = 0.5 * quad * quad + delta * (err - quad)
+    if weights is not None:
+        per_elem = per_elem * weights
     return jnp.mean(per_elem, axis=-1)
 
 
@@ -246,6 +248,8 @@ def log_poisson(labels, pre_output, activation="identity", mask=None,
         stirling = (safe * jnp.log(safe) - safe
                     + 0.5 * jnp.log(2.0 * jnp.pi * safe))
         per_elem = per_elem + jnp.where(labels > 1.0, stirling, 0.0)
+    if weights is not None:
+        per_elem = per_elem * weights
     return jnp.mean(per_elem, axis=-1)
 
 
@@ -270,6 +274,8 @@ def weighted_cross_entropy_with_logits(labels, pre_output,
     per_elem = ((1.0 - labels) * z
                 + log_w * (jnp.log1p(jnp.exp(-jnp.abs(z)))
                            + jnp.maximum(-z, 0.0)))
+    if weights is not None:
+        per_elem = per_elem * weights
     return jnp.mean(per_elem, axis=-1)
 
 
@@ -281,6 +287,8 @@ def mean_pairwise_squared_error(labels, pre_output, activation="identity",
     the variance identity sum_{ij}(d_i-d_j)^2 = 2n*sum d^2 - 2(sum d)^2."""
     out = _activate(pre_output, activation)
     d = out - labels
+    if weights is not None:      # TF semantics: weights scale the deltas
+        d = d * jnp.sqrt(weights)
     n = d.shape[-1]
     sum_sq = jnp.sum(d * d, axis=-1)
     sq_sum = jnp.sum(d, axis=-1) ** 2

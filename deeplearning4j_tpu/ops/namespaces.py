@@ -100,7 +100,9 @@ math = SimpleNamespace(
     squared_difference=lambda x, y: (x - y) ** 2,
     axpy=lambda a, x, y: a * x + y,
     all=jnp.all, any=jnp.any,
-    is_max=lambda x: x == jnp.max(x),
+    # libnd4j IsMax marks exactly ONE position (first argmax), not ties
+    is_max=lambda x: jnp.zeros(jnp.shape(x), bool).ravel()
+    .at[jnp.argmax(x)].set(True).reshape(jnp.shape(x)),
     # comparisons / predicates (libnd4j pairwise bool ops)
     eq=jnp.equal, neq=jnp.not_equal,
     gt=jnp.greater, gte=jnp.greater_equal,
@@ -310,14 +312,31 @@ def _avg_pool3d(x, k=(2, 2, 2), s=None, padding="VALID"):
 
 
 def _pnorm_pool2d(x, p=2.0, k=(2, 2), s=None, padding="VALID"):
-    """DL4J PNORM pooling.  |x|**p overflows f32 at moderate p, so scale
-    by the global max first: gmax * (Σ (|x|/gmax)^p)^(1/p) is the same
-    value with every intermediate in [0, 1] (ratios that underflow to 0
-    contribute negligibly to the p-norm by construction)."""
-    ax = jnp.abs(x)
-    gmax = jnp.maximum(jnp.max(ax), 1e-30)
-    scaled = _pool_nd((ax / gmax) ** p, k, s or k, padding, lax.add, 0.0)
-    return gmax * scaled ** (1.0 / p)
+    """DL4J PNORM pooling, per-window EXACT at any p: windows are
+    extracted as patches so each normalizes by its OWN max —
+    m_w * (Σ (|x|/m_w)^p)^(1/p) keeps every intermediate in [0, 1]
+    with no cross-window coupling (a global-max prescale would flush
+    windows far below the global max to zero at large p).
+
+    SubsamplingLayer's pnorm path keeps the reference's direct
+    ``Σ|x|^p`` reduce_window (bit-parity with DL4J, which computes the
+    same way and has the same f32 range limits; fine at practical
+    p ≲ 16) — use this op when p is large."""
+    s = s or k
+    kh, kw = k
+    if padding == "SAME":
+        h, w = x.shape[1], x.shape[2]
+        oh, ow = -(-h // s[0]), -(-w // s[1])
+        pad_h = max((oh - 1) * s[0] + kh - h, 0)
+        pad_w = max((ow - 1) * s[1] + kw - w, 0)
+        x = jnp.pad(x, ((0, 0), (pad_h // 2, pad_h - pad_h // 2),
+                        (pad_w // 2, pad_w - pad_w // 2), (0, 0)))
+    cols = _im2col(x, kh, kw, s[0], s[1])          # [N,oh,ow,kh*kw*C]
+    n, oh, ow, _ = cols.shape
+    patches = jnp.abs(cols.reshape(n, oh, ow, kh * kw, x.shape[-1]))
+    m = jnp.maximum(jnp.max(patches, axis=3), 1e-30)
+    scaled = jnp.sum((patches / m[:, :, :, None, :]) ** p, axis=3)
+    return m * scaled ** (1.0 / p)
 
 
 def _col2im(cols, h, w, kh, kw, sh=1, sw=1, ph=0, pw=0):

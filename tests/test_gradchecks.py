@@ -400,6 +400,15 @@ SMOOTH_LOSS_DATA = {
     "squared_hinge": ("identity", lambda b, n: 2.0 * _r().integers(0, 2, (b, n)) - 1.0),
     "wasserstein": ("identity", lambda b, n: 2.0 * _r().integers(0, 2, (b, n)) - 1.0),
     "fmeasure": ("sigmoid", lambda b, n: _r().integers(0, 2, (b, n)).astype(np.float64)),
+    # |err| = delta kink is measure-zero under random labels
+    "huber": ("identity", lambda b, n: _r().normal(size=(b, n))),
+    "log_poisson": ("identity", lambda b, n: _r().uniform(0.1, 3.0, (b, n))),
+    # labels fixed during the check: the labels>1 Stirling gate is constant
+    "log_poisson_full": ("identity", lambda b, n: _r().uniform(0.1, 3.0, (b, n))),
+    "weighted_cross_entropy_with_logits": (
+        "identity", lambda b, n: _r().integers(0, 2, (b, n)).astype(np.float64)),
+    "mean_pairwise_squared_error": (
+        "identity", lambda b, n: _r().normal(size=(b, n))),
 }
 
 
