@@ -59,11 +59,16 @@ def measure(per_device_batch: int = 32, steps: int = 8,
         for _ in range(warmup):
             loss = trainer.fit_batch(ds, key)
         float(loss)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            loss = trainer.fit_batch(ds, key)
-        float(loss)
-        dt = (time.perf_counter() - t0) / steps
+        # best-of-3: host-load noise on the shared virtual devices was
+        # ±2x run to run (BENCH_r03 vs r04 spreads); min is the stable
+        # estimator of the program's actual cost
+        dt = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = trainer.fit_batch(ds, key)
+            float(loss)
+            dt = min(dt, (time.perf_counter() - t0) / steps)
         rows.append({"dp": dp, "global_batch": batch,
                      "step_ms": round(dt * 1000, 2),
                      "img_per_sec": round(batch / dt, 1)})
