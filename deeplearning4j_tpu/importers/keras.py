@@ -354,6 +354,63 @@ def _convert_layer(kcfg: dict):
         from deeplearning4j_tpu.nn.layers import TimeDistributed
         inner = _convert_layer(conf["layer"])
         return TimeDistributed(name=name, underlying=inner)
+    if cls == "Permute":
+        from deeplearning4j_tpu.nn.layers import PermuteLayer
+        return PermuteLayer(name=name, dims=tuple(conf["dims"]))
+    if cls == "SeparableConv1D":
+        from deeplearning4j_tpu.nn.layers import SeparableConvolution1D
+        if conf.get("padding") == "causal":
+            raise KeyError("unsupported Keras SeparableConv1D "
+                           "padding='causal'")
+        return SeparableConvolution1D(
+            name=name, n_out=conf["filters"],
+            kernel_size=_one(conf["kernel_size"]),
+            stride=_one(conf.get("strides", 1)),
+            depth_multiplier=conf.get("depth_multiplier", 1),
+            convolution_mode="same" if conf.get("padding") == "same" else "truncate",
+            activation=_act(conf.get("activation")),
+            has_bias=conf.get("use_bias", True))
+    if cls == "ConvLSTM2D":
+        from deeplearning4j_tpu.nn.layers import ConvLSTM2D
+        return ConvLSTM2D(
+            name=name, n_out=conf["filters"],
+            kernel_size=tuple(conf["kernel_size"]),
+            stride=tuple(conf.get("strides", (1, 1))),
+            convolution_mode="same" if conf.get("padding") == "same" else "truncate",
+            return_sequences=conf.get("return_sequences", False),
+            activation=_act(conf.get("activation", "tanh")),
+            gate_activation=_act(conf.get("recurrent_activation", "sigmoid")),
+            has_bias=conf.get("use_bias", True))
+    if cls == "LocallyConnected2D":
+        from deeplearning4j_tpu.nn.layers import LocallyConnected2D
+        if conf.get("padding", "valid") != "valid":
+            raise KeyError("Keras LocallyConnected2D supports only "
+                           "padding='valid'")
+        return LocallyConnected2D(
+            name=name, n_out=conf["filters"],
+            kernel=tuple(conf["kernel_size"]),
+            stride=tuple(conf.get("strides", (1, 1))),
+            per_position_bias=True,
+            activation=_act(conf.get("activation")),
+            has_bias=conf.get("use_bias", True))
+    if cls == "LocallyConnected1D":
+        from deeplearning4j_tpu.nn.layers import LocallyConnected1D
+        if conf.get("padding", "valid") != "valid":
+            raise KeyError("Keras LocallyConnected1D supports only "
+                           "padding='valid'")
+        return LocallyConnected1D(
+            name=name, n_out=conf["filters"],
+            kernel=_one(conf["kernel_size"]),
+            stride=_one(conf.get("strides", 1)),
+            per_position_bias=True,
+            activation=_act(conf.get("activation")),
+            has_bias=conf.get("use_bias", True))
+    if cls == "Masking":
+        # handled in import_sequential (wraps the NEXT recurrent layer in
+        # MaskZeroLayer — DL4J's KerasMasking does the same)
+        raise KeyError("Masking must be followed by a recurrent layer "
+                       "(Sequential importer wraps it; standalone "
+                       "Masking has no layer equivalent)")
     if cls == "MultiHeadAttention":
         # handled specially in import_functional (multi-input layer);
         # reaching here means a Sequential placement, which Keras itself
@@ -442,8 +499,17 @@ def import_sequential(model_json: str,
     layer_cfgs = kmodel["config"]["layers"]
     our_layers = []
     flatten_pending = False
+    mask_pending = None     # Keras Masking → wrap the next layer
     for kcfg in layer_cfgs:
+        if kcfg.get("class_name") == "Masking":
+            mask_pending = kcfg["config"].get("mask_value", 0.0)
+            continue
         layer = _convert_layer(kcfg)
+        if layer is not None and mask_pending is not None:
+            from deeplearning4j_tpu.nn.layers import MaskZeroLayer
+            layer = MaskZeroLayer(name=layer.name, underlying=layer,
+                                  mask_value=mask_pending)
+            mask_pending = None
         if layer is None:
             # Keras Flatten is explicit; our framework flattens lazily via
             # preprocessors only when a layer DEMANDS ff input.  A layer
@@ -481,8 +547,10 @@ def load_weights(net: MultiLayerNetwork, weights: dict[str, list[np.ndarray]]) -
             continue
         arrays = [np.asarray(a) for a in weights[layer.name]]
         params = net.params_[i]
-        if isinstance(layer, LastTimeStep):
-            layer = layer.underlying      # params delegate to the wrapped cell
+        # unwrap param-delegating wrappers (possibly nested: Masking →
+        # MaskZeroLayer(LastTimeStep(LSTM)))
+        while isinstance(layer, LastTimeStep) or _is(layer, "MaskZeroLayer"):
+            layer = layer.underlying
         if isinstance(layer, Bidirectional) and isinstance(layer.fwd, LSTM):
             # keras order: fwd (W,U,b) then bwd (W,U,b), each IFCO
             h = layer.fwd.n_out
@@ -551,6 +619,35 @@ def load_weights(net: MultiLayerNetwork, weights: dict[str, list[np.ndarray]]) -
             params["W"] = np.flip(w, (0, 1)).transpose(0, 1, 3, 2).copy()
             if len(arrays) > 1:
                 params["b"] = np.asarray(arrays[1])
+        elif _is(layer, "ConvLSTM2D"):
+            # keras: [kernel (kh,kw,cin,4F), recurrent (kh,kw,F,4F),
+            # bias (4F)], gate order i,f,c,o — our layer uses the same
+            # order, so assignment is direct
+            params["W"] = np.asarray(arrays[0])
+            params["U"] = np.asarray(arrays[1])
+            if len(arrays) > 2:
+                params["b"] = np.asarray(arrays[2])
+        elif _is(layer, "LocallyConnected2D"):
+            # keras kernel (oh*ow, kh*kw*cin, F) → ours (oh, ow, fan, F);
+            # bias (oh, ow, F) is per-position (imported layers set
+            # per_position_bias)
+            w = np.asarray(arrays[0])
+            params["W"] = w.reshape(params["W"].shape)
+            if len(arrays) > 1:
+                params["b"] = np.asarray(arrays[1]).reshape(params["b"].shape)
+        elif _is(layer, "LocallyConnected1D"):
+            params["W"] = np.asarray(arrays[0]).reshape(params["W"].shape)
+            if len(arrays) > 1:
+                params["b"] = np.asarray(arrays[1]).reshape(params["b"].shape)
+        elif _is(layer, "SeparableConvolution1D"):
+            # keras: depthwise (k, cin, mult) → (k, 1, cin*mult)
+            # (channel-major flatten, same as the 2-D separable layout)
+            depth = np.asarray(arrays[0])
+            k, cin, mult = depth.shape
+            params["depthW"] = depth.reshape(k, 1, cin * mult)
+            params["pointW"] = np.asarray(arrays[1])
+            if len(arrays) > 2:
+                params["b"] = np.asarray(arrays[2])
         elif _is(layer, "SelfAttentionLayer"):
             # keras MultiHeadAttention: q/k/v kernels [D,H,dh] (+bias
             # [H,dh]), output kernel [H,dh,D] (+bias [D])

@@ -12,13 +12,15 @@ grads, and shards like native code.  ONNX's NCHW/OIHW conventions are
 executed natively via ``lax.conv_general_dilated`` dimension numbers
 (XLA:TPU re-lays-out internally; no host-side transposes).
 
-Scope: ~95 ops — the inference set for MLP/CNN/transformer classifier
-exports: the conv/pool/norm families (Conv, ConvTranspose, LRN,
-Instance/Layer/BatchNormalization), the activation catalog, variadic
-and comparison arithmetic, the Reduce* family (attr- and input-axes
-forms), and shape/structure ops (Slice/Split/Pad/Expand/Tile/TopK/
-CumSum/Trilu/Einsum/...).  Unsupported node types fail at import with
-the full supported-op list.
+Scope: ~100 ops — the inference set for MLP/CNN/RNN/transformer
+classifier exports: the conv/pool/norm families (Conv, ConvTranspose,
+LRN, Instance/Layer/BatchNormalization), the recurrent family
+(LSTM/GRU/RNN — see :mod:`onnx_rnn`), control flow (If/Loop/Scan →
+lax.cond/lax.scan), the activation catalog, variadic and comparison
+arithmetic, the Reduce* family (attr- and input-axes forms), and
+shape/structure ops (Slice/Split/Pad/Expand/Tile/TopK/CumSum/Trilu/
+Einsum/...).  Unsupported node types (incl. inside subgraphs) fail at
+import with the full supported-op list.
 """
 
 from __future__ import annotations
@@ -56,6 +58,7 @@ def onnx_op(name):
 
 #  AttributeProto.AttributeType enum values (public onnx.proto)
 _ATTR_FLOAT, _ATTR_INT, _ATTR_STRING, _ATTR_TENSOR = 1, 2, 3, 4
+_ATTR_GRAPH = 5
 _ATTR_FLOATS, _ATTR_INTS, _ATTR_STRINGS = 6, 7, 8
 
 
@@ -76,6 +79,8 @@ def _attrs(node: dict) -> dict[str, Any]:
             out[name] = a.get("s", b"").decode("utf-8")
         elif atype == _ATTR_TENSOR or (atype is None and "t" in a):
             out[name] = wire.tensor_to_array(a.get("t", {}))
+        elif atype == _ATTR_GRAPH or (atype is None and "g" in a):
+            out[name] = a.get("g", {})   # subgraph dict (If/Loop/Scan)
         elif atype == _ATTR_INTS or (atype is None and "ints" in a):
             out[name] = list(a.get("ints", []))
         elif atype == _ATTR_FLOATS or (atype is None and "floats" in a):
@@ -554,6 +559,10 @@ def _arg_reduce(jnp_name):
             out = x.shape[axis] - 1 - rev
         else:
             out = getattr(jnp, jnp_name)(x, axis=axis)
+        # ONNX requires int64 output; under default jax config (x64 off)
+        # this intentionally narrows to int32 — indices are bounded by the
+        # reduced axis length, so narrowing is lossless for any importable
+        # graph (documented deviation; enable jax x64 for strict parity)
         out = out.astype(jnp.int64)
         if attrs.get("keepdims", 1):
             out = jnp.expand_dims(out, axis)
@@ -876,6 +885,25 @@ def _layer_norm_op(inputs, attrs):
 
 
 # ------------------------------------------------------------------ graph
+def _run_nodes(nodes, env: dict) -> None:
+    """Execute a topologically-sorted node list under ``env`` — the ONE
+    node-execution loop, shared by :meth:`OnnxModel.__call__` and the
+    control-flow subgraph bodies (:mod:`onnx_rnn`), so top-level graphs
+    and If/Loop/Scan bodies can never drift apart semantically."""
+    for node in nodes:
+        ins = [env[n] if n else None for n in node.get("input", [])]
+        attrs = _attrs(node)
+        # arity-dependent ops (Split) need the declared output count,
+        # which lives on the node, not in its attributes
+        attrs["_n_outputs"] = len(node.get("output", []))
+        # control-flow subgraphs see the enclosing scope
+        attrs["_env"] = env
+        out = _OPS[node["op_type"]](ins, attrs)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        for name, val in zip(node.get("output", []), outs):
+            env[name] = val
+
+
 class OnnxModel:
     """Parsed ONNX graph bound to a pure, jittable forward function
     (``OnnxFrameworkImporter.runImport`` → SameDiff parity)."""
@@ -894,7 +922,16 @@ class OnnxModel:
                             if vi["name"] not in self.initializers]
         self.output_names = [vi["name"] for vi in g.get("output", [])]
         self._device_inits = None   # populated lazily on first call
-        unknown = {n["op_type"] for n in self.nodes} - set(_OPS)
+
+        def collect_ops(nodes, acc):
+            for n in nodes:
+                acc.add(n["op_type"])
+                for a in n.get("attribute", []):
+                    if isinstance(a.get("g"), dict):   # If/Loop/Scan bodies
+                        collect_ops(a["g"].get("node", []), acc)
+            return acc
+
+        unknown = collect_ops(self.nodes, set()) - set(_OPS)
         if unknown:
             raise NotImplementedError(
                 f"unsupported ONNX ops: {sorted(unknown)} "
@@ -946,16 +983,7 @@ class OnnxModel:
         p_token = _precision_var.set(self.precision)
         o_token = _opset_var.set(self.opset)
         try:
-            for node in self.nodes:  # ONNX graphs are topologically sorted
-                ins = [env[n] if n else None for n in node.get("input", [])]
-                attrs = _attrs(node)
-                # arity-dependent ops (Split) need the declared output
-                # count, which lives on the node, not in its attributes
-                attrs["_n_outputs"] = len(node.get("output", []))
-                out = _OPS[node["op_type"]](ins, attrs)
-                outs = out if isinstance(out, (tuple, list)) else (out,)
-                for name, val in zip(node.get("output", []), outs):
-                    env[name] = val
+            _run_nodes(self.nodes, env)  # ONNX graphs are topo-sorted
         finally:
             _precision_var.reset(p_token)
             _opset_var.reset(o_token)
@@ -974,3 +1002,8 @@ def import_onnx_model(path_or_bytes, precision: str = "highest") -> OnnxModel:
     ``precision="default"`` trades source-model fidelity for the TPU's
     fast bf16 matmul pass."""
     return OnnxModel.load(path_or_bytes, precision=precision)
+
+
+# recurrent + control-flow handlers register themselves into _OPS
+# (import at the bottom: onnx_rnn imports names defined above)
+from deeplearning4j_tpu.importers import onnx_rnn as _onnx_rnn  # noqa: E402,F401

@@ -117,6 +117,11 @@ GRAPH = {
     12: ("output", ("repeated", VALUE_INFO)),
 }
 
+# subgraph attributes (If/Loop/Scan bodies): AttributeProto.g is field 6.
+# Assigned after GRAPH exists — the schema is mutually recursive
+# (GRAPH → NODE → ATTRIBUTE → GRAPH).
+ATTRIBUTE[6] = ("g", GRAPH)
+
 MODEL = {
     1: ("ir_version", "varint"),
     5: ("model_version", "varint"),
@@ -254,7 +259,8 @@ def emit(schema: dict, data: dict) -> bytes:
 DTYPES = {1: np.float32, 6: np.int32, 7: np.int64, 9: np.bool_,
           11: np.float64, 10: np.float16}
 DTYPE_TO_ONNX = {np.dtype(np.float32): 1, np.dtype(np.int64): 7,
-                 np.dtype(np.int32): 6, np.dtype(np.float64): 11}
+                 np.dtype(np.int32): 6, np.dtype(np.float64): 11,
+                 np.dtype(np.bool_): 9, np.dtype(np.float16): 10}
 
 
 def tensor_to_array(t: dict) -> np.ndarray:

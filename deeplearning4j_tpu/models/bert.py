@@ -236,7 +236,8 @@ def pipeline_stages(config: BertConfig, params: dict, n_stages: int):
     Stage 0 owns embeddings (+ first encoder layers), middle stages own
     encoder layers, the last stage owns its layers + the MLM head (tied
     decode uses a COPY of the word embeddings in the last stage's params;
-    its gradient contribution is accounted to that copy).  Returns
+    apply :func:`merge_tied_embedding_grads` to each step's grads to keep
+    the two copies exactly tied under training).  Returns
     ``(stage_fns, stage_params)``; the pipeline input is
     ``input_ids.astype(float32)`` ([B, T]) and the last stage's output is
     the MLM logits ([B, T, V]).
@@ -280,6 +281,33 @@ def pipeline_stages(config: BertConfig, params: dict, n_stages: int):
 
         stage_fns.append(fn)
     return stage_fns, stage_params
+
+
+def merge_tied_embedding_grads(stage_grads):
+    """Re-tie the pipelined MLM decode weights to stage 0's embedding
+    table.
+
+    :func:`pipeline_stages` gives the LAST stage an independent copy of
+    ``word_embeddings`` (``decode_embeddings``) for the tied decode; a
+    single pipeline step therefore produces the embedding gradient split
+    across two leaves.  This sums the two and writes the total into BOTH
+    leaves, so under any per-leaf elementwise updater the two copies —
+    identical at init — receive identical updates every step and stay
+    exactly tied; multi-step training then matches the dense
+    :func:`mlm_loss` model (which owns a single shared table).  Call it
+    on the grads returned by ``pipeline_train_step`` before the updater.
+    """
+    grads = list(stage_grads)
+    first = dict(grads[0])
+    last = dict(grads[-1])
+    emb = dict(first["embeddings"])
+    total = emb["word_embeddings"] + last["decode_embeddings"]
+    emb["word_embeddings"] = total
+    first["embeddings"] = emb
+    last["decode_embeddings"] = total
+    grads[0] = first
+    grads[-1] = last
+    return tuple(grads)
 
 
 def mlm_loss_from_logits(logits, packed_labels):

@@ -50,14 +50,41 @@ int64_t threshold_count(const float* grad, int64_t n, float threshold) {
 
 // Passes 2+3 fused: write the message. `out` must hold 3 + max_elements
 // int32s. Returns number of encoded indices (clamped to max_elements).
+// When more than max_elements entries exceed the threshold, the cap keeps
+// the LARGEST |values| (ties -> lower index), indices ascending on the
+// wire — identical semantics to the numpy oracle and the device twin, so
+// mixed native/python hosts stay bitwise-identical.
 int64_t threshold_encode(const float* grad, int64_t n, float threshold,
                          int32_t* out, int64_t max_elements) {
+    if (max_elements < 0) max_elements = 0;
     int64_t written = 0;
-    for (int64_t i = 0; i < n && written < max_elements; ++i) {
+    bool overflow = false;
+    for (int64_t i = 0; i < n; ++i) {
         float g = grad[i];
         if (std::fabs(g) >= threshold) {
+            if (written == max_elements) { overflow = true; break; }
             int64_t idx1 = i + 1;
             out[3 + written] = (int32_t)(g >= 0.0f ? idx1 : -idx1);
+            ++written;
+        }
+    }
+    if (overflow && max_elements > 0) {
+        // slow path: full hit list, partial-select top-k by magnitude
+        std::vector<int64_t> hits;
+        for (int64_t i = 0; i < n; ++i)
+            if (std::fabs(grad[i]) >= threshold) hits.push_back(i);
+        auto larger = [&](int64_t a, int64_t b) {
+            float fa = std::fabs(grad[a]), fb = std::fabs(grad[b]);
+            return fa != fb ? fa > fb : a < b;
+        };
+        std::nth_element(hits.begin(), hits.begin() + max_elements - 1,
+                         hits.end(), larger);
+        hits.resize(max_elements);
+        std::sort(hits.begin(), hits.end());
+        written = 0;
+        for (int64_t i : hits) {
+            int64_t idx1 = i + 1;
+            out[3 + written] = (int32_t)(grad[i] >= 0.0f ? idx1 : -idx1);
             ++written;
         }
     }

@@ -89,6 +89,9 @@ from deeplearning4j_tpu.nn.layers.extra import (
     CapsuleStrengthLayer,
     RecurrentAttentionLayer,
     MixtureOfExperts,
+    PermuteLayer,
+    SeparableConvolution1D,
+    ConvLSTM2D,
 )
 
 __all__ = [
@@ -114,4 +117,5 @@ __all__ = [
     "Yolo2OutputLayer", "VariationalAutoencoder", "PrimaryCapsules",
     "CapsuleLayer", "CapsuleStrengthLayer", "RecurrentAttentionLayer",
     "MixtureOfExperts", "FusedBottleneck",
+    "PermuteLayer", "SeparableConvolution1D", "ConvLSTM2D",
 ]

@@ -350,6 +350,9 @@ class LocallyConnected2D(Layer):
     stride: Any = 1
     padding: Any = 0
     has_bias: bool = True
+    # Keras LocallyConnected2D learns one bias PER OUTPUT POSITION
+    # ([oh, ow, nOut]); DL4J shares it ([nOut]).  Import sets this flag.
+    per_position_bias: bool = False
 
     def _geom(self, input_type):
         kh, kw = _pair(self.kernel)
@@ -370,7 +373,9 @@ class LocallyConnected2D(Layer):
         params = {"W": self._init_weight(key, (oh, ow, fan_in, self.n_out),
                                          fan_in, self.n_out)}
         if self.has_bias:
-            params["b"] = self._init_bias((self.n_out,))
+            shape = ((oh, ow, self.n_out) if self.per_position_bias
+                     else (self.n_out,))
+            params["b"] = self._init_bias(shape)
         return params
 
     def _patches(self, x, kh, kw, sh, sw, oh, ow):
@@ -415,6 +420,7 @@ class LocallyConnected1D(Layer):
     stride: int = 1
     padding: int = 0
     has_bias: bool = True
+    per_position_bias: bool = False   # Keras parity: bias [ot, nOut]
 
     def transform_mask(self, mask):
         return None   # time length changes without a step correspondence
@@ -438,7 +444,9 @@ class LocallyConnected1D(Layer):
         params = {"W": self._init_weight(key, (ot, fan_in, self.n_out),
                                          fan_in, self.n_out)}
         if self.has_bias:
-            params["b"] = self._init_bias((self.n_out,))
+            shape = ((ot, self.n_out) if self.per_position_bias
+                     else (self.n_out,))
+            params["b"] = self._init_bias(shape)
         return params
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
@@ -1017,3 +1025,210 @@ class MixtureOfExperts(Layer):
         if mask is not None and y.ndim == 3:
             y = y * mask[..., None].astype(y.dtype)
         return y, state
+
+
+# ============================================== keras-import tail (round 5)
+@register_layer("permute")
+@dataclasses.dataclass
+class PermuteLayer(Layer):
+    """Permute the non-batch axes (Keras ``Permute`` parity; DL4J
+    ``KerasPermute`` → preprocessor).  ``dims`` are 1-indexed positions
+    of the INPUT axes (batch excluded), Keras convention."""
+
+    dims: Any = (1,)
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind == "rnn" and input_type.timesteps is None:
+            raise ValueError(
+                "Permute over a dynamic-length recurrent input needs a "
+                "fixed timesteps on the recurrent InputType (the time "
+                "axis becomes the feature axis)")
+        shape = input_type.batch_shape()[1:]
+        if len(self.dims) != len(shape):
+            raise ValueError(f"Permute dims {self.dims} rank != input "
+                             f"rank {len(shape)}")
+        new = tuple(shape[d - 1] for d in self.dims)
+        if input_type.kind == "rnn":
+            return InputType.recurrent(new[1], new[0])
+        if input_type.kind == "cnn":
+            return InputType.convolutional(new[0], new[1], new[2])
+        if input_type.kind == "ff":
+            return input_type
+        raise ValueError(f"Permute over {input_type.kind} input")
+
+    def transform_mask(self, mask):
+        return None   # the time axis moves; no step correspondence
+
+    def init_params(self, key, input_type):
+        return {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return jnp.transpose(x, (0,) + tuple(self.dims)), state
+
+
+@register_layer("separable_conv1d")
+@dataclasses.dataclass
+class SeparableConvolution1D(Layer):
+    """Depthwise-separable 1-D conv over [B, T, C] (Keras
+    ``SeparableConv1D`` parity; libnd4j sconv via the grouped-conv
+    lowering).  depthW [k, 1, C*mult] (group-major channel flatten,
+    matching the 2-D separable layout), pointW [1, C*mult, nOut]."""
+
+    INPUT_KIND = "rnn"
+
+    n_out: int = 0
+    kernel_size: int = 3
+    stride: int = 1
+    depth_multiplier: int = 1
+    convolution_mode: str = "truncate"
+    has_bias: bool = True
+
+    def transform_mask(self, mask):
+        if self.stride == 1 and self.convolution_mode == "same":
+            return mask
+        return None
+
+    def _out_len(self, t):
+        if t is None:
+            return None
+        if self.convolution_mode == "same":
+            return -(-t // self.stride)
+        return (t - self.kernel_size) // self.stride + 1
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out,
+                                   self._out_len(input_type.timesteps))
+
+    def init_params(self, key, input_type):
+        cin = input_type.size
+        mid = cin * self.depth_multiplier
+        k1, k2 = jax.random.split(key)
+        params = {
+            "depthW": self._init_weight(
+                k1, (self.kernel_size, 1, mid), self.kernel_size,
+                self.kernel_size * self.depth_multiplier),
+            "pointW": self._init_weight(k2, (1, mid, self.n_out),
+                                        mid, self.n_out),
+        }
+        if self.has_bias:
+            params["b"] = self._init_bias((self.n_out,))
+        return params
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self._maybe_dropout(x, train, rng)
+        policy = dtype_policy()
+        pad = "SAME" if self.convolution_mode == "same" else "VALID"
+        dn = jax.lax.conv_dimension_numbers(x.shape, params["depthW"].shape,
+                                            ("NWC", "WIO", "NWC"))
+        y = jax.lax.conv_general_dilated(
+            x.astype(policy.compute_dtype),
+            params["depthW"].astype(policy.compute_dtype),
+            (self.stride,), pad, dimension_numbers=dn,
+            feature_group_count=x.shape[-1])
+        y = jax.lax.conv_general_dilated(
+            y, params["pointW"].astype(policy.compute_dtype),
+            (1,), "VALID", dimension_numbers=dn)
+        if self.has_bias:
+            y = y + params["b"].astype(y.dtype)
+        y = y.astype(policy.output_dtype)
+        return activations.get(self.activation or "identity")(y), state
+
+
+@register_layer("conv_lstm2d")
+@dataclasses.dataclass
+class ConvLSTM2D(Layer):
+    """Convolutional LSTM over [B, T, H, W, C] (Keras ``ConvLSTM2D``
+    parity — xingjian et al.'s ConvLSTM).  Gate order follows Keras's
+    i,f,c,o so imported kernels map without permutation: W [kh,kw,Cin,4F]
+    convolves the input (``convolution_mode`` + stride), U [kh,kw,F,4F]
+    convolves the hidden state (always SAME, spatial dims preserved).
+    One ``lax.scan`` over time; the 4-gate convs batch into single MXU
+    convolutions per step."""
+
+    INPUT_KIND = "cnn3d"
+
+    n_out: int = 0
+    kernel_size: Any = (3, 3)
+    stride: Any = (1, 1)
+    convolution_mode: str = "truncate"
+    return_sequences: bool = False
+    gate_activation: str = "sigmoid"
+    has_bias: bool = True
+
+    def _spatial_out(self, h, w):
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        if self.convolution_mode == "same":
+            return -(-h // sh), -(-w // sw)
+        return (h - kh) // sh + 1, (w - kw) // sw + 1
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        oh, ow = self._spatial_out(input_type.height, input_type.width)
+        if self.return_sequences:
+            return InputType.convolutional3d(input_type.depth, oh, ow,
+                                             self.n_out)
+        return InputType.convolutional(oh, ow, self.n_out)
+
+    def init_params(self, key, input_type):
+        kh, kw = self.kernel_size
+        cin = input_type.channels
+        k1, k2 = jax.random.split(key)
+        params = {
+            "W": self._init_weight(k1, (kh, kw, cin, 4 * self.n_out),
+                                   kh * kw * cin, kh * kw * self.n_out),
+            "U": self._init_weight(k2, (kh, kw, self.n_out, 4 * self.n_out),
+                                   kh * kw * self.n_out,
+                                   kh * kw * self.n_out),
+        }
+        if self.has_bias:
+            params["b"] = self._init_bias((4 * self.n_out,))
+        return params
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self._maybe_dropout(x, train, rng)
+        policy = dtype_policy()
+        cd = policy.compute_dtype
+        B, T = x.shape[0], x.shape[1]
+        F = self.n_out
+        pad = "SAME" if self.convolution_mode == "same" else "VALID"
+        gate = activations.get(self.gate_activation)
+        act = activations.get(self.activation or "tanh")
+        W = params["W"].astype(cd)
+        U = params["U"].astype(cd)
+        dn = ("NHWC", "HWIO", "NHWC")
+
+        def in_conv(xt):
+            d = jax.lax.conv_dimension_numbers(xt.shape, W.shape, dn)
+            return jax.lax.conv_general_dilated(
+                xt.astype(cd), W, tuple(self.stride), pad,
+                dimension_numbers=d)
+
+        # all timesteps' input convolutions in one batched conv
+        zx = in_conv(x.reshape((B * T,) + x.shape[2:]))
+        zx = zx.reshape((B, T) + zx.shape[1:])
+        if self.has_bias:
+            zx = zx + params["b"].astype(cd)
+        oh, ow = zx.shape[2], zx.shape[3]
+        h0 = jnp.zeros((B, oh, ow, F), cd)
+        c0 = jnp.zeros((B, oh, ow, F), cd)
+
+        def step(carry, zt):
+            h, c = carry
+            d = jax.lax.conv_dimension_numbers(h.shape, U.shape, dn)
+            z = zt + jax.lax.conv_general_dilated(
+                h, U, (1, 1), "SAME", dimension_numbers=d)
+            i = gate(z[..., :F])
+            f = gate(z[..., F:2 * F])
+            cc = z[..., 2 * F:3 * F]
+            o = gate(z[..., 3 * F:])
+            c = f * c + i * act(cc)
+            h = o * act(c)
+            return (h, c), h
+
+        (hT, _), ys = jax.lax.scan(step, (h0, c0),
+                                   jnp.moveaxis(zx, 1, 0))
+        if self.return_sequences:
+            y = jnp.moveaxis(ys, 0, 1)          # [B, T, oh, ow, F]
+        else:
+            y = hT
+        return y.astype(policy.output_dtype), state

@@ -61,6 +61,14 @@ def adapt_type(current: InputType, layer) -> InputType:
             "cannot infer CNN dims from flat feed-forward input — use "
             "InputType.convolutional_flat(h, w, c) as the network input type")
     if want == "ff":
+        if current.kind == "rnn":
+            # runtime twin reshapes [B,T,C] → [B,T*C] (Keras Flatten
+            # semantics); flat_size() would drop the time axis
+            if current.timesteps is None:
+                raise ValueError(
+                    "flattening a dynamic-length recurrent input needs a "
+                    "fixed timesteps on the recurrent InputType")
+            return InputType.feed_forward(current.size * current.timesteps)
         return InputType.feed_forward(current.flat_size())
     if want == "rnn" and current.kind == "ff":
         return InputType.recurrent(current.size, 1)

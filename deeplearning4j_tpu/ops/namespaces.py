@@ -128,8 +128,10 @@ math = SimpleNamespace(
     merge_avg=lambda xs: jnp.mean(jnp.stack(xs), axis=0),
     merge_add=lambda xs: jnp.sum(jnp.stack(xs), axis=0),
     # clip family beyond value/norm
+    # average norm = ||x||2 / N (TF clip_by_average_norm / libnd4j
+    # clipbyavgnorm), not RMS
     clip_by_avg_norm=lambda x, n: x * jnp.minimum(
-        1.0, n / jnp.maximum(_norm2(x) / jnp.sqrt(float(jnp.size(x))), 1e-12)),
+        1.0, n / jnp.maximum(_norm2(x) / float(jnp.size(x)), 1e-12)),
     clip_by_global_norm=_clip_by_global_norm,
     percentile=lambda x, q, axis=None: jnp.percentile(x, q, axis=axis),
     nth_element=lambda x, n, reverse=False: (

@@ -32,6 +32,18 @@ FLAG_SIGN_IDX = 0      # 1-bit ±τ format (reference encodeThreshold parity)
 FLAG_VALUE_SPARSE = 1  # sparse index+VALUE format (top-τ sparsification)
 
 
+def _largest_by_magnitude(flat: np.ndarray, hits: np.ndarray,
+                          k: int) -> np.ndarray:
+    """When a capacity cap truncates the hit list, keep the k LARGEST
+    |values| (true top-τ semantics) rather than the first k by index —
+    error feedback recovers the rest, but the big entries should never
+    be the ones deferred.  Deterministic across all three codec twins
+    (numpy / C++ / device): ties at the boundary resolve to the LOWER
+    index, and the returned indices are ascending."""
+    order = np.lexsort((hits, -np.abs(flat[hits])))
+    return np.sort(hits[order[:k]])
+
+
 def threshold_encode(grad: np.ndarray, threshold: float,
                      max_elements: Optional[int] = None) -> np.ndarray:
     """3-pass threshold encode (P1 count → P2 prefix/index → P3 extract,
@@ -41,7 +53,7 @@ def threshold_encode(grad: np.ndarray, threshold: float,
     flat = np.ravel(np.asarray(grad, dtype=np.float32))
     hits = np.nonzero(np.abs(flat) >= threshold)[0]
     if max_elements is not None and hits.size > max_elements:
-        hits = hits[:max_elements]
+        hits = _largest_by_magnitude(flat, hits, max_elements)
     signs = np.where(flat[hits] >= 0, 1, -1).astype(np.int64)
     encoded = (signs * (hits + 1)).astype(np.int32)
     header = np.array([encoded.size, FLAG_SIGN_IDX,
@@ -61,7 +73,7 @@ def threshold_encode_values(grad: np.ndarray, threshold: float,
     flat = np.ravel(np.asarray(grad, dtype=np.float32))
     hits = np.nonzero(np.abs(flat) >= threshold)[0]
     if max_elements is not None and hits.size > max_elements:
-        hits = hits[:max_elements]
+        hits = _largest_by_magnitude(flat, hits, max_elements)
     header = np.array([hits.size, FLAG_VALUE_SPARSE,
                        np.float32(threshold).view(np.int32)], dtype=np.int32)
     return np.concatenate([header, (hits + 1).astype(np.int32),
@@ -169,16 +181,21 @@ class EncodedGradientsAccumulator:
 
     def __init__(self, shape: tuple,
                  algorithm: Optional[AdaptiveThresholdAlgorithm] = None,
-                 use_native: bool = True, value_coded: bool = False):
+                 use_native: bool = True, value_coded: bool = False,
+                 max_elements: Optional[int] = None):
         """``value_coded`` switches the wire format from the reference's
         1-bit ±τ quantization to top-τ value sparsification
         (:func:`threshold_encode_values`) — exact at transmitted
         coordinates, residual = sub-τ tail only.  The native C++ codec
-        implements only the 1-bit form, so value mode encodes in numpy."""
+        implements only the 1-bit form, so value mode encodes in numpy.
+        ``max_elements`` caps the message at the top-|v| entries — set it
+        to the device twin's ``capacity`` to make host- and device-encoded
+        wires bitwise-identical even under overflow."""
         self.shape = tuple(shape)
         self.residual = np.zeros(int(np.prod(shape)), dtype=np.float32)
         self.algorithm = algorithm or AdaptiveThresholdAlgorithm()
         self.value_coded = value_coded
+        self.max_elements = max_elements
         self._codec = None
         if use_native and not value_coded:
             try:
@@ -191,11 +208,14 @@ class EncodedGradientsAccumulator:
         self.residual += np.ravel(np.asarray(grad, dtype=np.float32))
         threshold = self.algorithm.current()
         if self._codec is not None:
-            message = self._codec.threshold_encode(self.residual, threshold)
+            message = self._codec.threshold_encode(
+                self.residual, threshold, max_elements=self.max_elements)
         elif self.value_coded:
-            message = threshold_encode_values(self.residual, threshold)
+            message = threshold_encode_values(
+                self.residual, threshold, max_elements=self.max_elements)
         else:
-            message = threshold_encode(self.residual, threshold)
+            message = threshold_encode(self.residual, threshold,
+                                       max_elements=self.max_elements)
         n_encoded = int(message[0])
         self.algorithm.update(n_encoded, self.residual.size)
         decoded = threshold_decode(message, (self.residual.size,))
@@ -208,6 +228,30 @@ class EncodedGradientsAccumulator:
 
 
 # ---------------------------------------------------------------- device side
+def _select_indices_device(mask, flat, capacity: int):
+    """Shared hit-selection for the device encoders: ascending indices of
+    the (≤ capacity) super-threshold entries; on overflow, the capacity
+    LARGEST |values| (ties → lower index; XLA top-k is index-stable) —
+    the single source of the truncation semantics all three codec twins
+    must match bitwise.  Returns (idx [capacity], count)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    total = jnp.sum(mask)
+    count = jnp.minimum(total, capacity).astype(jnp.int32)
+
+    def first_k(_):
+        return jnp.nonzero(mask, size=capacity, fill_value=flat.size)[0]
+
+    def top_k_mag(_):
+        scores = jnp.where(mask, jnp.abs(flat), -1.0)
+        _, idx = lax.top_k(scores, capacity)
+        return jnp.sort(idx)
+
+    idx = lax.cond(total > capacity, top_k_mag, first_k, None)
+    return idx, count
+
+
 def threshold_encode_device(grad, threshold, capacity: int):
     """jit-safe on-device threshold encode (same wire format, fixed
     ``capacity``): int32 [3 + capacity] = [count, flag, τ_bits, ±(idx+1)…,
@@ -218,6 +262,11 @@ def threshold_encode_device(grad, threshold, capacity: int):
     shipped device→host BEFORE encoding; this twin runs fused inside the
     step program (mask → compaction via XLA's sized ``nonzero`` lowering)
     so only the small message crosses to the host for DCN transport.
+
+    Overflow (> ``capacity`` super-threshold entries) keeps the largest
+    |values| (ties → lower index; XLA top-k is index-stable), matching
+    the numpy/C++ twins bitwise; the top-k only executes on the overflow
+    branch of a ``lax.cond``, so the steady state pays one compaction.
     """
     import jax.numpy as jnp
     from jax import lax
@@ -225,11 +274,11 @@ def threshold_encode_device(grad, threshold, capacity: int):
     flat = jnp.ravel(grad).astype(jnp.float32)
     threshold = jnp.asarray(threshold, jnp.float32)
     mask = jnp.abs(flat) >= threshold
-    idx = jnp.nonzero(mask, size=capacity, fill_value=0)[0]
-    count = jnp.minimum(jnp.sum(mask), capacity).astype(jnp.int32)
+    idx, count = _select_indices_device(mask, flat, capacity)
     slot = jnp.arange(capacity)
-    signs = jnp.where(flat[idx] >= 0, 1, -1).astype(jnp.int32)
-    body = jnp.where(slot < count, signs * (idx.astype(jnp.int32) + 1), 0)
+    safe = jnp.minimum(idx, flat.size - 1)
+    signs = jnp.where(flat[safe] >= 0, 1, -1).astype(jnp.int32)
+    body = jnp.where(slot < count, signs * (safe.astype(jnp.int32) + 1), 0)
     header = jnp.stack([count, jnp.int32(FLAG_SIGN_IDX),
                         lax.bitcast_convert_type(threshold, jnp.int32)])
     return jnp.concatenate([header, body])
@@ -251,6 +300,81 @@ def threshold_decode_device(message, size: int, out=None):
                      jnp.where(body > 0, threshold, -threshold), 0.0)
     base = jnp.zeros((size,), jnp.float32) if out is None else jnp.ravel(out)
     return base.at[idx].add(vals)
+
+
+def threshold_encode_values_device(grad, threshold, capacity: int):
+    """jit-safe device twin of :func:`threshold_encode_values` in the
+    FIXED device layout: int32 [3 + 2*capacity] = [count, flag, τ_bits,
+    (idx+1)…(cap idx slots), value_bits…(cap value slots)].  Use
+    :func:`compact_device_message` after D2H to obtain the exact host
+    wire format (so mixed device/host peers interoperate bitwise).
+    Overflow keeps the largest |values| (ties → lower index), matching
+    the host twins."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    flat = jnp.ravel(grad).astype(jnp.float32)
+    threshold = jnp.asarray(threshold, jnp.float32)
+    mask = jnp.abs(flat) >= threshold
+    idx, count = _select_indices_device(mask, flat, capacity)
+    slot = jnp.arange(capacity)
+    safe = jnp.minimum(idx, flat.size - 1)
+    active = slot < count
+    idx_body = jnp.where(active, safe.astype(jnp.int32) + 1, 0)
+    val_body = jnp.where(active,
+                         lax.bitcast_convert_type(flat[safe], jnp.int32), 0)
+    header = jnp.stack([count, jnp.int32(FLAG_VALUE_SPARSE),
+                        lax.bitcast_convert_type(threshold, jnp.int32)])
+    return jnp.concatenate([header, idx_body, val_body])
+
+
+def threshold_decode_values_device(message, size: int, capacity: int,
+                                   out=None):
+    """jit-safe decode of the FIXED device value layout (adds into
+    ``out``).  Scatter-adds run in slot order per message, so summing a
+    rank-ordered message stack is bitwise-identical on every slice."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    message = jnp.asarray(message, jnp.int32)
+    count = message[0]
+    idx_body = message[3:3 + capacity]
+    vals = lax.bitcast_convert_type(message[3 + capacity:3 + 2 * capacity],
+                                    jnp.float32)
+    active = jnp.arange(capacity) < count
+    idx = jnp.clip(idx_body - 1, 0, size - 1)
+    vals = jnp.where(active, vals, 0.0)
+    base = jnp.zeros((size,), jnp.float32) if out is None else jnp.ravel(out)
+    return base.at[idx].add(vals)
+
+
+def compact_device_message(message: np.ndarray, capacity: int) -> np.ndarray:
+    """Fixed device layout → exact host wire format (strips padding):
+    value mode [3+2cap] → [3+2count]; sign mode [3+cap] → [3+count]."""
+    message = np.asarray(message, dtype=np.int32)
+    count = int(message[0])
+    if int(message[1]) == FLAG_VALUE_SPARSE:
+        return np.concatenate([message[:3], message[3:3 + count],
+                               message[3 + capacity:3 + capacity + count]])
+    return message[:3 + count]
+
+
+def pad_to_device_layout(message: np.ndarray, capacity: int) -> np.ndarray:
+    """Host wire format → fixed device layout (for H2D decode): inverse
+    of :func:`compact_device_message`."""
+    message = np.asarray(message, dtype=np.int32)
+    count = int(message[0])
+    if count > capacity:
+        raise ValueError(f"message count {count} exceeds capacity {capacity}")
+    if int(message[1]) == FLAG_VALUE_SPARSE:
+        out = np.zeros(3 + 2 * capacity, np.int32)
+        out[:3] = message[:3]
+        out[3:3 + count] = message[3:3 + count]
+        out[3 + capacity:3 + capacity + count] = message[3 + count:3 + 2 * count]
+        return out
+    out = np.zeros(3 + capacity, np.int32)
+    out[:3 + count] = message[:3 + count]
+    return out
 
 
 def bitmap_encode_device(grad, threshold):

@@ -261,13 +261,14 @@ class CompressedAllReducer:
 
     def __init__(self, rank: int, size: int, transport,
                  algorithm: Optional[AdaptiveThresholdAlgorithm] = None,
-                 use_native: bool = True, value_coded: bool = False):
+                 use_native: bool = True, value_coded: bool = False,
+                 max_elements: Optional[int] = None):
         self.rank = rank
         self.size = int(size)
         self.transport = transport
         self.accumulator = EncodedGradientsAccumulator(
             (self.size,), algorithm=algorithm, use_native=use_native,
-            value_coded=value_coded)
+            value_coded=value_coded, max_elements=max_elements)
         self.last_message: Optional[np.ndarray] = None
 
     def allreduce(self, flat_grad: np.ndarray) -> np.ndarray:

@@ -18,15 +18,38 @@ TPU-native split of the same semantics:
     processes, :class:`InProcessTransport` in tests) → decode-and-sum
     in global rank order (bitwise-identical on every slice) → apply.
 
-Every slice applies the identical total update, so replicas stay
+Production shape (SURVEY §5.8/§7.7 "encode before leaving the chip"):
+
+  * ``device_encode=True`` (default) fuses residual-add → threshold
+    encode into the SAME jit program as the backward pass, so only the
+    fixed-capacity wire message (KBs) crosses device→host — not the
+    dense gradient (MBs); peers' messages are decoded-and-summed back
+    on device.  ``device_encode=False`` keeps the host/C++ codec path
+    (the correctness oracle).
+  * ``overlap=True`` double-buffers the DCN exchange: step N's messages
+    travel while step N+1's gradients compute (the reference's async
+    accumulator semantics, SURVEY §3.4 — updates land one step late on
+    every slice alike, so replicas remain identical).
+  * multi-process: give each process a ring
+    :class:`~deeplearning4j_tpu.parallel.dcn.SocketTransport` and set
+    ``world_size``/``rank_offset`` — the per-slice math is unchanged
+    (see ``examples/multislice_dcn_training.py`` and
+    ``tests/test_multiprocess.py``).
+
+Every slice applies the identical total update, so PARAMS stay
 byte-synchronized without any parameter re-broadcast; the quantization
 error stays in each slice's local residual and drains over subsequent
-steps (the error-feedback loop of SURVEY §3.4).
+steps (the error-feedback loop of SURVEY §3.4).  Stateful-layer
+statistics (BatchNorm running mean/var) are per-slice — each slice sees
+only its sub-batch — and are averaged across slices at :meth:`collect`
+(the reference averages them in the same place: SharedTrainingMaster's
+model collection).
 """
 
 from __future__ import annotations
 
 from concurrent.futures import ThreadPoolExecutor
+from functools import partial
 from typing import Optional, Sequence
 
 import jax
@@ -35,20 +58,24 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.parallel import mesh as mesh_mod
-from deeplearning4j_tpu.parallel.compression import AdaptiveThresholdAlgorithm
+from deeplearning4j_tpu.parallel.compression import (
+    AdaptiveThresholdAlgorithm, compact_device_message, pad_to_device_layout,
+    threshold_decode_device, threshold_decode_values_device,
+    threshold_encode_device, threshold_encode_values_device)
 from deeplearning4j_tpu.parallel.dcn import CompressedAllReducer, InProcessTransport
 
 
 class MultiSliceTrainer:
-    """Train one model across ``n_slices`` device slices with compressed
-    cross-slice gradient exchange (workload #5 across slices).
+    """Train one model across slices with compressed cross-slice
+    gradient exchange (workload #5 across slices).
 
-    Single-process form: each slice is a thread owning a contiguous
-    ``data_per_slice``-device sub-mesh (on real multi-slice hardware each
-    slice is a process and ``transports`` are ring SocketTransports; the
-    per-slice math is identical).  ``fit``/``fit_batch`` mirror the
-    Trainer surface; the global batch splits evenly across slices, then
-    across each slice's devices.
+    Single-process form: each LOCAL slice is a thread owning a
+    contiguous ``data_per_slice``-device sub-mesh.  Multi-process form:
+    each process owns its local slice(s) and a ring transport;
+    ``world_size`` is the global slice count and ``rank_offset`` this
+    process's first global rank.  ``fit``/``fit_batch`` mirror the
+    Trainer surface; the process's batch splits evenly across its local
+    slices, then across each slice's devices.
     """
 
     def __init__(self, net, n_slices: int, data_per_slice: int = 1,
@@ -56,11 +83,19 @@ class MultiSliceTrainer:
                  transports: Optional[Sequence] = None,
                  algorithm: Optional[AdaptiveThresholdAlgorithm] = None,
                  use_native: bool = True, value_coded: bool = True,
+                 device_encode: bool = True, capacity: Optional[int] = None,
+                 overlap: bool = False,
+                 world_size: Optional[int] = None, rank_offset: int = 0,
                  listeners=None):
         from deeplearning4j_tpu.obs.listeners import ListenerBus
         from deeplearning4j_tpu.train import updaters as updater_mod
         self.net = net
-        self.n_slices = n_slices
+        self.n_slices = n_slices                      # local slices
+        self.world_size = world_size or n_slices      # global slices
+        self.rank_offset = rank_offset
+        self.value_coded = value_coded
+        self.device_encode = device_encode
+        self.overlap = overlap
         self.bus = (listeners if isinstance(listeners, ListenerBus)
                     else ListenerBus(listeners))
         devices = list(devices if devices is not None else jax.devices())
@@ -84,16 +119,39 @@ class MultiSliceTrainer:
         flat, self._unravel = jax.flatten_util.ravel_pytree(net.params_)
         self.grad_size = int(flat.size)
         if transports is None:
-            shared = InProcessTransport(n_slices)
+            shared = InProcessTransport(self.world_size)
             transports = [shared] * n_slices
+        self.transports = list(transports)
         import dataclasses as _dc
-        self.reducers = [CompressedAllReducer(
-            r, self.grad_size, transports[r],
+        mk_alg = (AdaptiveThresholdAlgorithm if algorithm is None
+                  else partial(_dc.replace, algorithm))
+        # fixed message capacity (shared by BOTH paths so their wires are
+        # bitwise-identical under overflow): headroom over the adaptive
+        # target sparsity, bounded so the encoded message is always
+        # STRICTLY smaller than the dense gradient
+        alg0 = mk_alg()
+        dense_bound = ((self.grad_size - 4) // 2 if value_coded
+                       else self.grad_size - 4)
+        self.capacity = capacity or max(1, min(
+            dense_bound,
+            max(1024, int(4 * alg0.target_sparsity * self.grad_size))))
+        if device_encode:
             # fresh per-slice threshold state (the reference's algorithm
-            # is per-worker); _dc.replace re-runs __post_init__
-            algorithm=None if algorithm is None else _dc.replace(algorithm),
-            use_native=use_native, value_coded=value_coded)
-            for r in range(n_slices)]
+            # is per-worker)
+            self.algorithms = [mk_alg() for _ in range(n_slices)]
+            self.slice_residual = [
+                mesh_mod.replicate(m, jnp.zeros((self.grad_size,),
+                                                jnp.float32))
+                for m in self.meshes]
+            self.reducers = []
+        else:
+            self.algorithms = []
+            self.reducers = [CompressedAllReducer(
+                rank_offset + r, self.grad_size, self.transports[r],
+                algorithm=mk_alg(),
+                use_native=use_native, value_coded=value_coded,
+                max_elements=self.capacity)
+                for r in range(n_slices)]
 
         # per-slice replicas (identical values, per-mesh placement)
         self.slice_params = [mesh_mod.replicate(m, net.params_)
@@ -105,7 +163,12 @@ class MultiSliceTrainer:
 
         self._grad_fn = None
         self._apply_fn = None
+        self._grad_encode_fn = None
+        self._decode_apply_fn = None
         self._pool = ThreadPoolExecutor(max_workers=n_slices)
+        # separate IO lane so an in-flight exchange never blocks compute
+        self._io_pool = ThreadPoolExecutor(max_workers=n_slices)
+        self._pending = [None] * n_slices   # overlap: in-flight exchanges
         self.iteration = 0
         self.last_wire_stats: list[dict] = []
 
@@ -115,6 +178,12 @@ class MultiSliceTrainer:
         if self._grad_fn is not None:
             return
         loss_fn = make_loss_fn(self.net)
+        unravel = self._unravel
+        tx = self.tx
+        size = self.grad_size
+        cap = self.capacity
+        world = self.world_size
+        value_coded = self.value_coded
 
         @jax.jit
         def grad_fn(params, state, features, labels, fmask, lmask, rng):
@@ -123,8 +192,6 @@ class MultiSliceTrainer:
                                        fmask, lmask, rng)
             return loss, new_state, grads
 
-        tx = self.tx
-
         @jax.jit
         def apply_fn(params, opt_state, grads):
             updates, opt_state = tx.update(grads, opt_state, params)
@@ -132,13 +199,110 @@ class MultiSliceTrainer:
                                             params, updates)
             return params, opt_state
 
+        # ---- device-codec path: residual+encode fused into the step; only
+        # the fixed-size message leaves the device (SURVEY §5.8 "encode
+        # before the wire")
+        @partial(jax.jit, donate_argnums=(6,))
+        def grad_encode_fn(params, state, features, labels, fmask, lmask,
+                           residual, rng, tau):
+            (loss, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, state, features, labels,
+                                       fmask, lmask, rng)
+            flat = jax.flatten_util.ravel_pytree(grads)[0].astype(jnp.float32)
+            acc = residual + flat
+            if value_coded:
+                msg = threshold_encode_values_device(acc, tau, cap)
+                dec = threshold_decode_values_device(msg, size, cap)
+            else:
+                msg = threshold_encode_device(acc, tau, cap)
+                dec = threshold_decode_device(msg, size)
+            res = acc - dec
+            return loss, new_state, msg, res, jnp.max(jnp.abs(res))
+
+        @jax.jit
+        def decode_apply_fn(params, opt_state, padded_messages):
+            total = jnp.zeros((size,), jnp.float32)
+            for r in range(world):     # global rank order → bitwise equality
+                if value_coded:
+                    total = threshold_decode_values_device(
+                        padded_messages[r], size, cap, out=total)
+                else:
+                    total = threshold_decode_device(
+                        padded_messages[r], size, out=total)
+            grad_tree = unravel(total / world)
+            updates, opt_state = tx.update(grad_tree, opt_state, params)
+            params = jax.tree_util.tree_map(lambda p, u: p + u,
+                                            params, updates)
+            return params, opt_state
+
         self._grad_fn = grad_fn
         self._apply_fn = apply_fn
+        self._grad_encode_fn = grad_encode_fn
+        self._decode_apply_fn = decode_apply_fn
 
     # ----------------------------------------------------------- training
+    def _exchange(self, rank: int, compact: np.ndarray) -> np.ndarray:
+        """Ring-exchange one slice's compact wire message; returns the
+        [world, fixed_layout] stack in global rank order (H2D-ready)."""
+        grank = self.rank_offset + rank
+        peers = self.transports[rank].exchange(grank, compact)
+        ordered = peers[:grank] + [compact] + peers[grank:]
+        stack = np.stack([pad_to_device_layout(m, self.capacity)
+                          for m in ordered])
+        # H2D on the IO thread (overlapped too in overlap mode)
+        return mesh_mod.replicate(self.meshes[rank], jnp.asarray(stack))
+
+    def _slice_step_device(self, rank, features, labels, fmask, lmask, rng):
+        """Device-codec step: grads + residual + encode in ONE jit; only
+        the message crosses D2H; peers' messages decode-and-apply on
+        device.  With ``overlap`` the exchange of step N rides the IO
+        pool while step N+1 computes (one-step-stale apply)."""
+        m = self.meshes[rank]
+        batch = mesh_mod.shard_batch(
+            m, {"f": features, "l": labels, "fm": fmask, "lm": lmask})
+        alg = self.algorithms[rank]
+        loss, new_state, msg, new_residual, res_linf = self._grad_encode_fn(
+            self.slice_params[rank], self.slice_state[rank],
+            batch["f"], batch["l"], batch["fm"], batch["lm"],
+            self.slice_residual[rank], rng,
+            jnp.float32(alg.current()))
+        self.slice_residual[rank] = new_residual
+        self.slice_state[rank] = new_state
+        msg_np = np.asarray(msg)     # the ONLY bulk D2H: 3+2cap int32s
+        compact = compact_device_message(msg_np, self.capacity)
+        alg.update(int(msg_np[0]), self.grad_size)
+        self._record_wire(rank, msg_np, compact, float(res_linf))
+
+        if self.overlap:
+            if self._pending[rank] is not None:
+                padded = self._pending[rank].result()
+                self.slice_params[rank], self.slice_opt[rank] = \
+                    self._decode_apply_fn(self.slice_params[rank],
+                                          self.slice_opt[rank], padded)
+            self._pending[rank] = self._io_pool.submit(
+                self._exchange, rank, compact)
+        else:
+            padded = self._exchange(rank, compact)
+            self.slice_params[rank], self.slice_opt[rank] = \
+                self._decode_apply_fn(self.slice_params[rank],
+                                      self.slice_opt[rank], padded)
+        return float(loss)
+
+    def _record_wire(self, rank, msg_np, compact, res_linf):
+        self._wire_tmp[rank] = {
+            "encoded": int(msg_np[0]),
+            "dense_bytes": self.grad_size * 4,
+            "d2h_bytes": int(msg_np.size) * 4,
+            "wire_bytes": int(compact.size) * 4,
+            "compression": self.grad_size / max(int(compact.size), 1),
+            "threshold": float(self.algorithms[rank].current()),
+            "residual_linf": res_linf,
+        }
+
     def _slice_step(self, rank, features, labels, fmask, lmask, rng):
-        """One slice's step: in-jit grads (psum over the slice mesh) →
-        host flat grad → compressed DCN allreduce → identical apply."""
+        """Host-codec step (oracle path): in-jit grads (psum over the
+        slice mesh) → host flat grad → compressed DCN allreduce →
+        identical apply."""
         m = self.meshes[rank]
         batch = mesh_mod.shard_batch(
             m, {"f": features, "l": labels, "fm": fmask, "lm": lmask})
@@ -150,16 +314,21 @@ class MultiSliceTrainer:
                           dtype=np.float32)
         total = self.reducers[rank].allreduce(flat)
         # slice grads are means over the slice sub-batch → grand mean
-        grad_tree = self._unravel(jnp.asarray(total / self.n_slices))
+        grad_tree = self._unravel(jnp.asarray(total / self.world_size))
         grad_tree = mesh_mod.replicate(m, grad_tree)
         self.slice_params[rank], self.slice_opt[rank] = self._apply_fn(
             params, self.slice_opt[rank], grad_tree)
         self.slice_state[rank] = new_state
+        r = self.reducers[rank]
+        self._wire_tmp[rank] = {
+            "residual_linf": float(np.abs(r.accumulator.residual).max()),
+            **r.wire_stats(r.last_message)}
         return float(loss)
 
     def fit_batch(self, batch, rng) -> float:
-        """One global step.  The batch's leading dim splits evenly across
-        slices (then across each slice's ``data`` axis inside the jit)."""
+        """One LOCAL step.  The batch's leading dim splits evenly across
+        this process's slices (then across each slice's ``data`` axis
+        inside the jit)."""
         from deeplearning4j_tpu.train.trainer import _batch_masks
         self._ensure_ready()
         n = self.n_slices
@@ -174,15 +343,15 @@ class MultiSliceTrainer:
         def sub(v, i):
             return None if v is None else np.asarray(v)[i * per:(i + 1) * per]
 
+        step = (self._slice_step_device if self.device_encode
+                else self._slice_step)
+        self._wire_tmp = [None] * n
         rngs = jax.random.split(rng, n)
         futures = [self._pool.submit(
-            self._slice_step, i, sub(feats, i), sub(labels, i),
+            step, i, sub(feats, i), sub(labels, i),
             sub(fmask, i), sub(lmask, i), rngs[i]) for i in range(n)]
         losses = [f.result() for f in futures]
-        self.last_wire_stats = [
-            {"residual_linf": float(np.abs(r.accumulator.residual).max()),
-             **r.wire_stats(r.last_message)}
-            for r in self.reducers]
+        self.last_wire_stats = list(self._wire_tmp)
         mean_loss = float(np.mean(losses))
         self.bus.dispatch("iteration_done", self.net, self.iteration, 0,
                           mean_loss)
@@ -200,20 +369,78 @@ class MultiSliceTrainer:
             for batch in iterator:
                 key, sub = jax.random.split(key)
                 last = self.fit_batch(batch, sub)
+        self.finish()
         self.bus.dispatch("on_fit_end", self.net)
         return last
 
+    def finish(self):
+        """Drain in-flight overlapped exchanges (applies the final
+        pending totals).  No-op in synchronous mode."""
+        for rank in range(self.n_slices):
+            if self._pending[rank] is not None:
+                padded = self._pending[rank].result()
+                self.slice_params[rank], self.slice_opt[rank] = \
+                    self._decode_apply_fn(self.slice_params[rank],
+                                          self.slice_opt[rank], padded)
+                self._pending[rank] = None
+
     # ---------------------------------------------------------- sync back
-    def collect(self):
-        """Write slice 0's (synchronized) params/state/opt back onto the
-        wrapped net — the SharedTrainingMaster 'collect trained model'
-        step; no averaging needed because slices apply identical totals."""
+    def collect(self, average_state: bool = True):
+        """Write trained params/state/opt back onto the wrapped net — the
+        SharedTrainingMaster 'collect trained model' step.  Params and
+        updater state need no averaging (slices apply identical totals);
+        stateful-layer statistics (BatchNorm running mean/var) are
+        per-slice sub-batch estimates and ARE averaged here, matching the
+        reference's model-collection averaging."""
+        self.finish()
         unrep = lambda tree: jax.tree_util.tree_map(
             lambda a: jnp.asarray(np.asarray(a)), tree)
         self.net.params_ = unrep(self.slice_params[0])
-        self.net.state_ = unrep(self.slice_state[0])
+        if average_state and self.n_slices > 1:
+            hosts = [jax.tree_util.tree_map(np.asarray, s)
+                     for s in self.slice_state]
+
+            def avg(*xs):
+                # jnp.issubdtype: ml_dtypes (bf16/fp8) count as floating,
+                # np.issubdtype would miss them
+                if jnp.issubdtype(xs[0].dtype, jnp.floating):
+                    stacked = np.stack(
+                        [np.asarray(x, np.float32) for x in xs], 0)
+                    return jnp.asarray(stacked.mean(0)).astype(xs[0].dtype)
+                return jnp.asarray(xs[0])
+
+            self.net.state_ = jax.tree_util.tree_map(avg, *hosts)
+        else:
+            self.net.state_ = unrep(self.slice_state[0])
         self.net.opt_state = unrep(self.slice_opt[0])
         return self.net
+
+    # -------------------------------------------------- codec-state serde
+    def codec_state(self) -> list[dict]:
+        """Per-local-slice codec state (residual + adaptive τ) for
+        checkpointing — restoring it makes a restarted run bitwise-
+        continue the interrupted one (the reference loses in-flight
+        residuals on restart; we don't have to)."""
+        self.finish()
+        if self.device_encode:
+            return [{"residual": np.asarray(self.slice_residual[r]),
+                     "threshold": self.algorithms[r].current()}
+                    for r in range(self.n_slices)]
+        return [{"residual": self.reducers[r].accumulator.residual.copy(),
+                 "threshold": self.reducers[r].accumulator.algorithm.current()}
+                for r in range(self.n_slices)]
+
+    def load_codec_state(self, states: Sequence[dict]) -> None:
+        for r, st in enumerate(states):
+            if self.device_encode:
+                self.slice_residual[r] = mesh_mod.replicate(
+                    self.meshes[r],
+                    jnp.asarray(np.asarray(st["residual"], np.float32)))
+                self.algorithms[r]._threshold = float(st["threshold"])
+            else:
+                acc = self.reducers[r].accumulator
+                acc.residual[:] = np.asarray(st["residual"], np.float32)
+                acc.algorithm._threshold = float(st["threshold"])
 
     def max_param_divergence(self) -> float:
         """L∞ distance between slice replicas (0.0 = byte-synchronized)."""
@@ -224,3 +451,4 @@ class MultiSliceTrainer:
 
     def close(self):
         self._pool.shutdown(wait=False)
+        self._io_pool.shutdown(wait=False)

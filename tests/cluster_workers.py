@@ -146,3 +146,88 @@ def dcn_socket_allreduce_worker(pid, n, port=23401, steps=8):
             "grads": np.stack(grads),
             "residual": np.asarray(reducer.accumulator.residual),
             **stats}
+
+
+def dcn_multislice_fit_worker(pid, n, phase="full", workdir="/tmp",
+                              port=23601):
+    """Production multi-slice fit: each PROCESS is one slice leader
+    running MultiSliceTrainer(world_size=n) over a ring SocketTransport
+    with on-device encode + overlapped exchange — the multi-process
+    SharedTrainingMaster replacement (VERDICT r4 next #1c).
+
+    phase="full":   6 steps straight through, checkpoint after step #3.
+    phase="fail":   same, but process 1 hard-exits at step #5.
+    phase="resume": restore net + iterator + codec state, finish.
+    """
+    import pickle
+
+    import jax
+    from jax.experimental import multihost_utils
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import (ListDataSetIterator,
+                                                   ResumableIterator)
+    from deeplearning4j_tpu.io.model_serializer import read_iterator_state
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.compression import (
+        AdaptiveThresholdAlgorithm)
+    from deeplearning4j_tpu.parallel.dcn import SocketTransport
+    from deeplearning4j_tpu.parallel.dcn_trainer import MultiSliceTrainer
+    from deeplearning4j_tpu.utils.pytree import flat_param_vector
+
+    os.makedirs(workdir, exist_ok=True)
+    x, y = global_batch(n=48, seed=2)
+    # rank-local shard: rank r owns rows [r::n] of each global batch of 8
+    batches = [DataSet(x[i:i + 8][pid::n], y[i:i + 8][pid::n])
+               for i in range(0, 48, 8)]
+    iterator = ResumableIterator(ListDataSetIterator(batches))
+    ckpt = os.path.join(workdir, "dcn_ckpt.zip")
+    codec_path = os.path.join(workdir, f"dcn_codec_{pid}.pkl")
+
+    if phase == "resume":
+        net = MultiLayerNetwork.load(ckpt)
+        iterator.set_state(read_iterator_state(ckpt))
+        start = iterator.batch_index
+    else:
+        net = _small_net()
+        start = 0
+
+    transport = SocketTransport(pid, n, port=port + {"full": 0, "fail": 10,
+                                                     "resume": 20}[phase],
+                                timeout=20.0)
+    trainer = MultiSliceTrainer(
+        net, n_slices=1, world_size=n, rank_offset=pid,
+        transports=[transport], device_encode=True, overlap=True,
+        devices=jax.local_devices(),   # jax.devices() is GLOBAL here
+        algorithm=AdaptiveThresholdAlgorithm(initial_threshold=2e-2))
+    if phase == "resume":
+        with open(codec_path, "rb") as f:
+            trainer.load_codec_state(pickle.load(f))
+
+    key = jax.random.key(123)
+    try:
+        for i, batch in enumerate(iterator, start=start):
+            key, sub = jax.random.split(key)
+            trainer.fit_batch(batch, sub)
+            if phase != "resume" and i == 2:
+                # every rank persists its own codec state; rank 0 owns
+                # the model checkpoint (params are identical anyway)
+                with open(codec_path, "wb") as f:
+                    pickle.dump(trainer.codec_state(), f)
+                if pid == 0:
+                    trainer.collect()
+                    net.save(ckpt, iterator_state=iterator.state())
+            if phase == "fail" and i == 4 and pid == 1:
+                os._exit(3)      # fault injection: hard-kill this process
+        trainer.collect()
+    finally:
+        trainer.close()
+        transport.close()
+
+    flat = np.asarray(flat_param_vector(net.params_))
+    gathered = np.asarray(multihost_utils.process_allgather(
+        jax.numpy.asarray(flat)))
+    return {"pid": pid, "params": flat,
+            "all_equal": bool(np.allclose(gathered, gathered[0:1], atol=0)),
+            "batches_seen": iterator.batch_index - start,
+            "bytes_sent": transport.bytes_sent,
+            "dense_bytes_per_step": trainer.grad_size * 4}

@@ -97,3 +97,35 @@ def test_bitmap_jit_roundtrip_unaligned_size():
     np.testing.assert_allclose(dec[hits_pos], tau, atol=1e-7)
     np.testing.assert_allclose(dec[hits_neg], -tau, atol=1e-7)
     assert np.all(dec[~(hits_pos | hits_neg)] == 0)
+
+
+def test_overflow_topk_parity_all_twins():
+    """Capacity overflow keeps the LARGEST |values| (ties -> lower index),
+    bitwise-identically in numpy, C++, and device twins — mixed-host
+    slices must produce identical wire messages."""
+    rng = np.random.default_rng(7)
+    g = rng.normal(0, 1.0, 300).astype(np.float32)
+    g[10] = 5.0
+    g[250] = -5.0          # big entries at both ends
+    g[20] = g[30] = 2.5    # exact tie -> index 20 wins over 30 at the cap
+    tau, cap = 0.5, 16
+    ref = threshold_encode(g, tau, max_elements=cap)
+    assert ref[0] == cap
+    body = ref[3:3 + cap]
+    idx = np.abs(body) - 1
+    # the two largest magnitudes survived the cap and indices are ascending
+    assert 10 in idx and 250 in idx
+    assert np.all(np.diff(idx) > 0)
+    # kept set = top-cap by (|value| desc, index asc)
+    hits = np.nonzero(np.abs(g) >= tau)[0]
+    order = np.lexsort((hits, -np.abs(g[hits])))
+    np.testing.assert_array_equal(np.sort(hits[order[:cap]]), idx)
+
+    dev = np.asarray(threshold_encode_device(jnp.asarray(g), tau,
+                                             capacity=cap))
+    np.testing.assert_array_equal(dev[:3 + cap], ref[:3 + cap])
+
+    from deeplearning4j_tpu.native import codec as native_codec
+    if native_codec.available():
+        nat = native_codec.threshold_encode(g, tau, max_elements=cap)
+        np.testing.assert_array_equal(nat, ref)

@@ -99,6 +99,40 @@ class TestLocalCluster:
                                    rtol=1e-6)
 
 
+class TestMultiProcessDcnFit:
+    def test_multislice_fit_and_fault_restart(self, tmp_path):
+        """VERDICT r4 next #1c: multi-process MultiSliceTrainer.fit over a
+        real TCP ring (device encode + overlapped exchange), surviving
+        kill+restart with codec-state (residual+τ) checkpointing."""
+        wd = str(tmp_path)
+        full = spawn_local_cluster(
+            functools.partial(cluster_workers.dcn_multislice_fit_worker,
+                              phase="full", workdir=wd + "/full"),
+            n_processes=2, port=12721, local_devices=1, extra_env=_ENV)
+        assert all(r["all_equal"] for r in full)
+        assert full[0]["batches_seen"] == 6
+        # compressed wire: ring bytes ≪ what dense f32 exchange would cost
+        dense_total = full[0]["dense_bytes_per_step"] * 6
+        assert 0 < full[0]["bytes_sent"] < dense_total / 2
+
+        with pytest.raises(RuntimeError):
+            spawn_local_cluster(
+                functools.partial(cluster_workers.dcn_multislice_fit_worker,
+                                  phase="fail", workdir=wd + "/fail"),
+                n_processes=2, port=12723, local_devices=1, timeout=120.0,
+                extra_env=_ENV)
+        assert os.path.exists(wd + "/fail/dcn_ckpt.zip")
+
+        resumed = spawn_local_cluster(
+            functools.partial(cluster_workers.dcn_multislice_fit_worker,
+                              phase="resume", workdir=wd + "/fail"),
+            n_processes=2, port=12725, local_devices=1, extra_env=_ENV)
+        assert all(r["all_equal"] for r in resumed)
+        assert resumed[0]["batches_seen"] == 3
+        np.testing.assert_allclose(resumed[0]["params"], full[0]["params"],
+                                   rtol=1e-6)
+
+
 class TestResumableIterator:
     def _it(self):
         from deeplearning4j_tpu.data.dataset import DataSet

@@ -1106,7 +1106,7 @@ def test_math_merge_clip_percentile_family():
     LEDGER.record("math.merge_max", "math.merge_avg", "math.merge_add")
     x = jnp.asarray(A)
     got = np.asarray(ns.math.clip_by_avg_norm(x, 0.01))
-    avg_norm = np.linalg.norm(A) / np.sqrt(A.size)
+    avg_norm = np.linalg.norm(A) / A.size  # TF clip_by_average_norm: ||x||/N
     np.testing.assert_allclose(got, A * min(1.0, 0.01 / avg_norm),
                                rtol=1e-5)
     clipped = ns.math.clip_by_global_norm([x, 2 * x], 1.0)
