@@ -24,6 +24,10 @@ A100_PROXY_IMG_PER_SEC = 2750.0  # public MLPerf-era proxy, see BASELINE.md
 
 # v5e public peak numbers for utilization lines
 V5E_PEAK_BF16_TFLOPS = 197.0
+# measured r5 on THIS chip (axon tunnel): best sustained bf16 matmul rate
+# over shapes {8192³, 16384×2048×16384, dependency-free and scan chains} =
+# ~130 TFLOP/s — the silicon's demonstrated ceiling, 66% of nominal
+V5E_MEASURED_MATMUL_TFLOPS = 130.0
 V5E_HBM_GBPS = 819.0
 
 def _timed_region(run, sync, steps, repeats=3):
@@ -109,6 +113,8 @@ def bench_bert_mlm(batch: int = 32, seq_len: int = 128, steps: int = 30,
     (BASELINE.json config #4: SameDiff TF-import BERT-base MLM).
 
     Timing discipline: see ``_timed_region``."""
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.config import DTypePolicy, set_dtype_policy
@@ -116,9 +122,16 @@ def bench_bert_mlm(batch: int = 32, seq_len: int = 128, steps: int = 30,
     from deeplearning4j_tpu.train import Adam
 
     set_dtype_policy(DTypePolicy.bf16())
-    config = BertConfig.base()
+    # max_predictions: decode the vocab only at gathered masked positions
+    # (TF BERT max_predictions_per_seq; 32 of 128 = 25%, safely above the
+    # 15% masking rate) — FLOP accounting below credits the decode for
+    # the gathered positions only
+    config = dataclasses.replace(BertConfig.base(), max_predictions=32)
     model = BertForMaskedLM(config, seed=0)
-    tx = Adam(2e-5).to_optax()
+    # bf16 first moment: −1.3 ms/step of mu HBM traffic; loss trajectory
+    # agrees with f32-state Adam to ≤0.02 abs (≈0.3% rel) over 30 steps
+    # (measured r5)
+    tx = Adam(2e-5, mu_dtype="bf16").to_optax()
     opt_state = tx.init(model.params)
     step = model.make_train_step(tx)
 
@@ -144,15 +157,30 @@ def bench_bert_mlm(batch: int = 32, seq_len: int = 128, steps: int = 30,
 
     step_s = _timed_region(run, jax.device_get, steps, repeats)
     # transformer train FLOPs ≈ 6·P·tokens + attention 12·L·T²·H·Dh·3
-    # (fwd+bwd); the 6PT term dominates at seq 128
+    # (fwd+bwd); the 6PT term dominates at seq 128.  The word-embedding
+    # table's matmul is the MLM decode — credited only for the positions
+    # it actually decodes (max_predictions gather), not the full width.
     tokens = batch * seq_len
+    emb_params = config.vocab_size * config.hidden_size
+    decode_tokens = (batch * config.max_predictions
+                     if config.max_predictions else tokens)
     attn_flops = (12 * config.num_layers * batch * seq_len ** 2
                   * config.hidden_size)
-    flops = 6.0 * n_params * tokens + attn_flops
+    flops = (6.0 * (n_params - emb_params) * tokens
+             + 6.0 * emb_params * decode_tokens + attn_flops)
     return {"step_time_ms": round(1000 * step_s, 2),
             "batch": batch, "seq_len": seq_len,
+            "max_predictions": config.max_predictions,
             "tflops_per_step": round(flops / 1e12, 2),
-            "mfu": round(flops / step_s / 1e12 / V5E_PEAK_BF16_TFLOPS, 3)}
+            "mfu": round(flops / step_s / 1e12 / V5E_PEAK_BF16_TFLOPS, 3),
+            # nominal peak (197) is not reachable on this part: an 8192³
+            # bf16 matmul (zero overhead, measured in-program via
+            # lax.scan) sustains ~130 TFLOP/s — see bench/PROFILE.md
+            # "measured matmul ceiling"; this reports utilization of the
+            # silicon's demonstrated peak alongside nominal MFU
+            "practical_peak_tflops": V5E_MEASURED_MATMUL_TFLOPS,
+            "practical_peak_fraction": round(
+                flops / step_s / 1e12 / V5E_MEASURED_MATMUL_TFLOPS, 3)}
 
 
 def bench_bert_long_seq(seq_len: int = 4096, batch: int = 2,
@@ -210,6 +238,95 @@ def bench_bert_long_seq(seq_len: int = 4096, batch: int = 2,
     out["tflops_per_step"] = round(flops / 1e12, 2)
     out["flash_mfu"] = round(
         flops / (out["flash_step_ms"] / 1e3) / 1e12 / V5E_PEAK_BF16_TFLOPS, 3)
+    out["flash_practical_peak_fraction"] = round(
+        flops / (out["flash_step_ms"] / 1e3) / 1e12
+        / V5E_MEASURED_MATMUL_TFLOPS, 3)
+    return out
+
+
+def bench_dcn_multislice(steps: int = 6, batch: int = 32) -> dict:
+    """Production multi-slice DCN training at ResNet-50 gradient scale
+    (VERDICT r4 next #1 'done' row): wire-bytes ratio, D2H reduction,
+    and per-step exchange overhead, sync vs overlapped.
+
+    Both slices run on the ONE real chip (their compute serializes), so
+    per-step DCN overhead = multislice_step − 2 × plain_step; the codec
+    path (device encode → compact message → ring exchange → device
+    decode+apply) is exactly the multi-process production path."""
+    import time as _time
+
+    import jax
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.models import resnet50
+    from deeplearning4j_tpu.parallel.compression import (
+        AdaptiveThresholdAlgorithm)
+    from deeplearning4j_tpu.parallel.dcn_trainer import MultiSliceTrainer
+    from deeplearning4j_tpu.train import Sgd, Trainer
+
+    rng = np.random.default_rng(11)
+    x = rng.uniform(0, 1, (batch, 32, 32, 3)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
+    data = DataSet(x, y)
+    half = DataSet(x[:batch // 2], y[:batch // 2])
+
+    def wall(fn, n):
+        fn()                              # warm
+        t0 = _time.monotonic()
+        for _ in range(n):
+            fn()
+        return (_time.monotonic() - t0) / n
+
+    # plain single-slice baseline at the same per-slice batch
+    net0 = resnet50(height=32, width=32, num_classes=10,
+                    updater=Sgd(0.01))
+    net0.init()
+    tr0 = Trainer(net0)
+    key = jax.random.key(2)
+    plain_s = wall(lambda: tr0.fit_batch(half, key), steps)
+
+    out = {"grad_mb": None, "plain_step_ms": round(plain_s * 1e3, 2)}
+    for overlap in (False, True):
+        net = resnet50(height=32, width=32, num_classes=10,
+                       updater=Sgd(0.01))
+        net.init()
+        # steady-state message capacity (the production default, 4× the
+        # adaptive sparsity target ≈ 94k entries / 0.75 MB wire): the
+        # dense warm-up transient is top-|v|-truncated by design, and τ
+        # burns in over the warm-up steps below.  (A transient-sized
+        # capacity of 4M entries = 32 MB/message was measured to drown
+        # the row in this rig's tunnel D2H at ~70 ms/MB — real hardware
+        # moves D2H ~100× faster, so tunnel transfer time would have
+        # dominated the "overhead" being reported.)
+        trainer = MultiSliceTrainer(
+            net, n_slices=2, data_per_slice=1,
+            devices=[jax.devices()[0]] * 2,
+            device_encode=True, overlap=overlap,
+            algorithm=AdaptiveThresholdAlgorithm(initial_threshold=1.0))
+        try:
+            for _ in range(6):      # τ burn-in toward the target sparsity
+                trainer.fit_batch(data, key)
+            s = wall(lambda: trainer.fit_batch(data, key), steps)
+            ws = trainer.last_wire_stats[0]
+            out["grad_mb"] = round(ws["dense_bytes"] / 2 ** 20, 1)
+            label = "overlap" if overlap else "sync"
+            out[f"{label}_step_ms"] = round(s * 1e3, 2)
+            out[f"{label}_overhead_ms"] = round((s - 2 * plain_s) * 1e3, 2)
+            if not overlap:
+                out["wire_bytes"] = ws["wire_bytes"]
+                out["d2h_bytes"] = ws["d2h_bytes"]
+                out["dense_bytes"] = ws["dense_bytes"]
+                out["wire_ratio"] = round(
+                    ws["dense_bytes"] / max(ws["wire_bytes"], 1), 1)
+                out["d2h_reduction"] = round(
+                    ws["dense_bytes"] / max(ws["d2h_bytes"], 1), 1)
+        finally:
+            trainer.close()
+    out["note"] = ("2 slices share the one chip (compute serializes); "
+                   "overhead = step - 2*plain_step and is DOMINATED by "
+                   "this rig's tunnel device<->host link (~70 ms/MB; 4 "
+                   "sub-MB transfers/step) — real-HW PCIe moves the "
+                   "0.75 MB message in <1 ms; multi-process form "
+                   "measured in tests/test_multiprocess.py over real TCP")
     return out
 
 
@@ -313,6 +430,10 @@ def main():
                 result["detail"]["bert_long_seq"] = bench_bert_long_seq()
             except Exception as e:
                 result["detail"]["bert_long_seq"] = {"error": str(e)[:200]}
+            try:  # multi-slice DCN: wire/overhead row (r5, workload #5)
+                result["detail"]["dcn_multislice"] = bench_dcn_multislice()
+            except Exception as e:
+                result["detail"]["dcn_multislice"] = {"error": str(e)[:200]}
             try:  # DP scaling: CPU-mesh measurement + ICI model (#5)
                 result["detail"]["dp_scaling"] = bench_dp_scaling(
                     measured_img_per_sec=result["value"],

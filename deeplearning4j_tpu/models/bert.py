@@ -47,6 +47,21 @@ class BertConfig:
     # materialized [T,T] einsum chain — pays off at seq >= ~2-4k
     use_flash: bool = False
     flash_block: int = 0      # 0 = tuned default (1024×1024 blocks)
+    # MLM head scope: decode only `max_predictions` gathered positions
+    # per sequence instead of every token (TF BERT's
+    # max_predictions_per_seq; google-research/bert run_pretraining
+    # gathers masked positions before the vocab matmul).  0 = decode the
+    # full width (exact when every position may carry a label).  On TPU
+    # the gather removes ~6·E·(T−k)/T of vocab-matmul FLOPs AND the
+    # [B,T,V] f32 logits materialization (≈0.5 GB at base/seq128).
+    max_predictions: int = 0
+    # fuse the per-layer Q/K/V projections into ONE [H,3H] MXU matmul
+    # (kernels concatenated at trace time; param layout keeps the TF
+    # checkpoint naming so importers are unaffected).  MEASURED SLOWER
+    # on v5e at base/seq128 (+1.5 ms/step: the per-step concat + its
+    # transposed backward outweigh the wider matmul) — default OFF,
+    # kept for wider-model experiments.
+    fused_qkv: bool = False
 
     @staticmethod
     def base() -> "BertConfig":
@@ -143,9 +158,23 @@ def encoder_layer(lp: dict, config: BertConfig, x: jnp.ndarray,
                   rng: Optional[jax.Array] = None) -> jnp.ndarray:
     """One transformer encoder block (bert/encoder/layer_N) — the single
     source for both :func:`encode` and :func:`pipeline_stages`."""
-    q = _dense(lp["attention"]["query"], x)
-    k = _dense(lp["attention"]["key"], x)
-    v = _dense(lp["attention"]["value"], x)
+    if config.fused_qkv:
+        at = lp["attention"]
+        policy = dtype_policy()
+        cd = policy.compute_dtype
+        kernel = jnp.concatenate(
+            [at["query"]["kernel"], at["key"]["kernel"],
+             at["value"]["kernel"]], axis=1).astype(cd)
+        bias = jnp.concatenate(
+            [at["query"]["bias"], at["key"]["bias"], at["value"]["bias"]])
+        qkv = (jnp.einsum("...i,io->...o", x.astype(cd), kernel)
+               + bias.astype(cd)).astype(policy.output_dtype)
+        h = x.shape[-1]
+        q, k, v = qkv[..., :h], qkv[..., h:2 * h], qkv[..., 2 * h:]
+    else:
+        q = _dense(lp["attention"]["query"], x)
+        k = _dense(lp["attention"]["key"], x)
+        v = _dense(lp["attention"]["value"], x)
     attn = multi_head_attention(q, k, v, n_heads=config.num_heads,
                                 kv_mask=attention_mask,
                                 use_flash=config.use_flash,
@@ -222,9 +251,20 @@ def _weighted_mlm_ce(logits, labels, label_weights):
 def mlm_loss(params: dict, config: BertConfig, input_ids, labels, label_weights,
              token_type_ids=None, attention_mask=None, *, train=True, rng=None):
     """Masked-LM loss: mean cross-entropy over positions with
-    label_weights==1 (the masked positions)."""
+    label_weights==1 (the masked positions).
+
+    With ``config.max_predictions = k`` the masked positions are gathered
+    BEFORE the vocab decode (top-k by weight, ties → lower position —
+    exact whenever ≤ k positions carry weight; beyond-k positions drop,
+    which is TF BERT's max_predictions_per_seq behavior)."""
     hidden = encode(params, config, input_ids, token_type_ids, attention_mask,
                     train=train, rng=rng)
+    k = config.max_predictions
+    if k and k < hidden.shape[1]:
+        _, pos = jax.lax.top_k(label_weights, k)           # [B, k]
+        hidden = jnp.take_along_axis(hidden, pos[..., None], axis=1)
+        labels = jnp.take_along_axis(labels, pos, axis=1)
+        label_weights = jnp.take_along_axis(label_weights, pos, axis=1)
     logits = mlm_logits(params, config, hidden)
     return _weighted_mlm_ce(logits, labels, label_weights)
 

@@ -96,3 +96,99 @@ def ctc_loss(logits, labels, logit_lengths, label_lengths, blank: int = 0):
     # (torch.nn.functional.ctc_loss parity)
     return jnp.where((logit_lengths == 0) & (label_lengths == 0),
                      0.0, loss)
+
+
+# ------------------------------------------------------------------ decode
+def ctc_greedy_decode(logits, logit_lengths=None, blank: int = 0,
+                      merge_repeated: bool = True):
+    """Greedy (best-path) CTC decoding — libnd4j's greedy companion to
+    ``ctc_beam`` (TF ``ctc_greedy_decoder`` semantics).
+
+    logits [B, T, C] → (decoded [B, T] int32, left-packed and padded
+    with -1; lengths [B] int32).  jit-safe: the repeat-collapse +
+    blank-removal compaction is a masked cumsum scatter, no
+    data-dependent shapes.
+    """
+    logits = jnp.asarray(logits)
+    b, t, _ = logits.shape
+    ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)        # [B, T]
+    steps = jnp.arange(t)[None, :]
+    valid = (steps < (jnp.asarray(logit_lengths, jnp.int32)[:, None]
+                      if logit_lengths is not None else t))
+    prev = jnp.concatenate([jnp.full((b, 1), -1, jnp.int32), ids[:, :-1]],
+                           axis=1)
+    keep = (ids != blank) & valid
+    if merge_repeated:
+        keep &= ids != prev
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1       # [B, T]
+    lengths = jnp.where(keep, pos + 1, 0).max(axis=1).astype(jnp.int32)
+    dest = jnp.where(keep, pos, t)          # masked entries → overflow col
+    out = jnp.full((b, t + 1), -1, jnp.int32)
+    out = out.at[jnp.arange(b)[:, None], dest].set(
+        jnp.where(keep, ids, -1))
+    return out[:, :t], lengths
+
+
+def ctc_beam_decode(logits, beam_width: int = 10, top_paths: int = 1,
+                    blank: int = 0, logit_lengths=None):
+    """CTC prefix beam search — libnd4j ``ctc_beam`` parity.
+
+    Host-side (eager numpy) like the reference's CPU implementation and
+    this framework's other data-dependent-size ops: the beam's prefix
+    set grows dynamically, which has no static-shape formulation worth
+    jitting.  logits [B, T, C] (unnormalized) → list over batch of
+    ``top_paths`` (sequence list, log-probability) pairs, best first.
+    """
+    import numpy as np
+
+    logits = np.asarray(logits, np.float32)
+    b, t, c = logits.shape
+    logp_all = logits - _np_logsumexp(logits)
+    lengths = (np.asarray(logit_lengths, np.int64)
+               if logit_lengths is not None else np.full(b, t))
+    results = []
+    NEG = -1e30
+
+    def lse(*xs):
+        m = max(xs)
+        if m <= NEG / 2:
+            return NEG
+        return m + np.log(sum(np.exp(x - m) for x in xs))
+
+    for i in range(b):
+        # prefix -> (log P ending in blank, log P ending in non-blank)
+        beams = {(): (0.0, NEG)}
+        for step in range(int(lengths[i])):
+            lp = logp_all[i, step]
+            new: dict = {}
+
+            def add(prefix, pb, pnb):
+                opb, opnb = new.get(prefix, (NEG, NEG))
+                new[prefix] = (lse(opb, pb), lse(opnb, pnb))
+
+            for prefix, (pb, pnb) in beams.items():
+                total = lse(pb, pnb)
+                add(prefix, total + lp[blank], NEG)          # emit blank
+                for s in range(c):
+                    if s == blank:
+                        continue
+                    p_s = lp[s]
+                    if prefix and prefix[-1] == s:
+                        # repeat: extends only from the blank-ended mass;
+                        # the non-blank mass collapses into the same prefix
+                        add(prefix, NEG, pnb + p_s)
+                        add(prefix + (s,), NEG, pb + p_s)
+                    else:
+                        add(prefix + (s,), NEG, total + p_s)
+            beams = dict(sorted(new.items(), key=lambda kv: -lse(*kv[1]))
+                         [:beam_width])
+        ranked = sorted(((lse(*v), k) for k, v in beams.items()),
+                        reverse=True)[:top_paths]
+        results.append([(list(k), float(p)) for p, k in ranked])
+    return results
+
+
+def _np_logsumexp(x):
+    import numpy as np
+    m = np.max(x, axis=-1, keepdims=True)
+    return m + np.log(np.sum(np.exp(x - m), axis=-1, keepdims=True))

@@ -371,3 +371,153 @@ def crop_and_resize(img, boxes, box_indices, crop_h: int, crop_w: int):
 
     return jax.vmap(one)(jnp.asarray(boxes),
                          jnp.asarray(box_indices).astype(jnp.int32))
+
+
+# ===================================================== round-5 catalog tail
+def image_resize(img, out_h: int, out_w: int, method: str = "bilinear",
+                 antialias: bool = True):
+    """libnd4j ``image_resize`` method dispatcher over the last three
+    axes [..., H, W, C].  Methods: nearest, bilinear, bicubic, area,
+    lanczos3, lanczos5 (gaussian/mitchellcubic are documented exclusions
+    — docs/OPS_EXCLUSIONS.md)."""
+    method = method.lower()
+    if method == "area":
+        return resize_area(img, out_h, out_w)
+    table = {"nearest": "nearest", "bilinear": "bilinear",
+             "bicubic": "cubic", "lanczos3": "lanczos3",
+             "lanczos5": "lanczos5"}
+    if method not in table:
+        raise ValueError(f"unsupported resize method {method!r} "
+                         f"(see docs/OPS_EXCLUSIONS.md)")
+    shape = img.shape[:-3] + (out_h, out_w, img.shape[-1])
+    kw = {} if table[method] == "nearest" else {"antialias": antialias}
+    return jax.image.resize(img, shape, method=table[method], **kw)
+
+
+def central_crop(img, fraction: float):
+    """TF ``central_crop`` parity: keep the central ``fraction`` of H/W."""
+    h, w = img.shape[-3], img.shape[-2]
+    ch = max(1, int(round(h * fraction)))
+    cw = max(1, int(round(w * fraction)))
+    top, left = (h - ch) // 2, (w - cw) // 2
+    return img[..., top:top + ch, left:left + cw, :]
+
+
+def pad_to_bounding_box(img, offset_h: int, offset_w: int,
+                        target_h: int, target_w: int):
+    """TF ``pad_to_bounding_box`` parity (zero padding)."""
+    h, w = img.shape[-3], img.shape[-2]
+    if offset_h < 0 or offset_w < 0 or offset_h + h > target_h \
+            or offset_w + w > target_w:
+        raise ValueError("image does not fit the target bounding box")
+    widths = [(0, 0)] * (img.ndim - 3) + [
+        (offset_h, target_h - offset_h - h),
+        (offset_w, target_w - offset_w - w), (0, 0)]
+    return jnp.pad(img, widths)
+
+
+def max_pool_with_argmax(x, kh: int, kw: int, sh: int = 1, sw: int = 1,
+                         padding: str = "VALID"):
+    """libnd4j/TF ``max_pool_with_argmax``: NHWC max pool + the FLAT
+    NHWC index of each window's max (TF's include_batch_in_index=False
+    convention: index into the [H*W*C] plane of its own image)."""
+    n, h, w, c = x.shape
+    if padding == "SAME":
+        # pad with -inf, NOT zeros: a border window whose true max is
+        # negative must not have the padding win the argmax
+        oh, ow = -(-h // sh), -(-w // sw)
+        pad_h = max((oh - 1) * sh + kh - h, 0)
+        pad_w = max((ow - 1) * sw + kw - w, 0)
+        x = jnp.pad(x, ((0, 0), (pad_h // 2, pad_h - pad_h // 2),
+                        (pad_w // 2, pad_w - pad_w // 2), (0, 0)),
+                    constant_values=-jnp.inf)
+        patches = extract_image_patches(x, kh, kw, sh, sw, "VALID")
+    else:
+        patches = extract_image_patches(x, kh, kw, sh, sw, padding)
+    oh, ow = patches.shape[1], patches.shape[2]
+    # patch layout: (ki, kj, c) flattened — recover per-tap coordinates
+    p = patches.reshape(n, oh, ow, kh * kw, c)
+    tap = jnp.argmax(p, axis=3)                          # [N, oh, ow, C]
+    pooled = jnp.max(p, axis=3)
+    ki, kj = tap // kw, tap % kw
+    base_i = (jnp.arange(oh) * sh)[None, :, None, None]
+    base_j = (jnp.arange(ow) * sw)[None, None, :, None]
+    # SAME padding shifts the window origin left/up by the pre-pad
+    if padding == "SAME":
+        pad_h = max((oh - 1) * sh + kh - h, 0)
+        pad_w = max((ow - 1) * sw + kw - w, 0)
+        base_i = base_i - pad_h // 2
+        base_j = base_j - pad_w // 2
+    row = jnp.clip(base_i + ki, 0, h - 1)
+    col = jnp.clip(base_j + kj, 0, w - 1)
+    chan = jnp.arange(c)[None, None, None, :]
+    argmax = (row * w + col) * c + chan
+    return pooled, argmax.astype(jnp.int32)
+
+
+def dilation2d(x, filt, sh: int = 1, sw: int = 1, padding: str = "VALID",
+               rh: int = 1, rw: int = 1):
+    """Grayscale morphological dilation (libnd4j/TF ``dilation2d``):
+    y[i,j,c] = max_{di,dj} x[i·s+di·r, j·s+dj·r, c] + filt[di,dj,c]."""
+    kh, kw, c = filt.shape
+    if (rh, rw) != (1, 1):
+        # dilate the filter grid by inserting -inf holes
+        f = jnp.full(((kh - 1) * rh + 1, (kw - 1) * rw + 1, c), -jnp.inf,
+                     filt.dtype)
+        f = f.at[::rh, ::rw].set(filt)
+        filt, (kh, kw) = f, f.shape[:2]
+    if padding == "SAME":
+        # -inf padding (TF dilation2d semantics) — zero padding would
+        # corrupt borders of negative-valued feature maps
+        h, w = x.shape[1], x.shape[2]
+        oh, ow = -(-h // sh), -(-w // sw)
+        pad_h = max((oh - 1) * sh + kh - h, 0)
+        pad_w = max((ow - 1) * sw + kw - w, 0)
+        x = jnp.pad(x, ((0, 0), (pad_h // 2, pad_h - pad_h // 2),
+                        (pad_w // 2, pad_w - pad_w // 2), (0, 0)),
+                    constant_values=-jnp.inf)
+        patches = extract_image_patches(x, kh, kw, sh, sw, "VALID")
+    else:
+        patches = extract_image_patches(x, kh, kw, sh, sw, padding)
+    n, oh, ow, _ = patches.shape
+    p = patches.reshape(n, oh, ow, kh * kw, c)
+    return jnp.max(p + filt.reshape(kh * kw, c), axis=3)
+
+
+def random_multinomial(key, n: int, logits):
+    """Counts of ``n`` categorical draws per row of ``logits`` [..., C]
+    (libnd4j random_multinomial parity): returns [..., C] int32 counts
+    summing to ``n`` along the last axis."""
+    logits = jnp.asarray(logits)
+    c = logits.shape[-1]
+    tiled = jnp.broadcast_to(logits[..., None, :],
+                             logits.shape[:-1] + (n, c))
+    draws = jax.random.categorical(key, tiled, axis=-1)   # [..., n]
+    return jnp.sum(jax.nn.one_hot(draws, c, dtype=jnp.int32), axis=-2)
+
+
+def _cyclic_shift(x, n, left: bool):
+    x = jnp.asarray(x)
+    bits = x.dtype.itemsize * 8
+    n = jnp.asarray(n) % bits
+    # complementary shift stays < bits (a full-width shift is
+    # implementation-defined in XLA); n == 0 handled by the where
+    comp = (bits - n) % bits
+    ux = x.view(jnp.uint32 if bits == 32 else
+                jnp.uint64 if bits == 64 else
+                jnp.uint16 if bits == 16 else jnp.uint8)
+    if left:
+        out = (ux << n) | (ux >> comp)
+    else:
+        out = (ux >> n) | (ux << comp)
+    return jnp.where(n == 0, ux, out).view(x.dtype)
+
+
+def cyclic_shift_left(x, n):
+    """libnd4j ``cyclic_shift_bits`` (rotate left)."""
+    return _cyclic_shift(x, n, True)
+
+
+def cyclic_shift_right(x, n):
+    """libnd4j ``cyclic_rshift_bits`` (rotate right)."""
+    return _cyclic_shift(x, n, False)

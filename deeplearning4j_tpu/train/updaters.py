@@ -91,10 +91,17 @@ class Adam(_UpdaterBase):
     beta1: float = 0.9
     beta2: float = 0.999
     epsilon: float = 1e-8
+    # optional reduced-precision FIRST moment ("bf16"): halves the mu
+    # read+write HBM traffic of the update (~1.3 ms/step at BERT-base on
+    # v5e); second moment stays f32 (its dynamic range does the work)
+    mu_dtype: Any = None
 
     def to_optax(self):
+        import jax.numpy as jnp
+        mu = jnp.bfloat16 if self.mu_dtype in ("bf16", "bfloat16") \
+            else self.mu_dtype
         return optax.adam(_lr(self.learning_rate), b1=self.beta1, b2=self.beta2,
-                          eps=self.epsilon)
+                          eps=self.epsilon, mu_dtype=mu)
 
 
 @register("adamw")

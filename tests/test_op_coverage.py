@@ -334,8 +334,9 @@ def test_loss_ops_golden():
     # record the whole namespace as executed there + here
     for name in op_inventory()["loss"]:
         fn = getattr(ns.loss, name)
-        if name == "ctc_loss":
-            continue     # own signature; covered by test_ctc_loss_vs_torch
+        if name in ("ctc_loss", "ctc_greedy_decode", "ctc_beam_decode"):
+            continue     # own signatures; covered by test_ctc_loss_vs_torch
+            # and test_round5_ctc_decode
         if name == "mean_score":
             out = fn(jnp.asarray(np.abs(z[:, 0])), None)
         elif name == "sparse_mcxent":
@@ -356,6 +357,9 @@ def test_random_ops():
         if name in ("split", "key", "fold_in"):
             LEDGER.record(f"random.{name}")
             continue
+        if name in ("randint", "cauchy", "weibull", "dirichlet",
+                    "student_t", "rademacher", "multinomial"):
+            continue     # own signatures; covered by test_round5_random_tail
         if name == "bernoulli":
             a, b2 = fn(key, 0.3, (2000,)), fn(key, 0.3, (2000,))
             assert abs(float(jnp.mean(a)) - 0.3) < 0.05
@@ -1266,6 +1270,207 @@ def test_new_op_grad_smoke():
                     order=1, modes=("rev",), atol=1e-3, rtol=1e-3)
     finally:
         jax.config.update("jax_enable_x64", False)
+
+
+def test_round5_linalg_tail(rng):
+    """Matrix-function tail (sqrtm/expm/solve family/polar/structured)."""
+    A = rng.normal(0, 0.5, (4, 4)).astype(np.float64)
+    spd = jnp.asarray(A @ A.T + 4 * np.eye(4))
+    s = ns.linalg.sqrtm(spd)
+    np.testing.assert_allclose(np.asarray(s @ s).real, np.asarray(spd),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ns.linalg.expm(jnp.zeros((3, 3)))),
+                               np.eye(3), atol=1e-6)
+    L = jnp.asarray(np.tril(A) + 4 * np.eye(4))
+    bvec = jnp.asarray(rng.normal(size=(4,)))
+    xs = ns.linalg.solve_triangular(L, bvec, lower=True)
+    np.testing.assert_allclose(np.asarray(L @ xs), np.asarray(bvec),
+                               rtol=1e-6)
+    LEDGER.record("linalg.sqrtm", "linalg.expm", "linalg.solve_triangular")
+
+    M = jnp.asarray(A + 5 * np.eye(4))
+    lu = ns.linalg.lu_factor(M)
+    np.testing.assert_allclose(np.asarray(M @ ns.linalg.lu_solve(lu, bvec)),
+                               np.asarray(bvec), rtol=1e-6)
+    ch = ns.linalg.cho_factor(spd)
+    np.testing.assert_allclose(np.asarray(spd @ ns.linalg.cho_solve(ch, bvec)),
+                               np.asarray(bvec), rtol=1e-6)
+    LEDGER.record("linalg.lu_factor", "linalg.lu_solve",
+                  "linalg.cho_factor", "linalg.cho_solve")
+
+    ev = np.sort(np.asarray(ns.linalg.eigvalsh(spd)))
+    ref = np.sort(np.linalg.eigvalsh(np.asarray(spd)))
+    np.testing.assert_allclose(ev, ref, rtol=1e-6)
+    evg = np.asarray(ns.linalg.eigvals(spd))
+    np.testing.assert_allclose(np.sort(evg.real), ref, rtol=1e-5)
+    LEDGER.record("linalg.eigvals", "linalg.eigvalsh")
+
+    T4 = jnp.asarray(rng.normal(size=(2, 2, 2, 2)) + np.einsum(
+        "ik,jl->ijkl", 3 * np.eye(2), np.eye(2)))
+    B2 = jnp.asarray(rng.normal(size=(2, 2)))
+    X = ns.linalg.tensorsolve(T4, B2)
+    np.testing.assert_allclose(np.einsum("ijkl,kl->ij", np.asarray(T4),
+                                         np.asarray(X)),
+                               np.asarray(B2), rtol=1e-5)
+    Tinv = ns.linalg.tensorinv(T4, ind=2)
+    np.testing.assert_allclose(
+        np.einsum("ijkl,klmn->ijmn", np.asarray(Tinv), np.asarray(T4)),
+        np.einsum("ik,jl->ijkl", np.eye(2), np.eye(2)), atol=1e-5)
+    LEDGER.record("linalg.tensorsolve", "linalg.tensorinv")
+
+    U, P = ns.linalg.polar(jnp.asarray(A + 3 * np.eye(4)))
+    np.testing.assert_allclose(np.asarray(U @ P),
+                               np.asarray(A + 3 * np.eye(4)), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(U @ U.T), np.eye(4), atol=1e-5)
+    bd = np.asarray(ns.linalg.block_diag(jnp.ones((2, 2)),
+                                         2 * jnp.ones((1, 1))))
+    assert bd.shape == (3, 3) and bd[2, 2] == 2 and bd[0, 2] == 0
+    tp = np.asarray(ns.linalg.toeplitz(jnp.asarray([1.0, 2, 3])))
+    np.testing.assert_allclose(tp, [[1, 2, 3], [2, 1, 2], [3, 2, 1]])
+    LEDGER.record("linalg.polar", "linalg.block_diag", "linalg.toeplitz")
+
+
+def test_round5_random_tail():
+    key = jax.random.key(0)
+    r = ns.random.randint(key, (200,), 3, 9)
+    assert int(r.min()) >= 3 and int(r.max()) < 9
+    for name in ("cauchy", "student_t", "weibull"):
+        fn = getattr(ns.random, name)
+        if name == "student_t":
+            v = fn(key, 3.0, (50,))
+        elif name == "weibull":
+            v = fn(key, 1.0, 1.5, (50,))
+        else:
+            v = fn(key, (50,))
+        assert v.shape == (50,) and bool(jnp.all(jnp.isfinite(v)))
+    d = ns.random.dirichlet(key, jnp.ones(4), (10,))
+    np.testing.assert_allclose(np.asarray(d.sum(-1)), 1.0, rtol=1e-5)
+    rad = np.asarray(ns.random.rademacher(key, (100,)))
+    assert set(np.unique(rad)) <= {-1, 1}
+    counts_ = ns.random.multinomial(key, 32, jnp.zeros((5, 4)))
+    assert counts_.shape == (5, 4)
+    np.testing.assert_array_equal(np.asarray(counts_.sum(-1)), 32)
+    LEDGER.record("random.randint", "random.cauchy", "random.weibull",
+                  "random.dirichlet", "random.student_t",
+                  "random.rademacher", "random.multinomial")
+
+
+def test_round5_image_tail(rng):
+    img = jnp.asarray(rng.uniform(0, 1, (2, 8, 8, 3)).astype(np.float32))
+    bl = ns.image.image_resize(img, 4, 4, method="bilinear")
+    np.testing.assert_allclose(np.asarray(bl),
+                               np.asarray(ns.image.resize_bilinear(img, 4, 4)),
+                               rtol=1e-6)
+    for m in ("nearest", "bicubic", "area"):
+        assert ns.image.image_resize(img, 4, 4, method=m).shape == (2, 4, 4, 3)
+    assert ns.image.resize_lanczos3(img, 16, 16).shape == (2, 16, 16, 3)
+    assert ns.image.resize_lanczos5(img, 5, 5).shape == (2, 5, 5, 3)
+    cc = ns.image.central_crop(img, 0.5)
+    np.testing.assert_allclose(np.asarray(cc), np.asarray(img[:, 2:6, 2:6]))
+    pad = ns.image.pad_to_bounding_box(img, 1, 2, 12, 13)
+    assert pad.shape == (2, 12, 13, 3)
+    np.testing.assert_allclose(np.asarray(pad[:, 1:9, 2:10]),
+                               np.asarray(img))
+    assert float(pad[:, 0].max()) == 0.0
+    LEDGER.record("image.image_resize", "image.resize_lanczos3",
+                  "image.resize_lanczos5", "image.central_crop",
+                  "image.pad_to_bounding_box")
+
+
+def test_round5_cnn_tail(rng):
+    x = jnp.asarray(rng.normal(size=(1, 4, 4, 2)).astype(np.float32))
+    pooled, argmax = ns.cnn.max_pool_with_argmax(x, 2, 2, 2, 2)
+    assert pooled.shape == (1, 2, 2, 2)
+    # gather-back property: x.flat[argmax] == pooled (per image plane)
+    flat = np.asarray(x).reshape(1, -1)
+    np.testing.assert_allclose(
+        flat[0][np.asarray(argmax).reshape(-1)],
+        np.asarray(pooled).reshape(-1), rtol=1e-6)
+    ref = np.asarray(x).reshape(1, 2, 2, 2, 2, 2).max(axis=(2, 4))
+    np.testing.assert_allclose(np.asarray(pooled), ref, rtol=1e-6)
+
+    filt = jnp.asarray(rng.normal(0, 0.1, (2, 2, 2)).astype(np.float32))
+    dil = ns.cnn.dilation2d(x, filt, 1, 1, "VALID")
+    assert dil.shape == (1, 3, 3, 2)
+    want = np.full((3, 3, 2), -np.inf, np.float32)
+    xa, fa = np.asarray(x)[0], np.asarray(filt)
+    for i in range(3):
+        for j in range(3):
+            for c in range(2):
+                want[i, j, c] = max(xa[i + di, j + dj, c] + fa[di, dj, c]
+                                    for di in range(2) for dj in range(2))
+    np.testing.assert_allclose(np.asarray(dil)[0], want, rtol=1e-5)
+
+    # SAME padding pads with -inf, not zeros: all-negative input must
+    # pool/dilate to its own values at the borders (review regression)
+    neg = -jnp.ones((1, 3, 3, 1), jnp.float32)
+    pooled_s, arg_s = ns.cnn.max_pool_with_argmax(neg, 2, 2, 1, 1, "SAME")
+    np.testing.assert_allclose(np.asarray(pooled_s), -1.0)
+    flatneg = np.asarray(neg).reshape(-1)
+    np.testing.assert_allclose(flatneg[np.asarray(arg_s).reshape(-1)], -1.0)
+    dil_s = ns.cnn.dilation2d(-5 * jnp.ones((1, 3, 3, 1)),
+                              jnp.zeros((2, 2, 1)), 1, 1, "SAME")
+    np.testing.assert_allclose(np.asarray(dil_s), -5.0)
+    LEDGER.record("cnn.max_pool_with_argmax", "cnn.dilation2d")
+
+
+def test_round5_base_bitwise_tail():
+    oh = np.asarray(ns.base.one_hot(jnp.asarray([0, 2]), 3,
+                                    on_value=5.0, off_value=-1.0))
+    np.testing.assert_allclose(oh, [[5, -1, -1], [-1, -1, 5]])
+    assert int(ns.base.searchsorted(jnp.asarray([1.0, 3, 5]),
+                                    jnp.asarray(4.0))) == 2
+    np.testing.assert_array_equal(
+        np.asarray(ns.base.diff(jnp.asarray([1, 4, 9]))), [3, 5])
+    x = jnp.asarray(np.array([0x80000001], np.uint32).view(np.int32))
+    rl = ns.bitwise.cyclic_shift_left(x, 1)
+    np.testing.assert_array_equal(np.asarray(rl).view(np.uint32),
+                                  [0x00000003])
+    back = ns.bitwise.cyclic_shift_right(rl, 1)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+    LEDGER.record("base.one_hot", "base.searchsorted", "base.diff",
+                  "bitwise.cyclic_shift_left", "bitwise.cyclic_shift_right")
+
+
+def test_round5_ctc_decode():
+    """Greedy: collapse repeats then drop blanks; beam recovers the
+    higher-probability multi-path label over the greedy path."""
+    # T=5, C=3 (blank=0): argmax path = [1,1,0,2,2] → decode [1,2]
+    big = 5.0
+    logits = np.full((1, 5, 3), -big, np.float32)
+    for t, s in enumerate([1, 1, 0, 2, 2]):
+        logits[0, t, s] = big
+    dec, lens = ns.loss.ctc_greedy_decode(jnp.asarray(logits))
+    assert int(lens[0]) == 2
+    np.testing.assert_array_equal(np.asarray(dec)[0, :2], [1, 2])
+    assert np.all(np.asarray(dec)[0, 2:] == -1)
+
+    # merge_repeated=False keeps the duplicate
+    dec2, lens2 = ns.loss.ctc_greedy_decode(jnp.asarray(logits),
+                                            merge_repeated=False)
+    assert int(lens2[0]) == 4
+    np.testing.assert_array_equal(np.asarray(dec2)[0, :4], [1, 1, 2, 2])
+
+    # logit_lengths masks the tail
+    dec3, lens3 = ns.loss.ctc_greedy_decode(jnp.asarray(logits),
+                                            logit_lengths=jnp.asarray([2]))
+    assert int(lens3[0]) == 1 and int(np.asarray(dec3)[0, 0]) == 1
+
+    # beam == greedy on a peaked distribution
+    paths = ns.loss.ctc_beam_decode(jnp.asarray(logits), beam_width=4,
+                                    top_paths=2)
+    assert paths[0][0][0] == [1, 2]
+    assert paths[0][0][1] > paths[0][1][1]
+
+    # classic beam-vs-greedy case: greedy picks blank-heavy [T=2] frames
+    # but the summed label mass wins under the beam
+    lg = np.log(np.asarray([[[0.4, 0.6, 0.0],
+                             [0.4, 0.6, 0.0]]], np.float32) + 1e-9)
+    paths = ns.loss.ctc_beam_decode(jnp.asarray(lg), beam_width=8)
+    # P([1]) = 0.6·0.4 + 0.4·0.6 + 0.6·0.6 = 0.84 > P([]) = 0.16
+    assert paths[0][0][0] == [1]
+    np.testing.assert_allclose(np.exp(paths[0][0][1]), 0.84, rtol=1e-4)
+    LEDGER.record("loss.ctc_greedy_decode", "loss.ctc_beam_decode")
 
 
 def test_zz_coverage_ledger():
