@@ -145,18 +145,10 @@ def _convert_layer(kcfg: dict):
         return cell
     if cls == "Bidirectional":
         inner_cfg = conf["layer"]
-        if inner_cfg.get("class_name") != "LSTM":
-            raise KeyError(
-                f"unsupported Keras Bidirectional inner layer "
-                f"'{inner_cfg.get('class_name')}' (only LSTM is converted — "
-                f"KerasLayer converter missing)")
-        inner_conf = inner_cfg["config"]
         # build the bare cell: return_sequences handling belongs to the
         # WRAPPER (last-step of the merged fwd/bwd output), not the cell
-        cell = LSTM(name=inner_conf.get("name"), n_out=inner_conf["units"],
-                    activation=_act(inner_conf.get("activation", "tanh")),
-                    gate_activation=_act(inner_conf.get("recurrent_activation",
-                                                        "sigmoid")))
+        cell = _bare_recurrent_cell(inner_cfg)
+        inner_conf = inner_cfg["config"]
         mode = {"concat": "concat", "sum": "add", "ave": "average",
                 "mul": "mul"}.get(conf.get("merge_mode", "concat"), "concat")
         if not inner_conf.get("return_sequences", False):
@@ -167,9 +159,14 @@ def _convert_layer(kcfg: dict):
             return BidirectionalLastStep(name=name, fwd=cell, mode=mode)
         return Bidirectional(name=name, fwd=cell, mode=mode)
     if cls in ("GlobalAveragePooling2D", "GlobalMaxPooling2D",
-               "GlobalAveragePooling1D", "GlobalMaxPooling1D"):
+               "GlobalAveragePooling1D", "GlobalMaxPooling1D",
+               "GlobalAveragePooling3D", "GlobalMaxPooling3D"):
         return GlobalPoolingLayer(name=name,
                                   pooling_type="avg" if "Average" in cls else "max")
+    if cls == "ThresholdedReLU":
+        return ActivationLayer(
+            name=name,
+            activation=f"thresholdedrelu:{conf.get('theta', 1.0)}")
     if cls == "Conv1D":
         from deeplearning4j_tpu.nn.layers import Convolution1DLayer
         if conf.get("padding") == "causal":
@@ -421,6 +418,34 @@ def _convert_layer(kcfg: dict):
                    f"(register_custom_converter(class_name, fn) to extend)")
 
 
+def _bare_recurrent_cell(kcfg: dict):
+    """Inner cell for Bidirectional: LSTM / GRU / SimpleRNN without the
+    return_sequences wrapping (that belongs to the wrapper)."""
+    cls = kcfg.get("class_name")
+    conf = kcfg["config"]
+    name = conf.get("name")
+    if cls == "LSTM":
+        return LSTM(name=name, n_out=conf["units"],
+                    activation=_act(conf.get("activation", "tanh")),
+                    gate_activation=_act(conf.get("recurrent_activation",
+                                                  "sigmoid")))
+    if cls == "GRU":
+        from deeplearning4j_tpu.nn.layers import GRU as GRULayer
+        if not conf.get("reset_after", True):
+            raise KeyError("unsupported Keras GRU reset_after=False inside "
+                           "Bidirectional")
+        return GRULayer(name=name, n_out=conf["units"],
+                        activation=_act(conf.get("activation", "tanh")),
+                        gate_activation=_act(conf.get("recurrent_activation",
+                                                      "sigmoid")))
+    if cls == "SimpleRNN":
+        from deeplearning4j_tpu.nn.layers import SimpleRnn
+        return SimpleRnn(name=name, n_out=conf["units"],
+                         activation=_act(conf.get("activation", "tanh")))
+    raise KeyError(f"unsupported Keras Bidirectional inner layer '{cls}' "
+                   f"(LSTM/GRU/SimpleRNN convert)")
+
+
 def _mha_layer(kcfg: dict):
     """Keras MultiHeadAttention (self-attention form) →
     :class:`SelfAttentionLayer` with per-head projections + biases.
@@ -551,50 +576,19 @@ def load_weights(net: MultiLayerNetwork, weights: dict[str, list[np.ndarray]]) -
         # MaskZeroLayer(LastTimeStep(LSTM)))
         while isinstance(layer, LastTimeStep) or _is(layer, "MaskZeroLayer"):
             layer = layer.underlying
-        if isinstance(layer, Bidirectional) and isinstance(layer.fwd, LSTM):
-            # keras order: fwd (W,U,b) then bwd (W,U,b), each IFCO
-            h = layer.fwd.n_out
-            for half, (w, u, b) in (("fwd", arrays[:3]), ("bwd", arrays[3:])):
-                params[half]["W"] = _ifco_to_ifog(np.asarray(w), h)
-                params[half]["U"] = _ifco_to_ifog(np.asarray(u), h)
-                params[half]["b"] = _ifco_to_ifog(np.asarray(b)[None, :], h)[0]
-        elif isinstance(layer, LSTM):
-            w, u, b = arrays  # keras: [in,4H] IFCO
-            params["W"] = _ifco_to_ifog(w, layer.n_out)
-            params["U"] = _ifco_to_ifog(u, layer.n_out)
-            params["b"] = _ifco_to_ifog(b[None, :], layer.n_out)[0]
+        if isinstance(layer, Bidirectional):
+            # keras order: fwd (W,U[,b]) then bwd (W,U[,b]); per-cell
+            # gate mapping shared with the single-layer branches
+            per = len(arrays) // 2
+            for half, arrs in (("fwd", arrays[:per]), ("bwd", arrays[per:])):
+                params[half].update(_recurrent_param_map(layer.fwd, arrs))
+        elif isinstance(layer, LSTM) or _is(layer, "GRU") \
+                or _is(layer, "SimpleRnn"):
+            params.update(_recurrent_param_map(layer, arrays))
         elif isinstance(layer, BatchNormalization):
             gamma, beta, mean, var = arrays
             params["gamma"], params["beta"] = gamma, beta
             net.state_[i]["mean"], net.state_[i]["var"] = mean, var
-        elif _is(layer, "GRU"):
-            # keras (reset_after=True): kernel/recurrent [in,3H] gates
-            # z,r,h and bias [2,3H] (input + recurrent); ours: r,u(z),c
-            # with a single input-side bias
-            h = layer.n_out
-            w, u = arrays[0], arrays[1]
-            params["W"] = _zrh_to_ruc(np.asarray(w), h)
-            params["U"] = _zrh_to_ruc(np.asarray(u), h)
-            b = (np.asarray(arrays[2]) if len(arrays) > 2
-                 else np.zeros(3 * h, np.float32))
-            if b.ndim == 2:       # [2, 3H]: input bias + recurrent bias
-                # the z/r recurrent-bias slices add outside the reset
-                # product, so they fold exactly into the input bias; only
-                # the candidate slice is multiplied by r and cannot
-                rec = b[1].copy()
-                if not np.allclose(rec[2 * h:], 0.0, atol=1e-6):
-                    raise ValueError(
-                        "Keras GRU has a nonzero recurrent bias on the "
-                        "candidate gate — multiplied by r, it cannot be "
-                        "folded into the input bias exactly")
-                b = b[0].copy()
-                b[:2 * h] += rec[:2 * h]
-            params["b"] = _zrh_to_ruc(b[None, :], h)[0]
-        elif _is(layer, "SimpleRnn"):
-            w, u = arrays[0], arrays[1]
-            b = (np.asarray(arrays[2]) if len(arrays) > 2
-                 else np.zeros(layer.n_out, np.float32))
-            params["W"], params["U"], params["b"] = np.asarray(w), np.asarray(u), b
         elif _is(layer, "SeparableConvolution2D"):
             # keras: [depthwise (kh,kw,cin,mult), pointwise, bias];
             # ours: depthW (kh,kw,1,cin*mult) — both flatten (cin,mult)
@@ -686,6 +680,46 @@ def load_weights(net: MultiLayerNetwork, weights: dict[str, list[np.ndarray]]) -
                 params[key] = arr
 
 
+def _recurrent_param_map(cell, arrays) -> dict:
+    """Keras (W, U[, b]) arrays → this framework's cell params, per cell
+    family (shared by the single-layer and Bidirectional-half paths)."""
+    h = cell.n_out
+    kind = type(cell).__name__
+    if isinstance(cell, LSTM) or kind in ("LSTM", "GravesLSTM"):
+        w, u, b = arrays      # keras: [in,4H] IFCO
+        return {"W": _ifco_to_ifog(np.asarray(w), h),
+                "U": _ifco_to_ifog(np.asarray(u), h),
+                "b": _ifco_to_ifog(np.asarray(b)[None, :], h)[0]}
+    if kind == "GRU":
+        # keras (reset_after=True): kernel/recurrent [in,3H] gates z,r,h
+        # and bias [2,3H] (input + recurrent); ours: r,u(z),c with a
+        # single input-side bias
+        w, u = arrays[0], arrays[1]
+        b = (np.asarray(arrays[2]) if len(arrays) > 2
+             else np.zeros(3 * h, np.float32))
+        if b.ndim == 2:       # [2, 3H]: input bias + recurrent bias
+            # the z/r recurrent-bias slices add outside the reset
+            # product, so they fold exactly into the input bias; only
+            # the candidate slice is multiplied by r and cannot
+            rec = b[1].copy()
+            if not np.allclose(rec[2 * h:], 0.0, atol=1e-6):
+                raise ValueError(
+                    "Keras GRU has a nonzero recurrent bias on the "
+                    "candidate gate — multiplied by r, it cannot be "
+                    "folded into the input bias exactly")
+            b = b[0].copy()
+            b[:2 * h] += rec[:2 * h]
+        return {"W": _zrh_to_ruc(np.asarray(w), h),
+                "U": _zrh_to_ruc(np.asarray(u), h),
+                "b": _zrh_to_ruc(b[None, :], h)[0]}
+    if kind == "SimpleRnn":
+        w, u = arrays[0], arrays[1]
+        b = (np.asarray(arrays[2]) if len(arrays) > 2
+             else np.zeros(h, np.float32))
+        return {"W": np.asarray(w), "U": np.asarray(u), "b": b}
+    raise KeyError(f"no keras weight mapping for recurrent cell {kind}")
+
+
 def _ifco_to_ifog(w: np.ndarray, h: int) -> np.ndarray:
     """Keras LSTM gate order i,f,c,o → ours i,f,o,g(c)."""
     i, f, c, o = (w[:, 0:h], w[:, h:2 * h], w[:, 2 * h:3 * h], w[:, 3 * h:4 * h])
@@ -763,7 +797,7 @@ def import_keras_model_and_weights(path: str, loss: str = "mcxent"):
 # --------------------------------------------------------------- functional
 _MERGE_CLASSES = {"Concatenate": None, "Add": "add", "Subtract": "subtract",
                   "Multiply": "product", "Average": "average",
-                  "Maximum": "max"}
+                  "Maximum": "max", "Minimum": "min"}
 
 
 def _shape_to_input_type(shape) -> InputType:

@@ -100,6 +100,9 @@ class ElementWiseVertex(GraphVertex):
         elif op == "max":
             for x in inputs[1:]:
                 out = jnp.maximum(out, x)
+        elif op == "min":
+            for x in inputs[1:]:
+                out = jnp.minimum(out, x)
         else:
             raise ValueError(f"unknown elementwise op '{self.op}'")
         return out

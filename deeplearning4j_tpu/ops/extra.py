@@ -279,18 +279,26 @@ def resize_area(img, out_h: int, out_w: int):
     return jnp.einsum("oh,...hwc,pw->...opc", wh, img, ww)
 
 
+def _same_pads(h, w, kh, kw, sh, sw):
+    """TF SAME geometry: (pad_h, pad_w) with the surplus at the END —
+    the ONE copy of the asymmetric even-kernel split."""
+    oh, ow = -(-h // sh), -(-w // sw)
+    pad_h = max((oh - 1) * sh + kh - h, 0)
+    pad_w = max((ow - 1) * sw + kw - w, 0)
+    return pad_h, pad_w
+
+
 def extract_image_patches(x, kh: int, kw: int, sh: int = 1, sw: int = 1,
-                          padding: str = "VALID"):
+                          padding: str = "VALID", constant_values=0.0):
     """[N,H,W,C] → [N,oh,ow,kh*kw*C] sliding patches (TF parity, incl.
-    TF's asymmetric SAME pad split for even kernels)."""
+    TF's asymmetric SAME pad split for even kernels).
+    ``constant_values`` sets the SAME pad fill (-inf for max-reductions)."""
     from deeplearning4j_tpu.ops.namespaces import _im2col
     if padding == "SAME":
-        h, w = x.shape[1], x.shape[2]
-        oh, ow = -(-h // sh), -(-w // sw)
-        pad_h = max((oh - 1) * sh + kh - h, 0)
-        pad_w = max((ow - 1) * sw + kw - w, 0)
+        pad_h, pad_w = _same_pads(x.shape[1], x.shape[2], kh, kw, sh, sw)
         x = jnp.pad(x, ((0, 0), (pad_h // 2, pad_h - pad_h // 2),
-                        (pad_w // 2, pad_w - pad_w // 2), (0, 0)))
+                        (pad_w // 2, pad_w - pad_w // 2), (0, 0)),
+                    constant_values=constant_values)
     return _im2col(x, kh, kw, sh, sw, 0, 0)
 
 
@@ -396,6 +404,9 @@ def image_resize(img, out_h: int, out_w: int, method: str = "bilinear",
 
 def central_crop(img, fraction: float):
     """TF ``central_crop`` parity: keep the central ``fraction`` of H/W."""
+    if not 0.0 < fraction <= 1.0:
+        raise ValueError(f"central_crop fraction must be in (0, 1], "
+                         f"got {fraction}")
     h, w = img.shape[-3], img.shape[-2]
     ch = max(1, int(round(h * fraction)))
     cw = max(1, int(round(w * fraction)))
@@ -422,18 +433,10 @@ def max_pool_with_argmax(x, kh: int, kw: int, sh: int = 1, sw: int = 1,
     NHWC index of each window's max (TF's include_batch_in_index=False
     convention: index into the [H*W*C] plane of its own image)."""
     n, h, w, c = x.shape
-    if padding == "SAME":
-        # pad with -inf, NOT zeros: a border window whose true max is
-        # negative must not have the padding win the argmax
-        oh, ow = -(-h // sh), -(-w // sw)
-        pad_h = max((oh - 1) * sh + kh - h, 0)
-        pad_w = max((ow - 1) * sw + kw - w, 0)
-        x = jnp.pad(x, ((0, 0), (pad_h // 2, pad_h - pad_h // 2),
-                        (pad_w // 2, pad_w - pad_w // 2), (0, 0)),
-                    constant_values=-jnp.inf)
-        patches = extract_image_patches(x, kh, kw, sh, sw, "VALID")
-    else:
-        patches = extract_image_patches(x, kh, kw, sh, sw, padding)
+    # SAME pads with -inf, NOT zeros: a border window whose true max is
+    # negative must not have the padding win the argmax
+    patches = extract_image_patches(x, kh, kw, sh, sw, padding,
+                                    constant_values=-jnp.inf)
     oh, ow = patches.shape[1], patches.shape[2]
     # patch layout: (ki, kj, c) flattened — recover per-tap coordinates
     p = patches.reshape(n, oh, ow, kh * kw, c)
@@ -444,8 +447,7 @@ def max_pool_with_argmax(x, kh: int, kw: int, sh: int = 1, sw: int = 1,
     base_j = (jnp.arange(ow) * sw)[None, None, :, None]
     # SAME padding shifts the window origin left/up by the pre-pad
     if padding == "SAME":
-        pad_h = max((oh - 1) * sh + kh - h, 0)
-        pad_w = max((ow - 1) * sw + kw - w, 0)
+        pad_h, pad_w = _same_pads(h, w, kh, kw, sh, sw)
         base_i = base_i - pad_h // 2
         base_j = base_j - pad_w // 2
     row = jnp.clip(base_i + ki, 0, h - 1)
@@ -466,19 +468,10 @@ def dilation2d(x, filt, sh: int = 1, sw: int = 1, padding: str = "VALID",
                      filt.dtype)
         f = f.at[::rh, ::rw].set(filt)
         filt, (kh, kw) = f, f.shape[:2]
-    if padding == "SAME":
-        # -inf padding (TF dilation2d semantics) — zero padding would
-        # corrupt borders of negative-valued feature maps
-        h, w = x.shape[1], x.shape[2]
-        oh, ow = -(-h // sh), -(-w // sw)
-        pad_h = max((oh - 1) * sh + kh - h, 0)
-        pad_w = max((ow - 1) * sw + kw - w, 0)
-        x = jnp.pad(x, ((0, 0), (pad_h // 2, pad_h - pad_h // 2),
-                        (pad_w // 2, pad_w - pad_w // 2), (0, 0)),
-                    constant_values=-jnp.inf)
-        patches = extract_image_patches(x, kh, kw, sh, sw, "VALID")
-    else:
-        patches = extract_image_patches(x, kh, kw, sh, sw, padding)
+    # -inf SAME padding (TF dilation2d semantics) — zero padding would
+    # corrupt borders of negative-valued feature maps
+    patches = extract_image_patches(x, kh, kw, sh, sw, padding,
+                                    constant_values=-jnp.inf)
     n, oh, ow, _ = patches.shape
     p = patches.reshape(n, oh, ow, kh * kw, c)
     return jnp.max(p + filt.reshape(kh * kw, c), axis=3)
@@ -499,13 +492,15 @@ def random_multinomial(key, n: int, logits):
 def _cyclic_shift(x, n, left: bool):
     x = jnp.asarray(x)
     bits = x.dtype.itemsize * 8
-    n = jnp.asarray(n) % bits
-    # complementary shift stays < bits (a full-width shift is
-    # implementation-defined in XLA); n == 0 handled by the where
-    comp = (bits - n) % bits
     ux = x.view(jnp.uint32 if bits == 32 else
                 jnp.uint64 if bits == 64 else
                 jnp.uint16 if bits == 16 else jnp.uint8)
+    # the count must be UNSIGNED (ux's dtype): a signed array count would
+    # promote the >> into an arithmetic shift and smear the sign bit
+    n = (jnp.asarray(n) % bits).astype(ux.dtype)
+    # complementary shift stays < bits (a full-width shift is
+    # implementation-defined in XLA); n == 0 handled by the where
+    comp = (jnp.asarray(bits, ux.dtype) - n) % bits
     if left:
         out = (ux << n) | (ux >> comp)
     else:

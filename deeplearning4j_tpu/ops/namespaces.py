@@ -695,8 +695,9 @@ cnn.dilation2d = _extra.dilation2d
 
 # ---- base/bitwise tail
 base.one_hot = lambda x, depth, on_value=1.0, off_value=0.0, axis=-1, \
-    dtype=None: jax.nn.one_hot(x, depth, dtype=dtype or jnp.float32,
-                               axis=axis) * (on_value - off_value) + off_value
+    dtype=None: (jax.nn.one_hot(x, depth, dtype=jnp.float32, axis=axis)
+                 * (on_value - off_value)
+                 + off_value).astype(dtype or jnp.float32)
 base.searchsorted = jnp.searchsorted
 base.diff = jnp.diff
 bitwise.cyclic_shift_left = _extra.cyclic_shift_left

@@ -1,0 +1,96 @@
+"""Multi-PROCESS multi-slice training — the production form of the
+SharedTrainingMaster replacement (VERDICT r4 next #1c).
+
+Each process is one slice leader: gradients + residual + threshold
+encode run fused in that process's jit step (``device_encode``), the
+fixed-capacity message crosses to the host, and a ring
+``SocketTransport`` exchanges the compressed bytes between processes
+while the next step's gradients compute (``overlap``).  Params stay
+byte-identical across processes without any parameter broadcast.
+
+Run:  python examples/multiprocess_dcn_fit.py
+(spawns 2 local worker processes over loopback; the same worker code
+runs unchanged across real hosts by passing ``hosts=`` to
+SocketTransport and a real coordinator to ``launcher.initialize``.)
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+
+import numpy as np
+
+
+def worker(pid: int, n: int, steps: int = 8, port: int = 23801):
+    import jax
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn import NeuralNetConfiguration, InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.compression import (
+        AdaptiveThresholdAlgorithm)
+    from deeplearning4j_tpu.parallel.dcn import SocketTransport
+    from deeplearning4j_tpu.parallel.dcn_trainer import MultiSliceTrainer
+    from deeplearning4j_tpu.train import Sgd
+
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.1))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=32, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    net = MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+    local = DataSet(x[pid::n], y[pid::n])     # this process's shard
+
+    transport = SocketTransport(pid, n, port=port, timeout=30.0)
+    trainer = MultiSliceTrainer(
+        net, n_slices=1, world_size=n, rank_offset=pid,
+        transports=[transport], device_encode=True, overlap=True,
+        devices=jax.local_devices(),
+        algorithm=AdaptiveThresholdAlgorithm(initial_threshold=2e-2))
+    key = jax.random.key(0)
+    losses = []
+    try:
+        for _ in range(steps):
+            key, sub = jax.random.split(key)
+            losses.append(trainer.fit_batch(local, sub))
+        trainer.collect()
+    finally:
+        trainer.close()
+        transport.close()
+
+    from deeplearning4j_tpu.utils.pytree import flat_param_vector
+    ws = trainer.last_wire_stats[0]
+    return {"pid": pid, "losses": losses,
+            "params": np.asarray(flat_param_vector(net.params_)),
+            "wire_bytes": ws["wire_bytes"], "dense_bytes": ws["dense_bytes"],
+            "ring_bytes_sent": transport.bytes_sent}
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import multiprocess_dcn_fit as mod   # importable twin of __main__
+    from deeplearning4j_tpu.parallel.launcher import spawn_local_cluster
+
+    env = {"PYTHONPATH": os.path.dirname(os.path.abspath(__file__))
+           + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    results = spawn_local_cluster(functools.partial(mod.worker),
+                                  n_processes=2, port=12741,
+                                  local_devices=1, extra_env=env)
+    a, b = sorted(results, key=lambda r: r["pid"])
+    drift = float(np.abs(a["params"] - b["params"]).max())
+    print(f"losses (rank 0): {[round(l, 4) for l in a['losses']]}")
+    print(f"param drift between processes: {drift:.1e} (0.0 = byte-identical)")
+    print(f"wire {a['wire_bytes']}B vs dense {a['dense_bytes']}B per step; "
+          f"ring sent {a['ring_bytes_sent']}B total")
+    assert drift == 0.0
+
+
+if __name__ == "__main__":
+    main()

@@ -111,6 +111,21 @@ for l in spec["layers"]:
                                            name=l["name"]))
     elif kind == "permute":
         layers.append(keras.layers.Permute(tuple(l["dims"]), name=l["name"]))
+    elif kind == "bidi_gru":
+        layers.append(keras.layers.Bidirectional(
+            keras.layers.GRU(l["units"],
+                             return_sequences=l.get("seq", False)),
+            merge_mode=l.get("mode", "concat"), name=l["name"]))
+    elif kind == "bidi_rnn":
+        layers.append(keras.layers.Bidirectional(
+            keras.layers.SimpleRNN(l["units"],
+                                   return_sequences=l.get("seq", False)),
+            merge_mode=l.get("mode", "concat"), name=l["name"]))
+    elif kind == "thresholded_relu":
+        layers.append(keras.layers.ThresholdedReLU(theta=l.get("theta", 1.0),
+                                                   name=l["name"]))
+    elif kind == "gap3d":
+        layers.append(keras.layers.GlobalAveragePooling3D(name=l["name"]))
 if spec.get("functional") == "conv_branches":
     # two conv branches, explicit Flatten per branch, Concatenate, head
     inp = keras.layers.Input(shape=(6, 6, 2))
@@ -522,9 +537,11 @@ class TestKerasH5Golden:
         with pytest.raises(ValueError, match="candidate"):
             load_weights(net, {"g": [w, u, b_bad]})
 
-    def test_bidirectional_non_lstm_inner_rejected(self):
-        """Bidirectional(GRU) must fail loudly, not import as LSTM
-        (review regression)."""
+    def test_bidirectional_unsupported_inner_rejected(self):
+        """Bidirectional over a non-recurrent inner layer must fail
+        loudly, not import as LSTM (review regression; GRU/SimpleRNN
+        inner cells convert since round 5 — TestRound5BidirectionalTail
+        has their goldens)."""
         from deeplearning4j_tpu.importers.keras import import_sequential
         model_json = json.dumps({
             "class_name": "Sequential",
@@ -533,8 +550,9 @@ class TestKerasH5Golden:
                  "config": {"batch_input_shape": [None, 6, 4]}},
                 {"class_name": "Bidirectional",
                  "config": {"name": "bidi", "merge_mode": "concat",
-                            "layer": {"class_name": "GRU",
-                                      "config": {"name": "gru", "units": 5}}}},
+                            "layer": {"class_name": "ConvLSTM1D",
+                                      "config": {"name": "cl",
+                                                 "units": 5}}}},
             ]}})
         with pytest.raises(KeyError):
             import_sequential(model_json)
@@ -718,3 +736,42 @@ class TestKerasFinetuneAfterImport:
         # imported weights actually moved
         w = np.asarray(net.params_[0]["W"])
         assert np.all(np.isfinite(w))
+
+
+class TestRound5BidirectionalTail:
+    """Bidirectional beyond LSTM (GRU/SimpleRNN inner cells) + the last
+    activation/pooling converters."""
+
+    def test_bidirectional_gru_golden(self, tmp_path):
+        h5, x, golden = _make_fixture(tmp_path, [
+            {"kind": "input", "shape": [6, 5]},
+            {"kind": "bidi_gru", "units": 7, "name": "bg"},
+            {"kind": "dense", "units": 3, "act": "softmax", "name": "out"},
+        ], (3, 6, 5), seed=21)
+        net = import_keras_model_and_weights(h5)
+        np.testing.assert_allclose(np.asarray(net.output(x)), golden,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_bidirectional_simplernn_sequences_golden(self, tmp_path):
+        h5, x, golden = _make_fixture(tmp_path, [
+            {"kind": "input", "shape": [5, 4]},
+            {"kind": "bidi_rnn", "units": 6, "seq": True, "name": "br"},
+            {"kind": "gap1d", "name": "gp"},
+            {"kind": "dense", "units": 3, "act": "softmax", "name": "out"},
+        ], (2, 5, 4), seed=22)
+        net = import_keras_model_and_weights(h5)
+        np.testing.assert_allclose(np.asarray(net.output(x)), golden,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_thresholded_relu_and_gap3d_golden(self, tmp_path):
+        h5, x, golden = _make_fixture(tmp_path, [
+            {"kind": "input", "shape": [3, 4, 4, 2]},
+            {"kind": "conv3d", "filters": 3, "kernel": 2, "act": "linear",
+             "padding": "same", "name": "c3"},
+            {"kind": "thresholded_relu", "theta": 0.5, "name": "tr"},
+            {"kind": "gap3d", "name": "gp"},
+            {"kind": "dense", "units": 2, "act": "softmax", "name": "out"},
+        ], (2, 3, 4, 4, 2), seed=23)
+        net = import_keras_model_and_weights(h5)
+        np.testing.assert_allclose(np.asarray(net.output(x)), golden,
+                                   rtol=1e-4, atol=1e-5)

@@ -1422,12 +1422,22 @@ def test_round5_base_bitwise_tail():
                                     jnp.asarray(4.0))) == 2
     np.testing.assert_array_equal(
         np.asarray(ns.base.diff(jnp.asarray([1, 4, 9]))), [3, 5])
+    oh_i = ns.base.one_hot(jnp.asarray([1]), 2, dtype=jnp.int32)
+    assert oh_i.dtype == jnp.int32          # dtype honored (review reg.)
     x = jnp.asarray(np.array([0x80000001], np.uint32).view(np.int32))
     rl = ns.bitwise.cyclic_shift_left(x, 1)
     np.testing.assert_array_equal(np.asarray(rl).view(np.uint32),
                                   [0x00000003])
     back = ns.bitwise.cyclic_shift_right(rl, 1)
     np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+    # signed ARRAY shift counts must not become arithmetic shifts
+    # (review regression: sign-bit smear under dtype promotion)
+    x2 = jnp.asarray(np.array([0x80000001, 2], np.uint32).view(np.int32))
+    rl2 = ns.bitwise.cyclic_shift_left(x2, jnp.asarray([1, 1], jnp.int32))
+    np.testing.assert_array_equal(np.asarray(rl2).view(np.uint32), [3, 4])
+    with pytest.raises(ValueError):
+        from deeplearning4j_tpu.ops.extra import central_crop
+        central_crop(jnp.zeros((1, 8, 8, 3)), 1.5)
     LEDGER.record("base.one_hot", "base.searchsorted", "base.diff",
                   "bitwise.cyclic_shift_left", "bitwise.cyclic_shift_right")
 
