@@ -134,10 +134,8 @@ def _convert_layer(kcfg: dict):
     if cls == "Embedding":
         return EmbeddingSequenceLayer(name=name, n_in=conf["input_dim"],
                                       n_out=conf["output_dim"], has_bias=False)
-    if cls == "LSTM":
-        cell = LSTM(name=name, n_out=conf["units"],
-                    activation=_act(conf.get("activation", "tanh")),
-                    gate_activation=_act(conf.get("recurrent_activation", "sigmoid")))
+    if cls in ("LSTM", "GRU", "SimpleRNN"):
+        cell = _bare_recurrent_cell(kcfg)    # ONE cell-construction path
         if not conf.get("return_sequences", False):
             # Keras default emits only the final step — LastTimeStep parity
             from deeplearning4j_tpu.nn.layers import LastTimeStep
@@ -205,28 +203,6 @@ def _convert_layer(kcfg: dict):
             convolution_mode="same" if conf.get("padding") == "same" else "truncate",
             activation=_act(conf.get("activation")),
             has_bias=conf.get("use_bias", True))
-    if cls == "SimpleRNN":
-        from deeplearning4j_tpu.nn.layers import SimpleRnn
-        cell = SimpleRnn(name=name, n_out=conf["units"],
-                         activation=_act(conf.get("activation", "tanh")))
-        if not conf.get("return_sequences", False):
-            from deeplearning4j_tpu.nn.layers import LastTimeStep
-            return LastTimeStep(name=name, underlying=cell)
-        return cell
-    if cls == "GRU":
-        from deeplearning4j_tpu.nn.layers import GRU as GRULayer
-        if not conf.get("reset_after", True):
-            raise KeyError(
-                "unsupported Keras GRU reset_after=False (reset gate applied "
-                "before the recurrent matmul — different cell semantics)")
-        cell = GRULayer(name=name, n_out=conf["units"],
-                        activation=_act(conf.get("activation", "tanh")),
-                        gate_activation=_act(conf.get("recurrent_activation",
-                                                      "sigmoid")))
-        if not conf.get("return_sequences", False):
-            from deeplearning4j_tpu.nn.layers import LastTimeStep
-            return LastTimeStep(name=name, underlying=cell)
-        return cell
     if cls == "LayerNormalization":
         from deeplearning4j_tpu.nn.layers import LayerNormalization
         if not conf.get("scale", True):
@@ -432,8 +408,9 @@ def _bare_recurrent_cell(kcfg: dict):
     if cls == "GRU":
         from deeplearning4j_tpu.nn.layers import GRU as GRULayer
         if not conf.get("reset_after", True):
-            raise KeyError("unsupported Keras GRU reset_after=False inside "
-                           "Bidirectional")
+            raise KeyError(
+                "unsupported Keras GRU reset_after=False (reset gate applied "
+                "before the recurrent matmul — different cell semantics)")
         return GRULayer(name=name, n_out=conf["units"],
                         activation=_act(conf.get("activation", "tanh")),
                         gate_activation=_act(conf.get("recurrent_activation",

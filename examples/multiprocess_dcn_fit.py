@@ -16,7 +16,7 @@ SocketTransport and a real coordinator to ``launcher.initialize``.)
 
 from __future__ import annotations
 
-import functools
+
 import os
 import sys
 
@@ -80,8 +80,7 @@ def main():
 
     env = {"PYTHONPATH": os.path.dirname(os.path.abspath(__file__))
            + os.pathsep + os.environ.get("PYTHONPATH", "")}
-    results = spawn_local_cluster(functools.partial(mod.worker),
-                                  n_processes=2, port=12741,
+    results = spawn_local_cluster(mod.worker, n_processes=2, port=12741,
                                   local_devices=1, extra_env=env)
     a, b = sorted(results, key=lambda r: r["pid"])
     drift = float(np.abs(a["params"] - b["params"]).max())

@@ -283,6 +283,22 @@ LAYER_CASES = {
                                       _rnn_batch(3, 3, t=4).labels)),
     "mask_zero": ([MaskZeroLayer(underlying=LSTM(n_out=5)), RNN_OUT()],
                   InputType.recurrent(3, 5), lambda: _rnn_batch(3, 3)),
+    "permute": ([PermuteLayer(dims=(2, 1)), RNN_OUT()],
+                InputType.recurrent(3, 4),
+                lambda: DataSet(_r().normal(size=(3, 4, 3)),
+                                _rnn_batch(3, 3, t=3).labels)),
+    "separable_conv1d": ([SeparableConvolution1D(n_out=4, kernel_size=3,
+                                                 activation="tanh"),
+                          RNN_OUT()],
+                         InputType.recurrent(2, 6),
+                         lambda: DataSet(_r().normal(size=(3, 6, 2)),
+                                         _rnn_batch(3, 3, t=4).labels)),
+    "conv_lstm2d": ([ConvLSTM2D(n_out=3, kernel_size=(2, 2),
+                                convolution_mode="same"),
+                     GlobalPoolingLayer(pooling_type="avg"), FF_OUT()],
+                    InputType.convolutional3d(3, 4, 4, 2),
+                    lambda: DataSet(_r().normal(size=(2, 3, 4, 4, 2)),
+                                    np.eye(3)[_r().integers(0, 3, 2)])),
     "bidirectional_last": ([BidirectionalLastStep(fwd=LSTM(n_out=4),
                                                   mode="concat"), FF_OUT()],
                            InputType.recurrent(3, 5),

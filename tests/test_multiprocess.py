@@ -111,9 +111,18 @@ class TestMultiProcessDcnFit:
             n_processes=2, port=12721, local_devices=1, extra_env=_ENV)
         assert all(r["all_equal"] for r in full)
         assert full[0]["batches_seen"] == 6
-        # compressed wire: ring bytes ≪ what dense f32 exchange would cost
-        dense_total = full[0]["dense_bytes_per_step"] * 6
-        assert 0 < full[0]["bytes_sent"] < dense_total / 2
+        # the wire carries capacity-bounded messages + frame headers —
+        # for this 67-param toy the codec can't beat dense f32 (frames
+        # dominate; the real compression claim is measured at ResNet
+        # scale in bench_dcn_multislice / test_resnet50_multislice_fit),
+        # so assert the capacity bound, not a compression ratio.
+        # Constants derived from their owners, not restated:
+        from deeplearning4j_tpu.parallel.dcn import _FRAME
+        grad_size = full[0]["dense_bytes_per_step"] // 4
+        capacity = (grad_size - 4) // 2      # trainer's value-coded bound
+        cap_msg_bytes = (3 + 2 * capacity) * 4
+        assert 0 < full[0]["bytes_sent"] <= (cap_msg_bytes
+                                             + _FRAME.size) * 6
 
         with pytest.raises(RuntimeError):
             spawn_local_cluster(
