@@ -395,8 +395,10 @@ def _convert_layer(kcfg: dict):
 
 
 def _bare_recurrent_cell(kcfg: dict):
-    """Inner cell for Bidirectional: LSTM / GRU / SimpleRNN without the
-    return_sequences wrapping (that belongs to the wrapper)."""
+    """THE cell-construction path for LSTM / GRU / SimpleRNN — used by
+    the top-level converters (which add the LastTimeStep wrapping per
+    return_sequences) and by Bidirectional (whose wrapper owns the
+    last-step handling)."""
     cls = kcfg.get("class_name")
     conf = kcfg["config"]
     name = conf.get("name")

@@ -1,0 +1,175 @@
+"""TensorFlow GraphDef protobuf wire codec (no tensorflow import).
+
+Parity context: the reference's general TF importer
+(``nd4j/samediff-import/samediff-import-tensorflow`` — SURVEY §2.4,
+~50k LoC Kotlin over the official protos).  This environment cannot
+load TF into the main process (native-dep clash with jax), so GraphDef
+is read the same way the ONNX importer reads ModelProto: directly off
+the protobuf wire against a hand-declared field map of the PUBLIC
+tensorflow/core/framework protos (graph.proto, node_def.proto,
+attr_value.proto, tensor.proto, tensor_shape.proto, types.proto).
+
+Reuses the generic varint/length-delimited reader from
+:mod:`onnx_wire`; only the schema tables and the TF-specific
+``AttrValue``/``TensorProto`` decoding live here.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any
+
+import numpy as np
+
+from deeplearning4j_tpu.importers.onnx_wire import (_LEN, _VARINT, _I32,
+                                                    _I64, _fields,
+                                                    _read_varint,
+                                                    _zigzag_to_signed)
+
+# tensorflow/core/framework/types.proto DataType enum (public values)
+TF_DTYPES = {1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8,
+             5: np.int16, 6: np.int8, 7: np.bytes_, 9: np.int64,
+             10: np.bool_, 14: np.float16, 17: np.uint16, 22: np.uint32,
+             23: np.uint64}
+
+
+def _parse_shape(buf: bytes) -> list:
+    """TensorShapeProto: dim=2 repeated {size=1 (int64)}, unknown_rank=3."""
+    dims = []
+    for field, wire, raw in _fields(buf):
+        if field == 2 and wire == _LEN:
+            size = 0
+            for f2, w2, r2 in _fields(raw):
+                if f2 == 1:
+                    size = _zigzag_to_signed(r2)
+            dims.append(size)
+    return dims
+
+
+def _parse_tensor(buf: bytes) -> np.ndarray:
+    """TF TensorProto: dtype=1, tensor_shape=2, tensor_content=4,
+    then typed repeated value fields (float_val=5, double_val=6,
+    int_val=7, string_val=8, ... int64_val=10, bool_val=11)."""
+    dtype_code = 1
+    shape: list = []
+    content = b""
+    floats: list = []
+    doubles: list = []
+    ints: list = []
+    int64s: list = []
+    bools: list = []
+    for field, wire, raw in _fields(buf):
+        if field == 1:
+            dtype_code = raw
+        elif field == 2:
+            shape = _parse_shape(raw)
+        elif field == 4:
+            content = raw
+        elif field == 5:
+            if wire == _I32:
+                floats.append(struct.unpack("<f", raw)[0])
+            else:
+                floats.extend(np.frombuffer(raw, "<f4").tolist())
+        elif field == 6:
+            if wire == _I64:
+                doubles.append(struct.unpack("<d", raw)[0])
+            else:
+                doubles.extend(np.frombuffer(raw, "<f8").tolist())
+        elif field in (7, 10, 11):
+            vals = ([_zigzag_to_signed(raw)] if wire == _VARINT
+                    else _unpack_varints(raw))
+            {7: ints, 10: int64s, 11: bools}[field].extend(vals)
+    dtype = TF_DTYPES.get(dtype_code, np.float32)
+    n = int(np.prod(shape)) if shape else 1
+    if content:
+        arr = np.frombuffer(content, np.dtype(dtype).newbyteorder("<"))
+    elif floats:
+        arr = np.asarray(floats, np.float32)
+    elif doubles:
+        arr = np.asarray(doubles, np.float64)
+    elif int64s:
+        arr = np.asarray(int64s, np.int64)
+    elif bools:
+        arr = np.asarray(bools, np.bool_)
+    elif ints:
+        arr = np.asarray(ints, np.int32)
+    else:
+        arr = np.zeros(0, dtype)
+    arr = arr.astype(dtype, copy=False)
+    if arr.size == 1 and n > 1:       # scalar splat (TF's compact encoding)
+        arr = np.full(n, arr.reshape(-1)[0], dtype)
+    return arr.reshape(shape)
+
+
+def _unpack_varints(raw: bytes) -> list:
+    out, pos = [], 0
+    while pos < len(raw):
+        v, pos = _read_varint(raw, pos)
+        out.append(_zigzag_to_signed(v))
+    return out
+
+
+def _parse_attr_value(buf: bytes) -> Any:
+    """AttrValue: list=1 {s=2,i=3,f=4,b=5,type=6,shape=7,tensor=8},
+    s=2, i=3, f=4, b=5, type=6, shape=7, tensor=8."""
+    for field, wire, raw in _fields(buf):
+        if field == 2:
+            return raw.decode("utf-8", "replace")
+        if field == 3:
+            return _zigzag_to_signed(raw)
+        if field == 4:
+            return struct.unpack("<f", raw)[0]
+        if field == 5:
+            return bool(raw)
+        if field == 6:
+            return ("dtype", raw)
+        if field == 7:
+            return _parse_shape(raw)
+        if field == 8:
+            return _parse_tensor(raw)
+        if field == 1:   # ListValue
+            out: list = []
+            for f2, w2, r2 in _fields(raw):
+                if f2 == 2:
+                    out.append(r2.decode("utf-8", "replace"))
+                elif f2 == 3:
+                    if w2 == _VARINT:
+                        out.append(_zigzag_to_signed(r2))
+                    else:
+                        out.extend(_unpack_varints(r2))
+                elif f2 == 4:
+                    if w2 == _I32:
+                        out.append(struct.unpack("<f", r2)[0])
+                    else:
+                        out.extend(np.frombuffer(r2, "<f4").tolist())
+                elif f2 == 7:
+                    out.append(_parse_shape(r2))
+            return out
+    return None
+
+
+def parse_graphdef(buf: bytes) -> list[dict]:
+    """GraphDef bytes → list of node dicts
+    {name, op, input: [...], attrs: {...}} (graph.proto: node=1)."""
+    nodes = []
+    for field, wire, raw in _fields(buf):
+        if field != 1 or wire != _LEN:
+            continue
+        node = {"name": "", "op": "", "input": [], "attrs": {}}
+        for f2, w2, r2 in _fields(raw):
+            if f2 == 1:
+                node["name"] = r2.decode("utf-8")
+            elif f2 == 2:
+                node["op"] = r2.decode("utf-8")
+            elif f2 == 3:
+                node["input"].append(r2.decode("utf-8"))
+            elif f2 == 5:   # map<string, AttrValue> entry
+                key, val = "", None
+                for f3, w3, r3 in _fields(r2):
+                    if f3 == 1:
+                        key = r3.decode("utf-8")
+                    elif f3 == 2:
+                        val = _parse_attr_value(r3)
+                node["attrs"][key] = val
+        nodes.append(node)
+    return nodes

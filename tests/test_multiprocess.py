@@ -116,10 +116,13 @@ class TestMultiProcessDcnFit:
         # dominate; the real compression claim is measured at ResNet
         # scale in bench_dcn_multislice / test_resnet50_multislice_fit),
         # so assert the capacity bound, not a compression ratio.
-        # Constants derived from their owners, not restated:
+        # Frame size comes from the transport; the message bound restates
+        # the trainer's value-coded worst case (header 3 ints + 2 ints
+        # per entry at capacity (grad_size-4)//2 — dcn_trainer.__init__),
+        # intentionally duplicated here as the SPEC under test.
         from deeplearning4j_tpu.parallel.dcn import _FRAME
         grad_size = full[0]["dense_bytes_per_step"] // 4
-        capacity = (grad_size - 4) // 2      # trainer's value-coded bound
+        capacity = (grad_size - 4) // 2
         cap_msg_bytes = (3 + 2 * capacity) * 4
         assert 0 < full[0]["bytes_sent"] <= (cap_msg_bytes
                                              + _FRAME.size) * 6
