@@ -1,23 +1,26 @@
 """Model importers — parity with the reference's import stack
-(deeplearning4j-modelimport Keras .h5 reader; nd4j/samediff-import TF →
-SameDiff, scoped per SURVEY.md §7.8 to the BERT workload).
+(deeplearning4j-modelimport Keras .h5 reader; nd4j/samediff-import for
+TF and ONNX).
 
-Environment constraint: no h5py/TF/protobuf runtimes on the box, so the
-binary-container readers are split from the mapping logic:
-
-- ``keras``   — Keras architecture-JSON → our config-first networks
-  (Sequential + Functional), weights from a {name: array} dict (loaded
-  from npz; an .h5 → npz conversion one-liner runs wherever h5py exists).
-- ``tf_bert`` — TF BERT checkpoint variable-name mapping → our
-  ``models.bert`` parameter pytree (the fiddly part the reference's
-  ImportGraph + OpMappingRegistry handles), weights from npz/dict.
-- ``onnx_import`` — ONNX protobuf → jittable forward fn
-  (samediff-import-onnx parity); the protobuf wire format is decoded by
+- ``keras``     — Keras .h5 / architecture-JSON → our config-first
+  networks (Sequential + Functional, ~60 layer converters, custom/
+  Lambda registries).
+- ``tf_bert``   — TF BERT checkpoint variable-name mapping → our
+  ``models.bert`` parameter pytree (the SURVEY §7.8 workload scope).
+- ``tf_import`` — GENERAL frozen TF GraphDef → jittable forward fn
+  (round 5): the GraphDef is decoded by the in-repo ``tf_wire``
+  protobuf codec (no tensorflow import — TF cannot share this process
+  with jax), core inference op set.
+- ``onnx_import`` — ONNX protobuf → jittable forward fn incl.
+  LSTM/GRU/RNN and If/Loop/Scan control flow; wire format decoded by
   the in-repo ``onnx_wire`` codec (no onnx package needed).
 """
 
-from deeplearning4j_tpu.importers import keras, onnx_import, onnx_wire, tf_bert
+from deeplearning4j_tpu.importers import (keras, onnx_import, onnx_wire,
+                                          tf_bert, tf_import, tf_wire)
 from deeplearning4j_tpu.importers.onnx_import import OnnxModel, import_onnx_model
+from deeplearning4j_tpu.importers.tf_import import TFGraphModel, import_tf_graph
 
-__all__ = ["keras", "tf_bert", "onnx_import", "onnx_wire",
-           "OnnxModel", "import_onnx_model"]
+__all__ = ["keras", "tf_bert", "tf_import", "tf_wire", "onnx_import",
+           "onnx_wire", "OnnxModel", "import_onnx_model",
+           "TFGraphModel", "import_tf_graph"]

@@ -3,10 +3,11 @@
 Parity with ``deeplearning4j-modelimport``
 (``org/deeplearning4j/nn/modelimport/keras/KerasModelImport.java``,
 ``KerasModel``, per-layer converters in ``layers/``): Sequential and
-Functional architectures with ~45 layer converters (Dense, the full
+Functional architectures with ~60 layer converters (Dense, the full
 Conv1D/2D/3D + transpose/depthwise/separable family, pooling 1D/2D/3D,
 BatchNormalization/LayerNormalization, recurrent LSTM/GRU/SimpleRNN/
-Bidirectional, MultiHeadAttention, padding/cropping/upsampling 1D/2D/3D,
+Bidirectional (LSTM/GRU/SimpleRNN inner cells), ConvLSTM2D, Masking,
+LocallyConnected1D/2D, MultiHeadAttention, padding/cropping/upsampling 1D/2D/3D,
 RepeatVector/TimeDistributed, the dropout/noise family, activation
 layers) plus the custom-converter and Lambda registries
 (``register_custom_converter`` / ``register_lambda_layer`` —

@@ -40,6 +40,16 @@ def _nhwc(strides_or_dil):
     return (int(v[1]), int(v[2]))
 
 
+def _require_nhwc(attrs):
+    """Fail LOUD at execution of NCHW graphs (GPU-trained exports) —
+    silently convolving with NHWC numbers would corrupt results."""
+    df = attrs.get("data_format")
+    if df not in (None, "NHWC"):
+        raise NotImplementedError(
+            f"data_format={df!r} import is not supported (NHWC only — "
+            f"transpose the graph or re-export with NHWC)")
+
+
 # ---------------------------------------------------------------- op set
 @tf_op("Identity", "StopGradient", "PreventGradient", "Snapshot")
 def _identity(inputs, attrs):
@@ -59,12 +69,14 @@ def _matmul(inputs, attrs):
 
 @tf_op("BiasAdd")
 def _bias_add(inputs, attrs):
+    _require_nhwc(attrs)
     return inputs[0] + inputs[1]      # NHWC: bias on the last axis
 
 
 @tf_op("Conv2D")
 def _conv2d(inputs, attrs):
     import jax
+    _require_nhwc(attrs)
     x, w = inputs
     dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
                                         ("NHWC", "HWIO", "NHWC"))
@@ -78,6 +90,7 @@ def _conv2d(inputs, attrs):
 @tf_op("DepthwiseConv2dNative")
 def _dwconv(inputs, attrs):
     import jax
+    _require_nhwc(attrs)
     x, w = inputs                      # w [kh, kw, Cin, mult]
     kh, kw, cin, mult = w.shape
     wg = w.reshape(kh, kw, 1, cin * mult)
@@ -94,6 +107,7 @@ def _pool(reducer, init):
     def impl(inputs, attrs):
         import jax
         import jax.numpy as jnp
+        _require_nhwc(attrs)
         x = inputs[0]
         kh, kw = _nhwc(attrs.get("ksize"))
         sh, sw = _nhwc(attrs.get("strides"))
@@ -119,6 +133,7 @@ def _register_pools():
 @tf_op("FusedBatchNormV3", "FusedBatchNorm", "FusedBatchNormV2")
 def _fused_bn(inputs, attrs):
     import jax
+    _require_nhwc(attrs)
     x, gamma, beta, mean, var = inputs[:5]
     eps = attrs.get("epsilon", 1e-4) or 1e-4
     if attrs.get("is_training"):
@@ -271,8 +286,8 @@ for _name, _path in [("Relu", ("jax", "nn", "relu")),
 @tf_op("LeakyRelu")
 def _leaky(inputs, attrs):
     import jax
-    return jax.nn.leaky_relu(inputs[0],
-                             attrs.get("alpha", 0.2) or 0.2)
+    alpha = attrs.get("alpha")
+    return jax.nn.leaky_relu(inputs[0], 0.2 if alpha is None else alpha)
 
 
 def _binary(jnp_name):
@@ -307,10 +322,20 @@ def _fill(inputs, attrs):
                     inputs[1])
 
 
-@tf_op("Select", "SelectV2")
-def _select(inputs, attrs):
+@tf_op("SelectV2")
+def _select_v2(inputs, attrs):
     import jax.numpy as jnp
     return jnp.where(inputs[0], inputs[1], inputs[2])
+
+
+@tf_op("Select")
+def _select_v1(inputs, attrs):
+    import jax.numpy as jnp
+    c, x, y = inputs
+    # TF v1 Select: a rank-1 cond selects whole LEADING-axis rows
+    if c.ndim == 1 and x.ndim > 1:
+        c = c.reshape((c.shape[0],) + (1,) * (x.ndim - 1))
+    return jnp.where(c, x, y)
 
 
 # ------------------------------------------------------------------ model
@@ -322,9 +347,11 @@ class TFGraphModel:
                  outputs: list[str] | None = None):
         self.nodes = {n["name"]: n
                       for n in tf_wire.parse_graphdef(graphdef_bytes)}
+        # positional args bind to PURE placeholders only; a
+        # PlaceholderWithDefault evaluates its wired-in default unless
+        # fed by keyword
         self.inputs = [n["name"] for n in self.nodes.values()
-                       if n["op"] in ("Placeholder",
-                                      "PlaceholderWithDefault")]
+                       if n["op"] == "Placeholder"]
         self.consts = {n["name"]: n["attrs"].get("value")
                        for n in self.nodes.values() if n["op"] == "Const"}
         if outputs is None:
@@ -348,31 +375,52 @@ class TFGraphModel:
         with open(path_or_bytes, "rb") as f:
             return TFGraphModel(f.read(), outputs)
 
-    def _eval(self, ref: str, env: dict):
-        """Memoized evaluation of ``node`` / ``node:k`` references —
-        GraphDefs are not topologically sorted, so the graph walks
-        lazily from the requested outputs."""
-        import jax.numpy as jnp
+    @staticmethod
+    def _ref(ref: str):
         name, _, port = ref.partition(":")
-        port = int(port) if port else 0
-        if (name, port) in env:
-            return env[(name, port)]
-        node = self.nodes[name]
-        op = node["op"]
-        if op == "Const":
-            out = jnp.asarray(self.consts[name])
-        elif op in ("Placeholder", "PlaceholderWithDefault"):
-            raise ValueError(f"missing graph input: {name}")
-        else:
-            ins = [self._eval(r, env) for r in node["input"]
-                   if not r.startswith("^")]
-            attrs = dict(node["attrs"])
-            attrs["_op_type"] = op
-            out = _OPS[op](ins, attrs)
-        outs = out if isinstance(out, tuple) else (out,)
-        for k, v in enumerate(outs):
-            env[(name, k)] = v
-        return env[(name, port)]
+        return name, (int(port) if port else 0)
+
+    def _eval(self, ref: str, env: dict):
+        """Memoized ITERATIVE post-order evaluation of ``node`` /
+        ``node:k`` references — GraphDefs are not topologically sorted,
+        and real frozen graphs run hundreds of nodes deep (recursion
+        would hit Python's frame limit)."""
+        import jax.numpy as jnp
+        want_name, want_port = self._ref(ref)
+        stack = [want_name]
+        while stack:
+            name = stack[-1]
+            if (name, 0) in env:
+                stack.pop()
+                continue
+            node = self.nodes[name]
+            op = node["op"]
+            if op == "Const":
+                env[(name, 0)] = jnp.asarray(self.consts[name])
+                stack.pop()
+                continue
+            if op == "Placeholder":
+                raise ValueError(f"missing graph input: {name}")
+            data_refs = [r for r in node["input"] if not r.startswith("^")]
+            if op == "PlaceholderWithDefault":
+                data_refs = data_refs[:1]     # the wired-in default
+            pending = [self._ref(r)[0] for r in data_refs
+                       if (self._ref(r)[0], 0) not in env]
+            if pending:
+                stack.extend(pending)
+                continue
+            ins = [env[self._ref(r)] for r in data_refs]
+            if op == "PlaceholderWithDefault":
+                out = ins[0]
+            else:
+                attrs = dict(node["attrs"])
+                attrs["_op_type"] = op
+                out = _OPS[op](ins, attrs)
+            outs = out if isinstance(out, tuple) else (out,)
+            for k, v in enumerate(outs):
+                env[(name, k)] = v
+            stack.pop()
+        return env[(want_name, want_port)]
 
     def __call__(self, *args, **feeds):
         import jax.numpy as jnp
@@ -392,5 +440,10 @@ class TFGraphModel:
 
 def import_tf_graph(path_or_bytes, outputs=None) -> TFGraphModel:
     """Entry point: frozen GraphDef (.pb bytes or path) → jittable model."""
-    _register_pools()     # idempotent; needs jax importable
     return TFGraphModel.load(path_or_bytes, outputs)
+
+
+# jax is a hard dependency of this package — register the jax-typed ops
+# at import so EVERY public path (TFGraphModel(...) included) sees the
+# full op table
+_register_pools()

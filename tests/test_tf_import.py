@@ -27,7 +27,9 @@ with g.as_default():
         b1 = tf.constant(rng.normal(0, 0.1, (16,)).astype(np.float32))
         h = tf.nn.relu(tf.nn.bias_add(tf.matmul(x, w1), b1))
         w2 = tf.constant(rng.normal(0, 0.4, (16, 3)).astype(np.float32))
-        y = tf.nn.softmax(tf.matmul(h, w2), name="y")
+        scale = tf.compat.v1.placeholder_with_default(
+            tf.constant(1.0), [], name="scale")
+        y = tf.nn.softmax(tf.matmul(h, w2) * scale, name="y")
         feed = rng.normal(size=(4, 8)).astype(np.float32)
     elif spec["kind"] == "cnn_bn":
         x = tf.compat.v1.placeholder(tf.float32, [None, 8, 8, 3], name="x")
@@ -122,3 +124,45 @@ class TestTfGraphImport:
         gd = _w._key(1, _w._LEN) + _w._varint(len(node)) + node
         with pytest.raises(NotImplementedError, match="SparseFillEmptyRows"):
             import_tf_graph(gd)
+
+    def test_deep_graph_no_recursion_limit(self):
+        """400 chained Adds must evaluate iteratively (review regression:
+        recursive eval hit Python's frame limit on real frozen graphs)."""
+        from deeplearning4j_tpu.importers import onnx_wire as w
+        NODE = {1: ("name", "string"), 2: ("op", "string"),
+                3: ("input", "repeated_string"),
+                5: ("attr", ("repeated", {1: ("key", "string")}))}
+
+        def nd(name, op, inputs):
+            b = w.emit(NODE, {"name": name, "op": op, "input": inputs})
+            return w._key(1, w._LEN) + w._varint(len(b)) + b
+
+        parts = [nd("x", "Placeholder", [])]
+        prev = "x"
+        for i in range(400):
+            parts.append(nd(f"a{i}", "Identity", [prev]))
+            prev = f"a{i}"
+        m = import_tf_graph(b"".join(parts), outputs=[prev])
+        x = np.ones((2, 2), np.float32)
+        np.testing.assert_allclose(np.asarray(m(x)), x)
+
+    def test_pools_registered_on_constructor_path(self, tmp_path):
+        """MaxPool resolves via the TFGraphModel constructor too — not
+        only via the import_tf_graph entry point (review regression)."""
+        pb, x, golden = _fixture(tmp_path, "cnn_bn", seed=3)
+        from deeplearning4j_tpu.importers.tf_import import TFGraphModel
+        m = TFGraphModel.load(pb)
+        np.testing.assert_allclose(np.asarray(m(x)), golden,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_placeholder_with_default(self, tmp_path):
+        """The mlp fixture carries a PlaceholderWithDefault 'scale':
+        unfed it evaluates its wired-in default (golden match, and it is
+        NOT a positional input); fed by keyword it overrides."""
+        pb, x, golden = _fixture(tmp_path, "mlp", seed=4)
+        m = import_tf_graph(pb)
+        assert m.inputs == ["x"]       # scale is not positional
+        np.testing.assert_allclose(np.asarray(m(x)), golden,
+                                   rtol=1e-5, atol=1e-6)
+        scaled = np.asarray(m(x, scale=np.float32(3.0)))
+        assert not np.allclose(scaled, golden)
