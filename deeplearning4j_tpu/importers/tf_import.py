@@ -135,7 +135,8 @@ def _fused_bn(inputs, attrs):
     import jax
     _require_nhwc(attrs)
     x, gamma, beta, mean, var = inputs[:5]
-    eps = attrs.get("epsilon", 1e-4) or 1e-4
+    eps = attrs.get("epsilon")
+    eps = 1e-4 if eps is None else eps
     if attrs.get("is_training"):
         raise NotImplementedError(
             "FusedBatchNorm is_training=True import (freeze the graph)")
@@ -388,10 +389,12 @@ class TFGraphModel:
         import jax.numpy as jnp
         want_name, want_port = self._ref(ref)
         stack = [want_name]
+        on_stack = {want_name}
         while stack:
             name = stack[-1]
             if (name, 0) in env:
                 stack.pop()
+                on_stack.discard(name)
                 continue
             node = self.nodes[name]
             op = node["op"]
@@ -407,7 +410,11 @@ class TFGraphModel:
             pending = [self._ref(r)[0] for r in data_refs
                        if (self._ref(r)[0], 0) not in env]
             if pending:
+                cyc = [p for p in pending if p in on_stack]
+                if cyc:      # fail loud on corrupt/cyclic GraphDefs
+                    raise ValueError(f"GraphDef cycle through {cyc[0]!r}")
                 stack.extend(pending)
+                on_stack.update(pending)
                 continue
             ins = [env[self._ref(r)] for r in data_refs]
             if op == "PlaceholderWithDefault":
@@ -424,6 +431,14 @@ class TFGraphModel:
 
     def __call__(self, *args, **feeds):
         import jax.numpy as jnp
+        if len(args) > len(self.inputs):
+            raise ValueError(
+                f"{len(args)} positional feeds for {len(self.inputs)} "
+                f"placeholders {self.inputs} (feed "
+                f"PlaceholderWithDefault nodes by keyword)")
+        unknown = [n for n in feeds if n not in self.nodes]
+        if unknown:
+            raise ValueError(f"unknown feed names: {unknown}")
         env: dict = {}
         for name, val in zip(self.inputs, args):
             env[(name, 0)] = jnp.asarray(val)

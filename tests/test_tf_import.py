@@ -166,3 +166,27 @@ class TestTfGraphImport:
                                    rtol=1e-5, atol=1e-6)
         scaled = np.asarray(m(x, scale=np.float32(3.0)))
         assert not np.allclose(scaled, golden)
+
+    def test_feed_validation_and_cycle_detection(self):
+        """Extra positional feeds, unknown keyword feeds, and cyclic
+        GraphDefs all fail LOUD (review regressions)."""
+        from deeplearning4j_tpu.importers import onnx_wire as w
+        NODE = {1: ("name", "string"), 2: ("op", "string"),
+                3: ("input", "repeated_string")}
+
+        def nd(name, op, inputs):
+            b = w.emit(NODE, {"name": name, "op": op, "input": inputs})
+            return w._key(1, w._LEN) + w._varint(len(b)) + b
+
+        m = import_tf_graph(nd("x", "Placeholder", [])
+                            + nd("y", "Identity", ["x"]), outputs=["y"])
+        x = np.ones((2,), np.float32)
+        with pytest.raises(ValueError, match="positional"):
+            m(x, x)
+        with pytest.raises(ValueError, match="unknown feed"):
+            m(x, typo=x)
+
+        cyc = import_tf_graph(nd("a", "Identity", ["b"])
+                              + nd("b", "Identity", ["a"]), outputs=["a"])
+        with pytest.raises(ValueError, match="cycle"):
+            cyc()
