@@ -412,7 +412,38 @@ def bench_workload_steps() -> dict:
     return out
 
 
+def _probe_device(timeout_s: float = 300.0) -> str | None:
+    """Touch the accelerator in a SUBPROCESS with a hard timeout: a down
+    TPU tunnel makes backend init HANG (not raise), which would leave the
+    whole bench run recording nothing.  Returns an error string, or None
+    when the device answers."""
+    import subprocess
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(len(jax.devices()), jax.devices()[0])"],
+            capture_output=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return f"device probe timed out after {timeout_s:.0f}s (tunnel down?)"
+    if p.returncode != 0:
+        return (f"device probe failed (rc={p.returncode}): "
+                f"{p.stderr.decode()[-200:]}")
+    return None
+
+
 def main():
+    err = _probe_device()
+    if err:
+        # same failure contract as the other error paths: top-level
+        # "error", nonzero exit — a 0.0 must never read as a measurement
+        print(json.dumps({"metric": "resnet50_train_images_per_sec_per_chip",
+                          "value": 0.0, "unit": "images/sec/chip",
+                          "vs_baseline": 0.0, "error": err,
+                          "detail": {"note": "TPU unreachable at bench "
+                                             "time; see BENCH_r04 + "
+                                             "bench/PROFILE.md for the "
+                                             "last measured numbers"}}))
+        return 1
     batch = 256  # HBM-bound workload: large batch amortizes weight traffic
                  # (see bench/PROFILE.md; 256 ≈ saturation point on v5e)
     for attempt in range(3):
