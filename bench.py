@@ -14,6 +14,7 @@ timed after compile+warmup.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -57,12 +58,58 @@ RESNET50_TRAIN_GFLOP_PER_IMG = 22.34
 RESNET50_TRAIN_MB_PER_IMG = 344.0
 
 
+def _phase_spans(trainer, batch_ds, key, steps, warmup):
+    """Run warmup + one short attribution pass under a pinned tracer,
+    emitting the span taxonomy from docs/observability.md (``bench`` →
+    ``compile`` / ``steps``/``host_dispatch``).  Returns (tracer, phase
+    dict) — the dict is DERIVED from the spans, so the jsonl/Chrome
+    exports and the printed breakdown come from one measurement.  This
+    pass doubles as the headline run's warmup (compile + steady steps);
+    the headline number itself still comes from ``_timed_region``'s
+    best-of-repeats discipline, so the attribution pass is capped at a
+    few steps to keep its extra device time negligible."""
+    from deeplearning4j_tpu.obs import tracing
+
+    steps = min(steps, 4)
+    tracer = tracing.Tracer(enabled=True)
+    with tracing.use_tracer(tracer):
+        with tracing.span("bench", steps=steps):
+            with tracing.span("compile"):
+                # first call traces+compiles the whole donated train step
+                tracing.device_sync(trainer.fit_batch(batch_ds, key))
+            for _ in range(max(warmup - 1, 0)):
+                float(trainer.fit_batch(batch_ds, key))
+            with tracing.span("steps", n=steps) as sp:
+                handle = None
+                with tracing.span("host_dispatch"):
+                    for _ in range(steps):
+                        handle = trainer.fit_batch(batch_ds, key)
+                tracing.device_sync(handle)   # device wait lands on sp
+
+    compile_s = sum(s.duration_s for s in tracer.find("compile"))
+    host_s = sum(s.duration_s for s in tracer.find("host_dispatch"))
+    measured = tracer.find("steps")
+    wall_s = sum(s.duration_s for s in measured)
+    sync_s = sum(s.device_sync_s for s in measured)
+    phases = {
+        "compile_s": round(compile_s, 3),
+        "host_dispatch_ms_per_step": round(1e3 * host_s / steps, 3),
+        "device_wait_ms_per_step": round(1e3 * sync_s / steps, 3),
+        "wall_ms_per_step": round(1e3 * wall_s / steps, 3),
+        "note": ("host = python+dispatch; device wait = post-dispatch "
+                 "sync; execute/step ~= wall - host (async dispatch "
+                 "keeps the device busy across steps)"),
+    }
+    return tracer, phases
+
+
 def bench_resnet50(batch: int = 256, image: int = 224, steps: int = 12,
                    warmup: int = 2) -> dict:
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.config import DTypePolicy, set_dtype_policy
     from deeplearning4j_tpu.models import resnet50
+    from deeplearning4j_tpu.obs.registry import get_registry
     from deeplearning4j_tpu.train.trainer import Trainer
     from deeplearning4j_tpu.data.dataset import DataSet
     from deeplearning4j_tpu.train import Nesterovs
@@ -79,10 +126,15 @@ def bench_resnet50(batch: int = 256, image: int = 224, steps: int = 12,
     batch_ds = DataSet(jnp.asarray(x), jnp.asarray(y))
     key = jax.random.key(0)
 
-    for _ in range(warmup):  # first call compiles
-        float(trainer.fit_batch(batch_ds, key))
+    # warmup (compile) + phase attribution ride the same tracer
+    tracer, phases = _phase_spans(trainer, batch_ds, key, steps, warmup)
     step_s = _timed_region(lambda: trainer.fit_batch(batch_ds, key),
                            float, steps)
+    get_registry().histogram("tpudl_bench_step_seconds").observe(step_s)
+    trace_path = os.environ.get("DL4J_TPU_BENCH_TRACE")
+    if trace_path:
+        tracer.export_chrome_trace(trace_path)
+        phases["chrome_trace"] = trace_path
     dt = step_s * steps
     img_per_sec = batch * steps / dt
     n_chips = max(len(jax.devices()), 1)
@@ -98,6 +150,7 @@ def bench_resnet50(batch: int = 256, image: int = 224, steps: int = 12,
         "detail": {
             "batch": batch, "image": image, "steps": steps,
             "step_time_ms": round(1000 * dt / steps, 2),
+            "phases": phases,
             "mfu": round(mfu, 3),
             "hbm_gbps_sustained": round(hbm, 1),
             "hbm_roof_fraction": round(hbm / V5E_HBM_GBPS, 3),

@@ -57,6 +57,12 @@ class Config:
     - ``default_seed``: global RNG seed used when nets don't specify one.
     - ``metrics_dir``: where jsonl metric streams are written.
     - ``prefetch_size``: AsyncDataSetIterator-parity prefetch queue depth.
+    - ``tracing``: enable span-based tracing (``obs.tracing``); spans add
+      a device sync per step, so it's off by default.
+    - ``trace_dir``: where span jsonl / Chrome-trace / ``jax.profiler``
+      dumps land.
+    - ``profiling``: capture a ``jax.profiler`` trace (HLO-level,
+      Perfetto-viewable) around ``Trainer.fit`` into ``trace_dir``.
     """
 
     debug: bool = False
@@ -67,6 +73,8 @@ class Config:
     metrics_dir: str = "runs"
     prefetch_size: int = 2
     profiling: bool = False
+    tracing: bool = False
+    trace_dir: str = "traces"
 
     @classmethod
     def from_env(cls) -> "Config":

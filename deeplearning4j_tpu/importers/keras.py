@@ -465,6 +465,37 @@ def _one(v):
     return v[0] if isinstance(v, (list, tuple)) else v
 
 
+def _mask_wrappable(layer) -> bool:
+    """True when MaskZeroLayer (zero-timestep masking) semantics apply:
+    the layer consumes the time axis — recurrent cells and their
+    wrappers (LastTimeStep, Bidirectional, TimeDistributed)."""
+    from deeplearning4j_tpu.nn.layers import LastTimeStep, TimeDistributed
+    from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer
+    return isinstance(layer, (BaseRecurrentLayer, Bidirectional,
+                              LastTimeStep, TimeDistributed))
+
+
+def _mask_transparent(layer, mask_value: float) -> bool:
+    """True for per-timestep layers the Masking wrap may defer past
+    WITHOUT corrupting the mask: the deferred MaskZeroLayer re-derives
+    the mask downstream from all-``mask_value`` rows, so the layer must
+    map such a row to itself.  Dropout fixes 0 exactly; an activation
+    qualifies iff f(mask_value) == mask_value (relu/tanh/identity at 0
+    do, sigmoid does not).  Normalization layers shift the sentinel
+    (beta) and are deliberately NOT deferrable."""
+    from deeplearning4j_tpu.nn import activations
+    from deeplearning4j_tpu.nn.layers import ActivationLayer, DropoutLayer
+    if isinstance(layer, DropoutLayer):
+        return mask_value == 0.0
+    if isinstance(layer, ActivationLayer):
+        try:
+            f = activations.get(layer.activation or "identity")
+            return abs(float(f(np.float32(mask_value))) - mask_value) < 1e-6
+        except Exception:
+            return False
+    return False
+
+
 def _dense_to_output(d: DenseLayer, loss: str) -> OutputLayer:
     """Terminal Dense → OutputLayer (keeps any Flatten INPUT_KIND pin)."""
     out = OutputLayer(name=d.name, n_out=d.n_out, activation=d.activation,
@@ -512,9 +543,23 @@ def import_sequential(model_json: str,
         layer = _convert_layer(kcfg)
         if layer is not None and mask_pending is not None:
             from deeplearning4j_tpu.nn.layers import MaskZeroLayer
-            layer = MaskZeroLayer(name=layer.name, underlying=layer,
-                                  mask_value=mask_pending)
-            mask_pending = None
+            if _mask_wrappable(layer):
+                layer = MaskZeroLayer(name=layer.name, underlying=layer,
+                                      mask_value=mask_pending)
+                mask_pending = None
+            elif not _mask_transparent(layer, mask_pending):
+                # the promise _convert_layer makes for the Masking case:
+                # MaskZeroLayer semantics (zero-timestep masking) only
+                # apply to time-axis layers — wrapping e.g. a Dense would
+                # silently mis-mask.  Sentinel-preserving per-timestep
+                # layers (Dropout at mask_value 0, activations fixing the
+                # sentinel) defer the wrap to the first time-axis layer,
+                # matching Keras mask propagation.
+                raise ValueError(
+                    f"Keras Masking must be followed by a recurrent/"
+                    f"time-distributed layer (optionally behind "
+                    f"mask-transparent Dropout/Activation layers); got "
+                    f"{type(layer).__name__} ({layer.name!r})")
         if layer is None:
             # Keras Flatten is explicit; our framework flattens lazily via
             # preprocessors only when a layer DEMANDS ff input.  A layer
@@ -529,6 +574,12 @@ def import_sequential(model_json: str,
             layer.INPUT_KIND = "ff"   # instance-level preprocessor hook
             flatten_pending = False
         our_layers.append(layer)
+    if mask_pending is not None:
+        # a trailing Masking (or one followed only by no-op layers like
+        # Flatten) would otherwise be silently dropped
+        raise ValueError(
+            "dangling Keras Masking layer: no recurrent/time-distributed "
+            "layer follows it in the Sequential model")
     # last Dense+softmax becomes OutputLayer so fit() works (DL4J does the
     # same when the Keras model ends with Dense+activation)
     if our_layers and isinstance(our_layers[-1], DenseLayer) \

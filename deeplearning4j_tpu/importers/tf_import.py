@@ -151,10 +151,14 @@ def _reduce(inputs, attrs, _op=None):
     import jax.numpy as jnp
     x, axes = inputs
     axes = tuple(np.asarray(axes).reshape(-1).tolist())
+    if not axes:
+        # TF semantics: an EMPTY reduction_indices tensor is a no-op
+        # (returns the input unchanged) — NOT a reduce-over-all-axes
+        return x
     keep = bool(attrs.get("keep_dims"))
     fn = {"Mean": jnp.mean, "Sum": jnp.sum, "Max": jnp.max,
           "Min": jnp.min, "Prod": jnp.prod}[attrs["_op_type"]]
-    return fn(x, axis=axes or None, keepdims=keep)
+    return fn(x, axis=axes, keepdims=keep)
 
 
 @tf_op("Reshape")
@@ -209,8 +213,14 @@ def _gather(inputs, attrs):
 @tf_op("Cast")
 def _cast(inputs, attrs):
     dst = attrs.get("DstT")
-    dtype = tf_wire.TF_DTYPES.get(dst[1] if isinstance(dst, tuple) else 1,
-                                  np.float32)
+    code = dst[1] if isinstance(dst, tuple) else 1   # absent attr → float32
+    dtype = tf_wire.TF_DTYPES.get(code)
+    if dtype is None:
+        # fail loud (importer convention, cf. _require_nhwc): a silent
+        # float32 fallback on e.g. complex64 (code 8) corrupts results
+        raise NotImplementedError(
+            f"Cast DstT dtype code {code} is unsupported "
+            f"(TF_DTYPES codes: {sorted(tf_wire.TF_DTYPES)})")
     return inputs[0].astype(dtype)
 
 

@@ -335,6 +335,14 @@ class ComputationGraph:
         Trainer(self, listeners=listeners).fit(iterator, epochs)
         return self
 
+    def trace_attrs(self) -> dict:
+        """Model identity attached to the trainer's ``fit`` span
+        (``obs.tracing``) — what a trace viewer shows for this run."""
+        return {"model": "ComputationGraph",
+                "vertices": len(self._topo),
+                "layers": len(self.layers),
+                "params": self.num_params() if self.params_ is not None else 0}
+
     def evaluate(self, iterator, top_n: int = 1):
         from deeplearning4j_tpu.evaluation.classification import Evaluation
         evaluation = Evaluation(top_n=top_n)

@@ -9,9 +9,17 @@ from deeplearning4j_tpu.obs.listeners import (
 )
 from deeplearning4j_tpu.obs.metrics import MetricsWriter
 from deeplearning4j_tpu.obs.profiler import check_finite, StepTimer
+from deeplearning4j_tpu.obs.registry import (
+    Counter, Gauge, Histogram, MetricsRegistry,
+    get_registry, set_registry, install_standard_metrics,
+    record_device_memory)
 from deeplearning4j_tpu.obs.stats import (
     StatsListener, InMemoryStatsStorage, FileStatsStorage,
     render_html_report, render_html)
+from deeplearning4j_tpu.obs.tracing import (
+    Span, SpanContext, Tracer,
+    span, current_span, current_context, device_sync,
+    get_tracer, set_tracer, use_tracer, inject, extract)
 from deeplearning4j_tpu.obs.ui_server import UIServer
 
 __all__ = [
@@ -25,10 +33,30 @@ __all__ = [
     "MetricsWriter",
     "check_finite",
     "StepTimer",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "get_registry",
+    "set_registry",
+    "install_standard_metrics",
+    "record_device_memory",
     "StatsListener",
     "InMemoryStatsStorage",
     "FileStatsStorage",
     "render_html_report",
     "render_html",
+    "Span",
+    "SpanContext",
+    "Tracer",
+    "span",
+    "current_span",
+    "current_context",
+    "device_sync",
+    "get_tracer",
+    "set_tracer",
+    "use_tracer",
+    "inject",
+    "extract",
     "UIServer",
 ]

@@ -1,0 +1,55 @@
+"""Metric-name lint — ``python -m deeplearning4j_tpu.obs.check``.
+
+Verifies that every metric registered in the process-wide registry
+(after installing the framework's standard catalog) matches the
+documented ``tpudl_<area>_<name>`` convention, and that counters/
+histograms follow the suffix rules (``_total`` for counters,
+``_seconds``/``_bytes`` for duration/size histograms).  CI runs this so
+a PR can't quietly ship a metric the dashboards won't find.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from deeplearning4j_tpu.obs.registry import (
+    METRIC_NAME_RE, Counter, Histogram, get_registry,
+    install_standard_metrics)
+
+
+def lint(registry=None) -> list[str]:
+    """Returns a list of human-readable violations (empty = clean)."""
+    r = registry or get_registry()
+    install_standard_metrics(r)
+    problems = []
+    for name in r.names():
+        metric = r.get(name)
+        if not METRIC_NAME_RE.match(name):
+            problems.append(
+                f"{name}: violates tpudl_<area>_<name> "
+                f"({METRIC_NAME_RE.pattern})")
+            continue
+        if isinstance(metric, Counter) and not name.endswith("_total"):
+            problems.append(f"{name}: counters must end in _total")
+        if isinstance(metric, Histogram) and not (
+                name.endswith("_seconds") or name.endswith("_bytes")):
+            problems.append(
+                f"{name}: histograms must end in _seconds or _bytes")
+    return problems
+
+
+def main(argv=None) -> int:
+    problems = lint()
+    names = get_registry().names()
+    if problems:
+        print(f"obs.check: {len(problems)} metric-name violation(s):")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    print(f"obs.check: {len(names)} registered metric names OK "
+          f"(tpudl_<area>_<name>)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,10 +14,13 @@ import time
 from typing import Any, Optional
 
 from deeplearning4j_tpu.obs.listeners import TrainingListener
+from deeplearning4j_tpu.obs.registry import get_registry
 
 
 class MetricsWriter:
-    """Append-only jsonl writer; one file per run."""
+    """Append-only jsonl writer; one file per run.  Every record also
+    ticks ``tpudl_obs_records_total`` in the unified registry so the
+    ``/metrics`` endpoint reflects stream liveness."""
 
     def __init__(self, path: str):
         self.path = path
@@ -29,6 +32,7 @@ class MetricsWriter:
     def write(self, record: dict[str, Any]) -> None:
         record = {"ts": time.time(), **record}
         self._fh.write(json.dumps(record, default=_to_jsonable) + "\n")
+        get_registry().counter("tpudl_obs_records_total").inc()
 
     def close(self) -> None:
         self._fh.close()

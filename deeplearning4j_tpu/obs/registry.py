@@ -1,0 +1,321 @@
+"""Unified metrics registry — counters, gauges, histograms, Prometheus text.
+
+One process-wide registry that every telemetry producer feeds: the jsonl
+:class:`~deeplearning4j_tpu.obs.metrics.MetricsWriter`, the
+``StatsListener``s, trainer step instrumentation, the parallel stack's
+wire counters, and the bench harness.  The UI server exposes it at
+``GET /metrics`` in Prometheus text exposition format, so a scrape
+target exists wherever a training dashboard does.
+
+Naming convention (enforced at registration, linted by
+``python -m deeplearning4j_tpu.obs.check``)::
+
+    tpudl_<area>_<name>
+
+where ``<area>`` is one of the subsystem prefixes (``train``, ``device``,
+``obs``, ``dcn``, ``parallel``, ``bench``, ...) and counters end in
+``_total``, histograms/durations in ``_seconds`` (or ``_bytes``).  See
+``docs/observability.md`` for the full catalog.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from typing import Optional, Sequence
+
+METRIC_NAME_RE = re.compile(r"^tpudl_[a-z0-9]+_[a-z][a-z0-9_]*[a-z0-9]$")
+
+# latency buckets in seconds: µs-scale dispatch through minute-scale compiles
+DEFAULT_TIME_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                        0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                        10.0, 30.0, 60.0)
+# byte-size buckets: 1 KiB .. 16 GiB in powers of 4
+DEFAULT_BYTE_BUCKETS = tuple(float(1024 * 4 ** i) for i in range(13))
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+class Metric:
+    """Base: name + help + Prometheus type string."""
+
+    prom_type = "untyped"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+
+    def render(self) -> list[str]:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    prom_type = "counter"
+
+    def __init__(self, name: str, help: str = ""):
+        super().__init__(name, help)
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def render(self) -> list[str]:
+        return [f"{self.name} {_fmt(self._value)}"]
+
+
+class Gauge(Metric):
+    prom_type = "gauge"
+
+    def __init__(self, name: str, help: str = ""):
+        super().__init__(name, help)
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def render(self) -> list[str]:
+        return [f"{self.name} {_fmt(self._value)}"]
+
+
+class Histogram(Metric):
+    """Fixed-bucket histogram (cumulative buckets, Prometheus layout)."""
+
+    prom_type = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Sequence[float] = DEFAULT_TIME_BUCKETS):
+        super().__init__(name, help)
+        b = sorted(float(x) for x in buckets)
+        if not b:
+            raise ValueError(f"histogram {name} needs at least one bucket")
+        self.buckets = tuple(b)
+        self._counts = [0] * (len(b) + 1)   # +1 for the +Inf bucket
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self._sum += v
+            self._count += 1
+            for i, ub in enumerate(self.buckets):
+                if v <= ub:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def _snapshot(self) -> tuple[dict, float, int]:
+        """(cumulative buckets, sum, count) under ONE lock acquisition —
+        a scrape must never see count != the +Inf bucket."""
+        out, cum = {}, 0
+        with self._lock:
+            for ub, c in zip(self.buckets, self._counts):
+                cum += c
+                out[ub] = cum
+            out[math.inf] = cum + self._counts[-1]
+            return out, self._sum, self._count
+
+    def bucket_counts(self) -> dict:
+        """Cumulative counts keyed by upper bound (Prometheus semantics)."""
+        return self._snapshot()[0]
+
+    def render(self) -> list[str]:
+        buckets, total, count = self._snapshot()
+        lines = []
+        for ub, cum in buckets.items():
+            lines.append(f'{self.name}_bucket{{le="{_fmt(ub)}"}} {cum}')
+        lines.append(f"{self.name}_sum {_fmt(total)}")
+        lines.append(f"{self.name}_count {count}")
+        return lines
+
+
+class MetricsRegistry:
+    """Name → metric map with idempotent get-or-create registration.
+
+    Re-registering a name returns the existing metric when the type
+    matches (so module-level instrumentation is import-order free) and
+    raises when it doesn't (two subsystems fighting over one name is a
+    bug worth failing on)."""
+
+    def __init__(self, validate_names: bool = True):
+        self._metrics: dict[str, Metric] = {}
+        self._lock = threading.Lock()
+        self.validate_names = validate_names
+
+    def _get_or_create(self, cls, name: str, help: str, **kwargs) -> Metric:
+        if self.validate_names and not METRIC_NAME_RE.match(name):
+            raise ValueError(
+                f"metric name {name!r} violates the tpudl_<area>_<name> "
+                f"convention ({METRIC_NAME_RE.pattern})")
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if type(existing) is not cls:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{type(existing).__name__}, not {cls.__name__}")
+                want = kwargs.get("buckets")
+                if want is not None and tuple(sorted(
+                        float(b) for b in want)) != existing.buckets:
+                    raise ValueError(
+                        f"histogram {name!r} already registered with "
+                        f"buckets {existing.buckets}, requested "
+                        f"{tuple(want)}")
+                return existing
+            m = cls(name, help, **kwargs)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = DEFAULT_TIME_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def get(self, name: str) -> Optional[Metric]:
+        return self._metrics.get(name)
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        out = []
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        for m in metrics:
+            if m.help:
+                out.append(f"# HELP {m.name} {_escape_help(m.help)}")
+            out.append(f"# TYPE {m.name} {m.prom_type}")
+            out.extend(m.render())
+        return "\n".join(out) + "\n"
+
+
+_default = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _default
+
+
+def set_registry(registry: MetricsRegistry) -> MetricsRegistry:
+    """Swap the process-wide registry (tests isolate with this); returns
+    the previous one."""
+    global _default
+    prev = _default
+    _default = registry
+    return prev
+
+
+def install_standard_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
+    """Register the framework's standard metric set (the catalog in
+    docs/observability.md) and return it keyed by name.  Idempotent;
+    called lazily by the instrumentation sites and eagerly by the
+    ``obs.check`` lint so the full catalog is always visible to both the
+    scrape endpoint and the linter."""
+    r = registry or get_registry()
+    metrics = [
+        r.counter("tpudl_train_steps_total",
+                  "Optimization steps completed across all trainers"),
+        r.counter("tpudl_train_examples_total",
+                  "Training examples consumed"),
+        r.counter("tpudl_train_epochs_total", "Epochs completed"),
+        r.histogram("tpudl_train_step_seconds",
+                    "Wall time per training step (sync-inclusive when "
+                    "tracing is on, dispatch-only otherwise)"),
+        r.gauge("tpudl_train_compile_seconds",
+                "Wall time of the most recent first-call (trace+compile) "
+                "step through a jit boundary"),
+        r.gauge("tpudl_train_last_score", "Most recent training loss"),
+        r.gauge("tpudl_device_hbm_bytes_in_use",
+                "Device memory in use on local device 0 (memory_stats)"),
+        r.gauge("tpudl_device_hbm_bytes_limit",
+                "Device memory capacity on local device 0"),
+        r.gauge("tpudl_device_hbm_peak_bytes",
+                "Peak device memory in use on local device 0"),
+        r.counter("tpudl_obs_records_total",
+                  "Records written by MetricsWriter jsonl streams"),
+        r.counter("tpudl_obs_stats_samples_total",
+                  "On-device stats samples taken by StatsListener"),
+        r.counter("tpudl_dcn_steps_total",
+                  "Multi-slice DCN training steps (per local slice)"),
+        r.counter("tpudl_dcn_wire_bytes_total",
+                  "Compressed gradient bytes exchanged over DCN"),
+        r.counter("tpudl_dcn_d2h_bytes_total",
+                  "Device-to-host bytes for DCN message staging"),
+        r.histogram("tpudl_dcn_exchange_seconds",
+                    "Ring-exchange duration per slice step"),
+        r.counter("tpudl_dcn_drained_exchanges_total",
+                  "In-flight overlapped exchanges drained by finish()"),
+        r.gauge("tpudl_parallel_mesh_devices",
+                "Devices in the active data-parallel mesh"),
+        r.counter("tpudl_parallel_avg_syncs_total",
+                  "Parameter-averaging resyncs (averaging_frequency mode)"),
+        r.counter("tpudl_parallel_pipeline_calls_total",
+                  "pipeline_apply invocations (trace-time under jit)"),
+        r.histogram("tpudl_bench_step_seconds",
+                    "Steady-state step time measured by the bench harness"),
+    ]
+    return {m.name: m for m in metrics}
+
+
+def record_device_memory(registry: Optional[MetricsRegistry] = None,
+                         device=None) -> Optional[dict]:
+    """Sample HBM telemetry into the device gauges; returns the raw
+    ``memory_stats()`` dict (None where the backend has none, e.g. CPU)."""
+    from deeplearning4j_tpu.obs.tracing import device_memory_stats
+    stats = device_memory_stats(device)
+    if not stats:
+        return None
+    r = registry or get_registry()
+    if "bytes_in_use" in stats:
+        r.gauge("tpudl_device_hbm_bytes_in_use").set(stats["bytes_in_use"])
+    if "bytes_limit" in stats:
+        r.gauge("tpudl_device_hbm_bytes_limit").set(stats["bytes_limit"])
+    if "peak_bytes_in_use" in stats:
+        r.gauge("tpudl_device_hbm_peak_bytes").set(stats["peak_bytes_in_use"])
+    return stats

@@ -185,12 +185,14 @@ class StatsListener(TrainingListener):
 
     def stats_ready(self, model, iteration: int, epoch: int, score: float,
                     stats: dict) -> None:
+        from deeplearning4j_tpu.obs.registry import get_registry
         self._maybe_send_init(model)
         self._last_stats_iteration = iteration
         record = {"type": "stats", "iteration": iteration, "epoch": epoch,
                   "score": float(score)}
         record.update(_host(stats))
         self.storage.put(record)
+        get_registry().counter("tpudl_obs_stats_samples_total").inc()
 
     def iteration_done(self, model, iteration, epoch, score):
         self._maybe_send_init(model)

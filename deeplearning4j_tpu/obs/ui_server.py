@@ -14,6 +14,8 @@ update; ``<meta refresh>`` makes it hands-free).  Endpoints:
 - ``/``            dashboard (first attached storage, auto-refresh)
 - ``/train/<i>``   dashboard for attached storage i
 - ``/data/<i>.json`` raw records (the UI's JSON API surface)
+- ``/metrics``     Prometheus text exposition of the process-wide
+  metrics registry (``obs.registry``) — the scrape target
 - ``/healthz``     liveness
 """
 
@@ -24,6 +26,8 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from deeplearning4j_tpu.obs.registry import (get_registry,
+                                             install_standard_metrics)
 from deeplearning4j_tpu.obs.stats import render_html
 
 
@@ -55,6 +59,13 @@ class UIServer:
                 path = self.path.split("?")[0].rstrip("/") or "/"
                 if path == "/healthz":
                     return self._send(b'{"status":"ok"}', "application/json")
+                if path == "/metrics":
+                    # full catalog visible even before first increment so
+                    # scrapers see stable series from scrape #1
+                    install_standard_metrics()
+                    body = get_registry().render_prometheus().encode()
+                    return self._send(
+                        body, "text/plain; version=0.0.4; charset=utf-8")
                 if path.startswith("/data/") and path.endswith(".json"):
                     idx = path[len("/data/"):-len(".json")]
                     if idx.isdigit() and int(idx) < len(storages):

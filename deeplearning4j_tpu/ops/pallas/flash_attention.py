@@ -111,10 +111,15 @@ def _kernel(qoff_ref, koff_ref, klen_ref, q_ref, k_ref, v_ref, kmask_ref,
 
 def _sds(q, k, shape, dtype=jnp.float32):
     """Output ShapeDtypeStruct carrying the inputs' varying-manual-axes —
-    required when the kernel runs inside shard_map (ring attention)."""
+    required when the kernel runs inside shard_map (ring attention).
+    Older jax has neither ``jax.typeof`` nor vma tracking — there the
+    plain struct is correct."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
     vma = frozenset()
     for a in (q, k):
-        vma = vma | (getattr(jax.typeof(a), "vma", None) or frozenset())
+        vma = vma | (getattr(typeof(a), "vma", None) or frozenset())
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)

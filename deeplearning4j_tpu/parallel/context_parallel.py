@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from deeplearning4j_tpu.utils.jax_compat import pcast, shard_map
 
 NEG_INF = -1e30
 
@@ -122,8 +122,8 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         # sharded axis in play (seq ring + optional data/head axes)
         varying = tuple(a for a in (axis, data_axis, head_axis) if a)
         o0 = jnp.zeros_like(qh)
-        m0 = lax.pcast(jnp.full(qh.shape[:-1], NEG_INF, qh.dtype), varying, to="varying")
-        l0 = lax.pcast(jnp.zeros(qh.shape[:-1], qh.dtype), varying, to="varying")
+        m0 = pcast(jnp.full(qh.shape[:-1], NEG_INF, qh.dtype), varying, to="varying")
+        l0 = pcast(jnp.zeros(qh.shape[:-1], qh.dtype), varying, to="varying")
         (k_f, v_f, o, m, l), _ = lax.scan(step, (kh, vh, o0, m0, l0),
                                           jnp.arange(n_dev))
         out = o / jnp.maximum(l[..., None], 1e-20)

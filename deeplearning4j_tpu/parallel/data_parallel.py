@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.obs import tracing
+from deeplearning4j_tpu.obs.registry import get_registry
 from deeplearning4j_tpu.parallel import mesh as mesh_mod
 from deeplearning4j_tpu.train.trainer import Trainer
 
@@ -85,6 +87,8 @@ class ParallelWrapper(Trainer):
                 self.net.opt_state = self.tx.init(self.net.params_)
             self._opt_state_shardings = self._zero_shardings(self.net.opt_state)
         super()._ensure_ready()
+        get_registry().gauge("tpudl_parallel_mesh_devices").set(
+            int(self.mesh.shape["data"]))
         if not self._placed:
             net = self.net
             if self.averaging_frequency == 1:
@@ -212,9 +216,12 @@ class ParallelWrapper(Trainer):
         net.params_, net.state_, net.opt_state = params, state, opt_state
         self._steps_since_avg += 1
         if self._steps_since_avg >= self.averaging_frequency:
-            net.params_ = self._avg_fn(net.params_)
-            if self.average_updater_state:
-                net.opt_state = self._avg_fn(net.opt_state)
+            with tracing.span("average", shards=n,
+                              frequency=self.averaging_frequency):
+                net.params_ = self._avg_fn(net.params_)
+                if self.average_updater_state:
+                    net.opt_state = self._avg_fn(net.opt_state)
+            get_registry().counter("tpudl_parallel_avg_syncs_total").inc()
             self._steps_since_avg = 0
         from deeplearning4j_tpu.config import get_config
         from deeplearning4j_tpu.obs.profiler import check_finite

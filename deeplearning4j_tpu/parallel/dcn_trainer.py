@@ -57,6 +57,8 @@ import jax.flatten_util  # registers jax.flatten_util (not a jax re-export)
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.obs import tracing
+from deeplearning4j_tpu.obs.registry import get_registry
 from deeplearning4j_tpu.parallel import mesh as mesh_mod
 from deeplearning4j_tpu.parallel.compression import (
     AdaptiveThresholdAlgorithm, compact_device_message, pad_to_device_layout,
@@ -177,6 +179,7 @@ class MultiSliceTrainer:
         # separate IO lane so an in-flight exchange never blocks compute
         self._io_pool = ThreadPoolExecutor(max_workers=n_slices)
         self._pending = [None] * n_slices   # overlap: in-flight exchanges
+        self._step_ctx = None               # current step span ctx (threads)
         self.iteration = 0
         self.last_wire_stats: list[dict] = []
 
@@ -249,45 +252,62 @@ class MultiSliceTrainer:
         self._decode_apply_fn = decode_apply_fn
 
     # ----------------------------------------------------------- training
-    def _exchange(self, rank: int, compact: np.ndarray) -> np.ndarray:
+    def _exchange(self, rank: int, compact: np.ndarray,
+                  parent=None) -> np.ndarray:
         """Ring-exchange one slice's compact wire message; returns the
-        [world, fixed_layout] stack in global rank order (H2D-ready)."""
-        grank = self.rank_offset + rank
-        peers = self.transports[rank].exchange(grank, compact)
-        ordered = peers[:grank] + [compact] + peers[grank:]
-        stack = np.stack([pad_to_device_layout(m, self.capacity)
-                          for m in ordered])
-        # H2D on the IO thread (overlapped too in overlap mode)
-        return mesh_mod.replicate(self.meshes[rank], jnp.asarray(stack))
+        [world, fixed_layout] stack in global rank order (H2D-ready).
+        ``parent`` carries the slice span's context onto the IO thread
+        (overlap mode), where the ambient contextvar doesn't reach."""
+        import time as _time
+        t0 = _time.perf_counter()
+        with tracing.span("exchange", parent=parent, slice=rank,
+                          wire_bytes=int(compact.size) * 4):
+            grank = self.rank_offset + rank
+            peers = self.transports[rank].exchange(grank, compact)
+            ordered = peers[:grank] + [compact] + peers[grank:]
+            stack = np.stack([pad_to_device_layout(m, self.capacity)
+                              for m in ordered])
+            # H2D on the IO thread (overlapped too in overlap mode)
+            out = mesh_mod.replicate(self.meshes[rank], jnp.asarray(stack))
+        get_registry().histogram("tpudl_dcn_exchange_seconds").observe(
+            _time.perf_counter() - t0)
+        return out
 
     def _slice_step_device(self, rank, features, labels, fmask, lmask, rng):
         """Device-codec step: grads + residual + encode in ONE jit; only
         the message crosses D2H; peers' messages decode-and-apply on
         device.  With ``overlap`` the exchange of step N rides the IO
         pool while step N+1 computes (one-step-stale apply)."""
-        m = self.meshes[rank]
-        batch = mesh_mod.shard_batch(
-            m, {"f": features, "l": labels, "fm": fmask, "lm": lmask})
-        alg = self.algorithms[rank]
-        loss, new_state, msg, new_residual, res_linf = self._grad_encode_fn(
-            self.slice_params[rank], self.slice_state[rank],
-            batch["f"], batch["l"], batch["fm"], batch["lm"],
-            self.slice_residual[rank], rng,
-            jnp.float32(alg.current()))
-        self.slice_residual[rank] = new_residual
-        self.slice_state[rank] = new_state
-        msg_np = np.asarray(msg)     # the ONLY bulk D2H: 3+2cap int32s
-        compact = compact_device_message(msg_np, self.capacity)
-        alg.update(int(msg_np[0]), self.grad_size)
-        self._record_wire(rank, msg_np, compact, float(res_linf))
+        with tracing.span("slice", parent=self._step_ctx, slice=rank) as sp:
+            m = self.meshes[rank]
+            batch = mesh_mod.shard_batch(
+                m, {"f": features, "l": labels, "fm": fmask, "lm": lmask})
+            alg = self.algorithms[rank]
+            with tracing.span("encode", slice=rank):
+                loss, new_state, msg, new_residual, res_linf = \
+                    self._grad_encode_fn(
+                        self.slice_params[rank], self.slice_state[rank],
+                        batch["f"], batch["l"], batch["fm"], batch["lm"],
+                        self.slice_residual[rank], rng,
+                        jnp.float32(alg.current()))
+                self.slice_residual[rank] = new_residual
+                self.slice_state[rank] = new_state
+                msg_np = np.asarray(msg)  # the ONLY bulk D2H: 3+2cap int32s
+            compact = compact_device_message(msg_np, self.capacity)
+            alg.update(int(msg_np[0]), self.grad_size)
+            self._record_wire(rank, msg_np, compact, float(res_linf))
+            sp.set_attribute("wire_bytes", int(compact.size) * 4)
 
-        if self.overlap:
-            if self._pending[rank] is not None:
-                self._apply_messages(rank, self._pending[rank].result())
-            self._pending[rank] = self._io_pool.submit(
-                self._exchange, rank, compact)
-        else:
-            self._apply_messages(rank, self._exchange(rank, compact))
+            if self.overlap:
+                if self._pending[rank] is not None:
+                    with tracing.span("apply", slice=rank):
+                        self._apply_messages(rank, self._pending[rank].result())
+                self._pending[rank] = self._io_pool.submit(
+                    self._exchange, rank, compact, sp.context())
+            else:
+                padded = self._exchange(rank, compact)
+                with tracing.span("apply", slice=rank):
+                    self._apply_messages(rank, padded)
         return float(loss)
 
     def _apply_messages(self, rank: int, padded) -> None:
@@ -307,11 +327,21 @@ class MultiSliceTrainer:
             "threshold": float(self.algorithms[rank].current()),
             "residual_linf": res_linf,
         }
+        reg = get_registry()
+        reg.counter("tpudl_dcn_wire_bytes_total").inc(int(compact.size) * 4)
+        reg.counter("tpudl_dcn_d2h_bytes_total").inc(int(msg_np.size) * 4)
+        reg.counter("tpudl_dcn_steps_total").inc()
 
     def _slice_step(self, rank, features, labels, fmask, lmask, rng):
         """Host-codec step (oracle path): in-jit grads (psum over the
         slice mesh) → host flat grad → compressed DCN allreduce →
         identical apply."""
+        with tracing.span("slice", parent=self._step_ctx, slice=rank,
+                          codec="host"):
+            return self._slice_step_host(rank, features, labels, fmask,
+                                         lmask, rng)
+
+    def _slice_step_host(self, rank, features, labels, fmask, lmask, rng):
         m = self.meshes[rank]
         batch = mesh_mod.shard_batch(
             m, {"f": features, "l": labels, "fm": fmask, "lm": lmask})
@@ -329,9 +359,13 @@ class MultiSliceTrainer:
             params, self.slice_opt[rank], grad_tree)
         self.slice_state[rank] = new_state
         r = self.reducers[rank]
-        self._wire_tmp[rank] = {
-            "residual_linf": float(np.abs(r.accumulator.residual).max()),
-            **r.wire_stats(r.last_message)}
+        stats = {"residual_linf": float(np.abs(r.accumulator.residual).max()),
+                 **r.wire_stats(r.last_message)}
+        self._wire_tmp[rank] = stats
+        reg = get_registry()
+        if "wire_bytes" in stats:
+            reg.counter("tpudl_dcn_wire_bytes_total").inc(stats["wire_bytes"])
+        reg.counter("tpudl_dcn_steps_total").inc()
         return float(loss)
 
     def fit_batch(self, batch, rng) -> float:
@@ -356,12 +390,18 @@ class MultiSliceTrainer:
                 else self._slice_step)
         self._wire_tmp = [None] * n
         rngs = jax.random.split(rng, n)
-        futures = [self._pool.submit(
-            step, i, sub(feats, i), sub(labels, i),
-            sub(fmask, i), sub(lmask, i), rngs[i]) for i in range(n)]
-        losses = [f.result() for f in futures]
+        with tracing.span("step", iteration=self.iteration,
+                          slices=n) as sp:
+            # slice spans run on pool threads where the ambient context
+            # doesn't reach — hand them this step span's context explicitly
+            self._step_ctx = sp.context()
+            futures = [self._pool.submit(
+                step, i, sub(feats, i), sub(labels, i),
+                sub(fmask, i), sub(lmask, i), rngs[i]) for i in range(n)]
+            losses = [f.result() for f in futures]
+            mean_loss = float(np.mean(losses))
+            sp.set_attribute("score", mean_loss)
         self.last_wire_stats = list(self._wire_tmp)
-        mean_loss = float(np.mean(losses))
         self.bus.dispatch("iteration_done", self.net, self.iteration, 0,
                           mean_loss)
         self.iteration += 1
@@ -371,15 +411,19 @@ class MultiSliceTrainer:
         self._ensure_ready()
         key = jax.random.key(getattr(self.net.conf, "seed", 0) or 0)
         last = float("nan")
-        self.bus.dispatch("on_fit_start", self.net)
-        for epoch in range(epochs):
-            if hasattr(iterator, "reset"):
-                iterator.reset()
-            for batch in iterator:
-                key, sub = jax.random.split(key)
-                last = self.fit_batch(batch, sub)
-        self.finish()
-        self.bus.dispatch("on_fit_end", self.net)
+        with tracing.span("fit", model=type(self.net).__name__,
+                          slices=self.n_slices, world_size=self.world_size,
+                          epochs=epochs):
+            self.bus.dispatch("on_fit_start", self.net)
+            for epoch in range(epochs):
+                with tracing.span("epoch", epoch=epoch):
+                    if hasattr(iterator, "reset"):
+                        iterator.reset()
+                    for batch in iterator:
+                        key, sub = jax.random.split(key)
+                        last = self.fit_batch(batch, sub)
+            self.finish()
+            self.bus.dispatch("on_fit_end", self.net)
         return last
 
     def finish(self):
@@ -389,6 +433,8 @@ class MultiSliceTrainer:
             if self._pending[rank] is not None:
                 self._apply_messages(rank, self._pending[rank].result())
                 self._pending[rank] = None
+                get_registry().counter(
+                    "tpudl_dcn_drained_exchanges_total").inc()
 
     # ---------------------------------------------------------- sync back
     def collect(self, average_state: bool = True):
@@ -456,5 +502,11 @@ class MultiSliceTrainer:
                          default=0.0))
 
     def close(self):
-        self._pool.shutdown(wait=False)
-        self._io_pool.shutdown(wait=False)
+        # drain in-flight overlapped exchanges BEFORE tearing the pools
+        # down — otherwise overlap mode silently drops the last update
+        # unless the caller remembered finish()/collect() (ADVICE r5)
+        try:
+            self.finish()
+        finally:
+            self._pool.shutdown(wait=False)
+            self._io_pool.shutdown(wait=False)

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from deeplearning4j_tpu.utils.jax_compat import shard_map
 
 
 def init_moe_params(key, d_model: int, d_hidden: int, n_experts: int,

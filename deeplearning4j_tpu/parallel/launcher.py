@@ -29,6 +29,7 @@ def initialize(coordinator_address: Optional[str] = None,
     (DL4J VoidConfiguration's controller address/ports equivalent).
     No-ops on single-process runs."""
     import jax
+    from deeplearning4j_tpu.obs import tracing
     coordinator_address = coordinator_address or os.environ.get("DL4J_TPU_COORDINATOR")
     if num_processes is None:
         num_processes = int(os.environ.get("DL4J_TPU_NUM_PROCESSES", "1"))
@@ -36,9 +37,11 @@ def initialize(coordinator_address: Optional[str] = None,
         process_id = int(os.environ.get("DL4J_TPU_PROCESS_ID", "0"))
     if num_processes <= 1:
         return
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    with tracing.span("distributed_init", processes=num_processes,
+                      process_id=process_id):
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
 
 
 _WORKER_TEMPLATE = r"""
@@ -64,13 +67,20 @@ def spawn_local_cluster(fn: Callable, n_processes: int = 2, port: int = 12655,
     under a real jax.distributed runtime (CPU, loopback).  Returns each
     process's pickled return value.  ``fn`` must be picklable (module-level
     function).  This is the test rig for launcher/checkpoint/fault-
-    tolerance paths — the DummyTransport translation."""
+    tolerance paths — the DummyTransport translation.
+
+    When tracing is active in the launching process, its span context is
+    handed to every worker via ``DL4J_TPU_TRACE_CONTEXT`` — worker spans
+    parent under the launcher's current span, so one Chrome trace shows
+    the whole cluster."""
+    from deeplearning4j_tpu.obs import tracing
     workdir = tempfile.mkdtemp(prefix="dl4j_tpu_cluster_")
     fn_path = os.path.join(workdir, "fn.pkl")
     with open(fn_path, "wb") as f:
         pickle.dump(fn, f)
     procs = []
     out_paths = []
+    trace_env = tracing.propagation_env()
     for pid in range(n_processes):
         out_path = os.path.join(workdir, f"out_{pid}.pkl")
         out_paths.append(out_path)
@@ -79,6 +89,7 @@ def spawn_local_cluster(fn: Callable, n_processes: int = 2, port: int = 12655,
                                          local_devices=local_devices)
         env = dict(os.environ)
         env.pop("XLA_FLAGS", None)  # template sets its own
+        env.update(trace_env)
         if extra_env:
             env.update(extra_env)
         procs.append(subprocess.Popen([sys.executable, "-c", script], env=env,

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from deeplearning4j_tpu.utils.jax_compat import pcast, shard_map
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, x: jnp.ndarray,
@@ -45,6 +45,8 @@ def pipeline_apply(stage_fn: Callable, stage_params, x: jnp.ndarray,
 
     Returns y [B, ...] (the last stage's outputs, gathered).
     """
+    from deeplearning4j_tpu.obs import tracing
+    from deeplearning4j_tpu.obs.registry import get_registry
     n_stages = mesh.shape[axis]
     data_par = mesh.shape[data_axis] if data_axis else 1
     if x.shape[0] % (n_microbatches * data_par):
@@ -58,8 +60,8 @@ def pipeline_apply(stage_fn: Callable, stage_params, x: jnp.ndarray,
         micro = x_local.reshape((n_microbatches, -1) + x_local.shape[1:])
         n_ticks = n_stages + n_microbatches - 1
         # carry buffers are device-varying (each stage holds different acts)
-        buf = lax.pcast(jnp.zeros_like(micro[0]), (axis,), to="varying")
-        outs = lax.pcast(jnp.zeros_like(micro), (axis,), to="varying")
+        buf = pcast(jnp.zeros_like(micro[0]), (axis,), to="varying")
+        outs = pcast(jnp.zeros_like(micro), (axis,), to="varying")
 
         def tick(carry, t):
             buf, outs = carry
@@ -89,9 +91,15 @@ def pipeline_apply(stage_fn: Callable, stage_params, x: jnp.ndarray,
     param_spec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
     x_spec = P(data_axis) if data_axis else P()
     out_spec = P((axis, data_axis)) if data_axis else P(axis)
-    y = shard_map(local, mesh=mesh,
-                  in_specs=(param_spec, x_spec),
-                  out_specs=out_spec)(stage_params, x)  # each stage emits its block
+    # span covers build+dispatch on the host (under an outer jit this is
+    # trace-time only, which is exactly when the schedule cost is paid)
+    with tracing.span("pipeline", stages=int(n_stages),
+                      microbatches=n_microbatches,
+                      data_parallel=int(data_par)):
+        get_registry().counter("tpudl_parallel_pipeline_calls_total").inc()
+        y = shard_map(local, mesh=mesh,
+                      in_specs=(param_spec, x_spec),
+                      out_specs=out_spec)(stage_params, x)  # each stage emits its block
     # keep only the LAST stage's block (others are zeros): [S*B] → [B]
     b = x.shape[0]
     return y[(n_stages - 1) * b:]

@@ -34,7 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from deeplearning4j_tpu.utils.jax_compat import pcast, shard_map
 
 
 # ------------------------------------------------------------- scheduling
@@ -561,7 +561,7 @@ def pipeline_apply_stages(stage_fns: Sequence[Callable], stage_params,
     def local(params, x_local):
         idx = lax.axis_index(axis)
         micro = x_local.reshape((M, bm) + x_local.shape[1:])
-        dv = lambda a: lax.pcast(a, (axis,), to="varying")
+        dv = lambda a: pcast(a, (axis,), to="varying")
         buf = dv(jnp.zeros((bm, width), jnp.float32))
         outs = dv(jnp.zeros((M, bm, width), jnp.float32))
 

@@ -24,8 +24,10 @@ import jax.numpy as jnp
 
 from deeplearning4j_tpu.config import get_config
 from deeplearning4j_tpu.nn.losses import mean_score
+from deeplearning4j_tpu.obs import tracing
 from deeplearning4j_tpu.obs.listeners import ListenerBus
 from deeplearning4j_tpu.obs.profiler import check_finite
+from deeplearning4j_tpu.obs.registry import get_registry, record_device_memory
 from deeplearning4j_tpu.train import updaters as updater_mod
 
 
@@ -205,6 +207,7 @@ class Trainer:
         self._stats_step = None
         self._stats_listeners = [l for l in self.bus.listeners
                                  if getattr(l, "wants_model_stats", False)]
+        self._compiled = False   # first step through a jit boundary = compile
 
     def _build_multi_updater(self, default_updater, conf, frozen_mask):
         """Per-layer updater overrides (DL4J allows ``layer.updater(...)``):
@@ -364,16 +367,43 @@ class Trainer:
     def step_batch(self, batch, rng):
         """One training iteration with full semantics: tBPTT routing,
         score tracking, listener dispatch, iteration counter.  Used by
-        ``fit`` and by external epoch drivers (EarlyStoppingTrainer)."""
+        ``fit`` and by external epoch drivers (EarlyStoppingTrainer).
+
+        Observability: emits a ``step`` span (device-sync time split out,
+        HBM gauges sampled) and feeds the metrics registry.  With tracing
+        OFF the step stays sync-free — the latency histogram then records
+        dispatch wall time only."""
         net = self.net
         first = (batch.features[0] if isinstance(batch.features, (list, tuple))
                  else batch.features)
-        if net.conf.backprop_type == "tbptt" \
-                and not isinstance(batch.features, (list, tuple)) \
-                and first.ndim == 3:
-            loss = self._fit_tbptt(batch, rng)
+        compile_step = not self._compiled
+        t0 = time.perf_counter()
+        with tracing.span("step", iteration=net.iteration,
+                          epoch=net.epoch) as sp:
+            if net.conf.backprop_type == "tbptt" \
+                    and not isinstance(batch.features, (list, tuple)) \
+                    and first.ndim == 3:
+                loss = self._fit_tbptt(batch, rng)
+            else:
+                loss = self.fit_batch(batch, rng)
+            if tracing.get_tracer().enabled:
+                loss = tracing.device_sync(loss)
+                sp.set_attribute("score", float(loss))
+                if compile_step:
+                    sp.set_attribute("compile", True)
+                hbm = record_device_memory()
+                if hbm and "bytes_in_use" in hbm:
+                    sp.set_attribute("hbm_bytes_in_use", hbm["bytes_in_use"])
+                get_registry().gauge("tpudl_train_last_score").set(float(loss))
+        dt = time.perf_counter() - t0
+        self._compiled = True
+        reg = get_registry()
+        if compile_step:
+            reg.gauge("tpudl_train_compile_seconds").set(dt)
         else:
-            loss = self.fit_batch(batch, rng)
+            reg.histogram("tpudl_train_step_seconds").observe(dt)
+        reg.counter("tpudl_train_steps_total").inc()
+        reg.counter("tpudl_train_examples_total").inc(first.shape[0])
         net._score = loss
         for listener in self.bus.listeners:
             if hasattr(listener, "record_batch"):
@@ -386,22 +416,35 @@ class Trainer:
         self._ensure_ready()
         net = self.net
         key = jax.random.key(net.conf.seed + 7919)
-        self.bus.dispatch("on_fit_start", net)
-        for _ in range(epochs):
-            self.bus.dispatch("on_epoch_start", net, net.epoch)
-            epoch_t0 = time.perf_counter()
-            n_batches = 0
-            if hasattr(iterator, "reset"):
-                iterator.reset()
-            for batch in iterator:
-                key, sub = jax.random.split(key)
-                self.step_batch(batch, sub)
-                n_batches += 1
-            info = {"epoch_time_s": time.perf_counter() - epoch_t0,
-                    "batches": n_batches, "score": net._score}
-            self.bus.dispatch("on_epoch_end", net, net.epoch, info)
-            net.epoch += 1
-        self.bus.dispatch("on_fit_end", net, {"epochs": epochs})
+        attrs = (net.trace_attrs() if hasattr(net, "trace_attrs") else
+                 {"model": type(net).__name__})
+        cfg = get_config()
+        if cfg.profiling:
+            from deeplearning4j_tpu.obs.profiler import trace as profiler_trace
+            profile_ctx = profiler_trace(cfg.trace_dir)
+        else:
+            import contextlib
+            profile_ctx = contextlib.nullcontext()
+        with profile_ctx:
+            with tracing.span("fit", epochs=epochs, **attrs):
+                self.bus.dispatch("on_fit_start", net)
+                for _ in range(epochs):
+                    with tracing.span("epoch", epoch=net.epoch):
+                        self.bus.dispatch("on_epoch_start", net, net.epoch)
+                        epoch_t0 = time.perf_counter()
+                        n_batches = 0
+                        if hasattr(iterator, "reset"):
+                            iterator.reset()
+                        for batch in iterator:
+                            key, sub = jax.random.split(key)
+                            self.step_batch(batch, sub)
+                            n_batches += 1
+                        info = {"epoch_time_s": time.perf_counter() - epoch_t0,
+                                "batches": n_batches, "score": net._score}
+                        self.bus.dispatch("on_epoch_end", net, net.epoch, info)
+                    get_registry().counter("tpudl_train_epochs_total").inc()
+                    net.epoch += 1
+                self.bus.dispatch("on_fit_end", net, {"epochs": epochs})
         return net
 
 

@@ -1,11 +1,18 @@
 """MLP on MNIST — the canonical first example
-(dl4j-examples ``MLPMnistSingleLayerExample``)."""
+(dl4j-examples ``MLPMnistSingleLayerExample``).
 
+Run with ``DL4J_TPU_TRACING=1`` to get a Chrome-trace JSON of the
+``fit`` → ``epoch`` → ``step`` spans under ``config.trace_dir``
+(open it in chrome://tracing or https://ui.perfetto.dev)."""
+
+import os
+
+from deeplearning4j_tpu.config import get_config
 from deeplearning4j_tpu.data import datasets
 from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
 from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-from deeplearning4j_tpu.obs import ScoreIterationListener
+from deeplearning4j_tpu.obs import ScoreIterationListener, get_tracer
 from deeplearning4j_tpu.train import Adam
 
 
@@ -27,6 +34,15 @@ def main(epochs: int = 2, batch_size: int = 128, hidden: int = 256,
                           n_synthetic=n_synthetic)
     listeners = [ScoreIterationListener(10)] if verbose else None
     net.fit(train, epochs=epochs, listeners=listeners)
+
+    cfg = get_config()
+    if cfg.tracing:
+        path = os.path.join(cfg.trace_dir, "mlp_mnist_trace.json")
+        get_tracer().export_chrome_trace(path)
+        get_tracer().export_jsonl(os.path.join(cfg.trace_dir,
+                                               "mlp_mnist_spans.jsonl"))
+        if verbose:
+            print(f"chrome trace: {path}")
 
     ev = net.evaluate(test)
     if verbose:

@@ -12,7 +12,10 @@ from examples import (bert_mlm_finetune, char_rnn_textgen,
 
 
 def test_mlp_mnist_example():
-    acc = mlp_mnist.main(epochs=1, batch_size=64, hidden=32,
+    # 2 epochs: 1 epoch on 512 synthetic samples lands right at the 0.5
+    # threshold and flips with jax-version numerics (0.46 on 0.4.x,
+    # >0.5 on the rig's newer jax); 2 epochs is robustly >0.9
+    acc = mlp_mnist.main(epochs=2, batch_size=64, hidden=32,
                          n_synthetic=512, verbose=False)
     assert acc > 0.5
 
@@ -29,6 +32,7 @@ def test_lstm_uci_har_example():
     assert 0.0 <= acc <= 1.0
 
 
+@pytest.mark.slow
 def test_char_rnn_example_generates_text():
     text = char_rnn_textgen.main(epochs=1, seq_len=16, batch_size=8,
                                  hidden=24, verbose=False)
@@ -76,6 +80,7 @@ def test_multislice_dcn_example():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_pipeline_parallel_bert_example():
     losses = pipeline_parallel_bert.main(steps=2, verbose=False)
     assert all(np.isfinite(l) for l in losses)

@@ -775,3 +775,58 @@ class TestRound5BidirectionalTail:
         net = import_keras_model_and_weights(h5)
         np.testing.assert_allclose(np.asarray(net.output(x)), golden,
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestMaskingValidation:
+    """Masking wrap targeting (ADVICE r5): wraps only time-axis layers,
+    defers past sentinel-preserving per-timestep layers, and fails loud
+    on anything else (incl. a dangling trailing Masking)."""
+
+    @staticmethod
+    def _seq(layer_cfgs, input_shape=(6, 4)):
+        import json as _json
+        layers = [{"class_name": c, "config": dict(cfg)}
+                  for c, cfg in layer_cfgs]
+        layers[0]["config"]["batch_input_shape"] = \
+            [None] + list(input_shape)
+        return _json.dumps({"class_name": "Sequential",
+                            "config": {"layers": layers}})
+
+    def test_masking_before_dense_raises(self):
+        from deeplearning4j_tpu.importers.keras import import_sequential
+        js = self._seq([("Masking", {"mask_value": 0.0, "name": "m"}),
+                        ("Dense", {"units": 4, "activation": "linear",
+                                   "name": "d"})])
+        with pytest.raises(ValueError, match="Masking must be followed"):
+            import_sequential(js)
+
+    def test_trailing_masking_raises(self):
+        from deeplearning4j_tpu.importers.keras import import_sequential
+        js = self._seq([("LSTM", {"units": 3, "name": "l",
+                                  "return_sequences": True}),
+                        ("Masking", {"mask_value": 0.0, "name": "m"})])
+        with pytest.raises(ValueError, match="dangling"):
+            import_sequential(js)
+
+    def test_masking_defers_past_dropout_to_lstm(self):
+        from deeplearning4j_tpu.importers.keras import import_sequential
+        from deeplearning4j_tpu.nn.layers import DropoutLayer, MaskZeroLayer
+        js = self._seq([("Masking", {"mask_value": 0.0, "name": "m"}),
+                        ("Dropout", {"rate": 0.2, "name": "dr"}),
+                        ("LSTM", {"units": 3, "name": "l"}),
+                        ("Dense", {"units": 2, "activation": "softmax",
+                                   "name": "out"})])
+        net = import_sequential(js)
+        assert isinstance(net.layers[0], DropoutLayer)      # NOT wrapped
+        assert isinstance(net.layers[1], MaskZeroLayer)     # LSTM wrapped
+
+    def test_masking_does_not_defer_past_sigmoid_activation(self):
+        # sigmoid(0) != 0 destroys the sentinel rows the deferred wrap
+        # would re-derive the mask from
+        from deeplearning4j_tpu.importers.keras import import_sequential
+        js = self._seq([("Masking", {"mask_value": 0.0, "name": "m"}),
+                        ("Activation", {"activation": "sigmoid",
+                                        "name": "a"}),
+                        ("LSTM", {"units": 3, "name": "l"})])
+        with pytest.raises(ValueError, match="Masking must be followed"):
+            import_sequential(js)

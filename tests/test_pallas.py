@@ -227,6 +227,7 @@ class TestFlashMaskAndProduct:
                                        np.asarray(gr[name]),
                                        rtol=2e-4, atol=2e-5, err_msg=name)
 
+    @pytest.mark.slow
     def test_bert_flash_step_matches(self):
         """One MLM train step with use_flash on == off (tiny config)."""
         import dataclasses as dc

@@ -133,6 +133,7 @@ class TestBertPipeline:
         x = jnp.asarray(ids.astype(np.float32))
         return B, mesh, fns, sp, x, packed, M, bsz
 
+    @pytest.mark.slow
     def test_bert_four_stages_loss_and_grads(self):
         """BERT as 4 REAL stages (embeddings / encoder / encoder /
         encoder+MLM head): pipelined loss + grads equal the staged
@@ -162,6 +163,7 @@ class TestBertPipeline:
                 np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                            rtol=2e-3, atol=1e-5)
 
+    @pytest.mark.slow
     def test_tied_embedding_grad_merge(self):
         """merge_tied_embedding_grads re-ties the split embedding grad:
         the merged leaf equals the gradient of a SHARED-table reference,
@@ -209,6 +211,7 @@ class TestBertPipeline:
         new3 = np.asarray(sp[-1]["decode_embeddings"]) - lr * de
         np.testing.assert_array_equal(new0, new3)
 
+    @pytest.mark.slow
     def test_1f1b_reduces_compiled_temp_memory(self):
         """The point of 1F1B: bounded stash → smaller compiled temp
         allocation than all-forward-then-all-backward at the same M."""
@@ -243,6 +246,7 @@ class TestStageLocalOptimizer:
         opt = init_stage_local_opt(tx, flat, mesh)
         return mesh, fns, params, x, y, tx, flat, unravels, sizes, opt
 
+    @pytest.mark.slow
     def test_matches_replicated_pipeline_plus_optimizer(self):
         import optax
         from deeplearning4j_tpu.parallel.pipeline_stages import (
@@ -349,8 +353,9 @@ class TestVmaSwitchRegression:
         path segfaults XLA:CPU in a BACKEND-SWITCHED process (axon →
         clear_backends → CPU, the driver's dryrun environment) — see the
         comment at pipeline_stages.py's shard_map call."""
-        from jax import lax, shard_map
+        from jax import lax
         from jax.sharding import PartitionSpec as P
+        from deeplearning4j_tpu.utils.jax_compat import shard_map
 
         S = 4
         mesh = make_mesh(data=1, stage=S, devices=jax.devices()[:S])

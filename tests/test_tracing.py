@@ -1,0 +1,189 @@
+"""Span tracing tests: nesting, exports, cross-process context
+propagation, and the trainer's fit/epoch/step emission (all CPU)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.config import set_config
+from deeplearning4j_tpu.obs import tracing
+
+
+@pytest.fixture
+def tracer():
+    t = tracing.Tracer(enabled=True)
+    with tracing.use_tracer(t):
+        yield t
+
+
+def test_span_nesting_and_attributes(tracer):
+    with tracing.span("fit", model="test"):
+        with tracing.span("epoch", epoch=0):
+            with tracing.span("step", iteration=3) as s:
+                s.set_attribute("score", 1.25)
+    spans = {s.name: s for s in tracer.spans}
+    assert set(spans) == {"fit", "epoch", "step"}
+    assert spans["step"].parent_id == spans["epoch"].span_id
+    assert spans["epoch"].parent_id == spans["fit"].span_id
+    assert spans["fit"].parent_id is None
+    # one trace, durations contain each other
+    assert len({s.trace_id for s in tracer.spans}) == 1
+    assert spans["fit"].duration_s >= spans["epoch"].duration_s \
+        >= spans["step"].duration_s >= 0
+    assert spans["step"].attributes == {"iteration": 3, "score": 1.25}
+
+
+def test_disabled_tracing_is_noop():
+    t = tracing.Tracer(enabled=False)
+    with tracing.use_tracer(t):
+        with tracing.span("fit") as s:
+            assert s is tracing.NULL_SPAN
+            s.set_attribute("x", 1)          # no-op surface
+            assert tracing.current_span() is None
+    assert t.spans == []
+
+
+def test_sibling_spans_share_parent(tracer):
+    with tracing.span("step"):
+        with tracing.span("encode"):
+            pass
+        with tracing.span("exchange"):
+            pass
+    step = tracer.find("step")[0]
+    assert tracer.find("encode")[0].parent_id == step.span_id
+    assert tracer.find("exchange")[0].parent_id == step.span_id
+
+
+def test_explicit_parent_for_thread_hops(tracer):
+    # a worker thread has no ambient context — the parent rides explicitly
+    with tracing.span("step") as sp:
+        ctx = sp.context()
+    with tracing.span("slice", parent=ctx) as child:
+        pass
+    assert child.parent_id == ctx.span_id
+    assert child.trace_id == ctx.trace_id
+
+
+def test_chrome_trace_export_is_valid(tracer, tmp_path):
+    with tracing.span("fit"):
+        with tracing.span("step", iteration=0):
+            pass
+    path = tracer.export_chrome_trace(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    assert len(events) == 2
+    for ev in events:
+        assert ev["ph"] == "X" and ev["cat"] == "tpudl"
+        assert isinstance(ev["ts"], float) and isinstance(ev["dur"], float)
+        assert ev["dur"] >= 0
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+        assert "span_id" in ev["args"]
+    by_name = {ev["name"]: ev for ev in events}
+    # child event temporally contained in the parent event
+    fit, step = by_name["fit"], by_name["step"]
+    assert fit["ts"] <= step["ts"]
+    assert fit["ts"] + fit["dur"] >= step["ts"] + step["dur"] - 1e-3
+    assert step["args"]["parent_id"] == fit["args"]["span_id"]
+
+
+def test_jsonl_export(tracer, tmp_path):
+    with tracing.span("fit", k="v"):
+        pass
+    path = tracer.export_jsonl(str(tmp_path / "spans.jsonl"))
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == 1
+    rec = lines[0]
+    assert rec["name"] == "fit" and rec["attributes"] == {"k": "v"}
+    assert rec["duration_s"] >= 0 and rec["parent_id"] is None
+
+
+def test_jsonl_export_is_incremental(tracer, tmp_path):
+    """Periodic flushing must not duplicate spans (per-path high-water)."""
+    path = str(tmp_path / "spans.jsonl")
+    with tracing.span("a"):
+        pass
+    tracer.export_jsonl(path)
+    tracer.export_jsonl(path)                 # nothing new → no dupes
+    with tracing.span("b"):
+        pass
+    tracer.export_jsonl(path)
+    names = [json.loads(l)["name"] for l in open(path)]
+    assert names == ["a", "b"]
+
+
+def test_context_inject_extract_roundtrip(tracer):
+    assert tracing.inject() is None          # no active span
+    with tracing.span("parent") as p:
+        raw = tracing.inject()
+    ctx = tracing.extract(raw)
+    assert ctx.trace_id == p.trace_id and ctx.span_id == p.span_id
+    assert tracing.extract(None) is None
+    assert tracing.extract("not json{") is None
+
+
+def test_cross_process_context_via_env(tracer, monkeypatch):
+    """The launcher hands DL4J_TPU_TRACE_CONTEXT to workers; a fresh
+    Tracer in the child process parents its root spans under the
+    launcher's span — simulated here by re-reading the env."""
+    with tracing.span("launcher") as p:
+        env = tracing.propagation_env()
+    assert env["DL4J_TPU_TRACING"] == "1"
+    monkeypatch.setenv(tracing.TRACE_CONTEXT_ENV,
+                       env[tracing.TRACE_CONTEXT_ENV])
+    child = tracing.Tracer(enabled=True)     # what the worker builds
+    with tracing.use_tracer(child):
+        with tracing.span("worker_root") as w:
+            pass
+    assert w.trace_id == p.trace_id
+    assert w.parent_id == p.span_id
+    # malformed env never breaks a worker
+    monkeypatch.setenv(tracing.TRACE_CONTEXT_ENV, "}{garbage")
+    assert tracing.Tracer(enabled=True)._remote_parent is None
+
+
+def test_device_sync_attribution(tracer):
+    import jax.numpy as jnp
+    with tracing.span("step") as s:
+        out = tracing.device_sync(jnp.ones((8,)) * 2)
+    assert float(out[0]) == 2.0
+    assert s.device_sync_s >= 0
+
+
+def test_multilayer_fit_emits_step_spans():
+    """Smoke: MultiLayerNetwork.fit under tracing produces nested
+    fit → epoch → step spans with model attrs (acceptance criterion)."""
+    from deeplearning4j_tpu.data import datasets
+    from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.train import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-3))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(784)).build())
+    net = MultiLayerNetwork(conf).init()
+    it = datasets.mnist(batch_size=64, train=True, n_synthetic=192)
+
+    t = tracing.Tracer(enabled=True)
+    with tracing.use_tracer(t):
+        net.fit(it, epochs=2)
+
+    fits = t.find("fit")
+    epochs = t.find("epoch")
+    steps = t.find("step")
+    assert len(fits) == 1 and len(epochs) == 2
+    assert len(steps) == 6                    # 192/64 batches × 2 epochs
+    assert all(e.parent_id == fits[0].span_id for e in epochs)
+    epoch_ids = {e.span_id for e in epochs}
+    assert all(s.parent_id in epoch_ids for s in steps)
+    assert fits[0].attributes["model"] == "MultiLayerNetwork"
+    assert fits[0].attributes["params"] == net.num_params()
+    assert steps[0].attributes.get("compile") is True
+    assert all("score" in s.attributes for s in steps)
+    # tracing path syncs the loss → scores are real floats
+    assert all(np.isfinite(s.attributes["score"]) for s in steps[1:])
