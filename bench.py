@@ -465,11 +465,14 @@ def bench_workload_steps() -> dict:
     return out
 
 
-def _probe_device(timeout_s: float = 300.0) -> str | None:
+def _probe_device(timeout_s: float = 30.0) -> tuple[str, str] | None:
     """Touch the accelerator in a SUBPROCESS with a hard timeout: a down
     TPU tunnel makes backend init HANG (not raise), which would leave the
-    whole bench run recording nothing.  Returns an error string, or None
-    when the device answers."""
+    whole bench run recording nothing.  Returns None when the device
+    answers, else ``(status, message)`` where status is ``"skipped"``
+    (probe timed out — tunnel down, nothing to measure; BENCH_r05 burned
+    5 minutes at the old 300s timeout to report rc=1) or ``"error"``
+    (device answered with a failure worth a non-zero exit)."""
     import subprocess
     try:
         p = subprocess.run(
@@ -477,26 +480,30 @@ def _probe_device(timeout_s: float = 300.0) -> str | None:
              "import jax; print(len(jax.devices()), jax.devices()[0])"],
             capture_output=True, timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        return f"device probe timed out after {timeout_s:.0f}s (tunnel down?)"
+        return ("skipped",
+                f"device probe timed out after {timeout_s:.0f}s (tunnel down?)")
     if p.returncode != 0:
-        return (f"device probe failed (rc={p.returncode}): "
-                f"{p.stderr.decode()[-200:]}")
+        return ("error", f"device probe failed (rc={p.returncode}): "
+                         f"{p.stderr.decode()[-200:]}")
     return None
 
 
 def main():
-    err = _probe_device()
-    if err:
-        # same failure contract as the other error paths: top-level
-        # "error", nonzero exit — a 0.0 must never read as a measurement
+    probe = _probe_device()
+    if probe:
+        status, err = probe
+        # a 0.0 must never read as a measurement: a hung tunnel is a
+        # structured "skipped" record with rc=0 (nothing measurable, not
+        # a bench failure); a device that answered with an error keeps
+        # the nonzero-exit error contract
         print(json.dumps({"metric": "resnet50_train_images_per_sec_per_chip",
                           "value": 0.0, "unit": "images/sec/chip",
-                          "vs_baseline": 0.0, "error": err,
+                          "vs_baseline": 0.0, "status": status, "error": err,
                           "detail": {"note": "TPU unreachable at bench "
                                              "time; see BENCH_r04 + "
                                              "bench/PROFILE.md for the "
                                              "last measured numbers"}}))
-        return 1
+        return 0 if status == "skipped" else 1
     batch = 256  # HBM-bound workload: large batch amortizes weight traffic
                  # (see bench/PROFILE.md; 256 ≈ saturation point on v5e)
     for attempt in range(3):

@@ -1,0 +1,39 @@
+"""tpudl.analyze — pre-compile static validation + TPU-antipattern lint.
+
+The reference framework's value was largely in what it caught *before*
+anything ran (OpValidation ledgers, ``setInputType`` config-time shape
+inference).  This package walks the typed layers we already have —
+``ops/spec.py``, the ``nn/conf.py`` input-type chains, ``parallel/mesh.py``
+— and reports problems as diagnostics with stable rule IDs (``TPU101``…)
+instead of opaque XLA compile errors or silent recompiles.
+
+Two check families:
+
+- **Model/graph static validation** (:mod:`.model_checks`,
+  :mod:`.sharding`): full shape+dtype inference through a
+  ``MultiLayerConfiguration`` / ``ComputationGraphConfiguration``,
+  dead-vertex and dtype-join detection, HBM footprint vs budget,
+  PartitionSpec resolution against the declared mesh axes.
+- **Codebase lint** (:mod:`.lint`): AST rules over our own tree for TPU
+  antipatterns — host syncs inside ``@jit``, timing without
+  ``block_until_ready``, traced-value Python control flow, bare
+  ``shard_map``/``pmap`` imports that bypass ``utils/jax_compat`` — plus
+  the registry-backed metric-name and op-catalog rules.
+
+CLI: ``python -m deeplearning4j_tpu.analyze --model <zoo-or-json>`` /
+``--self`` / ``--lint <paths>``; exit code is non-zero on errors so CI
+can gate.  Rule catalog: ``docs/static_analysis.md``.
+"""
+
+from deeplearning4j_tpu.analyze.diagnostics import (
+    Diagnostic, Report, RULES, RuleInfo, ERROR, WARNING, INFO)
+from deeplearning4j_tpu.analyze.model_checks import analyze_model, load_model_conf
+from deeplearning4j_tpu.analyze.sharding import check_sharding
+from deeplearning4j_tpu.analyze.lint import (
+    lint_paths, lint_package, check_metric_names, check_op_catalog)
+
+__all__ = [
+    "Diagnostic", "Report", "RULES", "RuleInfo", "ERROR", "WARNING", "INFO",
+    "analyze_model", "load_model_conf", "check_sharding",
+    "lint_paths", "lint_package", "check_metric_names", "check_op_catalog",
+]

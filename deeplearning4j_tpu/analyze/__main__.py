@@ -1,0 +1,97 @@
+"""``python -m deeplearning4j_tpu.analyze`` — the pre-compile gate.
+
+Modes (at least one required, combinable — diagnostics merge into one
+report and one exit code):
+
+- ``--model <zoo-or-json>``: static graph/sharding validation of a zoo
+  model by name (``resnet50``) or a configuration JSON on disk.
+- ``--self``: AST-lint the installed ``deeplearning4j_tpu`` tree plus the
+  metric-name and op-catalog rules (what CI gates).
+- ``--lint <path> [...]``: AST-lint arbitrary files/directories.
+
+Exit code 0 = no error-severity diagnostics; 1 = errors found;
+2 = usage/load failure.  ``--format json`` emits one machine-readable
+document for tooling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from deeplearning4j_tpu.analyze.diagnostics import Report
+from deeplearning4j_tpu.analyze.model_checks import (
+    analyze_model, load_model_conf, parse_byte_size)
+from deeplearning4j_tpu.analyze.lint import lint_paths, lint_package
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.analyze",
+        description="pre-compile graph/sharding validator + TPU-antipattern "
+                    "linter (rule catalog: docs/static_analysis.md)")
+    p.add_argument("--model", metavar="ZOO_OR_JSON",
+                   help="zoo model name or configuration-JSON path to "
+                        "statically validate")
+    p.add_argument("--self", dest="self_check", action="store_true",
+                   help="lint the deeplearning4j_tpu tree itself "
+                        "(AST + metric-name + op-catalog rules)")
+    p.add_argument("--lint", nargs="+", metavar="PATH",
+                   help="AST-lint the given files/directories")
+    p.add_argument("--hbm-budget", metavar="SIZE",
+                   help="fail if the estimated training footprint exceeds "
+                        "this (e.g. 16GiB)")
+    p.add_argument("--batch", type=int, default=32,
+                   help="batch size for the activation-footprint estimate "
+                        "(default 32)")
+    p.add_argument("--mesh", metavar="AXES",
+                   help="comma-separated mesh axis names to resolve "
+                        "PartitionSpecs against (default: "
+                        "parallel.mesh.MESH_AXES)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--no-hints", action="store_true",
+                   help="omit fix hints from text output")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if not (args.model or args.self_check or args.lint):
+        build_parser().print_usage(sys.stderr)
+        print("error: nothing to do — pass --model, --self and/or --lint",
+              file=sys.stderr)
+        return 2
+
+    try:
+        budget = parse_byte_size(args.hbm_budget) if args.hbm_budget else None
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    report = Report()
+    if args.model:
+        try:
+            conf = load_model_conf(args.model)
+        except (ValueError, KeyError, OSError) as e:
+            print(f"error: cannot load model {args.model!r}: {e}",
+                  file=sys.stderr)
+            return 2
+        mesh_axes = (tuple(a.strip() for a in args.mesh.split(",") if a.strip())
+                     if args.mesh else None)
+        report.context["model"] = args.model
+        report.extend(analyze_model(conf, batch=args.batch, hbm_budget=budget,
+                                    mesh_axes=mesh_axes))
+    if args.self_check:
+        report.extend(lint_package())
+    if args.lint:
+        report.extend(lint_paths(args.lint))
+
+    if args.format == "json":
+        print(report.to_json())
+    else:
+        print(report.render_text(show_hints=not args.no_hints))
+    return report.exit_code()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,270 @@
+"""Diagnostic records + the stable rule catalog.
+
+Every check in ``tpudl.analyze`` emits :class:`Diagnostic` rows keyed by a
+rule ID from :data:`RULES`.  IDs are stable API — CI configs, suppression
+lists and the docs reference them — so new rules append, existing rules
+never renumber.  ``docs/static_analysis.md`` is generated from this table
+(see ``rule_catalog_markdown``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+
+_SEVERITY_ORDER = {ERROR: 0, WARNING: 1, INFO: 2}
+
+
+@dataclasses.dataclass(frozen=True)
+class RuleInfo:
+    id: str
+    slug: str            # short kebab-case name
+    severity: str        # default severity of findings
+    summary: str         # one-line what it catches
+    rationale: str       # why it matters on TPU
+    hint: str            # generic fix hint (diagnostics may carry a sharper one)
+
+
+# ---------------------------------------------------------------- catalog
+_RULE_LIST = [
+    # ---- model/graph static validation -------------------------------
+    RuleInfo(
+        "TPU101", "dead-vertex", ERROR,
+        "Vertex (or graph input) contributes to no declared output",
+        "A dead vertex still costs parameters, HBM and compile time; it "
+        "usually means a mis-wired edge that XLA would silently accept.",
+        "Wire the vertex toward an output or remove it."),
+    RuleInfo(
+        "TPU102", "dtype-mismatch", ERROR,
+        "Different activation dtypes meet at a vertex join (or the input "
+        "dtype contradicts the network dtype)",
+        "XLA inserts silent converts at joins; on TPU a stray f32 branch "
+        "in a bf16 graph doubles HBM traffic for that edge and hides a "
+        "config mistake.",
+        "Cast explicitly or align the InputType/network dtype."),
+    RuleInfo(
+        "TPU103", "preprocessor-gap", ERROR,
+        "No InputPreProcessor path from the incoming activation kind to "
+        "the kind the layer expects",
+        "The reference inserts preprocessors in setInputType; a gap here "
+        "is a config that can never build.",
+        "Insert a compatible layer ordering or use an InputType the "
+        "preprocessor table can adapt (e.g. convolutional_flat)."),
+    RuleInfo(
+        "TPU104", "shape-inference", ERROR,
+        "Shape/dtype inference raised while walking the layer chain",
+        "The same failure at run time surfaces as an opaque XLA error "
+        "without the layer path.",
+        "Fix the layer config named by the path anchor."),
+    RuleInfo(
+        "TPU105", "hbm-budget", ERROR,
+        "Estimated training footprint exceeds the declared --hbm-budget",
+        "Discovering OOM at compile time on a pod burns minutes per "
+        "attempt; the estimate (params + grads + updater slots + "
+        "activations) catches it at config time.",
+        "Shrink the model/batch, shard params (TP/ZeRO), or raise the "
+        "budget if the device allows."),
+    RuleInfo(
+        "TPU106", "missing-input-type", ERROR,
+        "Configuration lacks an InputType (or one per graph input)",
+        "Without it no shape inference, preprocessor insertion or "
+        "footprint estimate is possible — errors defer to first trace.",
+        "Call set_input_type(...) / set_input_types(...) on the builder."),
+    RuleInfo(
+        "TPU107", "unresolvable-graph", ERROR,
+        "Graph edge references an unknown vertex, or the DAG has a cycle",
+        "The topological walk cannot order the graph; nothing downstream "
+        "(init, fit, export) can run.",
+        "Fix the named dangling edge(s) or break the cycle."),
+    # ---- sharding-spec consistency ------------------------------------
+    RuleInfo(
+        "TPU201", "unresolvable-partition-axis", ERROR,
+        "A PartitionSpec names a mesh axis the declared mesh does not have",
+        "jax raises only at jit time, deep inside GSPMD, without naming "
+        "the rule that produced the spec.",
+        "Use an axis from parallel.mesh.MESH_AXES or extend the mesh."),
+    RuleInfo(
+        "TPU202", "axis-role-conflict", ERROR,
+        "The same mesh axis serves both data-parallel batch sharding and "
+        "a tensor-parallel rule",
+        "Batch and weight sharding over one axis silently halves both "
+        "degrees and corrupts the gradient psum grouping.",
+        "Give TP rules their own axis (canonically 'model')."),
+    RuleInfo(
+        "TPU203", "bad-sharding-rule", ERROR,
+        "A sharding rule's parameter-path regex does not compile",
+        "The rule silently matches nothing — parameters fall back to "
+        "replicated and the TP speedup quietly disappears.",
+        "Fix the regex (rules are matched with re.search on 'a/b/c' "
+        "parameter paths)."),
+    # ---- codebase lint (AST) ------------------------------------------
+    RuleInfo(
+        "TPU300", "lint-parse", ERROR,
+        "A linted file does not parse as Python",
+        "An unparseable file is invisible to every other rule (and to "
+        "the interpreter).",
+        "Fix the syntax error at the anchored line."),
+    RuleInfo(
+        "TPU301", "host-sync-in-jit", ERROR,
+        "Host materialization (.item()/float()/int()/np.asarray/"
+        "device_get) on a traced value inside a @jit function",
+        "Forces a device→host transfer at trace time: either a "
+        "ConcretizationError or a silent per-call sync that serializes "
+        "the TPU pipeline.",
+        "Keep the value on device (jnp ops) or move the readback outside "
+        "the jit boundary."),
+    RuleInfo(
+        "TPU302", "untimed-device-work", ERROR,
+        "Wall-clock timing around calls into jit-compiled code without a "
+        "block_until_ready/device_get fence",
+        "jax dispatch is async: the timer measures enqueue, not "
+        "execution — the phantom-regression class of bench bug.",
+        "Sync the result (jax.block_until_ready, device_get, float(...)) "
+        "inside the timed region; see obs.tracing.device_sync."),
+    RuleInfo(
+        "TPU303", "traced-python-control-flow", ERROR,
+        "Python if/while/range on a traced argument inside a @jit "
+        "function",
+        "Concretizes the tracer (error) or, with weak types, bakes the "
+        "value into the program and recompiles per distinct value.",
+        "Use lax.cond/lax.scan/jnp.where, or declare the argument in "
+        "static_argnames if it is genuinely static."),
+    RuleInfo(
+        "TPU304", "bare-parallel-import", ERROR,
+        "shard_map/pmap imported from jax directly instead of "
+        "utils/jax_compat",
+        "The API moved homes across the jax releases our rigs pin; bare "
+        "imports break one platform or silently lose replication "
+        "checking.",
+        "from deeplearning4j_tpu.utils.jax_compat import shard_map."),
+    RuleInfo(
+        "TPU305", "metric-name", ERROR,
+        "Registered metric violates the tpudl_<area>_<name> convention "
+        "or the counter/histogram suffix rules",
+        "Dashboards and alerts key on the convention; an off-convention "
+        "metric ships blind.",
+        "Rename to tpudl_<area>_<name>; counters end _total, duration/"
+        "size histograms end _seconds/_bytes."),
+    RuleInfo(
+        "TPU306", "op-catalog", ERROR,
+        "Op-spec catalog inconsistency (spec entry does not resolve, or "
+        "the coverage inventory and derived spec drifted)",
+        "The catalog is the single source of truth for coverage ledgers "
+        "and generated docs; drift breaks both silently.",
+        "Re-align ops/namespaces.py with ops/spec.py (see docs/OPS.md)."),
+]
+
+RULES: dict[str, RuleInfo] = {r.id: r for r in _RULE_LIST}
+
+
+@dataclasses.dataclass(frozen=True)
+class Diagnostic:
+    rule: str                      # rule ID from RULES
+    message: str
+    path: Optional[str] = None     # layer-path / vertex / file:line anchor
+    severity: Optional[str] = None # None = the rule's default
+    hint: Optional[str] = None     # None = the rule's generic hint
+
+    def effective_severity(self) -> str:
+        if self.severity:
+            return self.severity
+        info = RULES.get(self.rule)
+        return info.severity if info else ERROR
+
+    def effective_hint(self) -> Optional[str]:
+        if self.hint:
+            return self.hint
+        info = RULES.get(self.rule)
+        return info.hint if info else None
+
+    def render(self) -> str:
+        sev = self.effective_severity()
+        anchor = f"{self.path}: " if self.path else ""
+        return f"{self.rule} [{sev}] {anchor}{self.message}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "severity": self.effective_severity(),
+                "path": self.path, "message": self.message,
+                "hint": self.effective_hint()}
+
+
+class Report:
+    """Ordered collection of diagnostics + the CI contract (exit code)."""
+
+    def __init__(self, diagnostics: Optional[list[Diagnostic]] = None,
+                 context: Optional[dict] = None):
+        self.diagnostics: list[Diagnostic] = list(diagnostics or [])
+        # free-form facts worth printing even when clean (param counts,
+        # footprint estimate, files linted …)
+        self.context: dict = dict(context or {})
+
+    def add(self, rule: str, message: str, path: Optional[str] = None,
+            severity: Optional[str] = None, hint: Optional[str] = None) -> None:
+        self.diagnostics.append(Diagnostic(rule, message, path, severity, hint))
+
+    def extend(self, other: "Report") -> "Report":
+        self.diagnostics.extend(other.diagnostics)
+        for key, value in other.context.items():
+            # combined CLI modes (--self --lint …) must not clobber each
+            # other's tallies — counts accumulate, other facts overwrite
+            mine = self.context.get(key)
+            if isinstance(mine, int) and isinstance(value, int) \
+                    and not isinstance(mine, bool):
+                self.context[key] = mine + value
+            else:
+                self.context[key] = value
+        return self
+
+    def errors(self) -> list[Diagnostic]:
+        return [d for d in self.diagnostics
+                if d.effective_severity() == ERROR]
+
+    def by_rule(self, rule: str) -> list[Diagnostic]:
+        return [d for d in self.diagnostics if d.rule == rule]
+
+    def exit_code(self) -> int:
+        return 1 if self.errors() else 0
+
+    def sorted(self) -> list[Diagnostic]:
+        return sorted(
+            self.diagnostics,
+            key=lambda d: (_SEVERITY_ORDER.get(d.effective_severity(), 3),
+                           d.rule, d.path or ""))
+
+    def render_text(self, show_hints: bool = True) -> str:
+        lines = []
+        for key, value in self.context.items():
+            lines.append(f"# {key}: {value}")
+        for d in self.sorted():
+            lines.append(d.render())
+            hint = d.effective_hint()
+            if show_hints and hint:
+                lines.append(f"    hint: {hint}")
+        n_err = len(self.errors())
+        n_warn = sum(1 for d in self.diagnostics
+                     if d.effective_severity() == WARNING)
+        lines.append(f"{n_err} error(s), {n_warn} warning(s)")
+        return "\n".join(lines)
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "context": self.context,
+            "diagnostics": [d.to_dict() for d in self.sorted()],
+            "errors": len(self.errors()),
+            "exit_code": self.exit_code(),
+        }, indent=2, default=str)
+
+
+def rule_catalog_markdown() -> str:
+    """The docs/static_analysis.md rule table — generated so docs can't
+    drift from the registry."""
+    lines = ["| ID | rule | severity | catches |",
+             "|---|---|---|---|"]
+    for r in _RULE_LIST:
+        lines.append(f"| `{r.id}` | {r.slug} | {r.severity} | {r.summary} |")
+    return "\n".join(lines)

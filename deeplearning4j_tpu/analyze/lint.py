@@ -1,0 +1,503 @@
+"""TPU-antipattern linter — AST rules over our own tree.
+
+Rules are registered in :data:`LINT_RULES` (pluggable — a test or a
+downstream package can ``register_lint_rule`` its own) and run per
+module.  Each rule receives a :class:`ModuleInfo` — the parsed AST plus
+the jit-topology facts every rule needs: which functions are
+jit-compiled (and with which static arguments), which local names /
+``self.x`` attributes are bound to jit-compiled callables, and what the
+module's ``jax``/``numpy``/``time`` aliases are.
+
+AST rules: TPU301 (host sync inside @jit), TPU302 (timing jitted calls
+without a sync fence), TPU303 (Python control flow on traced args),
+TPU304 (bare shard_map/pmap imports bypassing utils/jax_compat).
+Registry-backed rules that ride along in ``lint_package``/``--self``:
+TPU305 (metric names — the former ``obs.check`` lint) and TPU306 (op-spec
+catalog integrity).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Callable, Iterable, Optional
+
+from deeplearning4j_tpu.analyze.diagnostics import Diagnostic, Report
+
+_TIME_FENCES = {"perf_counter", "monotonic", "time", "perf_counter_ns",
+                "monotonic_ns"}
+_SYNC_NAMES = {"block_until_ready", "device_get", "device_sync", "item"}
+_HOST_CAST_NAMES = {"float", "int", "bool"}
+_NP_MATERIALIZERS = {"asarray", "array"}
+# attributes whose values are trace-time Python constants — int(x.shape[0])
+# inside jit is legitimate metaprogramming, not a host sync
+_STATIC_VALUE_ATTRS = {"shape", "ndim", "size", "dtype", "itemsize"}
+
+
+# ------------------------------------------------------------ module facts
+class ModuleInfo:
+    def __init__(self, path: str, tree: ast.Module):
+        self.path = path
+        self.tree = tree
+        self.jax_aliases: set[str] = set()
+        self.np_aliases: set[str] = set()
+        self.time_aliases: set[str] = set()
+        self.partial_names: set[str] = set()
+        self.jit_names: set[str] = set()        # jax.jit imported by name
+        self.time_fn_names: set[str] = set()    # from time import perf_counter
+        # FunctionDef → frozenset of static (non-traced) parameter names
+        self.jit_functions: dict[ast.AST, frozenset] = {}
+        # local names / self-attributes whose call executes jitted code
+        self.jitted_callables: set[str] = set()
+        self._collect()
+
+    # -- jax.jit reference detection -----------------------------------
+    def is_jit_ref(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.jit_names
+        if isinstance(node, ast.Attribute) and node.attr == "jit":
+            return (isinstance(node.value, ast.Name)
+                    and node.value.id in self.jax_aliases)
+        return False
+
+    def _is_partial_ref(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.partial_names
+        return (isinstance(node, ast.Attribute) and node.attr == "partial"
+                and isinstance(node.value, ast.Name)
+                and node.value.id in {"functools", "ft"})
+
+    def _jit_call_static(self, call: ast.Call, fn_node) -> frozenset:
+        """static_argnames/static_argnums of a jax.jit(...) call, resolved
+        to parameter names of ``fn_node`` when possible."""
+        static: set[str] = set()
+        pos_names = []
+        if isinstance(fn_node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            pos_names = [a.arg for a in (fn_node.args.posonlyargs
+                                         + fn_node.args.args)]
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                        static.add(n.value)
+            elif kw.arg == "static_argnums":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                        if 0 <= n.value < len(pos_names):
+                            static.add(pos_names[n.value])
+        return frozenset(static)
+
+    def _decorator_jit_static(self, fn) -> Optional[frozenset]:
+        """None if ``fn`` is not jit-decorated, else its static params."""
+        for d in fn.decorator_list:
+            if self.is_jit_ref(d):
+                return frozenset()
+            if isinstance(d, ast.Call):
+                if self.is_jit_ref(d.func):
+                    return self._jit_call_static(d, fn)
+                if self._is_partial_ref(d.func) and d.args \
+                        and self.is_jit_ref(d.args[0]):
+                    return self._jit_call_static(d, fn)
+        return None
+
+    def _collect(self) -> None:
+        defs_by_name: dict[str, ast.AST] = {}
+        jit_wrapped: dict[str, frozenset] = {}   # def name → static params
+        jit_def_names: set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    root = alias.name.split(".")[0]
+                    bound = alias.asname or root
+                    if root == "jax" and (alias.asname is None
+                                          or alias.name == "jax"):
+                        # `import jax[.sub]` binds `jax`; `import jax as j`
+                        # binds the alias — either way it names the module
+                        self.jax_aliases.add(bound if alias.name == "jax"
+                                             else root)
+                    elif alias.name == "numpy":
+                        self.np_aliases.add(bound)
+                    elif alias.name == "time":
+                        self.time_aliases.add(bound)
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for alias in node.names:
+                    bound = alias.asname or alias.name
+                    if mod == "jax" and alias.name == "jit":
+                        self.jit_names.add(bound)
+                    elif mod == "functools" and alias.name == "partial":
+                        self.partial_names.add(bound)
+                    elif mod == "time" and alias.name in _TIME_FENCES:
+                        self.time_fn_names.add(bound)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name[node.name] = node
+                static = self._decorator_jit_static(node)
+                if static is not None:
+                    self.jit_functions[node] = static
+                    jit_def_names.add(node.name)
+                    self.jitted_callables.add(node.name)
+            elif isinstance(node, ast.Assign):
+                value = node.value
+                if isinstance(value, ast.Call) and self.is_jit_ref(value.func):
+                    for target in node.targets:
+                        if isinstance(target, ast.Name):
+                            self.jitted_callables.add(target.id)
+                        elif isinstance(target, ast.Attribute):
+                            self.jitted_callables.add(target.attr)
+                    if value.args and isinstance(value.args[0], ast.Name):
+                        jit_wrapped[value.args[0].id] = \
+                            self._jit_call_static(value, None)
+                elif isinstance(value, ast.Name) and value.id in jit_def_names:
+                    for target in node.targets:
+                        if isinstance(target, ast.Attribute):
+                            self.jitted_callables.add(target.attr)
+        # x = jax.jit(f): f's body is traced too
+        for name, static in jit_wrapped.items():
+            fn = defs_by_name.get(name)
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and fn not in self.jit_functions:
+                self.jit_functions[fn] = static
+
+    # -- small query helpers -------------------------------------------
+    def is_time_fence(self, node: ast.Call) -> bool:
+        f = node.func
+        if isinstance(f, ast.Name):
+            return f.id in self.time_fn_names
+        if isinstance(f, ast.Attribute) and f.attr in _TIME_FENCES:
+            return (isinstance(f.value, ast.Name)
+                    and f.value.id in (self.time_aliases | {"time", "_time"}))
+        return False
+
+    def is_sync_call(self, node: ast.Call) -> bool:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _SYNC_NAMES:
+            return True
+        if isinstance(f, ast.Name):
+            if f.id in _SYNC_NAMES:
+                return True
+            if f.id in _HOST_CAST_NAMES and node.args:
+                return True
+        if isinstance(f, ast.Attribute) and f.attr in _NP_MATERIALIZERS \
+                and isinstance(f.value, ast.Name) \
+                and f.value.id in (self.np_aliases | {"np"}):
+            return True
+        return False
+
+    def is_jitted_call(self, node: ast.Call) -> bool:
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in self.jitted_callables:
+            return True
+        if isinstance(f, ast.Attribute) and f.attr in self.jitted_callables:
+            return True
+        # jax.jit(f)(args) inline
+        if isinstance(f, ast.Call) and self.is_jit_ref(f.func):
+            return True
+        return False
+
+    def anchor(self, node: ast.AST) -> str:
+        return f"{self.path}:{getattr(node, 'lineno', '?')}"
+
+
+def _walk_shallow(fn: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function's own statements without descending into nested
+    function/class bodies (their timing/sync behavior is their own)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ------------------------------------------------------------ rule registry
+LINT_RULES: dict[str, Callable[[ModuleInfo], list[Diagnostic]]] = {}
+
+
+def register_lint_rule(rule_id: str):
+    """Add an AST rule: ``fn(module: ModuleInfo) -> list[Diagnostic]``.
+    Third-party rules register the same way the builtin ones do."""
+    def deco(fn):
+        LINT_RULES[rule_id] = fn
+        return fn
+    return deco
+
+
+def _mentions_static_value(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _STATIC_VALUE_ATTRS:
+            return True
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) \
+                and sub.func.id == "len":
+            return True
+    return False
+
+
+def _is_const_like(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.UnaryOp) and isinstance(node.operand, ast.Constant):
+        return True
+    return False
+
+
+@register_lint_rule("TPU301")
+def _rule_host_sync_in_jit(mod: ModuleInfo) -> list[Diagnostic]:
+    out = []
+    for fn, static in mod.jit_functions.items():
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            found = None
+            if isinstance(f, ast.Attribute) and f.attr == "item" \
+                    and not node.args:
+                found = ".item()"
+            elif isinstance(f, ast.Name) and f.id in _HOST_CAST_NAMES \
+                    and len(node.args) == 1:
+                arg = node.args[0]
+                if not _is_const_like(arg) and not _mentions_static_value(arg) \
+                        and not (isinstance(arg, ast.Name) and arg.id in static):
+                    found = f"{f.id}()"
+            elif isinstance(f, ast.Attribute) \
+                    and f.attr in _NP_MATERIALIZERS \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id in (mod.np_aliases | {"np", "numpy"}):
+                found = f"{f.value.id}.{f.attr}()"
+            elif isinstance(f, ast.Attribute) and f.attr == "device_get" \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id in mod.jax_aliases:
+                found = "jax.device_get()"
+            if found:
+                out.append(Diagnostic(
+                    "TPU301",
+                    f"{found} on a traced value inside jit-compiled "
+                    f"'{getattr(fn, 'name', '<lambda>')}' forces a "
+                    f"device→host sync at trace time",
+                    path=mod.anchor(node)))
+    return out
+
+
+@register_lint_rule("TPU302")
+def _rule_untimed_device_work(mod: ModuleInfo) -> list[Diagnostic]:
+    out = []
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        fences, jitted_calls, has_sync = [], [], False
+        for node in _walk_shallow(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if mod.is_time_fence(node):
+                fences.append(node)
+            elif mod.is_sync_call(node):
+                has_sync = True
+            elif mod.is_jitted_call(node):
+                jitted_calls.append(node)
+        if len(fences) >= 2 and jitted_calls and not has_sync:
+            fences.sort(key=lambda n: n.lineno)
+            jitted_calls.sort(key=lambda n: n.lineno)
+            out.append(Diagnostic(
+                "TPU302",
+                f"'{fn.name}' wall-clock-times calls into jit-compiled "
+                f"code (line {jitted_calls[0].lineno}) with no "
+                f"block_until_ready/device_get fence — async dispatch "
+                f"means the timer measures enqueue, not execution",
+                path=mod.anchor(fences[0])))
+    return out
+
+
+def _param_value_use(test: ast.AST, params: set[str]) -> Optional[str]:
+    """A traced-param name used by VALUE in a branch test (``is``/``is
+    not`` identity checks are host-side and fine)."""
+    def check(node) -> Optional[str]:
+        if isinstance(node, ast.Name) and node.id in params:
+            return node.id
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            return check(node.operand)
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                hit = check(v)
+                if hit:
+                    return hit
+            return None
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return None
+            for side in [node.left] + node.comparators:
+                if isinstance(side, ast.Name) and side.id in params:
+                    return side.id
+            return None
+        return None
+    return check(test)
+
+
+@register_lint_rule("TPU303")
+def _rule_traced_control_flow(mod: ModuleInfo) -> list[Diagnostic]:
+    out = []
+    for fn, static in mod.jit_functions.items():
+        args = fn.args
+        params = {a.arg for a in (args.posonlyargs + args.args
+                                  + args.kwonlyargs)} - set(static) - {"self"}
+        for node in _walk_shallow(fn):
+            name = None
+            if isinstance(node, (ast.If, ast.While)):
+                name = _param_value_use(node.test, params)
+                kind = "if/while"
+            elif isinstance(node, ast.For) and isinstance(node.iter, ast.Call) \
+                    and isinstance(node.iter.func, ast.Name) \
+                    and node.iter.func.id == "range":
+                for a in node.iter.args:
+                    if isinstance(a, ast.Name) and a.id in params:
+                        name = a.id
+                        break
+                kind = "range()"
+            if name:
+                out.append(Diagnostic(
+                    "TPU303",
+                    f"Python {kind} on traced argument '{name}' inside "
+                    f"jit-compiled '{fn.name}' — concretization error or "
+                    f"a recompile per distinct value",
+                    path=mod.anchor(node)))
+    return out
+
+
+@register_lint_rule("TPU304")
+def _rule_bare_parallel_import(mod: ModuleInfo) -> list[Diagnostic]:
+    norm = mod.path.replace(os.sep, "/")
+    if norm.endswith("utils/jax_compat.py"):
+        return []
+    out = []
+
+    def flag(node, what):
+        out.append(Diagnostic(
+            "TPU304",
+            f"{what} bypasses utils/jax_compat — the API's home moves "
+            f"across pinned jax releases",
+            path=mod.anchor(node)))
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom):
+            m = node.module or ""
+            for alias in node.names:
+                if m == "jax" and alias.name in {"shard_map", "pmap"}:
+                    flag(node, f"from jax import {alias.name}")
+                elif m == "jax.experimental.shard_map":
+                    flag(node, "from jax.experimental.shard_map import "
+                               f"{alias.name}")
+                elif m == "jax.experimental" and alias.name == "shard_map":
+                    flag(node, "from jax.experimental import shard_map")
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.startswith("jax.experimental.shard_map"):
+                    flag(node, f"import {alias.name}")
+        elif isinstance(node, ast.Attribute) and node.attr == "pmap" \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in mod.jax_aliases:
+            flag(node, "jax.pmap")
+    return out
+
+
+# ------------------------------------------------------------ drivers
+def iter_python_files(paths: Iterable[str]) -> tuple[list[str], list[str]]:
+    """(python files to lint, unusable input paths).  Explicitly-named
+    files are linted regardless of extension; directories contribute
+    their ``*.py`` trees; missing paths are returned, never dropped — a
+    typo'd CI target must not read as a clean lint."""
+    files, missing = [], []
+    for path in paths:
+        if os.path.isdir(path):
+            for root, dirs, names in os.walk(path):
+                dirs[:] = [d for d in dirs
+                           if d not in {"__pycache__", ".git"}]
+                files.extend(os.path.join(root, n) for n in sorted(names)
+                             if n.endswith(".py"))
+        elif os.path.isfile(path):
+            files.append(path)
+        else:
+            missing.append(path)
+    return files, missing
+
+
+def lint_paths(paths: Iterable[str],
+               rules: Optional[dict] = None) -> Report:
+    """Run the AST rules over files/directories.  ``rules`` defaults to
+    every registered rule."""
+    rules = rules if rules is not None else LINT_RULES
+    report = Report()
+    files, missing = iter_python_files(
+        paths if not isinstance(paths, str) else [paths])
+    report.context["files_linted"] = len(files)
+    for path in missing:
+        report.add("TPU300", "path does not exist — nothing was linted",
+                   path=path,
+                   hint="Fix the --lint path (a typo here must not read "
+                        "as a clean gate).")
+    for path in files:
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+        except SyntaxError as e:
+            report.add("TPU300", f"does not parse: {e.msg}",
+                       path=f"{path}:{e.lineno}")
+            continue
+        except (OSError, ValueError) as e:
+            report.add("TPU300", f"unreadable: {e}", path=path)
+            continue
+        mod = ModuleInfo(path, tree)
+        for rule_fn in rules.values():
+            report.diagnostics.extend(rule_fn(mod))
+    return report
+
+
+def check_metric_names(registry=None) -> Report:
+    """TPU305 — the former ``obs.check`` metric-name lint, as a rule.
+    Installs the standard catalog into the registry (idempotent) and
+    validates every registered name."""
+    from deeplearning4j_tpu.obs.registry import (
+        METRIC_NAME_RE, Counter, Histogram, get_registry,
+        install_standard_metrics)
+    r = registry if registry is not None else get_registry()
+    install_standard_metrics(r)
+    report = Report()
+    names = r.names()
+    report.context["metrics_checked"] = len(names)
+    for name in names:
+        metric = r.get(name)
+        if not METRIC_NAME_RE.match(name):
+            report.add("TPU305",
+                       f"violates tpudl_<area>_<name> "
+                       f"({METRIC_NAME_RE.pattern})", path=name)
+            continue
+        if isinstance(metric, Counter) and not name.endswith("_total"):
+            report.add("TPU305", "counters must end in _total", path=name)
+        if isinstance(metric, Histogram) and not (
+                name.endswith("_seconds") or name.endswith("_bytes")):
+            report.add("TPU305", "histograms must end in _seconds or _bytes",
+                       path=name)
+    return report
+
+
+def check_op_catalog() -> Report:
+    """TPU306 — op-spec catalog integrity (ops/spec.validate_catalog)."""
+    from deeplearning4j_tpu.ops import spec as op_spec
+    report = Report()
+    problems = op_spec.validate_catalog()
+    report.context["ops_checked"] = len(op_spec.op_specs())
+    for problem in problems:
+        report.add("TPU306", problem, path="ops.namespaces")
+    return report
+
+
+def lint_package(package_dir: Optional[str] = None) -> Report:
+    """The ``--self`` check: AST rules over the framework tree, plus the
+    registry-backed metric-name and op-catalog rules."""
+    if package_dir is None:
+        import deeplearning4j_tpu
+        package_dir = os.path.dirname(os.path.abspath(
+            deeplearning4j_tpu.__file__))
+    report = lint_paths([package_dir])
+    report.extend(check_metric_names())
+    report.extend(check_op_catalog())
+    return report

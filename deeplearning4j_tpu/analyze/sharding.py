@@ -1,0 +1,79 @@
+"""Sharding-spec consistency — resolve PartitionSpecs before GSPMD does.
+
+The parallel stack declares its layout in three places: the canonical
+mesh axes (:data:`parallel.mesh.MESH_AXES`), the data-parallel batch axes
+(:data:`parallel.data_parallel.DATA_AXES`) and the tensor-parallel
+parameter rules (:data:`parallel.tensor_parallel.BERT_TP_RULES` or a
+user-supplied list).  jax only cross-checks them at jit time, deep inside
+GSPMD, with an error that names none of them.  This module checks the
+same constraints statically:
+
+- every axis a PartitionSpec mentions exists on the mesh (TPU201),
+- no axis serves both the DP batch role and a TP rule (TPU202),
+- every rule regex compiles (TPU203).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Sequence
+
+from deeplearning4j_tpu.analyze.diagnostics import Report
+
+
+def _spec_axes(spec) -> list[str]:
+    out: list[str] = []
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            out.extend(str(a) for a in entry)
+        else:
+            out.append(str(entry))
+    return out
+
+
+def check_sharding(tp_rules: Optional[Sequence] = None,
+                   mesh_axes: Optional[Sequence[str]] = None,
+                   data_axes: Optional[Sequence[str]] = None) -> Report:
+    """Validate a TP rule set against the declared mesh + DP axes.
+
+    Defaults are the framework's own declarations, so a bare call audits
+    the shipped configuration (and must stay clean).
+    """
+    from deeplearning4j_tpu.parallel import mesh as mesh_mod
+    from deeplearning4j_tpu.parallel import data_parallel as dp_mod
+    from deeplearning4j_tpu.parallel import tensor_parallel as tp_mod
+
+    rules = list(tp_rules) if tp_rules is not None else tp_mod.BERT_TP_RULES
+    axes = tuple(mesh_axes) if mesh_axes is not None else mesh_mod.MESH_AXES
+    dp_axes = tuple(data_axes) if data_axes is not None else dp_mod.DATA_AXES
+
+    report = Report(context={"mesh_axes": list(axes),
+                             "data_axes": list(dp_axes),
+                             "tp_rules": len(rules)})
+    for axis in dp_axes:
+        if axis not in axes:
+            report.add("TPU201",
+                       f"data-parallel batch axis '{axis}' is not a mesh "
+                       f"axis (mesh declares {list(axes)})",
+                       path="data_parallel.DATA_AXES")
+    for pattern, spec in rules:
+        path = f"rule {pattern!r}"
+        try:
+            re.compile(pattern)
+        except re.error as e:
+            report.add("TPU203", f"regex does not compile: {e}", path=path)
+        for axis in _spec_axes(spec):
+            if axis not in axes:
+                report.add("TPU201",
+                           f"PartitionSpec axis '{axis}' is not a mesh "
+                           f"axis (mesh declares {list(axes)})",
+                           path=path)
+            elif axis in dp_axes:
+                report.add("TPU202",
+                           f"axis '{axis}' is the data-parallel batch axis "
+                           f"but a tensor-parallel rule shards params over "
+                           f"it",
+                           path=path)
+    return report

@@ -56,9 +56,14 @@ class CoverageLedger:
                 baseline = json.load(f)
         lost = set(baseline["covered"]) - self.covered
         if lost:
+            keys = "\n".join(f"  - {k}" for k in sorted(lost))
             raise AssertionError(
-                f"op coverage REGRESSED — previously-covered ops now untested: "
-                f"{sorted(lost)}")
+                f"op coverage REGRESSED — {len(lost)} previously-covered "
+                f"namespace.op key(s) now untested:\n{keys}\n"
+                f"If the removal is intentional, regenerate the baseline "
+                f"with:\n"
+                f"  rm {self.baseline_path} && JAX_PLATFORMS=cpu "
+                f"python -m pytest tests/test_op_coverage.py -q")
         if update_baseline or len(self.covered) > len(baseline["covered"]):
             with open(self.baseline_path, "w") as f:
                 json.dump({"covered": sorted(self.covered),

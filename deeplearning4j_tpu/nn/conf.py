@@ -25,6 +25,28 @@ _CASCADE_FIELDS = ("activation", "weight_init", "bias_init", "dropout",
                    "l1", "l2", "l1_bias", "l2_bias")
 
 
+def layer_path(index: int, layer) -> str:
+    """Stable human-readable anchor for a layer in a stack config —
+    ``layers[3] (DenseLayer 'fc1')``.  Used by shape-inference errors and
+    by ``tpudl.analyze`` diagnostics so a bad config names the layer, not
+    a bare KeyError deep in a layer impl."""
+    cls = type(layer).__name__
+    name = getattr(layer, "name", None)
+    return f"layers[{index}] ({cls} {name!r})" if name else f"layers[{index}] ({cls})"
+
+
+class ShapeInferenceError(ValueError):
+    """Shape/dtype inference failed at a specific layer; ``path`` anchors
+    the failing layer (``layers[i] (...)`` or a graph vertex name) and
+    ``cause`` keeps the underlying exception."""
+
+    def __init__(self, path: str, cause: BaseException):
+        self.path = path
+        self.cause = cause
+        super().__init__(f"shape inference failed at {path}: "
+                         f"{type(cause).__name__}: {cause}")
+
+
 @dataclasses.dataclass
 class MultiLayerConfiguration:
     """The built, serializable network spec (``MultiLayerConfiguration.java``)."""
@@ -49,18 +71,30 @@ class MultiLayerConfiguration:
             raise ValueError("input_type not set — call set_input_type(...) on the builder")
         types = []
         current = self.input_type
-        for layer in self.layers:
-            current = preprocessors.adapt_type(current, layer)
-            types.append(current)
-            current = layer.get_output_type(current)
+        for i, layer in enumerate(self.layers):
+            try:
+                current = preprocessors.adapt_type(current, layer)
+                types.append(current)
+                current = layer.get_output_type(current)
+            except ShapeInferenceError:
+                raise
+            except Exception as e:
+                raise ShapeInferenceError(layer_path(i, layer), e) from e
         return types
 
     def output_type(self) -> InputType:
         from deeplearning4j_tpu.nn import preprocessors
+        if self.input_type is None:
+            raise ValueError("input_type not set — call set_input_type(...) on the builder")
         current = self.input_type
-        for layer in self.layers:
-            current = preprocessors.adapt_type(current, layer)
-            current = layer.get_output_type(current)
+        for i, layer in enumerate(self.layers):
+            try:
+                current = preprocessors.adapt_type(current, layer)
+                current = layer.get_output_type(current)
+            except ShapeInferenceError:
+                raise
+            except Exception as e:
+                raise ShapeInferenceError(layer_path(i, layer), e) from e
         return current
 
     # ---- serde ------------------------------------------------------

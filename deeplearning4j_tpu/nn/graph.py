@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.nn.conf import ShapeInferenceError
 from deeplearning4j_tpu.nn.input_type import InputType
 from deeplearning4j_tpu.nn.layers.base import Layer, layer_from_dict
 from deeplearning4j_tpu.nn.vertices import GraphVertex, vertex_from_dict
@@ -92,25 +93,39 @@ class ComputationGraphConfiguration:
         known: dict[str, InputType] = dict(zip(self.inputs, self.input_types))
         result: dict[str, list[InputType]] = {}
         for spec in self.topo_order():
-            in_types = [known[i] for i in spec.inputs]
-            if spec.kind == "layer":
-                adapted = [preprocessors.adapt_type(in_types[0], spec.obj)]
-                result[spec.name] = adapted
-                known[spec.name] = spec.obj.get_output_type(adapted[0])
-            else:
-                result[spec.name] = in_types
-                known[spec.name] = spec.obj.get_output_type(in_types)
+            try:
+                in_types = [known[i] for i in spec.inputs]
+                if spec.kind == "layer":
+                    adapted = [preprocessors.adapt_type(in_types[0], spec.obj)]
+                    result[spec.name] = adapted
+                    known[spec.name] = spec.obj.get_output_type(adapted[0])
+                else:
+                    result[spec.name] = in_types
+                    known[spec.name] = spec.obj.get_output_type(in_types)
+            except ShapeInferenceError:
+                raise
+            except Exception as e:
+                raise ShapeInferenceError(
+                    f"vertex '{spec.name}' ({type(spec.obj).__name__})", e) from e
         return result
 
     def output_types(self) -> dict[str, InputType]:
+        if len(self.input_types) != len(self.inputs):
+            raise ValueError("set_input_types must provide one InputType per graph input")
         known = dict(zip(self.inputs, self.input_types))
         for spec in self.topo_order():
-            in_types = [known[i] for i in spec.inputs]
-            if spec.kind == "layer":
-                known[spec.name] = spec.obj.get_output_type(
-                    preprocessors.adapt_type(in_types[0], spec.obj))
-            else:
-                known[spec.name] = spec.obj.get_output_type(in_types)
+            try:
+                in_types = [known[i] for i in spec.inputs]
+                if spec.kind == "layer":
+                    known[spec.name] = spec.obj.get_output_type(
+                        preprocessors.adapt_type(in_types[0], spec.obj))
+                else:
+                    known[spec.name] = spec.obj.get_output_type(in_types)
+            except ShapeInferenceError:
+                raise
+            except Exception as e:
+                raise ShapeInferenceError(
+                    f"vertex '{spec.name}' ({type(spec.obj).__name__})", e) from e
         return {name: known[name] for name in self.outputs}
 
     # ---------------------------------------------------------- serde

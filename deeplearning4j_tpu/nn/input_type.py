@@ -22,27 +22,37 @@ class InputType:
     width: int = 0
     channels: int = 0
     depth: int = 0                     # cnn3d
+    # activation dtype carried by this input; None = the network default
+    # (conf.dtype / DTypePolicy).  Consumed by tpudl.analyze for
+    # static dtype-drift detection at graph joins.
+    dtype: Optional[str] = None
 
     @staticmethod
-    def feed_forward(size: int) -> "InputType":
-        return InputType(kind="ff", size=size)
+    def feed_forward(size: int, dtype: Optional[str] = None) -> "InputType":
+        return InputType(kind="ff", size=size, dtype=dtype)
 
     @staticmethod
-    def recurrent(size: int, timesteps: Optional[int] = None) -> "InputType":
-        return InputType(kind="rnn", size=size, timesteps=timesteps)
+    def recurrent(size: int, timesteps: Optional[int] = None,
+                  dtype: Optional[str] = None) -> "InputType":
+        return InputType(kind="rnn", size=size, timesteps=timesteps, dtype=dtype)
 
     @staticmethod
-    def convolutional(height: int, width: int, channels: int) -> "InputType":
-        return InputType(kind="cnn", height=height, width=width, channels=channels)
+    def convolutional(height: int, width: int, channels: int,
+                      dtype: Optional[str] = None) -> "InputType":
+        return InputType(kind="cnn", height=height, width=width, channels=channels,
+                         dtype=dtype)
 
     @staticmethod
-    def convolutional_flat(height: int, width: int, channels: int) -> "InputType":
+    def convolutional_flat(height: int, width: int, channels: int,
+                           dtype: Optional[str] = None) -> "InputType":
         return InputType(kind="cnn_flat", height=height, width=width, channels=channels,
-                         size=height * width * channels)
+                         size=height * width * channels, dtype=dtype)
 
     @staticmethod
-    def convolutional3d(depth: int, height: int, width: int, channels: int) -> "InputType":
-        return InputType(kind="cnn3d", depth=depth, height=height, width=width, channels=channels)
+    def convolutional3d(depth: int, height: int, width: int, channels: int,
+                        dtype: Optional[str] = None) -> "InputType":
+        return InputType(kind="cnn3d", depth=depth, height=height, width=width,
+                         channels=channels, dtype=dtype)
 
     def flat_size(self) -> int:
         if self.kind in ("ff", "rnn", "cnn_flat"):

@@ -1,48 +1,41 @@
 """Metric-name lint — ``python -m deeplearning4j_tpu.obs.check``.
 
+.. deprecated::
+    This module is now a thin shim over the ``tpudl.analyze`` rule
+    registry — the check lives in
+    :func:`deeplearning4j_tpu.analyze.lint.check_metric_names` as rule
+    ``TPU305`` and runs as part of
+    ``python -m deeplearning4j_tpu.analyze --self``.  This entry point
+    stays so existing CI invocations keep working; prefer the analyze
+    CLI for new wiring.
+
 Verifies that every metric registered in the process-wide registry
 (after installing the framework's standard catalog) matches the
 documented ``tpudl_<area>_<name>`` convention, and that counters/
 histograms follow the suffix rules (``_total`` for counters,
-``_seconds``/``_bytes`` for duration/size histograms).  CI runs this so
-a PR can't quietly ship a metric the dashboards won't find.
+``_seconds``/``_bytes`` for duration/size histograms).
 """
 
 from __future__ import annotations
 
 import sys
 
-from deeplearning4j_tpu.obs.registry import (
-    METRIC_NAME_RE, Counter, Histogram, get_registry,
-    install_standard_metrics)
+from deeplearning4j_tpu.obs.registry import get_registry
 
 
 def lint(registry=None) -> list[str]:
-    """Returns a list of human-readable violations (empty = clean)."""
-    r = registry or get_registry()
-    install_standard_metrics(r)
-    problems = []
-    for name in r.names():
-        metric = r.get(name)
-        if not METRIC_NAME_RE.match(name):
-            problems.append(
-                f"{name}: violates tpudl_<area>_<name> "
-                f"({METRIC_NAME_RE.pattern})")
-            continue
-        if isinstance(metric, Counter) and not name.endswith("_total"):
-            problems.append(f"{name}: counters must end in _total")
-        if isinstance(metric, Histogram) and not (
-                name.endswith("_seconds") or name.endswith("_bytes")):
-            problems.append(
-                f"{name}: histograms must end in _seconds or _bytes")
-    return problems
+    """Returns a list of human-readable violations (empty = clean).
+    Delegates to the TPU305 rule in ``tpudl.analyze``."""
+    from deeplearning4j_tpu.analyze.lint import check_metric_names
+    report = check_metric_names(registry)
+    return [f"{d.path}: {d.message}" for d in report.sorted()]
 
 
 def main(argv=None) -> int:
     problems = lint()
     names = get_registry().names()
     if problems:
-        print(f"obs.check: {len(problems)} metric-name violation(s):")
+        print(f"obs.check: {len(problems)} metric-name violation(s) [TPU305]:")
         for p in problems:
             print(f"  - {p}")
         return 1

@@ -33,6 +33,11 @@ from deeplearning4j_tpu.obs.registry import get_registry
 from deeplearning4j_tpu.parallel import mesh as mesh_mod
 from deeplearning4j_tpu.train.trainer import Trainer
 
+# Mesh axes the data-parallel path shards batches (and psums gradients)
+# over — the analyzer cross-checks these against tensor-parallel rule
+# axes (one axis must not serve both roles).
+DATA_AXES = ("data",)
+
 
 class ParallelWrapper(Trainer):
     """Drop-in DP trainer: same ``fit(iterator, epochs)`` surface as

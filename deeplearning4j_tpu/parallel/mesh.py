@@ -28,6 +28,12 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+# The canonical axis set every mesh built here declares, in layout order
+# (outermost → innermost).  ``tpudl.analyze`` resolves PartitionSpecs
+# against this tuple; parallelism modules name their axes from it.
+MESH_AXES = ("stage", "data", "seq", "expert", "model")
+
+
 @dataclasses.dataclass
 class MeshSpec:
     data: int = 1
@@ -60,7 +66,7 @@ def make_mesh(data: Optional[int] = None, model: int = 1, seq: int = 1,
     if spec.total() != n:
         raise ValueError(f"mesh {spec} needs {spec.total()} devices, have {n}")
     arr = np.asarray(devices).reshape(stage, data, seq, expert, model)
-    return Mesh(arr, axis_names=("stage", "data", "seq", "expert", "model"))
+    return Mesh(arr, axis_names=MESH_AXES)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

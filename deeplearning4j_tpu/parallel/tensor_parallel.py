@@ -33,6 +33,22 @@ BERT_TP_RULES: list[tuple[str, P]] = [
 ]
 
 
+def rule_axes(rules: Optional[list[tuple[str, P]]] = None) -> set[str]:
+    """Every mesh-axis name a TP rule set mentions (the analyzer resolves
+    these against ``mesh.MESH_AXES`` and against the DP batch axes)."""
+    rules = rules if rules is not None else BERT_TP_RULES
+    axes: set[str] = set()
+    for _, spec in rules:
+        for entry in spec:
+            if entry is None:
+                continue
+            if isinstance(entry, (tuple, list)):
+                axes.update(str(a) for a in entry)
+            else:
+                axes.add(str(entry))
+    return axes
+
+
 def _path_str(path) -> str:
     parts = []
     for entry in path:

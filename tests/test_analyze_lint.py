@@ -1,0 +1,224 @@
+"""tpudl.analyze — AST linter + registry-backed rules.
+
+Acceptance (ISSUE 2): seeded defects per lint family — host-sync-in-jit
+(TPU301), missing block_until_ready (TPU302), traced control flow
+(TPU303), bare shard_map import (TPU304), bad metric name (TPU305) —
+each reported with its rule ID and a non-zero exit; clean code exits 0.
+"""
+
+import textwrap
+
+from deeplearning4j_tpu.analyze import check_metric_names, check_op_catalog, lint_paths
+from deeplearning4j_tpu.analyze.__main__ import main as analyze_main
+from deeplearning4j_tpu.analyze.lint import LINT_RULES, register_lint_rule
+from deeplearning4j_tpu.obs.registry import MetricsRegistry
+
+
+def _lint_source(tmp_path, source, name="mod.py"):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(source))
+    return lint_paths([str(path)])
+
+
+# ------------------------------------------------------------ TPU301
+def test_host_sync_in_jit(tmp_path):
+    report = _lint_source(tmp_path, """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            v = float(x.sum())
+            a = np.asarray(x)
+            b = x.mean().item()
+            return x * v
+        """)
+    hits = report.by_rule("TPU301")
+    assert len(hits) == 3
+    assert report.exit_code() == 1
+
+
+def test_static_shape_reads_in_jit_are_fine(tmp_path):
+    report = _lint_source(tmp_path, """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def step(x, n):
+            scale = float(n)            # static arg — host value already
+            k = int(x.shape[0])         # trace-time constant
+            return x * scale / k
+        """)
+    assert report.by_rule("TPU301") == []
+    assert report.exit_code() == 0
+
+
+# ------------------------------------------------------------ TPU302
+def test_timing_without_block_until_ready(tmp_path):
+    report = _lint_source(tmp_path, """
+        import time
+        import jax
+
+        step = jax.jit(lambda x: x * 2)
+
+        def bench(x):
+            t0 = time.perf_counter()
+            for _ in range(8):
+                out = step(x)
+            return time.perf_counter() - t0
+        """)
+    hits = report.by_rule("TPU302")
+    assert len(hits) == 1 and "bench" in hits[0].message
+    assert report.exit_code() == 1
+
+
+def test_timing_with_sync_fence_is_fine(tmp_path):
+    report = _lint_source(tmp_path, """
+        import time
+        import jax
+
+        step = jax.jit(lambda x: x * 2)
+
+        def bench(x):
+            t0 = time.perf_counter()
+            out = step(x)
+            jax.block_until_ready(out)
+            return time.perf_counter() - t0
+
+        def host_only_timing():
+            t0 = time.perf_counter()
+            total = sum(range(1000))
+            return time.perf_counter() - t0
+        """)
+    assert report.by_rule("TPU302") == []
+    assert report.exit_code() == 0
+
+
+# ------------------------------------------------------------ TPU303
+def test_traced_python_control_flow(tmp_path):
+    report = _lint_source(tmp_path, """
+        import jax
+
+        @jax.jit
+        def step(x, threshold):
+            if threshold > 0.5:
+                x = x + 1
+            return x
+        """)
+    hits = report.by_rule("TPU303")
+    assert len(hits) == 1 and "threshold" in hits[0].message
+    assert report.exit_code() == 1
+
+
+def test_identity_checks_and_static_args_are_fine(tmp_path):
+    report = _lint_source(tmp_path, """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnames=("causal",))
+        def step(x, mask=None, causal=False):
+            if mask is not None:
+                x = x * mask
+            if causal:
+                x = x + 1
+            return x
+        """)
+    assert report.by_rule("TPU303") == []
+    assert report.exit_code() == 0
+
+
+# ------------------------------------------------------------ TPU304
+def test_bare_shard_map_import(tmp_path):
+    report = _lint_source(tmp_path, """
+        from jax.experimental.shard_map import shard_map
+
+        def run(mesh, f):
+            return shard_map(f, mesh=mesh, in_specs=None, out_specs=None)
+        """)
+    assert len(report.by_rule("TPU304")) == 1
+    assert report.exit_code() == 1
+
+
+def test_jax_compat_import_is_fine(tmp_path):
+    report = _lint_source(tmp_path, """
+        from deeplearning4j_tpu.utils.jax_compat import shard_map, pcast
+        """)
+    assert report.by_rule("TPU304") == []
+
+
+# ------------------------------------------------------------ TPU305/306
+def test_bad_metric_name_reported():
+    registry = MetricsRegistry(validate_names=False)
+    registry.counter("bad_metric")
+    report = check_metric_names(registry)
+    hits = report.by_rule("TPU305")
+    assert hits and hits[0].path == "bad_metric"
+    assert report.exit_code() == 1
+
+
+def test_metric_suffix_rules():
+    registry = MetricsRegistry(validate_names=False)
+    registry.counter("tpudl_test_widgets")       # counter without _total
+    registry.histogram("tpudl_test_latency")     # histogram without suffix
+    report = check_metric_names(registry)
+    messages = " ".join(d.message for d in report.by_rule("TPU305"))
+    assert "_total" in messages and "_seconds" in messages
+
+
+def test_obs_check_shim_still_works():
+    from deeplearning4j_tpu.obs.check import lint
+    registry = MetricsRegistry(validate_names=False)
+    registry.counter("tpudl_test_rogue")
+    problems = lint(registry)
+    assert any("_total" in p for p in problems)
+
+
+def test_op_catalog_is_consistent():
+    assert check_op_catalog().exit_code() == 0
+
+
+# ------------------------------------------------------------ harness
+def test_syntax_error_reported_as_tpu300(tmp_path):
+    report = _lint_source(tmp_path, "def broken(:\n")
+    assert report.by_rule("TPU300")
+    assert report.exit_code() == 1
+
+
+def test_missing_lint_path_is_not_a_clean_pass(tmp_path):
+    """A typo'd --lint target must not read as a green gate."""
+    report = lint_paths([str(tmp_path / "no_such_dir_or_file.py")])
+    missing = report.by_rule("TPU300")
+    assert len(missing) == 1 and "does not exist" in missing[0].message
+    assert report.exit_code() == 1
+    assert analyze_main(["--lint", str(tmp_path / "nope")]) == 1
+
+
+def test_combined_modes_accumulate_context(tmp_path):
+    from deeplearning4j_tpu.analyze.diagnostics import Report
+    a = Report(context={"files_linted": 100, "label": "x"})
+    b = Report(context={"files_linted": 1, "label": "y"})
+    a.extend(b)
+    assert a.context["files_linted"] == 101
+    assert a.context["label"] == "y"
+
+
+def test_cli_lint_seeded_and_clean(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("from jax import pmap\n")
+    assert analyze_main(["--lint", str(bad)]) == 1
+    assert "TPU304" in capsys.readouterr().out
+    good = tmp_path / "good.py"
+    good.write_text("import jax.numpy as jnp\n")
+    assert analyze_main(["--lint", str(good)]) == 0
+
+
+def test_rule_registry_is_pluggable(tmp_path):
+    @register_lint_rule("TPU999")
+    def _no_todo(mod):
+        from deeplearning4j_tpu.analyze.diagnostics import Diagnostic
+        return [Diagnostic("TPU999", "custom rule fired", path=mod.path)]
+    try:
+        report = _lint_source(tmp_path, "x = 1\n")
+        assert report.by_rule("TPU999")
+    finally:
+        LINT_RULES.pop("TPU999", None)

@@ -1,0 +1,225 @@
+"""tpudl.analyze — model/graph static validation.
+
+Acceptance (ISSUE 2): one seeded defect per graph-family rule — dead
+vertex (TPU101), dtype clash at a join (TPU102), preprocessor gap
+(TPU103), missing input_type (TPU106), dangling edge (TPU107),
+HBM-budget breach (TPU105), unresolvable PartitionSpec (TPU201), DP/TP
+axis conflict (TPU202) — each reported with its rule ID and a non-zero
+exit, while a clean zoo model exits 0.  Negative-path shape inference
+carries the layer path in the message, not a bare KeyError.
+"""
+
+import json
+
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deeplearning4j_tpu.analyze import analyze_model, check_sharding, load_model_conf
+from deeplearning4j_tpu.analyze.__main__ import main as analyze_main
+from deeplearning4j_tpu.analyze.model_checks import parse_byte_size, zoo_factories
+from deeplearning4j_tpu.models import mlp_mnist
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, ShapeInferenceError
+from deeplearning4j_tpu.nn.graph import ComputationGraphConfiguration, VertexSpec
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.layers import ConvolutionLayer, DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.vertices import ElementWiseVertex
+
+
+def _graph_builder():
+    return NeuralNetConfiguration.builder().seed(0).graph()
+
+
+# ------------------------------------------------------------- clean paths
+def test_clean_zoo_model_exits_zero():
+    report = analyze_model(mlp_mnist())
+    assert report.errors() == []
+    assert report.exit_code() == 0
+    assert report.context["param_count"] == 443610
+
+
+def test_cli_zoo_model_and_json_roundtrip(tmp_path, capsys):
+    assert analyze_main(["--model", "mlp_mnist"]) == 0
+    capsys.readouterr()  # drop the text-format output of the first run
+    path = tmp_path / "conf.json"
+    path.write_text(mlp_mnist().conf.to_json())
+    assert analyze_main(["--model", str(path), "--format", "json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["exit_code"] == 0
+    assert out["context"]["model_kind"] == "MultiLayerConfiguration"
+
+
+def test_cli_unknown_model_is_usage_error(capsys):
+    assert analyze_main(["--model", "definitely_not_a_model"]) == 2
+    assert "zoo model" in capsys.readouterr().err
+
+
+def test_cli_bad_hbm_budget_is_usage_error(capsys):
+    assert analyze_main(["--model", "mlp_mnist",
+                         "--hbm-budget", "sixteen"]) == 2
+    assert "unparseable" in capsys.readouterr().err
+
+
+def test_zoo_factories_cover_resnet50():
+    assert "resnet50" in zoo_factories()
+
+
+# --------------------------------------------------------- seeded defects
+def test_dead_vertex_reported_with_name():
+    gb = (_graph_builder()
+          .add_inputs("in")
+          .set_input_types(InputType.feed_forward(16)))
+    gb.add_layer("h", DenseLayer(n_out=8, activation="relu"), "in")
+    gb.add_layer("orphan", DenseLayer(n_out=4, activation="relu"), "in")
+    gb.add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                    loss="mcxent"), "h")
+    gb.set_outputs("out")
+    report = analyze_model(gb.build())
+    dead = report.by_rule("TPU101")
+    assert len(dead) == 1 and "orphan" in dead[0].message
+    assert report.exit_code() == 1
+
+
+def test_dtype_clash_at_vertex_join():
+    gb = (_graph_builder()
+          .add_inputs("a", "b")
+          .set_input_types(InputType.feed_forward(8, dtype="float32"),
+                           InputType.feed_forward(8, dtype="bfloat16")))
+    gb.add_vertex("join", ElementWiseVertex(op="add"), "a", "b")
+    gb.add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                    loss="mcxent"), "join")
+    gb.set_outputs("out")
+    report = analyze_model(gb.build())
+    clash = report.by_rule("TPU102")
+    assert len(clash) == 1
+    assert "join" in clash[0].path and "bfloat16" in clash[0].message
+    assert report.exit_code() == 1
+
+
+def test_network_vs_input_dtype_drift():
+    conf = (NeuralNetConfiguration.builder().dtype("float32").list()
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4, dtype="bfloat16"))
+            .build())
+    report = analyze_model(conf)
+    assert report.by_rule("TPU102")
+    assert report.exit_code() == 1
+
+
+def test_missing_input_type():
+    conf = (NeuralNetConfiguration.builder().list()
+            .layer(DenseLayer(n_out=4))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    report = analyze_model(conf)
+    missing = report.by_rule("TPU106")
+    assert len(missing) == 1 and "set_input_type" in missing[0].message
+    assert report.exit_code() == 1
+
+
+def test_preprocessor_gap_carries_layer_path():
+    conf = (NeuralNetConfiguration.builder().list()
+            .layer(ConvolutionLayer(n_out=8, kernel_size=(3, 3)))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(64))
+            .build())
+    report = analyze_model(conf)
+    gap = report.by_rule("TPU103")
+    assert len(gap) == 1
+    assert "layers[0]" in gap[0].path and "ConvolutionLayer" in gap[0].path
+    assert report.exit_code() == 1
+
+
+def test_dangling_edge_reported_not_crash():
+    conf = ComputationGraphConfiguration(
+        inputs=["in"], outputs=["out"],
+        vertices=[VertexSpec("out", "layer",
+                             OutputLayer(n_out=2, activation="softmax",
+                                         loss="mcxent"),
+                             ["nonexistent"])],
+        input_types=[InputType.feed_forward(4)])
+    report = analyze_model(conf)
+    dangling = report.by_rule("TPU107")
+    assert len(dangling) == 1 and "nonexistent" in dangling[0].message
+    assert report.exit_code() == 1
+
+
+def test_hbm_budget_breach():
+    report = analyze_model(mlp_mnist(), hbm_budget=parse_byte_size("1MiB"))
+    over = report.by_rule("TPU105")
+    assert len(over) == 1 and "exceeds" in over[0].message
+    assert report.exit_code() == 1
+    # a budget the model fits passes
+    assert analyze_model(mlp_mnist(),
+                         hbm_budget=parse_byte_size("16GiB")).exit_code() == 0
+
+
+# ------------------------------------------------------- sharding family
+def test_shipped_sharding_config_is_clean():
+    assert check_sharding().exit_code() == 0
+
+
+def test_unresolvable_partition_axis():
+    report = check_sharding(
+        tp_rules=[(r"kernel$", P(None, "tensor"))])
+    bad = report.by_rule("TPU201")
+    assert len(bad) == 1 and "'tensor'" in bad[0].message
+    assert report.exit_code() == 1
+
+
+def test_dp_tp_axis_conflict():
+    report = check_sharding(tp_rules=[(r"kernel$", P(None, "data"))])
+    conflict = report.by_rule("TPU202")
+    assert len(conflict) == 1 and "'data'" in conflict[0].message
+    assert report.exit_code() == 1
+
+
+def test_bad_rule_regex():
+    report = check_sharding(tp_rules=[(r"(unclosed", P(None, "model"))])
+    assert report.by_rule("TPU203")
+    assert report.exit_code() == 1
+
+
+# ------------------------------------------- negative-path shape inference
+def test_shape_inference_error_names_layer_not_keyerror():
+    conf = (NeuralNetConfiguration.builder().list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(ConvolutionLayer(n_out=8, kernel_size=(3, 3)))
+            .set_input_type(InputType.feed_forward(32))
+            .build())
+    with pytest.raises(ShapeInferenceError) as excinfo:
+        conf.input_types()
+    msg = str(excinfo.value)
+    assert "layers[1]" in msg and "ConvolutionLayer" in msg
+    assert not isinstance(excinfo.value, KeyError)
+
+
+def test_graph_output_types_anchored_and_guarded():
+    gb = (_graph_builder()
+          .add_inputs("a", "b")
+          .set_input_types(InputType.feed_forward(4)))  # one type short
+    gb.add_vertex("join", ElementWiseVertex(op="add"), "a", "b")
+    gb.add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                    loss="mcxent"), "join")
+    gb.set_outputs("out")
+    conf = gb.build()
+    with pytest.raises(ValueError, match="one InputType per graph input"):
+        conf.output_types()
+    # an inference failure inside the walk carries the vertex anchor
+    gb2 = (_graph_builder()
+           .add_inputs("in")
+           .set_input_types(InputType.feed_forward(32)))
+    gb2.add_layer("conv", ConvolutionLayer(n_out=8, kernel_size=(3, 3)), "in")
+    gb2.add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                     loss="mcxent"), "conv")
+    gb2.set_outputs("out")
+    with pytest.raises(ShapeInferenceError, match="vertex 'conv'"):
+        gb2.build().output_types()
+
+
+def test_parse_byte_size():
+    assert parse_byte_size("16GiB") == 16 * 2**30
+    assert parse_byte_size("512MiB") == 512 * 2**20
+    assert parse_byte_size("2KB") == 2048
+    assert parse_byte_size("1048576") == 1048576
+    with pytest.raises(ValueError):
+        parse_byte_size("sixteen gigs")

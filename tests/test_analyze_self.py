@@ -1,0 +1,51 @@
+"""Tier-1 gate: the AST linter over ``deeplearning4j_tpu/`` itself.
+
+Runs the whole TPU-antipattern rule set over the framework tree
+in-process and asserts zero errors — a PR introducing a host sync inside
+a jit step, an unfenced timing loop, or an off-convention metric name
+fails the suite, not a later TPU run.
+"""
+
+import os
+
+from deeplearning4j_tpu.analyze import lint_package, lint_paths
+from deeplearning4j_tpu.analyze.__main__ import main as analyze_main
+from deeplearning4j_tpu.analyze.diagnostics import RULES, rule_catalog_markdown
+
+import deeplearning4j_tpu
+
+PACKAGE_DIR = os.path.dirname(os.path.abspath(deeplearning4j_tpu.__file__))
+REPO_ROOT = os.path.dirname(PACKAGE_DIR)
+
+
+def test_framework_tree_is_lint_clean():
+    report = lint_package()
+    errors = report.errors()
+    assert errors == [], "TPU antipatterns in the tree:\n" + "\n".join(
+        d.render() for d in errors)
+    assert report.context["files_linted"] > 100
+    assert report.context["metrics_checked"] > 0
+    assert report.context["ops_checked"] > 300
+
+
+def test_self_cli_exits_zero():
+    assert analyze_main(["--self"]) == 0
+
+
+def test_bench_harness_is_lint_clean():
+    """bench.py is where an unfenced timing loop would hurt most."""
+    report = lint_paths([os.path.join(REPO_ROOT, "bench.py")])
+    assert report.errors() == [], "\n".join(
+        d.render() for d in report.errors())
+
+
+def test_rule_catalog_documented():
+    """Every rule ID in the registry appears in docs/static_analysis.md
+    (the doc embeds the generated catalog table)."""
+    doc_path = os.path.join(REPO_ROOT, "docs", "static_analysis.md")
+    with open(doc_path) as f:
+        doc = f.read()
+    for rule_id in RULES:
+        assert rule_id in doc, f"{rule_id} missing from docs/static_analysis.md"
+    # the generated table is embedded verbatim, so docs can't drift
+    assert rule_catalog_markdown() in doc
