@@ -157,6 +157,16 @@ _RULE_LIST = [
         "The catalog is the single source of truth for coverage ledgers "
         "and generated docs; drift breaks both silently.",
         "Re-align ops/namespaces.py with ops/spec.py (see docs/OPS.md)."),
+    RuleInfo(
+        "TPU307", "per-batch-host-transfer", ERROR,
+        "jnp.asarray/jax.device_put host→device transfer inside a "
+        "per-batch training loop, bypassing the device feeder",
+        "A synchronous transfer in the step loop serializes host ETL "
+        "against device execution (input starvation) — the stall the "
+        "DeviceFeeder's background stage exists to hide.",
+        "Stage batches through data.device_pipeline.DeviceFeeder (or "
+        "the trainer's _place_batch hook) instead of transferring "
+        "inline; see docs/data_pipeline.md."),
 ]
 
 RULES: dict[str, RuleInfo] = {r.id: r for r in _RULE_LIST}

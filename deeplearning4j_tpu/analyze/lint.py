@@ -40,10 +40,12 @@ class ModuleInfo:
         self.path = path
         self.tree = tree
         self.jax_aliases: set[str] = set()
+        self.jnp_aliases: set[str] = set()      # import jax.numpy as jnp
         self.np_aliases: set[str] = set()
         self.time_aliases: set[str] = set()
         self.partial_names: set[str] = set()
         self.jit_names: set[str] = set()        # jax.jit imported by name
+        self.device_put_names: set[str] = set() # from jax import device_put
         self.time_fn_names: set[str] = set()    # from time import perf_counter
         # FunctionDef → frozenset of static (non-traced) parameter names
         self.jit_functions: dict[ast.AST, frozenset] = {}
@@ -115,6 +117,8 @@ class ModuleInfo:
                         # binds the alias — either way it names the module
                         self.jax_aliases.add(bound if alias.name == "jax"
                                              else root)
+                    if alias.name == "jax.numpy" and alias.asname:
+                        self.jnp_aliases.add(alias.asname)
                     elif alias.name == "numpy":
                         self.np_aliases.add(bound)
                     elif alias.name == "time":
@@ -125,6 +129,10 @@ class ModuleInfo:
                     bound = alias.asname or alias.name
                     if mod == "jax" and alias.name == "jit":
                         self.jit_names.add(bound)
+                    elif mod == "jax" and alias.name == "device_put":
+                        self.device_put_names.add(bound)
+                    elif mod == "jax" and alias.name == "numpy":
+                        self.jnp_aliases.add(bound)
                     elif mod == "functools" and alias.name == "partial":
                         self.partial_names.add(bound)
                     elif mod == "time" and alias.name in _TIME_FENCES:
@@ -396,6 +404,77 @@ def _rule_bare_parallel_import(mod: ModuleInfo) -> list[Diagnostic]:
                 and isinstance(node.value, ast.Name) \
                 and node.value.id in mod.jax_aliases:
             flag(node, "jax.pmap")
+    return out
+
+
+# explicit per-batch step-driver names that carry no "step" token
+_STEP_CALL_NAMES = {"fit_batch", "train_batch", "train_on_batch"}
+
+
+def _transfer_call(mod: ModuleInfo, node: ast.Call) -> Optional[str]:
+    """'jnp.asarray' / 'jax.device_put' / bare imported device_put —
+    a host→device transfer expression; None otherwise."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        if f.attr in {"asarray", "array"} and f.value.id in mod.jnp_aliases:
+            return f"{f.value.id}.{f.attr}"
+        if f.attr == "device_put" and f.value.id in mod.jax_aliases:
+            return f"{f.value.id}.device_put"
+    if isinstance(f, ast.Name) and f.id in mod.device_put_names:
+        return f.id
+    return None
+
+
+def _step_call(mod: ModuleInfo, node: ast.Call) -> bool:
+    """A call that dispatches device work per batch: jit-compiled, or
+    named like a train-step driver — a whole ``step`` name token
+    (``step``, ``_step``, ``train_step``, ``step_batch``) or an explicit
+    per-batch driver name (``fit_batch``).  Token matching, not
+    substrings: ``normalizer.fit``, ``train_test_split`` or
+    ``fit_transform`` in a host-side loop must not flag."""
+    if mod.is_jitted_call(node):
+        return True
+    f = node.func
+    name = f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else "")
+    name = name.lower()
+    return "step" in name.split("_") or name in _STEP_CALL_NAMES
+
+
+@register_lint_rule("TPU307")
+def _rule_per_batch_host_transfer(mod: ModuleInfo) -> list[Diagnostic]:
+    """Per-batch host→device transfer inside a training loop: a loop
+    body that both transfers (jnp.asarray / jax.device_put) and calls a
+    step fn serializes ETL against device execution — route batches
+    through the DeviceFeeder's background stage instead."""
+    norm = mod.path.replace(os.sep, "/")
+    if norm.endswith("data/device_pipeline.py"):
+        return []   # the feeder's staging thread is WHERE transfers belong
+    out = []
+    seen: set[int] = set()   # nested loops must not double-report a call
+    for loop in ast.walk(mod.tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        transfers, has_step = [], False
+        for node in _walk_shallow(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            what = _transfer_call(mod, node)
+            if what is not None:
+                transfers.append((node, what))
+            elif _step_call(mod, node):
+                has_step = True
+        if has_step:
+            for node, what in transfers:
+                if id(node) in seen:
+                    continue
+                seen.add(id(node))
+                out.append(Diagnostic(
+                    "TPU307",
+                    f"{what}() host→device transfer inside a per-batch "
+                    f"training loop (line {loop.lineno}) bypasses the "
+                    f"device feeder — ETL serializes against the step",
+                    path=mod.anchor(node)))
     return out
 
 

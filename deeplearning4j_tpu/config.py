@@ -56,7 +56,16 @@ class Config:
       and raise on the first non-finite value.
     - ``default_seed``: global RNG seed used when nets don't specify one.
     - ``metrics_dir``: where jsonl metric streams are written.
-    - ``prefetch_size``: AsyncDataSetIterator-parity prefetch queue depth.
+    - ``prefetch_size``: prefetch queue depth (AsyncDataSetIterator and
+      the DeviceFeeder background stage).
+    - ``device_feed``: overlap host ETL + host→device transfer with the
+      device step via ``data.device_pipeline.DeviceFeeder`` in
+      ``Trainer.fit`` (double buffering ahead of the donating step).
+    - ``shape_bucketing``: pad ragged tail batches up to a static bucket
+      shape with mask-extension (zero loss / zero gradient padding) so
+      an epoch compiles the train step once — see docs/data_pipeline.md.
+    - ``compile_cache_dir``: when set, enables jax's persistent
+      compilation cache there (XLA programs survive process restarts).
     - ``tracing``: enable span-based tracing (``obs.tracing``); spans add
       a device sync per step, so it's off by default.
     - ``trace_dir``: where span jsonl / Chrome-trace / ``jax.profiler``
@@ -72,6 +81,9 @@ class Config:
     default_seed: int = 0
     metrics_dir: str = "runs"
     prefetch_size: int = 2
+    device_feed: bool = True
+    shape_bucketing: bool = True
+    compile_cache_dir: str = ""
     profiling: bool = False
     tracing: bool = False
     trace_dir: str = "traces"
@@ -95,6 +107,26 @@ class Config:
 _lock = threading.Lock()
 _config: Config | None = None
 _policy = DTypePolicy()
+_compile_cache_applied: str | None = None
+
+
+def _apply_compile_cache(path: str) -> None:
+    """Point jax's persistent compilation cache at ``path`` (idempotent;
+    XLA executables then survive process restarts — a pod-scale re-fit
+    skips straight to execution).  An empty path reverts a previously
+    applied dir (back to the in-memory-only cache).  Failures are
+    non-fatal: an old jax without the flag just keeps the in-memory
+    cache."""
+    global _compile_cache_applied
+    target = path or None
+    if target == _compile_cache_applied:
+        return
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", target)
+        _compile_cache_applied = target
+    except Exception:
+        pass
 
 
 def get_config() -> Config:
@@ -102,6 +134,7 @@ def get_config() -> Config:
     with _lock:
         if _config is None:
             _config = Config.from_env()
+            _apply_compile_cache(_config.compile_cache_dir)
         return _config
 
 
@@ -111,6 +144,8 @@ def set_config(**kwargs: Any) -> Config:
         if not hasattr(cfg, k):
             raise AttributeError(f"unknown config key: {k}")
         setattr(cfg, k, v)
+    if "compile_cache_dir" in kwargs:
+        _apply_compile_cache(cfg.compile_cache_dir)
     return cfg
 
 

@@ -1,4 +1,10 @@
 from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.data.device_pipeline import (
+    DeviceFeeder,
+    FedBatch,
+    pad_to_bucket,
+    pad_segment,
+)
 from deeplearning4j_tpu.data.iterators import (
     DataSetIterator,
     ListDataSetIterator,
@@ -18,6 +24,7 @@ from deeplearning4j_tpu.data.transform import (
 
 __all__ = [
     "DataSet", "MultiDataSet",
+    "DeviceFeeder", "FedBatch", "pad_to_bucket", "pad_segment",
     "DataSetIterator", "ListDataSetIterator", "ArrayDataSetIterator",
     "AsyncDataSetIterator", "EarlyTerminationIterator",
     "NormalizerStandardize", "NormalizerMinMaxScaler", "ImagePreProcessingScaler",

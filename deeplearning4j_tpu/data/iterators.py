@@ -13,8 +13,6 @@ thread — SURVEY.md stack 3.1).
 
 from __future__ import annotations
 
-import queue
-import threading
 from typing import Callable, Iterable, Iterator, Optional
 
 import numpy as np
@@ -146,9 +144,17 @@ class GeneratorDataSetIterator(DataSetIterator):
 
 class AsyncDataSetIterator(DataSetIterator):
     """Background-thread prefetch (``AsyncDataSetIterator.java``): keeps a
-    bounded queue of ready batches so the accelerator never waits on ETL."""
+    bounded queue of ready batches so the accelerator never waits on ETL.
 
-    _DONE = object()
+    A thin DL4J-named shell over
+    :class:`~deeplearning4j_tpu.data.device_pipeline.DeviceFeeder`
+    (identity placement, no bucketing), so the event-driven queue
+    protocol — blocking puts, sentinel, abandonment drain — lives in
+    exactly one place.
+
+    ``etl_wait_s`` (PerformanceListener parity) resets at each epoch
+    start; per-batch waits also land in the
+    ``tpudl_data_etl_wait_seconds`` registry histogram."""
 
     def __init__(self, underlying: DataSetIterator, queue_size: int = 2):
         self.underlying = underlying
@@ -160,55 +166,17 @@ class AsyncDataSetIterator(DataSetIterator):
             self.underlying.reset()
 
     def __iter__(self):
-        q: queue.Queue = queue.Queue(maxsize=self.queue_size)
-        error: list[BaseException] = []
-        stop = threading.Event()
-
-        def producer():
-            try:
-                for item in self.underlying:
-                    while not stop.is_set():
-                        try:
-                            q.put(item, timeout=0.1)
-                            break
-                        except queue.Full:
-                            continue
-                    if stop.is_set():
-                        return
-            except BaseException as e:  # surfaced on the consumer side
-                error.append(e)
-            finally:
-                # the sentinel must arrive even when the queue is full —
-                # block-with-retry like item puts, bailing only if the
-                # consumer already abandoned the epoch
-                while not stop.is_set():
-                    try:
-                        q.put(self._DONE, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
-        thread = threading.Thread(target=producer, daemon=True)
-        thread.start()
-        import time
-        try:
-            while True:
-                t0 = time.perf_counter()
-                item = q.get()
-                self.etl_wait_s += time.perf_counter() - t0
-                if item is self._DONE:
-                    if error:
-                        raise error[0]
-                    return
-                yield item
-        finally:
-            # consumer abandoned the epoch (break / EarlyTermination):
-            # release the producer so it doesn't block on the full queue
-            stop.set()
-            while not q.empty():
-                try:
-                    q.get_nowait()
-                except queue.Empty:
-                    break
+        from deeplearning4j_tpu.data.device_pipeline import DeviceFeeder
+        # ONE implementation of the producer/sentinel/drain protocol:
+        # delegate to the DeviceFeeder's background stage with identity
+        # placement and no bucketing — this class only adds the
+        # DL4J-named surface (queue_size, etl_wait_s)
+        feeder = DeviceFeeder(depth=self.queue_size, bucketing=False)
+        self.etl_wait_s = 0.0   # fresh per epoch
+        for fed in feeder.feed(self.underlying):
+            self.etl_wait_s = feeder.etl_wait_s
+            yield fed.batch
+        self.etl_wait_s = feeder.etl_wait_s
 
 
 class EarlyTerminationIterator(DataSetIterator):

@@ -413,17 +413,27 @@ class BertForMaskedLM:
         # dropout needs speed, not counter-stream reproducibility
         key = jax.random.key(self.seed + 31, impl="rbg")
         last = float("nan")
+
+        def _place(batch):
+            """Background-stage H2D: batch N+1 transfers while step N
+            executes (batches are fixed-shape dicts — no bucketing)."""
+            attn = batch.get("attention_mask")
+            return (jnp.asarray(batch["input_ids"]),
+                    jnp.asarray(batch["labels"]),
+                    jnp.asarray(batch["label_weights"]),
+                    None if attn is None else jnp.asarray(attn))
+
+        from deeplearning4j_tpu.data.device_pipeline import DeviceFeeder
+        feeder = DeviceFeeder(_place, bucketing=False)
         for _ in range(epochs):
             if hasattr(batches, "reset"):
                 batches.reset()
-            for batch in batches:
+            for fed in feeder.feed(batches):
                 key, sub = jax.random.split(key)
+                ids, labels, weights, attn = fed.batch
                 self.params, self.opt_state, loss = self._step(
-                    self.params, self.opt_state,
-                    jnp.asarray(batch["input_ids"]), jnp.asarray(batch["labels"]),
-                    jnp.asarray(batch["label_weights"]),
-                    jnp.asarray(batch["attention_mask"]) if batch.get("attention_mask") is not None else None,
-                    sub)
+                    self.params, self.opt_state, ids, labels, weights,
+                    attn, sub)
                 last = float(loss)
                 bus.dispatch("iteration_done", self, self.iteration, 0, last)
                 self.iteration += 1

@@ -367,34 +367,37 @@ class Word2Vec:
                               // self.batch_size)
         total_steps = steps_per_epoch * self.epochs
 
+        n_arrays = 4 if (self.cbow and not dbow) else 3
+
+        def epoch_batches(batch, n, perm):
+            """Host ETL per batch (index-gather + ragged-tail pad to the
+            one compiled shape) — runs on the feeder's background stage
+            so it overlaps the device step."""
+            for s in range(0, n, self.batch_size):
+                idx = perm[s:s + self.batch_size]
+                if len(idx) == 0:
+                    continue
+                if len(idx) < self.batch_size:
+                    pad = rng.choice(n, self.batch_size - len(idx))
+                    idx = np.concatenate([idx, perm[pad]])
+                yield tuple(batch[i][idx] for i in range(n_arrays))
+
+        from deeplearning4j_tpu.data.device_pipeline import DeviceFeeder
+        feeder = DeviceFeeder(
+            lambda arrays: tuple(jnp.asarray(a) for a in arrays),
+            bucketing=False)
         step_i = 0
         for epoch in range(self.epochs):
             batch, n = first if epoch == 0 else make_epoch()
             first = None   # drop epoch-0 arrays once superseded
             perm = rng.permutation(n)
-            for s in range(0, n, self.batch_size):
-                idx = perm[s:s + self.batch_size]
-                if len(idx) == 0:
-                    continue
-                # pad the ragged tail so one static shape is compiled
-                if len(idx) < self.batch_size:
-                    pad = rng.choice(n, self.batch_size - len(idx))
-                    idx = np.concatenate([idx, perm[pad]])
+            for fed in feeder.feed(epoch_batches(batch, n, perm)):
                 lr = max(self.min_learning_rate,
                          self.learning_rate * (1 - step_i / max(total_steps, 1)))
                 key, sub = jax.random.split(key)
-                if self.cbow and not dbow:
-                    ctx, msk, ctr, did = (jnp.asarray(batch[0][idx]),
-                                          jnp.asarray(batch[1][idx]),
-                                          jnp.asarray(batch[2][idx]),
-                                          jnp.asarray(batch[3][idx]))
-                    args = (ctx, msk, ctr, did)
-                else:
-                    args = (jnp.asarray(batch[0][idx]),
-                            jnp.asarray(batch[1][idx]),
-                            jnp.asarray(batch[2][idx]))
-                syn0, syn1, dvecs = step(syn0, syn1, dvecs, args, hs_tabs,
-                                         neg_logits, sub, jnp.float32(lr))
+                syn0, syn1, dvecs = step(syn0, syn1, dvecs, fed.batch,
+                                         hs_tabs, neg_logits, sub,
+                                         jnp.float32(lr))
                 step_i += 1
 
         if not freeze_words:
@@ -666,15 +669,21 @@ class Glove:
 
         n = len(vals)
         bs = min(self.batch_size, n)
-        for _ in range(self.epochs):
-            perm = rng.permutation(n)
+
+        def epoch_batches(perm):
             for s in range(0, n, bs):
                 idx = perm[s:s + bs]
                 if len(idx) < bs:  # pad tail to keep one compiled shape
                     idx = np.concatenate([idx, perm[rng.choice(n, bs - len(idx))]])
-                params, accum, _ = glove_step(
-                    params, accum, jnp.asarray(keys[idx, 0]),
-                    jnp.asarray(keys[idx, 1]), jnp.asarray(vals[idx]))
+                yield keys[idx, 0], keys[idx, 1], vals[idx]
+
+        from deeplearning4j_tpu.data.device_pipeline import DeviceFeeder
+        feeder = DeviceFeeder(
+            lambda arrays: tuple(jnp.asarray(a) for a in arrays),
+            bucketing=False)
+        for _ in range(self.epochs):
+            for fed in feeder.feed(epoch_batches(rng.permutation(n))):
+                params, accum, _ = glove_step(params, accum, *fed.batch)
 
         w, wt, _, _ = (np.asarray(p) for p in params)
         self.vectors = w + wt  # GloVe convention: sum both tables

@@ -271,6 +271,20 @@ def install_standard_metrics(registry: Optional[MetricsRegistry] = None) -> dict
                 "Wall time of the most recent first-call (trace+compile) "
                 "step through a jit boundary"),
         r.gauge("tpudl_train_last_score", "Most recent training loss"),
+        r.counter("tpudl_train_recompiles_total",
+                  "New XLA traces of trainer step functions (first "
+                  "compile included; shape churn past step 1 means the "
+                  "recompile guard is being bypassed)"),
+        r.counter("tpudl_train_step_cache_hits_total",
+                  "Compiled-step reuses served by train.step_cache"),
+        r.counter("tpudl_train_step_cache_misses_total",
+                  "Step builds admitted into train.step_cache"),
+        r.histogram("tpudl_data_etl_wait_seconds",
+                    "Consumer-side wait for the next ready batch "
+                    "(DeviceFeeder / AsyncDataSetIterator queue get)"),
+        r.gauge("tpudl_data_prefetch_depth",
+                "Device-ready batches still queued after the most "
+                "recent get (0 = consumer racing the producer)"),
         r.gauge("tpudl_device_hbm_bytes_in_use",
                 "Device memory in use on local device 0 (memory_stats)"),
         r.gauge("tpudl_device_hbm_bytes_limit",

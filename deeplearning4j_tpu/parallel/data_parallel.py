@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deeplearning4j_tpu.obs import tracing
 from deeplearning4j_tpu.obs.registry import get_registry
 from deeplearning4j_tpu.parallel import mesh as mesh_mod
+from deeplearning4j_tpu.train import step_cache
 from deeplearning4j_tpu.train.trainer import Trainer
 
 # Mesh axes the data-parallel path shards batches (and psums gradients)
@@ -122,7 +123,10 @@ class ParallelWrapper(Trainer):
                 fields[name] = mesh_mod.shard_batch(self.mesh, getattr(batch, name))
         return _dc.replace(batch, **fields)
 
-    def fit_batch(self, batch, rng) -> float:
+    def _jit_step_fns(self) -> tuple:
+        return super()._jit_step_fns() + (self._avg_step, self._avg_fn)
+
+    def fit_batch(self, batch, rng, prepared: bool = False) -> float:
         """One DP step.
 
         ``averaging_frequency == 1`` (default): params replicated, GSPMD
@@ -138,14 +142,14 @@ class ParallelWrapper(Trainer):
         self._ensure_ready()
         if self.averaging_frequency > 1:
             return self._fit_batch_averaging(batch, rng)
-        return super().fit_batch(batch, rng)
+        return super().fit_batch(batch, rng, prepared=prepared)
 
-    def _fit_tbptt(self, batch, rng):
+    def _fit_tbptt(self, batch, rng, prepared: bool = False):
         if self.averaging_frequency > 1:
             raise NotImplementedError(
                 "tBPTT with averaging_frequency > 1 is not supported — use "
                 "the default every-step allreduce (averaging_frequency=1)")
-        return super()._fit_tbptt(batch, rng)
+        return super()._fit_tbptt(batch, rng, prepared=prepared)
 
     def fit(self, iterator, epochs: int = 1):
         result = super().fit(iterator, epochs)
@@ -176,29 +180,35 @@ class ParallelWrapper(Trainer):
         net = self.net
         n = self._n_shards()
         if self._avg_step is None:
-            loss_fn = make_loss_fn(net)
-            tx = self.tx
+            def build_avg_step():
+                loss_fn = make_loss_fn(net)
+                tx = self.tx
 
-            def local_step(params, state, opt_state, features, labels,
-                           features_mask, labels_mask, rng):
-                (loss, new_state), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True)(params, state, features, labels,
-                                           features_mask, labels_mask, rng)
-                updates, opt_state = tx.update(grads, opt_state, params)
-                params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
-                return params, new_state, opt_state, loss
+                def local_step(params, state, opt_state, features, labels,
+                               features_mask, labels_mask, rng):
+                    (loss, new_state), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True)(params, state, features, labels,
+                                               features_mask, labels_mask, rng)
+                    updates, opt_state = tx.update(grads, opt_state, params)
+                    params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+                    return params, new_state, opt_state, loss
 
-            # vmap over the replica axis: leading dim is sharded over
-            # 'data', so XLA partitions this with no collectives at all
-            self._avg_step = jax.jit(jax.vmap(local_step),
-                                     donate_argnums=(0, 1, 2))
+                # vmap over the replica axis: leading dim is sharded over
+                # 'data', so XLA partitions this with no collectives at all
+                return jax.jit(jax.vmap(local_step), donate_argnums=(0, 1, 2))
 
-            @jax.jit
-            def avg(tree):
-                return jax.tree_util.tree_map(
-                    lambda a: jnp.broadcast_to(jnp.mean(a, axis=0), a.shape),
-                    tree)
-            self._avg_fn = avg
+            def build_avg_fn():
+                @jax.jit
+                def avg(tree):
+                    return jax.tree_util.tree_map(
+                        lambda a: jnp.broadcast_to(jnp.mean(a, axis=0), a.shape),
+                        tree)
+                return avg
+
+            key = self._step_key(f"dp_avg_{n}")
+            self._avg_step = step_cache.get_or_build(key, build_avg_step)
+            self._avg_fn = step_cache.get_or_build(
+                None if key is None else key + ("mean",), build_avg_fn)
 
         def split_leading(v):
             if v is None:

@@ -185,6 +185,7 @@ class MultiSliceTrainer:
 
     # ------------------------------------------------------------ jit fns
     def _ensure_ready(self):
+        from deeplearning4j_tpu.train import step_cache
         from deeplearning4j_tpu.train.trainer import make_loss_fn
         if self._grad_fn is not None:
             return
@@ -195,61 +196,83 @@ class MultiSliceTrainer:
         cap = self.capacity
         world = self.world_size
         value_coded = self.value_coded
+        # process-level step cache: a re-built MultiSliceTrainer over the
+        # same net config + codec geometry reuses the compiled programs
+        net_sig = step_cache.net_signature(self.net)
+        tx_sig = step_cache.updater_signature(self.net.conf)
+        base_key = None
+        if net_sig is not None and tx_sig is not None:
+            base_key = net_sig + (tx_sig, size, cap, world, value_coded)
 
-        @jax.jit
-        def grad_fn(params, state, features, labels, fmask, lmask, rng):
-            (loss, new_state), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, state, features, labels,
-                                       fmask, lmask, rng)
-            return loss, new_state, grads
+        def keyed(kind):
+            return None if base_key is None else base_key + (kind,)
 
-        @jax.jit
-        def apply_fn(params, opt_state, grads):
-            updates, opt_state = tx.update(grads, opt_state, params)
-            params = jax.tree_util.tree_map(lambda p, u: p + u,
-                                            params, updates)
-            return params, opt_state
+        def build_grad_fn():
+            @jax.jit
+            def grad_fn(params, state, features, labels, fmask, lmask, rng):
+                (loss, new_state), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, state, features, labels,
+                                           fmask, lmask, rng)
+                return loss, new_state, grads
+            return grad_fn
+
+        def build_apply_fn():
+            @jax.jit
+            def apply_fn(params, opt_state, grads):
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = jax.tree_util.tree_map(lambda p, u: p + u,
+                                                params, updates)
+                return params, opt_state
+            return apply_fn
 
         # ---- device-codec path: residual+encode fused into the step; only
         # the fixed-size message leaves the device (SURVEY §5.8 "encode
         # before the wire")
-        @partial(jax.jit, donate_argnums=(6,))
-        def grad_encode_fn(params, state, features, labels, fmask, lmask,
-                           residual, rng, tau):
-            (loss, new_state), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, state, features, labels,
-                                       fmask, lmask, rng)
-            flat = jax.flatten_util.ravel_pytree(grads)[0].astype(jnp.float32)
-            acc = residual + flat
-            if value_coded:
-                msg = threshold_encode_values_device(acc, tau, cap)
-                dec = threshold_decode_values_device(msg, size, cap)
-            else:
-                msg = threshold_encode_device(acc, tau, cap)
-                dec = threshold_decode_device(msg, size)
-            res = acc - dec
-            return loss, new_state, msg, res, jnp.max(jnp.abs(res))
-
-        @jax.jit
-        def decode_apply_fn(params, opt_state, padded_messages):
-            total = jnp.zeros((size,), jnp.float32)
-            for r in range(world):     # global rank order → bitwise equality
+        def build_grad_encode_fn():
+            @partial(jax.jit, donate_argnums=(6,))
+            def grad_encode_fn(params, state, features, labels, fmask, lmask,
+                               residual, rng, tau):
+                (loss, new_state), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, state, features, labels,
+                                           fmask, lmask, rng)
+                flat = jax.flatten_util.ravel_pytree(grads)[0].astype(jnp.float32)
+                acc = residual + flat
                 if value_coded:
-                    total = threshold_decode_values_device(
-                        padded_messages[r], size, cap, out=total)
+                    msg = threshold_encode_values_device(acc, tau, cap)
+                    dec = threshold_decode_values_device(msg, size, cap)
                 else:
-                    total = threshold_decode_device(
-                        padded_messages[r], size, out=total)
-            grad_tree = unravel(total / world)
-            updates, opt_state = tx.update(grad_tree, opt_state, params)
-            params = jax.tree_util.tree_map(lambda p, u: p + u,
-                                            params, updates)
-            return params, opt_state
+                    msg = threshold_encode_device(acc, tau, cap)
+                    dec = threshold_decode_device(msg, size)
+                res = acc - dec
+                return loss, new_state, msg, res, jnp.max(jnp.abs(res))
+            return grad_encode_fn
 
-        self._grad_fn = grad_fn
-        self._apply_fn = apply_fn
-        self._grad_encode_fn = grad_encode_fn
-        self._decode_apply_fn = decode_apply_fn
+        def build_decode_apply_fn():
+            @jax.jit
+            def decode_apply_fn(params, opt_state, padded_messages):
+                total = jnp.zeros((size,), jnp.float32)
+                for r in range(world):  # global rank order → bitwise equality
+                    if value_coded:
+                        total = threshold_decode_values_device(
+                            padded_messages[r], size, cap, out=total)
+                    else:
+                        total = threshold_decode_device(
+                            padded_messages[r], size, out=total)
+                grad_tree = unravel(total / world)
+                updates, opt_state = tx.update(grad_tree, opt_state, params)
+                params = jax.tree_util.tree_map(lambda p, u: p + u,
+                                                params, updates)
+                return params, opt_state
+            return decode_apply_fn
+
+        self._grad_fn = step_cache.get_or_build(
+            keyed("dcn_grad"), build_grad_fn)
+        self._apply_fn = step_cache.get_or_build(
+            keyed("dcn_apply"), build_apply_fn)
+        self._grad_encode_fn = step_cache.get_or_build(
+            keyed("dcn_grad_encode"), build_grad_encode_fn)
+        self._decode_apply_fn = step_cache.get_or_build(
+            keyed("dcn_decode_apply"), build_decode_apply_fn)
 
     # ----------------------------------------------------------- training
     def _exchange(self, rank: int, compact: np.ndarray,

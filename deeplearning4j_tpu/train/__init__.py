@@ -1,4 +1,4 @@
-from deeplearning4j_tpu.train import updaters, schedules
+from deeplearning4j_tpu.train import step_cache, updaters, schedules
 from deeplearning4j_tpu.train.updaters import (
     Sgd, Adam, AdamW, AdaMax, AMSGrad, Nadam, Nesterovs, AdaGrad, AdaDelta,
     RmsProp, NoOp,
@@ -15,7 +15,7 @@ from deeplearning4j_tpu.train.early_stopping import (
 )
 
 __all__ = [
-    "updaters", "schedules", "Trainer", "make_train_step",
+    "step_cache", "updaters", "schedules", "Trainer", "make_train_step",
     "Sgd", "Adam", "AdamW", "AdaMax", "AMSGrad", "Nadam", "Nesterovs",
     "AdaGrad", "AdaDelta", "RmsProp", "NoOp",
     "EarlyStoppingConfiguration", "EarlyStoppingTrainer", "EarlyStoppingResult",

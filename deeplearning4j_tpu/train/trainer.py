@@ -15,6 +15,7 @@ divides by batch size, DL4J semantics).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import time
 from typing import Any, Iterable, Optional
@@ -23,11 +24,14 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.config import get_config
+from deeplearning4j_tpu.data.device_pipeline import (
+    DeviceFeeder, FedBatch, ensure_feature_mask, pad_segment)
 from deeplearning4j_tpu.nn.losses import mean_score
 from deeplearning4j_tpu.obs import tracing
 from deeplearning4j_tpu.obs.listeners import ListenerBus
 from deeplearning4j_tpu.obs.profiler import check_finite
 from deeplearning4j_tpu.obs.registry import get_registry, record_device_memory
+from deeplearning4j_tpu.train import step_cache
 from deeplearning4j_tpu.train import updaters as updater_mod
 
 
@@ -168,6 +172,20 @@ def make_train_step(net, tx, with_stats: bool = False,
     return step
 
 
+def make_eval_step(net):
+    """jit'd inference-mode loss: (params, state, features, labels,
+    fmask, lmask) → scalar loss (``MultiLayerNetwork.score(DataSet)``)."""
+    loss_fn = make_loss_fn(net, train=False)
+
+    @jax.jit
+    def _eval(params, state, features, labels, fmask, lmask):
+        loss, _ = loss_fn(params, state, features, labels, fmask, lmask,
+                          None)
+        return loss
+
+    return _eval
+
+
 class Trainer:
     def __init__(self, net, listeners=None):
         self.net = net
@@ -205,9 +223,18 @@ class Trainer:
         self._step = None
         self._tbptt_step = None
         self._stats_step = None
+        self._eval_loss_fn = None
         self._stats_listeners = [l for l in self.bus.listeners
                                  if getattr(l, "wants_model_stats", False)]
         self._compiled = False   # first step through a jit boundary = compile
+        # process-level step-cache identity; None (per-layer updaters,
+        # frozen layers, unserializable conf) = build per instance
+        self._cache_sig = None
+        if not self._per_layer_updaters and frozen_mask is None:
+            net_sig = step_cache.net_signature(net)
+            tx_sig = step_cache.updater_signature(conf)
+            if net_sig is not None and tx_sig is not None:
+                self._cache_sig = net_sig + (tx_sig,)
 
     def _build_multi_updater(self, default_updater, conf, frozen_mask):
         """Per-layer updater overrides (DL4J allows ``layer.updater(...)``):
@@ -259,6 +286,19 @@ class Trainer:
     # the first step is built (ParallelWrapper's ZeRO-1 mode)
     _opt_state_shardings = None
 
+    def _step_key(self, kind: str) -> Optional[tuple]:
+        """Step-cache key for this trainer's config, or None (no cache)."""
+        if self._cache_sig is None:
+            return None
+        return self._cache_sig + (
+            step_cache.sharding_signature(self._opt_state_shardings), kind)
+
+    def _jit_step_fns(self) -> tuple:
+        """Every jit-wrapped step this trainer may call — the recompile
+        guard sums their traced-program counts around each step."""
+        return (self._step, self._stats_step, self._tbptt_step,
+                self._eval_loss_fn)
+
     def _ensure_ready(self):
         net = self.net
         if net.params_ is None:
@@ -266,13 +306,31 @@ class Trainer:
         if net.opt_state is None:
             net.opt_state = self.tx.init(net.params_)
         if self._step is None:
-            self._step = make_train_step(
-                net, self.tx, opt_state_shardings=self._opt_state_shardings)
+            self._step = step_cache.get_or_build(
+                self._step_key("train"),
+                lambda: make_train_step(
+                    net, self.tx,
+                    opt_state_shardings=self._opt_state_shardings))
 
     def _prepare_batch(self, batch):
         """Hook for subclasses (ParallelWrapper shards the batch over the
         mesh here); identity for the single-device trainer."""
         return batch
+
+    def _place_batch(self, batch):
+        """Full host→device placement for one batch: the subclass
+        sharding hook, then device conversion of every array.  The
+        DeviceFeeder runs this on its background stage so the transfer
+        of batch N+1 overlaps step N; direct ``fit_batch`` callers hit
+        it inline (the old synchronous behavior)."""
+        batch = self._prepare_batch(batch)
+        fields = {}
+        for name in ("features", "labels", "features_mask", "labels_mask",
+                     "features_masks", "labels_masks"):
+            v = getattr(batch, name, None)
+            if v is not None:
+                fields[name] = _as_device(v)
+        return dataclasses.replace(batch, **fields) if fields else batch
 
     def eval_loss(self, batch) -> float:
         """Inference-mode loss on one batch, no parameter update
@@ -280,38 +338,39 @@ class Trainer:
         NOT allocate optimizer state or build the donating train step."""
         if self.net.params_ is None:
             self.net.init()
-        batch = self._prepare_batch(batch)
-        if getattr(self, "_eval_loss_fn", None) is None:
-            loss_fn = make_loss_fn(self.net, train=False)
-
-            @jax.jit
-            def _eval(params, state, features, labels, fmask, lmask):
-                loss, _ = loss_fn(params, state, features, labels, fmask,
-                                  lmask, None)
-                return loss
-            self._eval_loss_fn = _eval
+        if isinstance(batch, FedBatch):
+            batch = batch.batch
+        else:
+            batch = self._place_batch(batch)
+        if self._eval_loss_fn is None:
+            self._eval_loss_fn = step_cache.get_or_build(
+                self._step_key("eval"), lambda: make_eval_step(self.net))
         net = self.net
         fmask, lmask = _batch_masks(batch)
         return self._eval_loss_fn(
-            net.params_, net.state_, _as_device(batch.features),
-            _as_device(batch.labels), _as_device(fmask), _as_device(lmask))
+            net.params_, net.state_, batch.features, batch.labels,
+            fmask, lmask)
 
-    def fit_batch(self, batch, rng) -> float:
-        """One optimization step on one batch; returns host-side loss."""
+    def fit_batch(self, batch, rng, prepared: bool = False) -> float:
+        """One optimization step on one batch; returns host-side loss.
+        ``prepared=True`` marks a batch the DeviceFeeder already staged
+        (sharded + device-resident) — no further host work happens."""
         self._ensure_ready()
-        batch = self._prepare_batch(batch)
+        if not prepared:
+            batch = self._place_batch(batch)
         net = self.net
         fmask, lmask = _batch_masks(batch)
         sampling = [l for l in self._stats_listeners
                     if l.wants_stats_now(net.iteration)]
         args = (net.params_, net.state_, net.opt_state,
-                _as_device(batch.features), _as_device(batch.labels),
-                _as_device(fmask), _as_device(lmask), rng)
+                batch.features, batch.labels, fmask, lmask, rng)
         if sampling:
             if self._stats_step is None:
-                self._stats_step = make_train_step(
-                    net, self.tx, with_stats=True,
-                    opt_state_shardings=self._opt_state_shardings)
+                self._stats_step = step_cache.get_or_build(
+                    self._step_key("train_stats"),
+                    lambda: make_train_step(
+                        net, self.tx, with_stats=True,
+                        opt_state_shardings=self._opt_state_shardings))
             params, state, opt_state, loss, stats = self._stats_step(*args)
             # publish the fresh (non-donated) buffers BEFORE listeners run —
             # net.params_ still references donated inputs at this point
@@ -331,33 +390,43 @@ class Trainer:
         # syncing per *step* would still serialize dispatch on TPU).
         return loss
 
-    def _fit_tbptt(self, batch, rng):
+    def _fit_tbptt(self, batch, rng, prepared: bool = False):
         """Truncated BPTT over one batch of full sequences: forward state
         carries between segments (gradient-truncated); dropout rng is
-        folded per segment so masks differ across segments."""
+        folded per segment so masks differ across segments.
+
+        Recompile guard: a non-divisible T gets an all-ones
+        features_mask up front (so every segment shares one pytree
+        structure) and the short tail segment is padded to the static
+        ``tbptt_fwd_length`` with a masked tail — one segment shape,
+        one compile, carries and loss untouched (masked steps are
+        carry-through in the recurrent scan)."""
         from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer
         self._ensure_ready()
         net = self.net
         if self._tbptt_step is None:
-            self._tbptt_step = make_tbptt_step(
-                net, self.tx, opt_state_shardings=self._opt_state_shardings)
+            self._tbptt_step = step_cache.get_or_build(
+                self._step_key("tbptt"),
+                lambda: make_tbptt_step(
+                    net, self.tx,
+                    opt_state_shardings=self._opt_state_shardings))
+        length = net.conf.tbptt_fwd_length
+        if batch.features.shape[1] % length:
+            batch = ensure_feature_mask(batch)
+        if not prepared:
+            batch = self._place_batch(batch)
         b = batch.features.shape[0]
-        dtype = jnp.asarray(batch.features).dtype
+        dtype = batch.features.dtype
         carries = [layer.init_carry(b, dtype)
                    if isinstance(layer, BaseRecurrentLayer) else None
                    for layer in net.layers]
         loss = None
-        for seg_idx, seg in enumerate(
-                _tbptt_segments(batch, net.conf.tbptt_fwd_length)):
-            seg = self._prepare_batch(seg)
+        for seg_idx, seg in enumerate(_tbptt_segments(batch, length)):
             seg_rng = jax.random.fold_in(rng, seg_idx)
             params, state, opt_state, carries, loss = self._tbptt_step(
                 net.params_, net.state_, net.opt_state, carries,
-                jnp.asarray(seg.features),
-                None if seg.labels is None else jnp.asarray(seg.labels),
-                None if seg.features_mask is None else jnp.asarray(seg.features_mask),
-                None if seg.labels_mask is None else jnp.asarray(seg.labels_mask),
-                seg_rng)
+                seg.features, seg.labels, seg.features_mask,
+                seg.labels_mask, seg_rng)
             net.params_, net.state_, net.opt_state = params, state, opt_state
         cfg = get_config()
         if cfg.nan_panic or cfg.inf_panic:
@@ -374,18 +443,24 @@ class Trainer:
         OFF the step stays sync-free — the latency histogram then records
         dispatch wall time only."""
         net = self.net
-        first = (batch.features[0] if isinstance(batch.features, (list, tuple))
-                 else batch.features)
+        fed = isinstance(batch, FedBatch)
+        data = batch.batch if fed else batch
+        first = (data.features[0] if isinstance(data.features, (list, tuple))
+                 else data.features)
+        # listeners and the examples counter must see the REAL example
+        # count, not the bucket-padded shape
+        n_examples = batch.n_examples if fed else int(first.shape[0])
         compile_step = not self._compiled
+        traces_before = step_cache.jit_cache_entries(*self._jit_step_fns())
         t0 = time.perf_counter()
         with tracing.span("step", iteration=net.iteration,
                           epoch=net.epoch) as sp:
             if net.conf.backprop_type == "tbptt" \
-                    and not isinstance(batch.features, (list, tuple)) \
+                    and not isinstance(data.features, (list, tuple)) \
                     and first.ndim == 3:
-                loss = self._fit_tbptt(batch, rng)
+                loss = self._fit_tbptt(data, rng, prepared=fed)
             else:
-                loss = self.fit_batch(batch, rng)
+                loss = self.fit_batch(data, rng, prepared=fed)
             if tracing.get_tracer().enabled:
                 loss = tracing.device_sync(loss)
                 sp.set_attribute("score", float(loss))
@@ -397,17 +472,23 @@ class Trainer:
                 get_registry().gauge("tpudl_train_last_score").set(float(loss))
         dt = time.perf_counter() - t0
         self._compiled = True
+        # recompile guard measurement: new traced programs across this
+        # step (first compile counts too; a shared step-cache hit does
+        # not — the program already existed)
+        retraced = (step_cache.jit_cache_entries(*self._jit_step_fns())
+                    - traces_before)
         reg = get_registry()
-        if compile_step:
+        if retraced > 0:
+            reg.counter("tpudl_train_recompiles_total").inc(retraced)
             reg.gauge("tpudl_train_compile_seconds").set(dt)
         else:
             reg.histogram("tpudl_train_step_seconds").observe(dt)
         reg.counter("tpudl_train_steps_total").inc()
-        reg.counter("tpudl_train_examples_total").inc(first.shape[0])
+        reg.counter("tpudl_train_examples_total").inc(n_examples)
         net._score = loss
         for listener in self.bus.listeners:
             if hasattr(listener, "record_batch"):
-                listener.record_batch(first.shape[0])
+                listener.record_batch(n_examples)
         self.bus.dispatch("iteration_done", net, net.iteration, net.epoch, loss)
         net.iteration += 1
         return loss
@@ -419,6 +500,10 @@ class Trainer:
         attrs = (net.trace_attrs() if hasattr(net, "trace_attrs") else
                  {"model": type(net).__name__})
         cfg = get_config()
+        # the device-feed stage: bucket-pad + shard + device_put batch
+        # N+1 on a background thread while step N executes; one feeder
+        # for the whole fit so the bucket set stays sticky across epochs
+        feeder = DeviceFeeder(self._place_batch) if cfg.device_feed else None
         if cfg.profiling:
             from deeplearning4j_tpu.obs.profiler import trace as profiler_trace
             profile_ctx = profiler_trace(cfg.trace_dir)
@@ -435,7 +520,9 @@ class Trainer:
                         n_batches = 0
                         if hasattr(iterator, "reset"):
                             iterator.reset()
-                        for batch in iterator:
+                        source = (feeder.feed(iterator) if feeder is not None
+                                  else iterator)
+                        for batch in source:
                             key, sub = jax.random.split(key)
                             self.step_batch(batch, sub)
                             n_batches += 1
@@ -448,16 +535,21 @@ class Trainer:
         return net
 
 
-def _tbptt_segments(batch, length: int):
+def _tbptt_segments(batch, length: int, pad_tail: bool = True):
     """Truncated-BPTT segmentation (``MultiLayerConfiguration.tBPTTLength``):
     split [B, T, C] sequences into chunks of ``length`` steps.  Forward
     state is carried across chunks by ``Trainer._fit_tbptt`` (gradients
-    truncate at chunk boundaries, DL4J semantics)."""
-    import dataclasses as _dc
+    truncate at chunk boundaries, DL4J semantics).
+
+    ``pad_tail`` (default): a final chunk shorter than ``length`` is
+    zero-padded to the static segment shape with a masked tail — one
+    segment shape per config means ONE compiled tBPTT step instead of a
+    second trace+compile every epoch (the caller synthesizes a
+    features_mask for non-divisible T so segment pytrees stay uniform)."""
     t = batch.features.shape[1]
     for start in range(0, t, length):
         end = min(start + length, t)
-        yield _dc.replace(
+        seg = dataclasses.replace(
             batch,
             features=batch.features[:, start:end],
             labels=batch.labels[:, start:end] if batch.labels is not None and batch.labels.ndim == 3 else batch.labels,
@@ -465,3 +557,6 @@ def _tbptt_segments(batch, length: int):
             labels_mask=None if batch.labels_mask is None else (
                 batch.labels_mask[:, start:end] if batch.labels_mask.ndim >= 2 else batch.labels_mask),
         )
+        if pad_tail and end - start < length:
+            seg = pad_segment(seg, length)
+        yield seg

@@ -36,7 +36,10 @@ def _bound_jax_memory_per_module():
     """Drop jit executables + buffers between test modules — the suite
     compiles hundreds of programs (gradchecks alone build ~120 nets in
     f64) and the accumulated cache otherwise OOMs the process before the
-    last modules run."""
+    last modules run.  The process-level step cache pins the nets its
+    cached closures capture, so it is cleared alongside."""
     yield
+    from deeplearning4j_tpu.train.step_cache import clear_step_cache
+    clear_step_cache()
     gc.collect()
     jax.clear_caches()

@@ -184,8 +184,31 @@ np.savez(spec["npz"], x=x, golden=model.predict(x, verbose=0))
 """
 
 
+# Committed golden-fixture cache: each spec's .h5 + recorded Keras
+# activations live under tests/fixtures/keras_cache keyed by
+# sha1(spec + generator script), so the suite replays REAL tf.keras
+# outputs without paying a ~10s TF-subprocess import per test (~6 min
+# across the module) — and still runs where tensorflow is absent.
+# Cache miss (new spec, or a _GEN change rotating every key) falls back
+# to live generation and refreshes the cache; delete the directory to
+# force regeneration against the installed tensorflow.
+_FIXTURE_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "fixtures", "keras_cache")
+
+
 def _make_fixture(tmp_path, spec_layers, x_shape, seed=0, functional=None,
                   zero_tail=None):
+    import hashlib
+    import shutil
+    key_src = json.dumps(
+        [spec_layers, list(x_shape), seed, functional, zero_tail, _GEN],
+        sort_keys=True, default=str)
+    key = hashlib.sha1(key_src.encode()).hexdigest()[:16]
+    cached_h5 = os.path.join(_FIXTURE_CACHE, f"{key}.h5")
+    cached_npz = os.path.join(_FIXTURE_CACHE, f"{key}.npz")
+    if os.path.exists(cached_h5) and os.path.exists(cached_npz):
+        data = np.load(cached_npz)
+        return cached_h5, data["x"], data["golden"]
     h5 = str(tmp_path / "model.h5")
     npz = str(tmp_path / "golden.npz")
     spec = {"layers": spec_layers, "h5": h5, "npz": npz,
@@ -197,8 +220,11 @@ def _make_fixture(tmp_path, spec_layers, x_shape, seed=0, functional=None,
                           capture_output=True, timeout=300, env=env)
     if proc.returncode != 0:
         if b"No module named 'tensorflow'" in proc.stderr:
-            pytest.skip("tensorflow unavailable")
+            pytest.skip("tensorflow unavailable (and no cached fixture)")
         raise RuntimeError(proc.stderr.decode()[-1500:])
+    os.makedirs(_FIXTURE_CACHE, exist_ok=True)
+    shutil.copy(h5, cached_h5)
+    shutil.copy(npz, cached_npz)
     data = np.load(npz)
     return h5, data["x"], data["golden"]
 

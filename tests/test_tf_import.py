@@ -63,7 +63,25 @@ np.savez(spec["npz"], x=feed, golden=golden)
 """
 
 
+# Committed golden-fixture cache (same scheme as test_keras_import):
+# real TF GraphDefs + recorded session outputs keyed by
+# sha1(spec + generator), so re-runs skip the ~10s TF subprocess per
+# test.  Cache miss regenerates live and refreshes; delete the dir to
+# force regeneration against the installed tensorflow.
+_FIXTURE_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "fixtures", "tf_cache")
+
+
 def _fixture(tmp_path, kind, seed=0):
+    import hashlib
+    import shutil
+    key = hashlib.sha1(json.dumps([kind, seed, _GEN]).encode()) \
+        .hexdigest()[:16]
+    cached_pb = os.path.join(_FIXTURE_CACHE, f"{key}.pb")
+    cached_npz = os.path.join(_FIXTURE_CACHE, f"{key}.npz")
+    if os.path.exists(cached_pb) and os.path.exists(cached_npz):
+        data = np.load(cached_npz)
+        return cached_pb, data["x"], data["golden"]
     pb = str(tmp_path / "g.pb")
     npz = str(tmp_path / "golden.npz")
     spec = {"kind": kind, "pb": pb, "npz": npz, "seed": seed}
@@ -73,8 +91,11 @@ def _fixture(tmp_path, kind, seed=0):
                           capture_output=True, timeout=300, env=env)
     if proc.returncode != 0:
         if b"No module named 'tensorflow'" in proc.stderr:
-            pytest.skip("tensorflow unavailable")
+            pytest.skip("tensorflow unavailable (and no cached fixture)")
         raise RuntimeError(proc.stderr.decode()[-1500:])
+    os.makedirs(_FIXTURE_CACHE, exist_ok=True)
+    shutil.copy(pb, cached_pb)
+    shutil.copy(npz, cached_npz)
     data = np.load(npz)
     return pb, data["x"], data["golden"]
 
