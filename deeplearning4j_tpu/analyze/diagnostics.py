@@ -167,6 +167,17 @@ _RULE_LIST = [
         "Stage batches through data.device_pipeline.DeviceFeeder (or "
         "the trainer's _place_batch hook) instead of transferring "
         "inline; see docs/data_pipeline.md."),
+    RuleInfo(
+        "TPU308", "swallowed-exception-in-loop", ERROR,
+        "bare except/except Exception with a pass/continue-only body "
+        "inside a training/exchange/feed loop",
+        "A swallowed error in a step/exchange/feeder loop turns one "
+        "failed iteration into silent data loss or divergence — the "
+        "failure mode the resilience layer exists to surface.  Retries "
+        "belong in resilience.with_retries (classified, bounded, "
+        "counted), not in a blanket except.",
+        "Re-raise, classify via resilience.retry.with_retries, or at "
+        "minimum record the error (log/metric) before continuing."),
 ]
 
 RULES: dict[str, RuleInfo] = {r.id: r for r in _RULE_LIST}

@@ -478,6 +478,77 @@ def _rule_per_batch_host_transfer(mod: ModuleInfo) -> list[Diagnostic]:
     return out
 
 
+# whole-name tokens marking a function as part of a training/exchange/
+# feed path — the loops where a swallowed exception means silent data
+# loss or divergence rather than a cosmetic hiccup
+_TRAIN_LOOP_TOKENS = {"fit", "train", "step", "epoch", "exchange", "feed",
+                      "feeder", "producer", "consumer", "stage", "batch",
+                      "worker", "allreduce"}
+
+
+def _is_swallow_body(body: list) -> bool:
+    """True when a handler body does nothing with the error: only
+    pass/continue (docstrings allowed) — no raise, no logging, no
+    bookkeeping."""
+    real = [s for s in body
+            if not (isinstance(s, ast.Expr)
+                    and isinstance(s.value, ast.Constant)
+                    and isinstance(s.value.value, str))]
+    return bool(real) and all(isinstance(s, (ast.Pass, ast.Continue))
+                              for s in real)
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    """bare ``except:`` or ``except (Base)Exception``."""
+    t = handler.type
+    if t is None:
+        return True
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    return any(isinstance(n, ast.Name)
+               and n.id in {"Exception", "BaseException"} for n in names)
+
+
+@register_lint_rule("TPU308")
+def _rule_swallowed_exception_in_loop(mod: ModuleInfo) -> list[Diagnostic]:
+    """Swallowed exceptions inside training/exchange/feed loops: a bare
+    ``except:`` (or ``except Exception:``) whose body is only pass/
+    continue, inside a for/while loop of a function whose name carries a
+    training-path token (fit/step/exchange/feed/...).  Such a handler
+    converts a failed step into silent divergence; bounded, classified
+    retries live in ``resilience.retry.with_retries``."""
+    out = []
+    seen: set[int] = set()   # nested loops must not double-report
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        tokens = set(fn.name.lower().strip("_").split("_"))
+        if not tokens & _TRAIN_LOOP_TOKENS:
+            continue
+        for loop in _walk_shallow(fn):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            # shallow within the loop too: a handler inside a nested def
+            # is not on the per-iteration path (the nested function gets
+            # its own pass, gated by its own name)
+            for node in _walk_shallow(loop):
+                if not isinstance(node, ast.Try):
+                    continue
+                for handler in node.handlers:
+                    if id(handler) in seen or not _is_broad_handler(handler) \
+                            or not _is_swallow_body(handler.body):
+                        continue
+                    seen.add(id(handler))
+                    caught = ("bare except" if handler.type is None
+                              else "except Exception")
+                    out.append(Diagnostic(
+                        "TPU308",
+                        f"{caught} with a pass/continue-only body inside "
+                        f"the loop at line {loop.lineno} of '{fn.name}' "
+                        f"swallows per-iteration failures silently",
+                        path=mod.anchor(handler)))
+    return out
+
+
 # ------------------------------------------------------------ drivers
 def iter_python_files(paths: Iterable[str]) -> tuple[list[str], list[str]]:
     """(python files to lint, unusable input paths).  Explicitly-named

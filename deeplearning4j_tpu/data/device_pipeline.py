@@ -58,6 +58,8 @@ from deeplearning4j_tpu.config import get_config
 from deeplearning4j_tpu.data.dataset import DataSet
 from deeplearning4j_tpu.obs import tracing
 from deeplearning4j_tpu.obs.registry import get_registry
+from deeplearning4j_tpu.resilience import faults
+from deeplearning4j_tpu.resilience.retry import RetryPolicy, with_retries
 
 
 # ---------------------------------------------------------------- bucketing
@@ -215,7 +217,8 @@ class DeviceFeeder:
     def __init__(self, place_fn: Optional[Callable[[Any], Any]] = None,
                  depth: Optional[int] = None,
                  bucketing: Optional[bool] = None,
-                 buckets: Optional[Sequence[int]] = None):
+                 buckets: Optional[Sequence[int]] = None,
+                 retry_policy: Optional[RetryPolicy] = None):
         cfg = get_config()
         self.place_fn = place_fn if place_fn is not None else (lambda b: b)
         self.depth = max(1, cfg.prefetch_size if depth is None else depth)
@@ -224,6 +227,11 @@ class DeviceFeeder:
         self.buckets: tuple[int, ...] = tuple(
             sorted(int(b) for b in buckets)) if buckets else ()
         self.etl_wait_s = 0.0   # PerformanceListener parity attribute
+        # transient staging failures (a flaky H2D transfer, an injected
+        # feeder fault) retry briefly on the producer thread; persistent
+        # ones re-raise on the CONSUMER with the original traceback
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_attempts=2, base_delay_s=0.02, max_delay_s=0.2)
 
     def _bucket_for(self, n: int) -> int:
         bucket = choose_bucket(n, self.buckets)
@@ -236,13 +244,16 @@ class DeviceFeeder:
 
     def stage(self, batch) -> FedBatch:
         """Producer-side work for one batch: host-side bucket padding,
-        then device placement via ``place_fn``."""
+        then device placement via ``place_fn``.  The ``feeder.stage``
+        fault site fires per attempt, so injected transient errors
+        exercise the producer's retry path."""
         padded, bucket = 0, None
         n = batch.num_examples() if hasattr(batch, "num_examples") else None
         if self.bucketing and isinstance(batch, DataSet):
             bucket = self._bucket_for(n)
             batch, n = pad_to_bucket(batch, bucket)
             padded = max(bucket - n, 0)
+        faults.fire("feeder.stage")
         placed = self.place_fn(batch)
         if n is None:
             n = _leading_dim(placed)
@@ -261,7 +272,9 @@ class DeviceFeeder:
                 for item in iterator:
                     if stop.is_set():
                         return
-                    staged = self.stage(item)
+                    staged = with_retries(
+                        lambda item=item: self.stage(item),
+                        policy=self.retry_policy, site="feeder.stage")
                     q.put(staged)   # blocking; consumer drains on abandon
                     if stop.is_set():
                         return

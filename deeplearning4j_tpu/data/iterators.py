@@ -46,6 +46,11 @@ class ResumableIterator(DataSetIterator):
         self._restored = False
 
     def __iter__(self):
+        # shuffle-aware bases re-derive their permutation from the epoch
+        # (not a sequential RNG draw), so a restored run replays the SAME
+        # epoch order it was interrupted in
+        if hasattr(self.base, "set_epoch"):
+            self.base.set_epoch(self.epoch)
         skipped = 0
         for batch in self.base:
             if skipped < self._skip:
@@ -102,7 +107,14 @@ class ListDataSetIterator(DataSetIterator):
 
 class ArrayDataSetIterator(DataSetIterator):
     """Batch one big (features, labels) array pair, with optional
-    per-epoch shuffling (RecordReaderDataSetIterator-style usage)."""
+    per-epoch shuffling (RecordReaderDataSetIterator-style usage).
+
+    The shuffle permutation derives from ``(seed, epoch)`` — not a
+    sequential RNG draw — so epoch N's order is a pure function of the
+    epoch number.  ``ResumableIterator`` calls :meth:`set_epoch` on
+    restore, making a resumed run replay the interrupted epoch's exact
+    batch order (the resilience layer's 1e-6 trajectory contract holds
+    for shuffling pipelines too)."""
 
     def __init__(self, features, labels, batch_size: int = 32,
                  shuffle: bool = False, seed: int = 0,
@@ -114,11 +126,19 @@ class ArrayDataSetIterator(DataSetIterator):
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.drop_last = drop_last
-        self._rng = np.random.default_rng(seed)
+        self.seed = seed
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        """Pin the epoch the next pass shuffles for (resume support)."""
+        self.epoch = int(epoch)
 
     def __iter__(self):
         n = self.features.shape[0]
-        idx = self._rng.permutation(n) if self.shuffle else np.arange(n)
+        if self.shuffle:
+            idx = np.random.default_rng((self.seed, self.epoch)).permutation(n)
+        else:
+            idx = np.arange(n)
         stop = n - (n % self.batch_size) if self.drop_last else n
         for lo in range(0, stop, self.batch_size):
             sel = idx[lo: lo + self.batch_size]
@@ -126,6 +146,7 @@ class ArrayDataSetIterator(DataSetIterator):
                 self.features[sel], self.labels[sel],
                 None if self.features_mask is None else self.features_mask[sel],
                 None if self.labels_mask is None else self.labels_mask[sel])
+        self.epoch += 1   # standalone multi-epoch use still varies order
 
     def __len__(self):
         n = self.features.shape[0]

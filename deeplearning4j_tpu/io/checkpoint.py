@@ -1,20 +1,56 @@
-"""CheckpointListener — periodic checkpoints with keep-last-K.
+"""CheckpointListener — periodic durable checkpoints with keep-last-K.
 
-Parity with DL4J ``org/deeplearning4j/optimize/listeners/CheckpointListener.java``:
-save every N iterations / epochs / seconds, keep last K (or all),
-``last_checkpoint()`` lookup for resume.  Saves run on the listener thread
-AFTER the step's host sync — the device is already past the step, so this
-is effectively the async-checkpoint pattern (device never blocked on disk).
+Parity with DL4J ``org/deeplearning4j/optimize/listeners/
+CheckpointListener.java`` — save every N iterations / epochs / seconds,
+keep last K (or all), ``last_checkpoint()`` lookup for resume — hardened
+for preemptible fleets (resilience layer):
+
+- every checkpoint zip is written atomically with a sha256 manifest
+  (``io.model_serializer.write_model`` → ``resilience.checkpoint``);
+- the ``checkpoints.json`` index is itself written atomically AND
+  rebuilt from a directory scan on startup, so a restarted process
+  keeps pruning/rotating the prior run's checkpoints instead of
+  forgetting them;
+- ``last_checkpoint_in`` verifies each candidate (zip CRCs + manifest)
+  and falls back to the newest INTACT checkpoint instead of handing a
+  truncated file to resume;
+- ``background=True`` snapshots device state on the listener thread
+  (cheap device→host copies) and runs serialize/zip/fsync on a
+  dedicated save thread — the device never blocks on disk.  Call
+  ``flush()`` (or ``close()``) to make pending saves durable; failures
+  re-raise there rather than vanishing.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
 import time
 from typing import Optional
 
 from deeplearning4j_tpu.obs.listeners import TrainingListener
+from deeplearning4j_tpu.resilience.checkpoint import (
+    AsyncCheckpointer, atomic_write, is_valid_checkpoint, snapshot_net)
+
+_CHECKPOINT_RE = re.compile(r"^checkpoint_iter(\d+)_epoch(\d+)\.zip$")
+INDEX_NAME = "checkpoints.json"
+
+
+def _scan_checkpoints(directory: str) -> list[str]:
+    """Prior-run checkpoints in ``directory``, oldest→newest by
+    (iteration, epoch) parsed from the canonical filename."""
+    found = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    for name in names:
+        m = _CHECKPOINT_RE.match(name)
+        if m:
+            found.append((int(m.group(1)), int(m.group(2)),
+                          os.path.join(directory, name)))
+    return [path for _, _, path in sorted(found)]
 
 
 class CheckpointListener(TrainingListener):
@@ -24,18 +60,45 @@ class CheckpointListener(TrainingListener):
                  save_every_seconds: Optional[float] = None,
                  keep_last: Optional[int] = 3,
                  keep_all: bool = False,
-                 iterator=None):
+                 iterator=None,
+                 normalizer=None,
+                 background: bool = False):
         """``iterator``: a ResumableIterator whose position is stored in
-        every checkpoint (iteratorState.json) for mid-epoch restarts."""
+        every checkpoint (iteratorState.json) for mid-epoch restarts.
+        ``normalizer``: fitted input normalizer captured alongside the
+        model.  ``background``: write zips on a dedicated save thread."""
         self.directory = directory
         self.every_iter = save_every_n_iterations
         self.every_epoch = save_every_n_epochs
         self.every_seconds = save_every_seconds
         self.keep_last = None if keep_all else (keep_last or 3)
         self.iterator = iterator
+        self.normalizer = normalizer
         self._last_save_time = time.time()
-        self._saved: list[str] = []
         os.makedirs(directory, exist_ok=True)
+        # restart resilience: the index is rebuilt from what is actually
+        # on disk, so keep-last-K pruning spans process restarts
+        self._saved: list[str] = _scan_checkpoints(directory)
+        self._write_index()
+        self._async = AsyncCheckpointer() if background else None
+
+    # ------------------------------------------------------------- saving
+    def _write_index(self) -> None:
+        index_path = os.path.join(self.directory, INDEX_NAME)
+        with atomic_write(index_path) as tmp:
+            with open(tmp, "w") as f:
+                json.dump({"checkpoints": self._saved}, f)
+
+    def _commit(self, path: str) -> None:
+        """Post-write bookkeeping (runs on the save thread in background
+        mode): index update + keep-last-K pruning, both restart-safe."""
+        self._saved.append(path)
+        if self.keep_last is not None:
+            while len(self._saved) > self.keep_last:
+                old = self._saved.pop(0)
+                if os.path.exists(old):
+                    os.remove(old)
+        self._write_index()
 
     def _save(self, model, iteration: int, epoch: int) -> str:
         name = f"checkpoint_iter{iteration}_epoch{epoch}.zip"
@@ -43,18 +106,35 @@ class CheckpointListener(TrainingListener):
         it_state = (self.iterator.state()
                     if self.iterator is not None and hasattr(self.iterator, "state")
                     else None)
-        model.save(path, iterator_state=it_state)
-        self._saved.append(path)
-        with open(os.path.join(self.directory, "checkpoints.json"), "w") as f:
-            json.dump({"checkpoints": self._saved}, f)
-        if self.keep_last is not None:
-            while len(self._saved) > self.keep_last:
-                old = self._saved.pop(0)
-                if os.path.exists(old):
-                    os.remove(old)
+        if self._async is not None:
+            from deeplearning4j_tpu.io.model_serializer import write_model
+            # device→host copies happen HERE (the live buffers are about
+            # to be donated to the next step); only disk work moves off
+            snap = snapshot_net(model)
+
+            def job(snap=snap, path=path, it_state=it_state):
+                write_model(snap, path, iterator_state=it_state,
+                            normalizer=self.normalizer)
+                self._commit(path)
+
+            self._async.submit(job)
+        else:
+            model.save(path, iterator_state=it_state,
+                       normalizer=self.normalizer)
+            self._commit(path)
         self._last_save_time = time.time()
         return path
 
+    def flush(self) -> None:
+        """Wait for pending background saves; re-raise any failure."""
+        if self._async is not None:
+            self._async.flush()
+
+    def close(self) -> None:
+        if self._async is not None:
+            self._async.close()
+
+    # ---------------------------------------------------------- listener
     def iteration_done(self, model, iteration, epoch, score):
         if self.every_iter and iteration > 0 and iteration % self.every_iter == 0:
             self._save(model, iteration, epoch)
@@ -65,16 +145,66 @@ class CheckpointListener(TrainingListener):
         if self.every_epoch and (epoch + 1) % self.every_epoch == 0:
             self._save(model, model.iteration, epoch)
 
+    def on_fit_end(self, model, info=None):
+        # background saves must be durable before fit() returns — a
+        # preemption right after fit would otherwise lose the tail
+        self.flush()
+
+    # ----------------------------------------------------------- lookups
     def last_checkpoint(self) -> Optional[str]:
+        self.flush()
         return self._saved[-1] if self._saved else None
 
     @staticmethod
-    def last_checkpoint_in(directory: str) -> Optional[str]:
-        index = os.path.join(directory, "checkpoints.json")
+    def last_checkpoint_in(directory: str,
+                           verify: bool = True) -> Optional[str]:
+        """Newest INTACT checkpoint under ``directory`` (or None).
+
+        Candidates come from ``checkpoints.json`` when present, else a
+        directory scan.  With ``verify`` (default) each candidate is
+        integrity-checked newest-first — a truncated/corrupt zip is
+        skipped (and counted in
+        ``tpudl_resilience_corrupt_checkpoints_total``) so resume falls
+        back to the last durable state instead of crashing on garbage."""
+        from deeplearning4j_tpu.obs.registry import get_registry
+        index = os.path.join(directory, INDEX_NAME)
+        saved: list[str] = []
         if os.path.exists(index):
-            with open(index) as f:
-                saved = json.load(f).get("checkpoints", [])
-            for path in reversed(saved):
-                if os.path.exists(path):
-                    return path
+            try:
+                with open(index) as f:
+                    saved = json.load(f).get("checkpoints", [])
+            except (OSError, ValueError):
+                saved = []   # torn index → trust the directory instead
+        # a moved/copied checkpoint dir has an index recorded against the
+        # OLD location: rebase stale paths onto this directory, and fall
+        # back to a scan so a lying index never hides intact checkpoints
+        rebased = []
+        for path in saved:
+            if not os.path.exists(path):
+                local = os.path.join(directory, os.path.basename(path))
+                path = local if os.path.exists(local) else path
+            rebased.append(path)
+        candidates = list(dict.fromkeys(rebased + _scan_checkpoints(directory)))
+
+        def recency(item):
+            # order by the PARSED (iteration, epoch), not list position —
+            # a stray old checkpoint the index doesn't know about must
+            # not outrank newer indexed ones just because the scan
+            # appended it; unparseable names keep their index position
+            # (oldest-first) as a conservative fallback
+            position, path = item
+            m = _CHECKPOINT_RE.match(os.path.basename(path))
+            if m:
+                return (1, int(m.group(1)), int(m.group(2)), position)
+            return (0, 0, 0, position)
+
+        ordered = [p for _, p in sorted(enumerate(candidates), key=recency)]
+        for path in reversed(ordered):
+            if not os.path.exists(path):
+                continue
+            if verify and not is_valid_checkpoint(path):
+                get_registry().counter(
+                    "tpudl_resilience_corrupt_checkpoints_total").inc()
+                continue
+            return path
         return None

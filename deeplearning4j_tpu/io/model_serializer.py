@@ -1,4 +1,4 @@
-"""Model serialization — ModelSerializer parity.
+"""Model serialization — ModelSerializer parity, made durable.
 
 Parity with DL4J ``org/deeplearning4j/util/ModelSerializer.java``: a model
 file is a ZIP containing
@@ -9,24 +9,35 @@ file is a ZIP containing
 - ``state.npz``            — non-trainable state (BN running stats)
 - ``updater.npz``          — optax updater state pytree (``updaterState.bin``)
 - ``meta.json``            — iteration/epoch counters, format version
-- optional ``normalizer.npz`` (``NormalizerSerializer`` parity)
+- ``trainingState.json``   — exact-resume extras: the post-split RNG key,
+  completed-iteration/epoch counters, mid-epoch batch position, dtype
+  policy (see docs/fault_tolerance.md)
+- ``manifest.json``        — sha256 per entry (resilience.checkpoint)
+- optional ``normalizer.npz`` (``NormalizerSerializer`` parity) and
+  ``iteratorState.json`` (resumable input-pipeline position)
 
-Arrays transfer device→host on save and host→device lazily on load (jax
-moves them at first use).
+Durability (resilience layer): every write is atomic (same-dir temp +
+fsync + ``os.replace``) and manifested; ``restore_*`` verifies zip CRCs
+and manifest digests first and raises
+:class:`~deeplearning4j_tpu.resilience.checkpoint.CheckpointCorruptError`
+instead of inflating a torn file.  Arrays transfer device→host on save
+and host→device lazily on load (jax moves them at first use).
 """
 
 from __future__ import annotations
 
 import io as _io
 import json
-import os
 import zipfile
 from typing import Any, Optional
 
 import jax
 import numpy as np
 
-FORMAT_VERSION = 1
+from deeplearning4j_tpu.resilience.checkpoint import (
+    CheckpointCorruptError, verify_checkpoint, write_checkpoint_zip)
+
+FORMAT_VERSION = 2   # v2: manifest + trainingState.json (v1 zips still load)
 
 
 def _tree_to_npz_bytes(tree: Any) -> bytes:
@@ -55,32 +66,71 @@ def _rebuild_like(template: Any, leaves: list[np.ndarray]) -> Any:
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def _rng_key_data(key) -> Optional[np.ndarray]:
+    """uint32 key data from a typed jax PRNG key (or already-host data)."""
+    if key is None:
+        return None
+    if isinstance(key, np.ndarray):
+        return key
+    return np.asarray(jax.random.key_data(key))
+
+
+def _training_state_json(net) -> str:
+    """Exact-resume extras.  The trainer stamps ``_rng_key`` (post-split)
+    and the ``_completed_*`` counters on the net each step (see
+    ``Trainer.fit``); a net that never trained just records its
+    counters."""
+    from deeplearning4j_tpu.config import dtype_policy
+    policy = dtype_policy()
+    state: dict[str, Any] = {
+        "iteration": int(getattr(net, "_completed_iterations",
+                                 net.iteration)),
+        "epoch": int(getattr(net, "_completed_epochs", net.epoch)),
+        "dtype_policy": {
+            "param_dtype": np.dtype(policy.param_dtype).name,
+            "compute_dtype": np.dtype(policy.compute_dtype).name,
+            "output_dtype": np.dtype(policy.output_dtype).name,
+        },
+    }
+    batches = getattr(net, "_epoch_batches", None)
+    if batches is not None:
+        state["epoch_batches"] = int(batches)
+    key_data = _rng_key_data(getattr(net, "_rng_key", None))
+    if key_data is not None:
+        state["rng_key_data"] = [int(v) for v in key_data.ravel()]
+        state["rng_key_shape"] = list(key_data.shape)
+    return json.dumps(state)
+
+
 def write_model(net, path: str, save_updater: bool = True,
                 normalizer=None, iterator_state: dict | None = None) -> None:
     """``iterator_state``: resumable input-pipeline position
     (``ResumableIterator.state()``) stored as ``iteratorState.json`` so a
     mid-epoch restart can fast-forward instead of replaying data
-    (SURVEY §5.4)."""
-    directory = os.path.dirname(os.path.abspath(path))
-    os.makedirs(directory, exist_ok=True)
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-        zf.writestr("configuration.json", net.conf.to_json())
-        zf.writestr("coefficients.npz", _tree_to_npz_bytes(net.params_))
-        zf.writestr("state.npz", _tree_to_npz_bytes(net.state_))
-        if save_updater and net.opt_state is not None:
-            zf.writestr("updater.npz", _tree_to_npz_bytes(net.opt_state))
-        zf.writestr("meta.json", json.dumps({
-            "format_version": FORMAT_VERSION,
-            "iteration": net.iteration,
-            "epoch": net.epoch,
-            "model_type": type(net).__name__,
-        }))
-        if iterator_state is not None:
-            zf.writestr("iteratorState.json", json.dumps(iterator_state))
-        if normalizer is not None:
-            buf = _io.BytesIO()
-            np.savez(buf, _type=type(normalizer).__name__, **normalizer._state())
-            zf.writestr("normalizer.npz", buf.getvalue())
+    (SURVEY §5.4).  The zip is written atomically with a sha256 manifest
+    — a crash mid-save leaves the previous checkpoint intact, never a
+    truncated file."""
+    entries: dict[str, Any] = {
+        "configuration.json": net.conf.to_json(),
+        "coefficients.npz": _tree_to_npz_bytes(net.params_),
+        "state.npz": _tree_to_npz_bytes(net.state_),
+    }
+    if save_updater and net.opt_state is not None:
+        entries["updater.npz"] = _tree_to_npz_bytes(net.opt_state)
+    entries["meta.json"] = json.dumps({
+        "format_version": FORMAT_VERSION,
+        "iteration": net.iteration,
+        "epoch": net.epoch,
+        "model_type": getattr(net, "model_type", type(net).__name__),
+    })
+    entries["trainingState.json"] = _training_state_json(net)
+    if iterator_state is not None:
+        entries["iteratorState.json"] = json.dumps(iterator_state)
+    if normalizer is not None:
+        buf = _io.BytesIO()
+        np.savez(buf, _type=type(normalizer).__name__, **normalizer._state())
+        entries["normalizer.npz"] = buf.getvalue()
+    write_checkpoint_zip(path, entries)
 
 
 def read_iterator_state(path: str) -> dict | None:
@@ -91,7 +141,51 @@ def read_iterator_state(path: str) -> dict | None:
         return json.loads(zf.read("iteratorState.json").decode())
 
 
-def _restore(path: str, conf_cls, net_cls, load_updater: bool):
+def read_training_state(path: str) -> dict | None:
+    """trainingState.json (exact-resume extras), if present."""
+    with zipfile.ZipFile(path, "r") as zf:
+        if "trainingState.json" not in zf.namelist():
+            return None
+        return json.loads(zf.read("trainingState.json").decode())
+
+
+def read_normalizer(path: str):
+    """Rebuild the normalizer stored in a checkpoint zip, if present."""
+    from deeplearning4j_tpu.data.normalizers import Normalizer
+    with zipfile.ZipFile(path, "r") as zf:
+        if "normalizer.npz" not in zf.namelist():
+            return None
+        return Normalizer.load(_io.BytesIO(zf.read("normalizer.npz")))
+
+
+def _verify_or_raise(path: str) -> None:
+    problems = verify_checkpoint(path)
+    if problems:
+        raise CheckpointCorruptError(path, problems)
+
+
+def _apply_training_state(net, zf: zipfile.ZipFile) -> None:
+    """Restore exact-resume extras onto a freshly-inflated net: the
+    completed-iteration/epoch counters (authoritative over meta.json,
+    which records the listener-visible counter) and the RNG key."""
+    if "trainingState.json" not in zf.namelist():
+        return
+    state = json.loads(zf.read("trainingState.json").decode())
+    net.iteration = int(state.get("iteration", net.iteration))
+    net.epoch = int(state.get("epoch", net.epoch))
+    data = state.get("rng_key_data")
+    if data is not None:
+        shape = tuple(state.get("rng_key_shape", [len(data)]))
+        key_data = np.asarray(data, np.uint32).reshape(shape)
+        net._rng_key = jax.random.wrap_key_data(jax.numpy.asarray(key_data))
+    if "epoch_batches" in state:
+        net._epoch_batches = int(state["epoch_batches"])
+
+
+def _restore(path: str, conf_cls, net_cls, load_updater: bool,
+             verify: bool = True):
+    if verify:
+        _verify_or_raise(path)
     with zipfile.ZipFile(path, "r") as zf:
         conf = conf_cls.from_json(zf.read("configuration.json").decode())
         net = net_cls(conf)
@@ -106,27 +200,67 @@ def _restore(path: str, conf_cls, net_cls, load_updater: bool):
             trainer = Trainer(net)
             template = trainer.tx.init(net.params_)
             net.opt_state = _rebuild_like(template, _npz_bytes_to_leaves(zf.read("updater.npz")))
+        _apply_training_state(net, zf)
     return net
 
 
-def restore_multi_layer_network(path: str, load_updater: bool = True):
+def restore_multi_layer_network(path: str, load_updater: bool = True,
+                                verify: bool = True):
     from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-    return _restore(path, MultiLayerConfiguration, MultiLayerNetwork, load_updater)
+    return _restore(path, MultiLayerConfiguration, MultiLayerNetwork,
+                    load_updater, verify=verify)
 
 
-def restore_computation_graph(path: str, load_updater: bool = True):
+def restore_computation_graph(path: str, load_updater: bool = True,
+                              verify: bool = True):
     from deeplearning4j_tpu.nn.graph import ComputationGraphConfiguration, ComputationGraph
-    return _restore(path, ComputationGraphConfiguration, ComputationGraph, load_updater)
+    return _restore(path, ComputationGraphConfiguration, ComputationGraph,
+                    load_updater, verify=verify)
 
 
-def restore_model(path: str, load_updater: bool = True):
+def restore_model(path: str, load_updater: bool = True, verify: bool = True):
     """ModelGuesser parity: dispatch on the saved model_type."""
+    if verify:
+        _verify_or_raise(path)
     with zipfile.ZipFile(path, "r") as zf:
         meta = json.loads(zf.read("meta.json").decode())
     if meta.get("model_type") == "ComputationGraph":
-        return restore_computation_graph(path, load_updater)
-    return restore_multi_layer_network(path, load_updater)
+        return restore_computation_graph(path, load_updater, verify=False)
+    return restore_multi_layer_network(path, load_updater, verify=False)
+
+
+def restore_into(net, path: str, tx=None, load_updater: bool = True,
+                 verify: bool = True) -> dict:
+    """Inflate a checkpoint into an EXISTING net (the resume path: the
+    trainer already built the net/optimizer and wants the saved values,
+    not a new object).  ``tx`` supplies the updater-state template;
+    without it the net's current ``opt_state`` shape is used.  Returns
+    the checkpoint's training-state dict (empty for pre-v2 zips)."""
+    if verify:
+        _verify_or_raise(path)
+    with zipfile.ZipFile(path, "r") as zf:
+        if net.params_ is None:
+            net.init()
+        net.params_ = _rebuild_like(
+            net.params_, _npz_bytes_to_leaves(zf.read("coefficients.npz")))
+        net.state_ = _rebuild_like(
+            net.state_, _npz_bytes_to_leaves(zf.read("state.npz")))
+        meta = json.loads(zf.read("meta.json").decode())
+        net.iteration = meta.get("iteration", 0)
+        net.epoch = meta.get("epoch", 0)
+        if load_updater and "updater.npz" in zf.namelist():
+            template = tx.init(net.params_) if tx is not None else net.opt_state
+            if template is None:
+                raise ValueError(
+                    "restore_into needs either tx= or an initialized "
+                    "opt_state on the net to shape the updater state")
+            net.opt_state = _rebuild_like(
+                template, _npz_bytes_to_leaves(zf.read("updater.npz")))
+        _apply_training_state(net, zf)
+        if "trainingState.json" in zf.namelist():
+            return json.loads(zf.read("trainingState.json").decode())
+    return {}
 
 
 def save_params(params: Any, path: str) -> None:

@@ -345,9 +345,11 @@ class ComputationGraph:
     def score(self) -> float:
         return float(self._score)
 
-    def fit(self, iterator, epochs: int = 1, listeners=None):
+    def fit(self, iterator, epochs: int = 1, listeners=None,
+            resume_from=None):
         from deeplearning4j_tpu.train.trainer import Trainer
-        Trainer(self, listeners=listeners).fit(iterator, epochs)
+        Trainer(self, listeners=listeners).fit(iterator, epochs,
+                                               resume_from=resume_from)
         return self
 
     def trace_attrs(self) -> dict:
@@ -370,10 +372,10 @@ class ComputationGraph:
 
     # ---------------------------------------------------------- serde
     def save(self, path: str, save_updater: bool = True,
-             iterator_state=None) -> None:
+             iterator_state=None, normalizer=None) -> None:
         from deeplearning4j_tpu.io.model_serializer import write_model
         write_model(self, path, save_updater=save_updater,
-                    iterator_state=iterator_state)
+                    iterator_state=iterator_state, normalizer=normalizer)
 
     @staticmethod
     def load(path: str, load_updater: bool = True) -> "ComputationGraph":

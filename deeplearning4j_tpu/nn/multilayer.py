@@ -145,9 +145,11 @@ class MultiLayerNetwork:
         device scalar on read."""
         return float(self._score)
 
-    def fit(self, iterator, epochs: int = 1, listeners=None):
+    def fit(self, iterator, epochs: int = 1, listeners=None,
+            resume_from=None):
         from deeplearning4j_tpu.train.trainer import Trainer
-        Trainer(self, listeners=listeners).fit(iterator, epochs)
+        Trainer(self, listeners=listeners).fit(iterator, epochs,
+                                               resume_from=resume_from)
         return self
 
     def trace_attrs(self) -> dict:
@@ -214,10 +216,10 @@ class MultiLayerNetwork:
 
     # ---------------------------------------------------------- serde
     def save(self, path: str, save_updater: bool = True,
-             iterator_state: Optional[dict] = None) -> None:
+             iterator_state: Optional[dict] = None, normalizer=None) -> None:
         from deeplearning4j_tpu.io.model_serializer import write_model
         write_model(self, path, save_updater=save_updater,
-                    iterator_state=iterator_state)
+                    iterator_state=iterator_state, normalizer=normalizer)
 
     @staticmethod
     def load(path: str, load_updater: bool = True) -> "MultiLayerNetwork":

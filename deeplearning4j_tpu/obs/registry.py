@@ -313,6 +313,28 @@ def install_standard_metrics(registry: Optional[MetricsRegistry] = None) -> dict
                   "pipeline_apply invocations (trace-time under jit)"),
         r.histogram("tpudl_bench_step_seconds",
                     "Steady-state step time measured by the bench harness"),
+        r.counter("tpudl_resilience_attempts_total",
+                  "Calls into retry-wrapped operations (first tries "
+                  "included)"),
+        r.counter("tpudl_resilience_retries_total",
+                  "Retries after a transient failure (with_retries)"),
+        r.counter("tpudl_resilience_giveups_total",
+                  "Retry-wrapped operations that exhausted attempts/"
+                  "deadline or hit a non-retryable error"),
+        r.histogram("tpudl_resilience_backoff_seconds",
+                    "Backoff slept between retry attempts"),
+        r.counter("tpudl_resilience_checkpoint_writes_total",
+                  "Durable (atomic + manifested) checkpoint zips "
+                  "published"),
+        r.histogram("tpudl_resilience_checkpoint_write_seconds",
+                    "Wall time to serialize + fsync + publish one "
+                    "checkpoint zip"),
+        r.counter("tpudl_resilience_corrupt_checkpoints_total",
+                  "Checkpoints skipped by discovery after failing "
+                  "zip/manifest verification"),
+        r.counter("tpudl_resilience_faults_injected_total",
+                  "Faults fired by the active FaultPlan (test/drill "
+                  "runs only)"),
     ]
     return {m.name: m for m in metrics}
 

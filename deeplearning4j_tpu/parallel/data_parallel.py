@@ -151,8 +151,8 @@ class ParallelWrapper(Trainer):
                 "the default every-step allreduce (averaging_frequency=1)")
         return super()._fit_tbptt(batch, rng, prepared=prepared)
 
-    def fit(self, iterator, epochs: int = 1):
-        result = super().fit(iterator, epochs)
+    def fit(self, iterator, epochs: int = 1, resume_from=None):
+        result = super().fit(iterator, epochs, resume_from=resume_from)
         if self.averaging_frequency > 1:
             self._finalize_averaging()
         return result

@@ -65,6 +65,23 @@ from deeplearning4j_tpu.parallel.compression import (
     threshold_decode_device, threshold_decode_values_device,
     threshold_encode_device, threshold_encode_values_device)
 from deeplearning4j_tpu.parallel.dcn import CompressedAllReducer, InProcessTransport
+from deeplearning4j_tpu.resilience import faults
+from deeplearning4j_tpu.resilience.retry import (
+    RetryPolicy, TransientError, with_retries)
+from deeplearning4j_tpu.resilience.faults import InjectedCrash, InjectedFault
+
+
+def _exchange_retryable(e: BaseException) -> bool:
+    """Ring exchange is NOT idempotent: the transport advances its round
+    counter (and may have sent frames) before failing, so replaying a
+    timed-out exchange would desync the whole gang.  Only errors raised
+    BEFORE the transport touched its state are safe to retry — explicit
+    ``TransientError`` markers (a transport that raises one vouches for
+    its own idempotency) and injected faults (fired ahead of the
+    transport call); generic timeouts/socket errors propagate."""
+    if isinstance(e, InjectedCrash):
+        return False
+    return isinstance(e, (TransientError, InjectedFault))
 
 
 class MultiSliceTrainer:
@@ -88,7 +105,7 @@ class MultiSliceTrainer:
                  device_encode: bool = True, capacity: Optional[int] = None,
                  overlap: bool = False,
                  world_size: Optional[int] = None, rank_offset: int = 0,
-                 listeners=None):
+                 listeners=None, retry_policy: Optional[RetryPolicy] = None):
         from deeplearning4j_tpu.obs.listeners import ListenerBus
         from deeplearning4j_tpu.train import updaters as updater_mod
         self.net = net
@@ -180,6 +197,13 @@ class MultiSliceTrainer:
         self._io_pool = ThreadPoolExecutor(max_workers=n_slices)
         self._pending = [None] * n_slices   # overlap: in-flight exchanges
         self._step_ctx = None               # current step span ctx (threads)
+        # a flaky DCN hop must not kill the gang: retry with backoff
+        # under a deadline (shared, frozen policy — slice threads use it
+        # concurrently).  Classification is deliberately narrow: see
+        # _exchange_retryable (the exchange is not idempotent).
+        self._retry_policy = retry_policy or RetryPolicy(
+            max_attempts=4, deadline_s=60.0, base_delay_s=0.05,
+            retryable=_exchange_retryable)
         self.iteration = 0
         self.last_wire_stats: list[dict] = []
 
@@ -286,7 +310,15 @@ class MultiSliceTrainer:
         with tracing.span("exchange", parent=parent, slice=rank,
                           wire_bytes=int(compact.size) * 4):
             grank = self.rank_offset + rank
-            peers = self.transports[rank].exchange(grank, compact)
+
+            def _do_exchange():
+                # fault site first: injected delays model a slow DCN hop,
+                # injected errors exercise the retry path per-attempt
+                faults.fire("dcn.exchange")
+                return self.transports[rank].exchange(grank, compact)
+
+            peers = with_retries(_do_exchange, policy=self._retry_policy,
+                                 site="dcn.exchange")
             ordered = peers[:grank] + [compact] + peers[grank:]
             stack = np.stack([pad_to_device_layout(m, self.capacity)
                               for m in ordered])
@@ -397,6 +429,7 @@ class MultiSliceTrainer:
         inside the jit)."""
         from deeplearning4j_tpu.train.trainer import _batch_masks
         self._ensure_ready()
+        faults.fire("trainer.step", index=self.iteration)
         n = self.n_slices
         feats = np.asarray(batch.features)
         labels = np.asarray(batch.labels)

@@ -19,6 +19,7 @@ import pickle
 import subprocess
 import sys
 import tempfile
+import time
 from typing import Callable, Optional
 
 
@@ -60,20 +61,66 @@ with open({out_path!r}, "wb") as f:
 """
 
 
-def spawn_local_cluster(fn: Callable, n_processes: int = 2, port: int = 12655,
-                        local_devices: int = 1, timeout: float = 120.0,
-                        extra_env: Optional[dict] = None) -> list:
-    """Run ``fn(process_index, process_count)`` in N fresh local processes
-    under a real jax.distributed runtime (CPU, loopback).  Returns each
-    process's pickled return value.  ``fn`` must be picklable (module-level
-    function).  This is the test rig for launcher/checkpoint/fault-
-    tolerance paths — the DummyTransport translation.
+class ClusterTimeoutError(RuntimeError):
+    """The gang never completed within the wall budget.  Deliberately
+    NOT retryable: its message embeds every child's stderr tail, which
+    routinely contains coordinator-join noise ('connection refused')
+    that must not be mistaken for a startup flake — re-running a
+    timed-out gang would multiply an already-spent timeout."""
 
-    When tracing is active in the launching process, its span context is
-    handed to every worker via ``DL4J_TPU_TRACE_CONTEXT`` — worker spans
-    parent under the launcher's current span, so one Chrome trace shows
-    the whole cluster."""
+
+# stderr fingerprints of a flaky STARTUP (stale coordinator port, racing
+# binds) — worth retrying on a fresh port; genuine hangs/crashes are not.
+# Deliberately NOT "connection refused": when one child dies for a real
+# reason, its SIBLINGS routinely print coordinator-join 'connection
+# refused' noise, and retrying a deterministic failure just multiplies it.
+_STARTUP_FLAKE_MARKERS = ("address already in use", "failed to bind",
+                          "errno 98")
+
+
+def _is_startup_flake(e: BaseException) -> bool:
+    from deeplearning4j_tpu.resilience.retry import default_retryable
+    if isinstance(e, ClusterTimeoutError):
+        return False
+    if default_retryable(e):
+        return True
+    msg = str(e).lower()
+    return isinstance(e, RuntimeError) and any(
+        marker in msg for marker in _STARTUP_FLAKE_MARKERS)
+
+
+def _terminate_then_kill(procs, grace: float = 3.0) -> list[str]:
+    """Stop every child (TERM, grace period, then KILL) and return each
+    one's captured stderr tail — a timed-out gang must leave no orphans
+    and no silent diagnostics."""
+    for proc in procs:
+        if proc.poll() is None:
+            proc.terminate()
+    deadline = time.monotonic() + grace
+    for proc in procs:
+        if proc.poll() is None:
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    tails = []
+    for pid, proc in enumerate(procs):
+        try:
+            _, stderr = proc.communicate(timeout=5.0)
+        except (subprocess.TimeoutExpired, ValueError, OSError):
+            stderr = b""
+        rc = proc.poll()
+        tails.append(f"process {pid} rc={rc} stderr tail: "
+                     f"{(stderr or b'').decode(errors='replace')[-800:]}")
+    return tails
+
+
+def _spawn_once(fn: Callable, n_processes: int, port: int,
+                local_devices: int, timeout: float,
+                extra_env: Optional[dict]) -> list:
     from deeplearning4j_tpu.obs import tracing
+    from deeplearning4j_tpu.resilience import faults
+    faults.fire("launcher.spawn")
     workdir = tempfile.mkdtemp(prefix="dl4j_tpu_cluster_")
     fn_path = os.path.join(workdir, "fn.pkl")
     with open(fn_path, "wb") as f:
@@ -97,13 +144,22 @@ def spawn_local_cluster(fn: Callable, n_processes: int = 2, port: int = 12655,
                                       stderr=subprocess.PIPE))
     results = []
     errors = []
+    # ONE wall-clock budget for the whole gang: jax.distributed blocks
+    # until every process joins, so child 0 timing out means they all did
+    deadline = time.monotonic() + timeout
     for pid, proc in enumerate(procs):
         try:
-            _, stderr = proc.communicate(timeout=timeout)
+            _, stderr = proc.communicate(
+                timeout=max(0.1, deadline - time.monotonic()))
         except subprocess.TimeoutExpired:
-            proc.kill()
-            errors.append(f"process {pid} timed out")
-            continue
+            # a hung gang member: stop EVERY child (terminate → grace →
+            # kill) and surface each one's stderr — the raised error must
+            # say which process wedged and why, not just "timed out"
+            tails = _terminate_then_kill(procs)
+            raise ClusterTimeoutError(
+                f"local cluster timed out after {timeout:.0f}s waiting for "
+                f"process {pid}; all {n_processes} children stopped:\n"
+                + "\n".join(tails))
         if proc.returncode != 0:
             errors.append(f"process {pid} rc={proc.returncode}: "
                           f"{stderr.decode()[-800:]}")
@@ -113,3 +169,40 @@ def spawn_local_cluster(fn: Callable, n_processes: int = 2, port: int = 12655,
     if errors:
         raise RuntimeError("local cluster failed:\n" + "\n".join(errors))
     return results
+
+
+def spawn_local_cluster(fn: Callable, n_processes: int = 2, port: int = 12655,
+                        local_devices: int = 1, timeout: float = 120.0,
+                        extra_env: Optional[dict] = None,
+                        startup_retries: int = 2) -> list:
+    """Run ``fn(process_index, process_count)`` in N fresh local processes
+    under a real jax.distributed runtime (CPU, loopback).  Returns each
+    process's pickled return value.  ``fn`` must be picklable (module-level
+    function).  This is the test rig for launcher/checkpoint/fault-
+    tolerance paths — the DummyTransport translation.
+
+    Resilience: a gang member that never joins gets the WHOLE gang
+    terminated (then killed) and the error carries every child's stderr
+    tail; startup flakes (stale coordinator port, racing binds) retry up
+    to ``startup_retries`` times on a shifted port with backoff
+    (``resilience.retry``, site ``launcher.spawn``).
+
+    When tracing is active in the launching process, its span context is
+    handed to every worker via ``DL4J_TPU_TRACE_CONTEXT`` — worker spans
+    parent under the launcher's current span, so one Chrome trace shows
+    the whole cluster."""
+    from deeplearning4j_tpu.resilience.retry import RetryPolicy, with_retries
+    attempt = {"n": 0}
+
+    def _once():
+        i = attempt["n"]
+        attempt["n"] += 1
+        # a fresh port per retry: the usual flake is the previous gang's
+        # coordinator socket lingering in TIME_WAIT
+        return _spawn_once(fn, n_processes, port + i * 97, local_devices,
+                           timeout, extra_env)
+
+    policy = RetryPolicy(max_attempts=1 + max(0, startup_retries),
+                         base_delay_s=0.2, jitter=0.0,
+                         retryable=_is_startup_flake)
+    return with_retries(_once, policy=policy, site="launcher.spawn")

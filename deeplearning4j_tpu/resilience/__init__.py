@@ -1,0 +1,36 @@
+"""Fault-tolerance layer — durable checkpoints, retries, fault injection.
+
+The north star runs on preemptible TPU slices, where worker death,
+flaky DCN exchanges and torn checkpoint writes are routine events, not
+exceptions (TensorFlow treats consistent checkpoint/recovery as part of
+the runtime, arXiv:1605.08695; TPU fine-tuning guides put
+preemption-safe checkpoint/resume at the center of pod operations).
+This package is that layer for tpudl:
+
+- :mod:`~deeplearning4j_tpu.resilience.checkpoint` — atomic
+  (tmp + fsync + rename) checkpoint zips with a sha256-per-entry
+  manifest, verification on load, host-side snapshots and a background
+  save thread so the device never blocks on disk.
+- :mod:`~deeplearning4j_tpu.resilience.retry` — a reusable
+  retry/timeout/backoff policy (:func:`with_retries`) with
+  retryable-error classification, per-attempt spans and
+  ``tpudl_resilience_*`` counters; wrapped around the DCN exchange,
+  the device-feeder staging path and local-cluster startup.
+- :mod:`~deeplearning4j_tpu.resilience.faults` — a deterministic
+  :class:`FaultPlan` (env/config-driven) that injects crashes, slow or
+  failing exchanges, feeder exceptions and truncated checkpoint files
+  at chosen steps — the harness that keeps the rest honest
+  (tests/test_resilience.py).
+
+See docs/fault_tolerance.md for the operational story.
+"""
+
+from deeplearning4j_tpu.resilience.checkpoint import (  # noqa: F401
+    MANIFEST_NAME, AsyncCheckpointer, CheckpointCorruptError, NetSnapshot,
+    atomic_write, is_valid_checkpoint, snapshot_net, verify_checkpoint,
+    write_checkpoint_zip)
+from deeplearning4j_tpu.resilience.faults import (  # noqa: F401
+    FaultPlan, FaultRule, InjectedCrash, InjectedFault, clear_fault_plan,
+    get_fault_plan, inject, install_fault_plan)
+from deeplearning4j_tpu.resilience.retry import (  # noqa: F401
+    RetryPolicy, TransientError, default_retryable, with_retries)

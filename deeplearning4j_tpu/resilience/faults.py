@@ -1,0 +1,203 @@
+"""Deterministic fault injection — the harness that keeps resilience honest.
+
+A :class:`FaultPlan` is a list of :class:`FaultRule`\\ s, each naming an
+instrumented **site** (``trainer.step``, ``dcn.exchange``,
+``feeder.stage``, ``checkpoint.write``, ``launcher.spawn``), the event
+index at which it fires, and an action:
+
+- ``crash``     — raise :class:`InjectedCrash` (a process-death stand-in;
+  **not** retryable, it must propagate out of retry loops the way a
+  ``kill -9`` propagates out of everything)
+- ``error``     — raise :class:`InjectedFault` (a transient failure;
+  classified retryable so retry policies exercise their real path)
+- ``delay``     — sleep ``arg`` seconds (a slow DCN exchange / stuck ETL)
+- ``truncate``  — chop ``arg`` bytes off the end of the file a site just
+  published (torn-disk simulation; applied by :func:`corrupt`)
+
+Plans come from code (``install_fault_plan`` / the :func:`inject`
+context manager) or from the environment (``DL4J_TPU_FAULT_PLAN``), so a
+kill-and-resume drill can wrap an unmodified training script:
+
+    DL4J_TPU_FAULT_PLAN="trainer.step@7:crash" python train.py
+
+Spec grammar: ``site@index:action[:arg[:times]]`` joined by ``;``.
+Sites count their own events (0-based) unless the instrumentation point
+passes an explicit index (the trainer passes ``net.iteration`` so a rule
+fires at a *global step*, not a per-process call count).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import threading
+import time
+from typing import Optional
+
+ENV_VAR = "DL4J_TPU_FAULT_PLAN"
+
+
+class InjectedFault(RuntimeError):
+    """A deterministic injected failure (transient — retry policies
+    classify it retryable)."""
+
+
+class InjectedCrash(InjectedFault):
+    """An injected process death.  NOT retryable: it must tear through
+    retry loops and surface exactly like a real preemption."""
+
+
+@dataclasses.dataclass
+class FaultRule:
+    site: str
+    at: int                 # first event index (within the site) to fire on
+    action: str             # crash | error | delay | truncate
+    arg: float = 0.0        # delay seconds / bytes to truncate
+    times: int = 1          # consecutive events to fire on
+
+    def matches(self, index: int) -> bool:
+        return self.at <= index < self.at + self.times
+
+
+class FaultPlan:
+    """Deterministic per-site fault schedule.  Thread-safe: sites fire
+    from trainer threads, feeder producer threads and DCN IO pools."""
+
+    def __init__(self, rules: Optional[list[FaultRule]] = None):
+        self.rules = list(rules or [])
+        self._counts: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ parsing
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """``site@index:action[:arg[:times]];...`` → plan."""
+        rules = []
+        for part in spec.replace(",", ";").split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            try:
+                head, _, tail = part.partition(":")
+                site, _, at = head.partition("@")
+                bits = tail.split(":") if tail else []
+                action = bits[0] if bits else "error"
+                arg = float(bits[1]) if len(bits) > 1 else 0.0
+                times = int(bits[2]) if len(bits) > 2 else 1
+                rules.append(FaultRule(site.strip(), int(at), action.strip(),
+                                       arg, times))
+            except (ValueError, IndexError) as e:
+                raise ValueError(
+                    f"bad fault rule {part!r} (want "
+                    f"site@index:action[:arg[:times]]): {e}") from e
+        return cls(rules)
+
+    @classmethod
+    def from_env(cls) -> Optional["FaultPlan"]:
+        spec = os.environ.get(ENV_VAR, "").strip()
+        return cls.parse(spec) if spec else None
+
+    # ------------------------------------------------------------- firing
+    def _next_index(self, key: str) -> int:
+        with self._lock:
+            index = self._counts.get(key, 0)
+            self._counts[key] = index + 1
+            return index
+
+    def _record(self, rule: FaultRule) -> None:
+        from deeplearning4j_tpu.obs.registry import get_registry
+        get_registry().counter(
+            "tpudl_resilience_faults_injected_total").inc()
+
+    def fire(self, site: str, index: Optional[int] = None) -> None:
+        """Run the site's non-file actions for this event: ``delay``
+        sleeps, ``error``/``crash`` raise.  ``index`` overrides the
+        site's own event counter (the trainer passes the global step so
+        rules are step-deterministic under retries and restarts)."""
+        idx = self._next_index(site) if index is None else index
+        for rule in self.rules:
+            if rule.site != site or rule.action == "truncate" \
+                    or not rule.matches(idx):
+                continue
+            self._record(rule)
+            if rule.action == "delay":
+                time.sleep(rule.arg)
+            elif rule.action == "crash":
+                raise InjectedCrash(
+                    f"injected crash at {site}[{idx}]")
+            else:
+                raise InjectedFault(
+                    f"injected {rule.action} at {site}[{idx}]")
+
+    def corrupt(self, site: str, path: str) -> bool:
+        """Apply any matching ``truncate`` rule to a file the site just
+        published (its own event counter, keyed ``site#truncate``).
+        Returns True when the file was damaged."""
+        rules = [r for r in self.rules
+                 if r.site == site and r.action == "truncate"]
+        if not rules:
+            return False
+        idx = self._next_index(site + "#truncate")
+        for rule in rules:
+            if not rule.matches(idx):
+                continue
+            size = os.path.getsize(path)
+            keep = max(0, size - max(1, int(rule.arg or 64)))
+            with open(path, "r+b") as f:
+                f.truncate(keep)
+            self._record(rule)
+            return True
+        return False
+
+
+# ------------------------------------------------------------ global plan
+_active: Optional[FaultPlan] = None
+_env_checked = False
+
+
+def install_fault_plan(plan: Optional[FaultPlan]) -> None:
+    global _active, _env_checked
+    _active = plan
+    _env_checked = True      # an explicit install overrides the env var
+
+
+def clear_fault_plan() -> None:
+    global _active, _env_checked
+    _active = None
+    _env_checked = True
+
+
+def get_fault_plan() -> Optional[FaultPlan]:
+    global _active, _env_checked
+    if not _env_checked:
+        _env_checked = True
+        _active = FaultPlan.from_env()
+    return _active
+
+
+@contextlib.contextmanager
+def inject(plan_or_spec):
+    """Scoped plan installation for tests:
+    ``with faults.inject("trainer.step@7:crash"): ...``"""
+    plan = (FaultPlan.parse(plan_or_spec)
+            if isinstance(plan_or_spec, str) else plan_or_spec)
+    prev, prev_checked = _active, _env_checked
+    install_fault_plan(plan)
+    try:
+        yield plan
+    finally:
+        install_fault_plan(prev)
+        globals()["_env_checked"] = prev_checked
+
+
+def fire(site: str, index: Optional[int] = None) -> None:
+    """Instrumentation entry point — a no-op when no plan is active."""
+    plan = get_fault_plan()
+    if plan is not None:
+        plan.fire(site, index)
+
+
+def corrupt(site: str, path: str) -> bool:
+    plan = get_fault_plan()
+    return plan.corrupt(site, path) if plan is not None else False

@@ -1,0 +1,124 @@
+"""Retry/timeout/backoff — one policy shared by every flaky boundary.
+
+The reference's cluster stack retries transport sends inside Aeron and
+gives every RPC a deadline; tpudl's equivalents (DCN ring exchange,
+host→device staging, local-cluster bootstrap) get the same discipline
+from ONE reusable policy instead of ad-hoc loops:
+
+    peers = with_retries(lambda: transport.exchange(rank, msg),
+                         policy=RetryPolicy(max_attempts=4,
+                                            deadline_s=30.0),
+                         site="dcn.exchange")
+
+Semantics:
+
+- exponential backoff (``base_delay_s * multiplier**(attempt-1)``,
+  capped at ``max_delay_s``) with proportional jitter — deterministic
+  per (site, attempt), so two workers hammering one coordinator
+  desynchronize without making tests flaky;
+- a **deadline**: when the next backoff would overrun ``deadline_s``
+  since the first attempt, give up now rather than sleep past it;
+- **classification**: only transient errors retry.  By default that is
+  :class:`TransientError`, :class:`InjectedFault` (the fault harness),
+  timeouts, connection failures and transient OS errors —
+  :class:`~deeplearning4j_tpu.resilience.faults.InjectedCrash` and
+  everything else propagate on the first throw;
+- observability: a ``retry_attempt`` span per attempt and the
+  ``tpudl_resilience_{attempts,retries,giveups}_total`` counters plus
+  the ``tpudl_resilience_backoff_seconds`` histogram.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import errno
+import time
+import zlib
+from typing import Any, Callable, Optional
+
+from deeplearning4j_tpu.obs import tracing
+from deeplearning4j_tpu.resilience.faults import InjectedCrash, InjectedFault
+
+
+class TransientError(RuntimeError):
+    """Marker for errors the raiser knows to be retryable."""
+
+
+_TRANSIENT_ERRNOS = {errno.EAGAIN, errno.EBUSY, errno.ETIMEDOUT,
+                     errno.ECONNRESET, errno.ECONNREFUSED,
+                     errno.ECONNABORTED, errno.EADDRINUSE, errno.EINTR,
+                     errno.EPIPE}
+
+
+def default_retryable(e: BaseException) -> bool:
+    """Transient-error classification: retry timeouts, connection
+    trouble, transient OS errors, explicit markers and injected faults;
+    never retry an injected crash (it stands in for process death)."""
+    if isinstance(e, InjectedCrash):
+        return False
+    if isinstance(e, (TransientError, InjectedFault, TimeoutError,
+                      ConnectionError)):
+        return True
+    if isinstance(e, OSError):
+        return e.errno in _TRANSIENT_ERRNOS
+    return False
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Knobs for :func:`with_retries`.  Frozen so one policy instance
+    can be shared across threads (DCN slice pools, feeder producers)."""
+
+    max_attempts: int = 3
+    deadline_s: Optional[float] = None     # wall budget across ALL attempts
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.25                   # +[0, jitter) fraction of the delay
+    retryable: Callable[[BaseException], bool] = default_retryable
+
+    def delay_for(self, attempt: int, site: str = "") -> float:
+        """Backoff before attempt ``attempt + 1`` (``attempt`` is the
+        1-based attempt that just failed).  Jitter is deterministic per
+        (site, attempt) so retries are reproducible in tests yet spread
+        across sites in production."""
+        base = min(self.max_delay_s,
+                   self.base_delay_s * self.multiplier ** (attempt - 1))
+        if not self.jitter:
+            return base
+        u = (zlib.crc32(f"{site}:{attempt}".encode()) % 1000) / 1000.0
+        return base * (1.0 + self.jitter * u)
+
+
+def with_retries(fn: Callable[[], Any], *,
+                 policy: Optional[RetryPolicy] = None,
+                 site: str = "call",
+                 sleep: Callable[[float], None] = time.sleep) -> Any:
+    """Call ``fn()`` under ``policy``; return its value or raise the
+    last error once attempts/deadline are exhausted or the error is not
+    retryable.  ``sleep`` is injectable so tests assert the exact
+    backoff schedule without waiting it out."""
+    from deeplearning4j_tpu.obs.registry import get_registry
+    policy = policy or RetryPolicy()
+    reg = get_registry()
+    start = time.monotonic()
+    attempt = 0
+    while True:
+        attempt += 1
+        reg.counter("tpudl_resilience_attempts_total").inc()
+        with tracing.span("retry_attempt", site=site, attempt=attempt) as sp:
+            try:
+                return fn()
+            except BaseException as e:
+                sp.set_attribute("error", type(e).__name__)
+                if not policy.retryable(e) or attempt >= policy.max_attempts:
+                    reg.counter("tpudl_resilience_giveups_total").inc()
+                    raise
+                delay = policy.delay_for(attempt, site)
+                if policy.deadline_s is not None and \
+                        time.monotonic() - start + delay > policy.deadline_s:
+                    reg.counter("tpudl_resilience_giveups_total").inc()
+                    raise
+        reg.counter("tpudl_resilience_retries_total").inc()
+        reg.histogram("tpudl_resilience_backoff_seconds").observe(delay)
+        sleep(delay)

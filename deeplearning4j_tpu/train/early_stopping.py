@@ -216,7 +216,12 @@ class InMemoryModelSaver:
 
 class LocalFileModelSaver:
     """Writes ``bestModel.zip`` / ``latestModel.zip`` under a directory
-    (``LocalFileModelSaver``)."""
+    (``LocalFileModelSaver``).  Saves ride the durable checkpoint path
+    (atomic replace + sha256 manifest), and loads verify integrity
+    first: selecting a torn "best model" would silently deploy garbage,
+    so corruption raises
+    :class:`~deeplearning4j_tpu.resilience.checkpoint.CheckpointCorruptError`
+    instead."""
 
     def __init__(self, directory: str):
         self.directory = directory
@@ -236,17 +241,20 @@ class LocalFileModelSaver:
     def save_latest_model(self, net, score: float) -> None:
         net.save(self.latest_path)
 
-    def get_best_model(self):
+    @staticmethod
+    def _load_verified(path: str):
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-        if not os.path.exists(self.best_path):
+        if not os.path.exists(path):
             return None
-        return MultiLayerNetwork.load(self.best_path)
+        # load() verifies zip CRCs + manifest digests and raises
+        # CheckpointCorruptError itself — no second hashing pass needed
+        return MultiLayerNetwork.load(path)
+
+    def get_best_model(self):
+        return self._load_verified(self.best_path)
 
     def get_latest_model(self):
-        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-        if not os.path.exists(self.latest_path):
-            return None
-        return MultiLayerNetwork.load(self.latest_path)
+        return self._load_verified(self.latest_path)
 
 
 # ------------------------------------------------------------ config/result

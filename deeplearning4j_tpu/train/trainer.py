@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 import time
 from typing import Any, Iterable, Optional
 
@@ -31,6 +32,7 @@ from deeplearning4j_tpu.obs import tracing
 from deeplearning4j_tpu.obs.listeners import ListenerBus
 from deeplearning4j_tpu.obs.profiler import check_finite
 from deeplearning4j_tpu.obs.registry import get_registry, record_device_memory
+from deeplearning4j_tpu.resilience import faults
 from deeplearning4j_tpu.train import step_cache
 from deeplearning4j_tpu.train import updaters as updater_mod
 
@@ -443,6 +445,9 @@ class Trainer:
         OFF the step stays sync-free — the latency histogram then records
         dispatch wall time only."""
         net = self.net
+        # fault-injection site: a "crash" here models preemption BEFORE
+        # the step commits — the last durable checkpoint stays authoritative
+        faults.fire("trainer.step", index=net.iteration)
         fed = isinstance(batch, FedBatch)
         data = batch.batch if fed else batch
         first = (data.features[0] if isinstance(data.features, (list, tuple))
@@ -493,10 +498,75 @@ class Trainer:
         net.iteration += 1
         return loss
 
-    def fit(self, iterator, epochs: int = 1):
+    def resume_state(self, source, iterator=None) -> dict:
+        """Restore full training state from ``source`` (a checkpoint zip
+        or a directory of them) into this trainer's net: params, updater
+        state, RNG key, completed iteration/epoch counters, dtype policy
+        — and fast-forward ``iterator`` past already-consumed batches
+        when the checkpoint was taken mid-epoch.  Returns the restored
+        training-state dict (see docs/fault_tolerance.md)."""
+        from deeplearning4j_tpu.config import set_dtype_policy, DTypePolicy
+        from deeplearning4j_tpu.io.checkpoint import CheckpointListener
+        from deeplearning4j_tpu.io.model_serializer import (
+            read_iterator_state, restore_into)
+        path = source
+        verified = False
+        if os.path.isdir(source):
+            # discovery verifies each candidate (newest intact wins) —
+            # don't re-hash the multi-GB zip a second time below
+            path = CheckpointListener.last_checkpoint_in(source)
+            verified = True
+            if path is None:
+                raise FileNotFoundError(
+                    f"no intact checkpoint found under {source}")
+        elif not os.path.exists(source):
+            raise FileNotFoundError(
+                f"resume_from path does not exist: {source}")
+        self._ensure_ready()
+        state = restore_into(self.net, path, tx=self.tx,
+                             verify=not verified)
+        policy = state.get("dtype_policy")
+        if policy:
+            # the compiled step must see the dtypes the run was using
+            set_dtype_policy(DTypePolicy(
+                param_dtype=jnp.dtype(policy["param_dtype"]),
+                compute_dtype=jnp.dtype(policy["compute_dtype"]),
+                output_dtype=jnp.dtype(policy["output_dtype"])))
+        skip = int(state.get("epoch_batches", 0) or 0)
+        if skip:
+            if iterator is None or not hasattr(iterator, "set_state"):
+                raise ValueError(
+                    f"checkpoint {path} was taken mid-epoch "
+                    f"({skip} batches in) — resuming exactly needs a "
+                    f"ResumableIterator (data.iterators) to fast-forward")
+            # iteratorState.json carries whatever extra fields the
+            # iterator saved (shuffle RNG, shard offset, ...); the
+            # position itself comes from the TRAINER's counters — the
+            # feeder prefetches ahead, so the iterator's own count lies
+            it_state = read_iterator_state(path) or {}
+            it_state.update({"epoch": self.net.epoch, "batch_index": skip})
+            iterator.set_state(it_state)
+        state["checkpoint_path"] = path
+        return state
+
+    def fit(self, iterator, epochs: int = 1, resume_from=None):
+        """Train ``epochs`` epochs.  With ``resume_from`` (a checkpoint
+        zip or directory), training state is restored first and
+        ``epochs`` counts the TOTAL run — completed epochs are skipped
+        and a mid-epoch checkpoint fast-forwards the iterator, so an
+        interrupted fit resumed here reproduces the uninterrupted run's
+        per-step losses exactly (tests/test_resilience.py pins 1e-6)."""
         self._ensure_ready()
         net = self.net
-        key = jax.random.key(net.conf.seed + 7919)
+        epochs_to_run = epochs
+        if resume_from is not None:
+            self.resume_state(resume_from, iterator)
+            epochs_to_run = max(0, epochs - net.epoch)
+        # the post-split key stamped by the previous step/restore; a
+        # fresh net derives from its seed (bitwise-deterministic runs)
+        key = getattr(net, "_rng_key", None)
+        if key is None:
+            key = jax.random.key(net.conf.seed + 7919)
         attrs = (net.trace_attrs() if hasattr(net, "trace_attrs") else
                  {"model": type(net).__name__})
         cfg = get_config()
@@ -513,25 +583,43 @@ class Trainer:
         with profile_ctx:
             with tracing.span("fit", epochs=epochs, **attrs):
                 self.bus.dispatch("on_fit_start", net)
-                for _ in range(epochs):
+                for _ in range(epochs_to_run):
                     with tracing.span("epoch", epoch=net.epoch):
                         self.bus.dispatch("on_epoch_start", net, net.epoch)
                         epoch_t0 = time.perf_counter()
                         n_batches = 0
+                        # resume bookkeeping: what a checkpoint taken NOW
+                        # should record (counters are post-step values,
+                        # stamped before each step so a mid-step crash
+                        # leaves the previous step's stamp in place)
+                        net._completed_epochs = net.epoch
                         if hasattr(iterator, "reset"):
                             iterator.reset()
                         source = (feeder.feed(iterator) if feeder is not None
                                   else iterator)
                         for batch in source:
                             key, sub = jax.random.split(key)
+                            net._rng_key = key
+                            net._completed_iterations = net.iteration + 1
+                            net._epoch_batches = n_batches + 1
                             self.step_batch(batch, sub)
                             n_batches += 1
+                        # epoch complete: a checkpoint here resumes at
+                        # the NEXT epoch's first batch
+                        net._completed_epochs = net.epoch + 1
+                        net._epoch_batches = 0
                         info = {"epoch_time_s": time.perf_counter() - epoch_t0,
                                 "batches": n_batches, "score": net._score}
                         self.bus.dispatch("on_epoch_end", net, net.epoch, info)
                     get_registry().counter("tpudl_train_epochs_total").inc()
                     net.epoch += 1
                 self.bus.dispatch("on_fit_end", net, {"epochs": epochs})
+        # a COMPLETED fit restores pre-resilience RNG semantics: the next
+        # fit() derives from the seed again (repeated-fit reproducibility
+        # baselines hold).  A crash skips this line, so mid-run restarts
+        # — and every checkpoint written along the way — keep the
+        # continuation key that makes resume exact.
+        net._rng_key = None
         return net
 
 

@@ -231,3 +231,19 @@ def dcn_multislice_fit_worker(pid, n, phase="full", workdir="/tmp",
             "batches_seen": iterator.batch_index - start,
             "bytes_sent": transport.bytes_sent,
             "dense_bytes_per_step": trainer.grad_size * 4}
+
+
+def hang_worker(pid, n):
+    """Fault drill: announce on stderr, then wedge — the launcher's
+    timeout path must terminate-then-kill the gang and surface this
+    stderr tail in its RuntimeError."""
+    import sys
+    import time
+    print(f"hang_worker {pid} wedged on purpose", file=sys.stderr, flush=True)
+    time.sleep(600)
+    return {"pid": pid}
+
+
+def trivial_worker(pid, n):
+    """Minimal gang member for launcher startup-retry tests."""
+    return {"pid": pid, "n": n}

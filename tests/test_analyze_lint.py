@@ -222,3 +222,78 @@ def test_rule_registry_is_pluggable(tmp_path):
         assert report.by_rule("TPU999")
     finally:
         LINT_RULES.pop("TPU999", None)
+
+
+# ------------------------------------------------------------ TPU308
+def test_swallowed_exception_in_training_loop(tmp_path):
+    report = _lint_source(tmp_path, """
+        def fit_epoch(trainer, iterator, rng):
+            for batch in iterator:
+                try:
+                    trainer.fit_batch(batch, rng)
+                except Exception:
+                    continue
+
+        def exchange_loop(transport, messages):
+            for msg in messages:
+                try:
+                    transport.exchange(0, msg)
+                except:
+                    pass
+        """)
+    hits = report.by_rule("TPU308")
+    assert len(hits) == 2
+    assert report.exit_code() == 1
+    assert "swallows" in hits[0].message
+
+
+def test_swallowed_exception_clean_cases(tmp_path):
+    report = _lint_source(tmp_path, """
+        import logging
+
+        def fit_epoch(trainer, iterator, rng):
+            for batch in iterator:
+                try:
+                    trainer.fit_batch(batch, rng)
+                except Exception:
+                    logging.exception("step failed")   # recorded, not silent
+                except ValueError:
+                    pass                               # narrow catch: fine
+
+        def fit_with_collection(trainer, batches):
+            errors = []
+            for b in batches:
+                try:
+                    trainer.fit_batch(b, None)
+                except Exception as e:
+                    errors.append(e)                   # bookkeeping: fine
+            return errors
+
+        def parse_optional_configs(paths):
+            # not a training-path function name: out of scope
+            for p in paths:
+                try:
+                    open(p).read()
+                except Exception:
+                    continue
+
+        def fit_once(trainer, batch):
+            try:
+                trainer.fit_batch(batch, None)         # no loop: out of scope
+            except Exception:
+                pass
+
+        def fit_with_nested_teardown(trainer, batches):
+            for b in batches:
+                def _cleanup():
+                    # lives in a nested def: not on the per-iteration
+                    # path, and _cleanup carries no training token
+                    try:
+                        b.close()
+                    except Exception:
+                        pass
+                trainer.fit_batch(b, None)
+                _cleanup()
+        """)
+    assert report.by_rule("TPU308") == []
+    assert report.exit_code() == 0

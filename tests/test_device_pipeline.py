@@ -244,6 +244,32 @@ def test_feeder_propagates_producer_errors():
         list(feeder.feed(gen()))
 
 
+def test_feeder_producer_failure_hygiene():
+    """Producer-thread death mid-epoch: the consumer re-raises the
+    ORIGINAL exception object (traceback intact, pointing into the ETL
+    generator), the queue drains, and the daemon thread exits — no
+    leaked threads across tests."""
+    import traceback
+
+    def gen():
+        for i in range(4):
+            yield DataSet(*_mlp_data(4, seed=8))
+        raise RuntimeError("ETL exploded at batch 4")
+
+    feeder = DeviceFeeder(bucketing=False, depth=2)
+    before = threading.active_count()
+    with pytest.raises(RuntimeError, match="ETL exploded") as exc_info:
+        for _ in feeder.feed(gen()):
+            pass
+    frames = traceback.extract_tb(exc_info.value.__traceback__)
+    assert any(f.name == "gen" for f in frames), (
+        "original producer traceback was lost in the thread handoff")
+    deadline = time.time() + 5.0
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.01)
+    assert threading.active_count() <= before, "feeder thread leaked"
+
+
 def test_bucket_helpers():
     assert choose_bucket(7, (32, 64)) == 32
     assert choose_bucket(33, (32, 64)) == 64

@@ -5,7 +5,8 @@ import numpy as np
 import pytest
 
 from examples import (bert_mlm_finetune, char_rnn_textgen,
-                      data_parallel_training, early_stopping, lenet_cifar10,
+                      data_parallel_training, early_stopping,
+                      fault_tolerant_training, lenet_cifar10,
                       lstm_uci_har, mlp_mnist, multislice_dcn_training,
                       pipeline_parallel_bert, training_dashboard,
                       transfer_learning, word2vec_embeddings)
@@ -78,6 +79,13 @@ def test_dashboard_example_writes_report(tmp_path):
 def test_multislice_dcn_example():
     losses = multislice_dcn_training.main(steps=6, verbose=False)
     assert losses[-1] < losses[0]
+
+
+def test_fault_tolerant_training_example(tmp_path):
+    drift = fault_tolerant_training.main(epochs=2, crash_at_step=11,
+                                         checkpoint_dir=str(tmp_path),
+                                         verbose=False)
+    assert drift <= 1e-6
 
 
 @pytest.mark.slow

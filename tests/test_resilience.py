@@ -1,0 +1,697 @@
+"""Fault-tolerance layer (ISSUE 4): atomic/verified checkpoints, exact
+kill-and-resume, retry/backoff policy, and the deterministic fault-
+injection harness across the trainer, parallel stack and device pipeline.
+
+Acceptance pins:
+- a run interrupted by an injected crash at step k, resumed via
+  ``resume_from``, matches the uninterrupted run's per-step losses to
+  1e-6 (with dropout in the net, so RNG-key capture is really proven);
+- an injected truncated checkpoint is detected and SKIPPED (discovery
+  falls back to the newest intact one) rather than loaded.
+"""
+
+import json
+import os
+import threading
+import time
+import zipfile
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.device_pipeline import DeviceFeeder
+from deeplearning4j_tpu.data.iterators import (
+    ListDataSetIterator, ResumableIterator)
+from deeplearning4j_tpu.io.checkpoint import CheckpointListener
+from deeplearning4j_tpu.io.model_serializer import (
+    read_training_state, restore_model, write_model)
+from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, DropoutLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.obs.listeners import CollectScoresListener
+from deeplearning4j_tpu.obs.registry import (
+    MetricsRegistry, get_registry, set_registry)
+from deeplearning4j_tpu.resilience import faults
+from deeplearning4j_tpu.resilience.checkpoint import (
+    AsyncCheckpointer, CheckpointCorruptError, is_valid_checkpoint,
+    verify_checkpoint)
+from deeplearning4j_tpu.resilience.faults import (
+    FaultPlan, InjectedCrash, InjectedFault)
+from deeplearning4j_tpu.resilience.retry import (
+    RetryPolicy, TransientError, default_retryable, with_retries)
+from deeplearning4j_tpu.train import Adam
+from deeplearning4j_tpu.train.trainer import Trainer
+
+
+@pytest.fixture
+def registry():
+    prev = set_registry(MetricsRegistry())
+    try:
+        yield get_registry()
+    finally:
+        set_registry(prev)
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_fault_plan():
+    """Every test starts and ends with no active fault plan."""
+    faults.clear_fault_plan()
+    yield
+    faults.clear_fault_plan()
+
+
+def _conf(seed=42, n_in=6, n_out=3):
+    return (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(DropoutLayer(dropout=0.8))   # resume must replay RNG too
+            .layer(OutputLayer(n_out=n_out, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(n_in)).build())
+
+
+def _data_iter(n=96, batch=16, seed=3):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return ListDataSetIterator([DataSet(x[i:i + batch], y[i:i + batch])
+                                for i in range(0, n, batch)])
+
+
+# ================================================== durable checkpoint zips
+def test_checkpoint_zip_has_manifest_and_verifies(tmp_path):
+    net = MultiLayerNetwork(_conf()).init()
+    path = str(tmp_path / "model.zip")
+    net.save(path)
+    with zipfile.ZipFile(path) as zf:
+        names = set(zf.namelist())
+        manifest = json.loads(zf.read("manifest.json").decode())
+    assert "manifest.json" in names and "trainingState.json" in names
+    # every non-manifest entry is digest-covered
+    assert set(manifest["entries"]) == names - {"manifest.json"}
+    assert verify_checkpoint(path) == []
+    assert is_valid_checkpoint(path)
+
+
+def test_corrupt_checkpoint_detected_and_load_raises(tmp_path):
+    net = MultiLayerNetwork(_conf()).init()
+    path = str(tmp_path / "model.zip")
+    net.save(path)
+    # flip bytes INSIDE an entry's compressed stream (not just the tail)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size // 2)
+        f.write(b"\xde\xad\xbe\xef")
+    assert verify_checkpoint(path) != []
+    with pytest.raises(CheckpointCorruptError):
+        restore_model(path)
+
+
+def test_truncated_zip_detected(tmp_path):
+    net = MultiLayerNetwork(_conf()).init()
+    path = str(tmp_path / "model.zip")
+    net.save(path)
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) - 64)
+    problems = verify_checkpoint(path)
+    assert problems, "truncated zip must not verify"
+    with pytest.raises(CheckpointCorruptError):
+        MultiLayerNetwork.load(path)
+
+
+def test_atomic_write_preserves_previous_on_crash(tmp_path, registry):
+    """An injected crash mid-save (inside the atomic region) leaves the
+    previously-published checkpoint intact and no temp litter."""
+    net = MultiLayerNetwork(_conf()).init()
+    path = str(tmp_path / "model.zip")
+    net.save(path)
+    before = open(path, "rb").read()
+    with faults.inject("checkpoint.write@0:crash"):
+        with pytest.raises(InjectedCrash):
+            net.save(path)
+    assert open(path, "rb").read() == before
+    assert [n for n in os.listdir(tmp_path) if ".tmp-" in n] == []
+    assert is_valid_checkpoint(path)
+
+
+def test_training_state_captured(tmp_path):
+    net = MultiLayerNetwork(_conf()).init()
+    ckpt = CheckpointListener(str(tmp_path / "mid"),
+                              save_every_n_iterations=1)
+    Trainer(net, listeners=[ckpt]).fit(_data_iter(), epochs=1)
+    # a checkpoint written DURING fit captures the live post-split key
+    mid = read_training_state(ckpt.last_checkpoint())
+    assert mid["rng_key_data"], "mid-fit RNG key must be captured"
+    # a save after a COMPLETED fit records counters but deliberately no
+    # continuation key — the next fit() restarts from the seed
+    path = str(tmp_path / "model.zip")
+    net.save(path)
+    state = read_training_state(path)
+    assert state["iteration"] == 6 and state["epoch"] == 1
+    assert state["epoch_batches"] == 0          # epoch boundary
+    assert "rng_key_data" not in state
+    assert state["dtype_policy"]["param_dtype"] == "float32"
+
+
+# ============================================= checkpoint listener + index
+def test_listener_rebuilds_index_and_prunes_across_restarts(tmp_path):
+    d = str(tmp_path)
+    net = MultiLayerNetwork(_conf()).init()
+    first = CheckpointListener(d, save_every_n_iterations=1, keep_last=5)
+    for i in range(1, 4):
+        first.iteration_done(net, i, 0, 0.5)
+    assert len(first._saved) == 3
+    # "restart": a fresh listener must rediscover the 3 prior checkpoints
+    # from the directory (not trust its empty memory) and keep pruning
+    second = CheckpointListener(d, save_every_n_iterations=1, keep_last=3)
+    assert len(second._saved) == 3
+    for i in range(4, 6):
+        second.iteration_done(net, i, 0, 0.5)
+    remaining = sorted(n for n in os.listdir(d) if n.endswith(".zip"))
+    assert remaining == ["checkpoint_iter3_epoch0.zip",
+                         "checkpoint_iter4_epoch0.zip",
+                         "checkpoint_iter5_epoch0.zip"]
+    index = json.load(open(os.path.join(d, "checkpoints.json")))
+    assert [os.path.basename(p) for p in index["checkpoints"]] == remaining
+
+
+def test_last_checkpoint_in_skips_corrupt_falls_back_to_intact(
+        tmp_path, registry):
+    """Acceptance: an injected truncated checkpoint is detected and
+    skipped — discovery returns the newest INTACT one."""
+    d = str(tmp_path)
+    net = MultiLayerNetwork(_conf()).init()
+    listener = CheckpointListener(d, save_every_n_iterations=1, keep_all=True)
+    # newest checkpoint (iter2) gets torn by the fault plan post-publish
+    with faults.inject("checkpoint.write@1:truncate:2000"):
+        listener.iteration_done(net, 1, 0, 0.5)
+        listener.iteration_done(net, 2, 0, 0.4)
+    newest = os.path.join(d, "checkpoint_iter2_epoch0.zip")
+    assert not is_valid_checkpoint(newest)
+    picked = CheckpointListener.last_checkpoint_in(d)
+    assert picked == os.path.join(d, "checkpoint_iter1_epoch0.zip")
+    assert registry.counter(
+        "tpudl_resilience_corrupt_checkpoints_total").value >= 1
+    # unverified legacy behavior would have handed back the corrupt one
+    assert CheckpointListener.last_checkpoint_in(d, verify=False) == newest
+    # every checkpoint corrupt → None, not garbage
+    with open(picked, "r+b") as f:
+        f.truncate(100)
+    assert CheckpointListener.last_checkpoint_in(d) is None
+
+
+def test_last_checkpoint_in_survives_moved_directory(tmp_path):
+    """A checkpoint dir copied/moved elsewhere has an index recording
+    the OLD paths — discovery must rebase onto the new location instead
+    of declaring every checkpoint missing."""
+    import shutil
+    old = str(tmp_path / "old")
+    net = MultiLayerNetwork(_conf()).init()
+    listener = CheckpointListener(old, save_every_n_iterations=1)
+    listener.iteration_done(net, 1, 0, 0.5)
+    listener.iteration_done(net, 2, 0, 0.4)
+    new = str(tmp_path / "new")
+    shutil.move(old, new)
+    index = json.load(open(os.path.join(new, "checkpoints.json")))
+    assert not any(os.path.exists(p) for p in index["checkpoints"])
+    picked = CheckpointListener.last_checkpoint_in(new)
+    assert picked == os.path.join(new, "checkpoint_iter2_epoch0.zip")
+    # and resume actually works from the moved directory
+    net2 = MultiLayerNetwork(_conf()).init()
+    Trainer(net2).resume_state(new)
+
+
+def test_last_checkpoint_in_survives_missing_index(tmp_path):
+    d = str(tmp_path)
+    net = MultiLayerNetwork(_conf()).init()
+    listener = CheckpointListener(d, save_every_n_iterations=1)
+    listener.iteration_done(net, 1, 0, 0.5)
+    os.remove(os.path.join(d, "checkpoints.json"))
+    assert CheckpointListener.last_checkpoint_in(d) == os.path.join(
+        d, "checkpoint_iter1_epoch0.zip")
+
+
+def test_background_checkpointer_writes_and_flushes(tmp_path):
+    d = str(tmp_path)
+    net = MultiLayerNetwork(_conf()).init()
+    listener = CheckpointListener(d, save_every_n_iterations=1,
+                                  background=True)
+    try:
+        for i in range(1, 4):
+            listener.iteration_done(net, i, 0, 0.5)
+        listener.flush()
+        assert len([n for n in os.listdir(d) if n.endswith(".zip")]) == 3
+        assert listener.last_checkpoint() == os.path.join(
+            d, "checkpoint_iter3_epoch0.zip")
+        assert is_valid_checkpoint(listener.last_checkpoint())
+    finally:
+        listener.close()
+
+
+def test_background_save_failure_surfaces_on_flush(tmp_path):
+    saver = AsyncCheckpointer()
+
+    def boom():
+        raise OSError("disk gone")
+
+    saver.submit(boom)
+    with pytest.raises(RuntimeError, match="background checkpoint save"):
+        saver.flush()
+    saver.close()
+
+
+# ======================================================== kill-and-resume
+def _run_uninterrupted(epochs=2):
+    scores = CollectScoresListener()
+    net = MultiLayerNetwork(_conf()).init()
+    Trainer(net, listeners=[scores]).fit(_data_iter(), epochs=epochs)
+    return net, scores.scores
+
+
+def test_kill_and_resume_matches_uninterrupted_losses(tmp_path):
+    """THE acceptance test: crash injected at step 7 of a 12-step run
+    (mid-epoch 1, dropout active); resume via ``resume_from`` reproduces
+    the uninterrupted per-step losses to 1e-6 and the final params."""
+    net_a, losses_a = _run_uninterrupted(epochs=2)
+
+    d = str(tmp_path)
+    scores_b = CollectScoresListener()
+    net_b = MultiLayerNetwork(_conf()).init()
+    ckpt = CheckpointListener(d, save_every_n_iterations=1, keep_last=3)
+    with faults.inject("trainer.step@7:crash"):
+        with pytest.raises(InjectedCrash):
+            Trainer(net_b, listeners=[scores_b, ckpt]).fit(
+                ResumableIterator(_data_iter()), epochs=2)
+    assert len(scores_b.scores) == 7            # steps 0..6 committed
+
+    # "new process": fresh net + fresh iterator, resume from the dir
+    scores_c = CollectScoresListener()
+    net_c = MultiLayerNetwork(_conf()).init()
+    trainer_c = Trainer(net_c, listeners=[scores_c])
+    trainer_c.fit(ResumableIterator(_data_iter()), epochs=2, resume_from=d)
+
+    assert len(scores_c.scores) == 5            # steps 7..11 only
+    np.testing.assert_allclose(scores_b.scores + scores_c.scores, losses_a,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(net_c.params()),
+                               np.asarray(net_a.params()), atol=1e-6)
+    assert net_c.iteration == net_a.iteration
+    assert net_c.epoch == net_a.epoch
+
+
+def test_resume_skips_truncated_checkpoint(tmp_path):
+    """Crash at step 7 with the LAST checkpoint torn: resume must fall
+    back to the previous intact checkpoint and still converge to the
+    uninterrupted trajectory (it replays step 6 exactly)."""
+    _, losses_a = _run_uninterrupted(epochs=2)
+
+    d = str(tmp_path)
+    net_b = MultiLayerNetwork(_conf()).init()
+    ckpt = CheckpointListener(d, save_every_n_iterations=1, keep_all=True)
+    # checkpoints land at iters 1..6; the one named iter6 gets torn
+    with faults.inject("trainer.step@7:crash; checkpoint.write@5:truncate:3000"):
+        with pytest.raises(InjectedCrash):
+            Trainer(net_b, listeners=[ckpt]).fit(
+                ResumableIterator(_data_iter()), epochs=2)
+    assert not is_valid_checkpoint(
+        os.path.join(d, "checkpoint_iter6_epoch0.zip"))
+
+    scores_c = CollectScoresListener()
+    net_c = MultiLayerNetwork(_conf()).init()
+    Trainer(net_c, listeners=[scores_c]).fit(
+        ResumableIterator(_data_iter()), epochs=2, resume_from=d)
+    assert len(scores_c.scores) == 6            # steps 6..11 replayed
+    np.testing.assert_allclose(scores_c.scores, losses_a[6:], atol=1e-6)
+
+
+def test_kill_and_resume_with_shuffling_iterator(tmp_path):
+    """The 1e-6 contract must hold for shuffling pipelines too: the
+    permutation derives from (seed, epoch), so the resumed run replays
+    the interrupted epoch's exact batch order."""
+    from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(96, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 96)]
+
+    def shuffled():
+        return ResumableIterator(
+            ArrayDataSetIterator(x, y, batch_size=16, shuffle=True, seed=13))
+
+    scores_a = CollectScoresListener()
+    net_a = MultiLayerNetwork(_conf()).init()
+    Trainer(net_a, listeners=[scores_a]).fit(shuffled(), epochs=2)
+
+    d = str(tmp_path)
+    net_b = MultiLayerNetwork(_conf()).init()
+    scores_b = CollectScoresListener()
+    ckpt = CheckpointListener(d, save_every_n_iterations=1, keep_last=3)
+    with faults.inject("trainer.step@8:crash"):    # mid-epoch 1
+        with pytest.raises(InjectedCrash):
+            Trainer(net_b, listeners=[scores_b, ckpt]).fit(shuffled(),
+                                                           epochs=2)
+
+    scores_c = CollectScoresListener()
+    net_c = MultiLayerNetwork(_conf()).init()
+    Trainer(net_c, listeners=[scores_c]).fit(shuffled(), epochs=2,
+                                             resume_from=d)
+    np.testing.assert_allclose(scores_b.scores + scores_c.scores,
+                               scores_a.scores, atol=1e-6)
+
+
+def test_last_checkpoint_in_ignores_stray_old_checkpoint_position(tmp_path):
+    """A stray OLD checkpoint the index doesn't know about (backup
+    restore, crashed prune) must not outrank newer indexed ones just
+    because the directory scan appended it last."""
+    import shutil
+    d = str(tmp_path)
+    net = MultiLayerNetwork(_conf()).init()
+    listener = CheckpointListener(d, save_every_n_iterations=1, keep_last=2)
+    for i in range(1, 6):
+        listener.iteration_done(net, i, 0, 0.5)   # keeps iter4, iter5
+    # a pruned-era checkpoint reappears from a backup, bypassing the index
+    shutil.copy(os.path.join(d, "checkpoint_iter4_epoch0.zip"),
+                os.path.join(d, "checkpoint_iter2_epoch0.zip"))
+    assert CheckpointListener.last_checkpoint_in(d) == os.path.join(
+        d, "checkpoint_iter5_epoch0.zip")
+
+
+def test_completed_fit_restores_seed_rng_semantics():
+    """Pre-resilience reproducibility baseline: after a COMPLETED fit,
+    the next fit() derives its RNG from the seed again — two nets taking
+    different fit-call paths to the same total epochs stay bitwise
+    equal.  (A crash skips the reset, which is what makes resume exact.)"""
+    net_a = MultiLayerNetwork(_conf()).init()
+    Trainer(net_a).fit(_data_iter(), epochs=1)
+    assert getattr(net_a, "_rng_key", None) is None
+    Trainer(net_a).fit(_data_iter(), epochs=1)
+    net_b = MultiLayerNetwork(_conf()).init()
+    for _ in range(2):
+        Trainer(net_b).fit(_data_iter(), epochs=1)
+    np.testing.assert_array_equal(np.asarray(net_a.params()),
+                                  np.asarray(net_b.params()))
+
+
+def test_resume_from_epoch_boundary_checkpoint(tmp_path):
+    _, losses_a = _run_uninterrupted(epochs=2)
+    d = str(tmp_path)
+    net_b = MultiLayerNetwork(_conf()).init()
+    ckpt = CheckpointListener(d, save_every_n_epochs=1)
+    Trainer(net_b, listeners=[ckpt]).fit(_data_iter(), epochs=1)
+
+    scores_c = CollectScoresListener()
+    net_c = MultiLayerNetwork(_conf()).init()
+    # epoch-boundary resume needs no ResumableIterator (nothing to skip)
+    Trainer(net_c, listeners=[scores_c]).fit(_data_iter(), epochs=2,
+                                             resume_from=d)
+    np.testing.assert_allclose(scores_c.scores, losses_a[6:], atol=1e-6)
+
+
+def test_resume_requires_resumable_iterator_mid_epoch(tmp_path):
+    d = str(tmp_path)
+    net = MultiLayerNetwork(_conf()).init()
+    ckpt = CheckpointListener(d, save_every_n_iterations=1)
+    with faults.inject("trainer.step@3:crash"):
+        with pytest.raises(InjectedCrash):
+            Trainer(net, listeners=[ckpt]).fit(
+                ResumableIterator(_data_iter()), epochs=2)
+    net2 = MultiLayerNetwork(_conf()).init()
+    with pytest.raises(ValueError, match="mid-epoch"):
+        Trainer(net2).fit(_data_iter(), epochs=2, resume_from=d)
+
+
+def test_resume_from_empty_dir_raises(tmp_path):
+    net = MultiLayerNetwork(_conf()).init()
+    with pytest.raises(FileNotFoundError, match="no intact checkpoint"):
+        Trainer(net).fit(_data_iter(), epochs=1,
+                         resume_from=str(tmp_path))
+
+
+# ============================================================ retry policy
+def test_retry_policy_backoff_schedule_and_success(registry):
+    calls = {"n": 0}
+    slept = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise TransientError("blip")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=4, base_delay_s=0.1, jitter=0.0)
+    assert with_retries(flaky, policy=policy, site="t",
+                        sleep=slept.append) == "ok"
+    assert calls["n"] == 3
+    np.testing.assert_allclose(slept, [0.1, 0.2])   # exponential
+    assert registry.counter("tpudl_resilience_retries_total").value == 2
+    assert registry.counter("tpudl_resilience_attempts_total").value == 3
+    assert registry.counter("tpudl_resilience_giveups_total").value == 0
+
+
+def test_retry_gives_up_after_max_attempts(registry):
+    def always():
+        raise TransientError("down")
+
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter=0.0)
+    with pytest.raises(TransientError):
+        with_retries(always, policy=policy, sleep=lambda s: None)
+    assert registry.counter("tpudl_resilience_giveups_total").value == 1
+    assert registry.counter("tpudl_resilience_attempts_total").value == 3
+
+
+def test_retry_nonretryable_raises_immediately(registry):
+    calls = {"n": 0}
+
+    def fatal():
+        calls["n"] += 1
+        raise ValueError("config bug, not a flake")
+
+    with pytest.raises(ValueError):
+        with_retries(fatal, policy=RetryPolicy(max_attempts=5),
+                     sleep=lambda s: None)
+    assert calls["n"] == 1
+
+
+def test_retry_deadline_stops_backoff():
+    slept = []
+
+    def always():
+        raise TransientError("down")
+
+    # 2nd delay (0.2) would overrun the 0.25s deadline → give up early
+    policy = RetryPolicy(max_attempts=10, base_delay_s=0.2, jitter=0.0,
+                         deadline_s=0.25)
+    with pytest.raises(TransientError):
+        with_retries(always, policy=policy, sleep=slept.append)
+    assert len(slept) <= 1
+
+
+def test_retryable_classification():
+    assert default_retryable(TimeoutError())
+    assert default_retryable(ConnectionResetError())
+    assert default_retryable(TransientError("x"))
+    assert default_retryable(InjectedFault("x"))
+    assert not default_retryable(InjectedCrash("x"))   # process death
+    assert not default_retryable(ValueError("x"))
+    assert not default_retryable(FileNotFoundError(2, "gone"))
+
+
+def test_jitter_is_deterministic_and_bounded():
+    p = RetryPolicy(base_delay_s=0.1, jitter=0.5)
+    d1 = p.delay_for(1, "site-a")
+    assert d1 == p.delay_for(1, "site-a")       # reproducible
+    assert 0.1 <= d1 <= 0.15
+    assert p.delay_for(1, "site-b") != d1 or True   # spread (not pinned)
+
+
+# ============================================================= fault plans
+def test_fault_plan_parsing_and_env(monkeypatch):
+    plan = FaultPlan.parse(
+        "trainer.step@7:crash; dcn.exchange@2:error:0:3;"
+        "feeder.stage@1:delay:0.25")
+    kinds = {(r.site, r.action) for r in plan.rules}
+    assert kinds == {("trainer.step", "crash"), ("dcn.exchange", "error"),
+                     ("feeder.stage", "delay")}
+    assert plan.rules[1].times == 3
+    monkeypatch.setenv(faults.ENV_VAR, "trainer.step@5:crash")
+    env_plan = FaultPlan.from_env()
+    assert env_plan.rules[0].at == 5
+    with pytest.raises(ValueError, match="bad fault rule"):
+        FaultPlan.parse("nonsense")
+
+
+def test_fault_plan_deterministic_indexing(registry):
+    plan = FaultPlan.parse("s@2:error")
+    plan.fire("s")          # 0
+    plan.fire("s")          # 1
+    with pytest.raises(InjectedFault):
+        plan.fire("s")      # 2 → fires
+    plan.fire("s")          # 3 → past the window
+    # explicit index overrides the counter
+    with pytest.raises(InjectedFault):
+        plan.fire("s", index=2)
+    assert registry.counter(
+        "tpudl_resilience_faults_injected_total").value == 2
+
+
+# =============================================== wired paths under faults
+def test_feeder_retries_transient_stage_fault(registry):
+    """One injected transient staging failure: the producer retries and
+    every batch still arrives, in order."""
+    it = _data_iter(n=64, batch=16)
+    feeder = DeviceFeeder(bucketing=False,
+                          retry_policy=RetryPolicy(max_attempts=2,
+                                                   base_delay_s=0.0,
+                                                   jitter=0.0))
+    with faults.inject("feeder.stage@1:error"):
+        fed = list(feeder.feed(it))
+    assert [f.n_examples for f in fed] == [16, 16, 16, 16]
+    assert registry.counter("tpudl_resilience_retries_total").value == 1
+
+
+def test_feeder_persistent_fault_reraises_with_traceback():
+    """Satellite: producer-thread failure re-raises on the consumer with
+    the ORIGINAL traceback (pointing into stage), the queue drains, and
+    the daemon thread exits."""
+    import traceback
+    it = _data_iter(n=64, batch=16)
+    feeder = DeviceFeeder(bucketing=False,
+                          retry_policy=RetryPolicy(max_attempts=2,
+                                                   base_delay_s=0.0,
+                                                   jitter=0.0))
+    before = threading.active_count()
+    with faults.inject("feeder.stage@1:error:0:8"):   # outlasts retries
+        with pytest.raises(InjectedFault) as exc_info:
+            list(feeder.feed(it))
+    frames = traceback.extract_tb(exc_info.value.__traceback__)
+    assert any("stage" in f.name for f in frames), (
+        "original producer traceback lost")
+    deadline = time.time() + 5.0
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.01)
+    assert threading.active_count() <= before, "feeder thread leaked"
+
+
+def test_multislice_exchange_retries_injected_faults(registry):
+    """Two slices over InProcessTransport with transient exchange faults:
+    with_retries absorbs them, training completes, slices stay
+    byte-identical, and the retry counters tick."""
+    import jax
+    from deeplearning4j_tpu.parallel.dcn_trainer import MultiSliceTrainer
+    conf = (NeuralNetConfiguration.builder().seed(11).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(32, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+    batch = DataSet(x, y)
+    trainer = MultiSliceTrainer(
+        net, n_slices=2, data_per_slice=1, devices=jax.devices()[:2],
+        retry_policy=RetryPolicy(max_attempts=3, base_delay_s=0.0,
+                                 jitter=0.0))
+    try:
+        # each slice's first exchange attempt fails once (events 0 and 1
+        # are the two slices' first calls), then a slow hop at event 4
+        with faults.inject(
+                "dcn.exchange@0:error:0:2; dcn.exchange@4:delay:0.05"):
+            losses = [trainer.fit_batch(batch, jax.random.key(i))
+                      for i in range(4)]
+        assert trainer.max_param_divergence() == 0.0
+        assert np.isfinite(losses).all()
+        assert registry.counter("tpudl_resilience_retries_total").value >= 2
+    finally:
+        trainer.close()
+
+
+def test_multislice_exchange_giveup_propagates():
+    """A non-transient exchange failure (crash action) must NOT be
+    retried — it propagates like real preemption."""
+    import jax
+    from deeplearning4j_tpu.parallel.dcn_trainer import MultiSliceTrainer
+    net = MultiLayerNetwork(_conf()).init()
+    x, y = (np.zeros((8, 6), np.float32),
+            np.eye(3, dtype=np.float32)[np.zeros(8, int)])
+    trainer = MultiSliceTrainer(
+        net, n_slices=2, data_per_slice=1, devices=jax.devices()[:2],
+        retry_policy=RetryPolicy(max_attempts=3, base_delay_s=0.0,
+                                 jitter=0.0))
+    try:
+        with faults.inject("dcn.exchange@0:crash:0:99"):
+            with pytest.raises(InjectedCrash):
+                trainer.fit_batch(DataSet(x, y), jax.random.key(0))
+    finally:
+        trainer.close()
+
+
+# ================================================================ launcher
+def _cluster_workers():
+    import sys
+    sys.path.insert(0, os.path.dirname(__file__))
+    import cluster_workers
+    return cluster_workers
+
+
+_CLUSTER_ENV = {"PYTHONPATH": os.path.dirname(os.path.abspath(__file__))
+                + os.pathsep + os.environ.get("PYTHONPATH", "")}
+
+
+def test_spawn_local_cluster_timeout_kills_gang_with_stderr(registry):
+    """Satellite: a wedged gang member times the cluster out; ALL
+    children are terminated-then-killed and the RuntimeError carries
+    each child's stderr tail (jax swallows SIGTERM via its preemption
+    notifier, so the kill fallback is load-bearing)."""
+    from deeplearning4j_tpu.parallel.launcher import spawn_local_cluster
+    workers = _cluster_workers()
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError) as exc_info:
+        spawn_local_cluster(workers.hang_worker, n_processes=1, port=13421,
+                            timeout=6.0, extra_env=_CLUSTER_ENV)
+    msg = str(exc_info.value)
+    assert "timed out" in msg and "process 0" in msg
+    assert "wedged on purpose" in msg, "child stderr tail missing"
+    # terminate-then-kill bounded: no lingering 120s default wait
+    assert time.monotonic() - t0 < 30.0
+
+
+def test_spawn_local_cluster_retries_startup_flake(registry):
+    """An injected transient failure on the first spawn attempt is
+    retried on a shifted port; the cluster then comes up."""
+    from deeplearning4j_tpu.parallel.launcher import spawn_local_cluster
+    workers = _cluster_workers()
+    with faults.inject("launcher.spawn@0:error"):
+        results = spawn_local_cluster(workers.trivial_worker,
+                                      n_processes=1, port=13431,
+                                      timeout=60.0, extra_env=_CLUSTER_ENV)
+    assert results == [{"pid": 0, "n": 1}]
+    assert registry.counter("tpudl_resilience_retries_total").value == 1
+
+
+# ============================================ early-stopping durable saver
+def test_local_file_saver_rejects_corrupt_best_model(tmp_path):
+    from deeplearning4j_tpu.train.early_stopping import LocalFileModelSaver
+    saver = LocalFileModelSaver(str(tmp_path))
+    net = MultiLayerNetwork(_conf()).init()
+    saver.save_best_model(net, 1.0)
+    assert saver.get_best_model() is not None
+    with open(saver.best_path, "r+b") as f:
+        f.truncate(os.path.getsize(saver.best_path) - 128)
+    with pytest.raises(CheckpointCorruptError):
+        saver.get_best_model()
+
+
+def test_write_model_snapshot_roundtrip(tmp_path):
+    """A NetSnapshot (host copies) serializes identically to the live
+    net — the background-save path's correctness contract."""
+    from deeplearning4j_tpu.resilience.checkpoint import snapshot_net
+    net = MultiLayerNetwork(_conf()).init()
+    Trainer(net).fit(_data_iter(), epochs=1)
+    live, snap = str(tmp_path / "live.zip"), str(tmp_path / "snap.zip")
+    write_model(net, live)
+    write_model(snapshot_net(net), snap)
+    a, b = restore_model(live), restore_model(snap)
+    np.testing.assert_array_equal(np.asarray(a.params()),
+                                  np.asarray(b.params()))
+    assert read_training_state(live) == read_training_state(snap)
