@@ -465,17 +465,14 @@ def bench_workload_steps() -> dict:
     return out
 
 
-def bench_feed_overlap(timeout_s: float = 300.0) -> dict:
-    """Device-feed pipeline micro-bench (docs/data_pipeline.md):
-    DeviceFeeder on vs off steps/sec + recompile counts over an
-    ETL-heavy ragged epoch.  Runs ``bench/feed_overlap.py`` in a
-    subprocess pinned to CPU, so the record stays measurable — and the
-    recompile-guard win stays visible — even when the TPU tunnel is
-    down."""
+def _cpu_subbench(script_name: str, timeout_s: float) -> dict:
+    """Run a bench/ script in a subprocess pinned to CPU and scrape its
+    one json line — the pattern that keeps a record measurable even
+    when the TPU tunnel is down."""
     import subprocess
 
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "bench", "feed_overlap.py")
+                          "bench", script_name)
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("XLA_FLAGS", None)   # no virtual-device carryover
     proc = subprocess.run([sys.executable, script], capture_output=True,
@@ -484,6 +481,20 @@ def bench_feed_overlap(timeout_s: float = 300.0) -> dict:
     if lines:
         return json.loads(lines[-1])
     return {"error": (proc.stderr or "no output")[-300:]}
+
+
+def bench_feed_overlap(timeout_s: float = 300.0) -> dict:
+    """Device-feed pipeline micro-bench (docs/data_pipeline.md):
+    DeviceFeeder on vs off steps/sec + recompile counts over an
+    ETL-heavy ragged epoch."""
+    return _cpu_subbench("feed_overlap.py", timeout_s)
+
+
+def bench_serving(timeout_s: float = 300.0) -> dict:
+    """Inference-serving micro-bench (docs/serving.md): batch-1
+    sequential vs dynamic micro-batching — p50/p99 latency, requests/sec
+    and compiled-program counts across ragged request shapes."""
+    return _cpu_subbench("serving.py", timeout_s)
 
 
 def _probe_device(timeout_s: float = 30.0) -> tuple[str, str] | None:
@@ -524,6 +535,10 @@ def main():
             detail["feed_overlap"] = bench_feed_overlap()
         except Exception as e:
             detail["feed_overlap"] = {"error": str(e)[:200]}
+        try:  # CPU-runnable: the serving row survives a down tunnel too
+            detail["serving"] = bench_serving()
+        except Exception as e:
+            detail["serving"] = {"error": str(e)[:200]}
         print(json.dumps({"metric": "resnet50_train_images_per_sec_per_chip",
                           "value": 0.0, "unit": "images/sec/chip",
                           "vs_baseline": 0.0, "status": status, "error": err,
@@ -560,6 +575,10 @@ def main():
                 result["detail"]["feed_overlap"] = bench_feed_overlap()
             except Exception as e:
                 result["detail"]["feed_overlap"] = {"error": str(e)[:200]}
+            try:  # serving: sequential vs dynamic micro-batching
+                result["detail"]["serving"] = bench_serving()
+            except Exception as e:
+                result["detail"]["serving"] = {"error": str(e)[:200]}
             print(json.dumps(result))
             return 0
         except Exception as e:  # OOM etc. → halve the batch and retry

@@ -178,6 +178,18 @@ _RULE_LIST = [
         "counted), not in a blanket except.",
         "Re-raise, classify via resilience.retry.with_retries, or at "
         "minimum record the error (log/metric) before continuing."),
+    RuleInfo(
+        "TPU309", "jit-in-request-path", ERROR,
+        "jax.jit built inside a serving/request-handler function — a "
+        "fresh jit wrapper per request re-traces and re-compiles, "
+        "bypassing the compiled-forward cache",
+        "Every jax.jit(...) call returns a NEW callable with an empty "
+        "trace cache; wrapping the model inside a request handler or "
+        "serving loop pays seconds of XLA compile on a millisecond-"
+        "budget path, per request.",
+        "Build the jit-wrapped forward once at setup (serve.engine "
+        "caches one compiled forward per model config via "
+        "train.step_cache) and close over it in the handler."),
 ]
 
 RULES: dict[str, RuleInfo] = {r.id: r for r in _RULE_LIST}

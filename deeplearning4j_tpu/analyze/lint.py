@@ -10,10 +10,12 @@ module's ``jax``/``numpy``/``time`` aliases are.
 
 AST rules: TPU301 (host sync inside @jit), TPU302 (timing jitted calls
 without a sync fence), TPU303 (Python control flow on traced args),
-TPU304 (bare shard_map/pmap imports bypassing utils/jax_compat).
-Registry-backed rules that ride along in ``lint_package``/``--self``:
-TPU305 (metric names — the former ``obs.check`` lint) and TPU306 (op-spec
-catalog integrity).
+TPU304 (bare shard_map/pmap imports bypassing utils/jax_compat),
+TPU307 (per-batch host transfer in a training loop), TPU308 (swallowed
+exception in a training loop), TPU309 (jax.jit built per request in a
+serving handler).  Registry-backed rules that ride along in
+``lint_package``/``--self``: TPU305 (metric names — the former
+``obs.check`` lint) and TPU306 (op-spec catalog integrity).
 """
 
 from __future__ import annotations
@@ -547,6 +549,52 @@ def _rule_swallowed_exception_in_loop(mod: ModuleInfo) -> list[Diagnostic]:
                         f"swallows per-iteration failures silently",
                         path=mod.anchor(handler)))
     return out
+
+
+# whole-name tokens marking a function as a serving/request-handler
+# path — code that runs once PER REQUEST, where building a jit wrapper
+# means trace+compile on a millisecond-budget path
+_SERVING_TOKENS = {"serve", "serving", "predict", "infer", "inference",
+                   "handle", "handler", "request", "respond"}
+# ...unless the name also says it is a one-time builder (the factory
+# that CREATES the compiled forward legitimately calls jax.jit)
+_BUILDER_TOKENS = {"make", "build", "create", "compile", "init", "setup"}
+# stdlib http.server request hooks: per-request by contract, and their
+# lowercased name tokens ({"do", "post"}) carry no serving token
+_HTTP_HANDLER_NAMES = {"do_GET", "do_POST", "do_PUT", "do_DELETE"}
+
+
+@register_lint_rule("TPU309")
+def _rule_jit_in_request_path(mod: ModuleInfo) -> list[Diagnostic]:
+    """jax.jit built inside a serving/request-handler function or its
+    loops: every ``jax.jit(...)`` call returns a NEW callable with an
+    empty trace cache, so wrapping the model per request re-traces and
+    re-compiles the forward each time — the compiled-forward cache
+    (serve.engine / train.step_cache) is bypassed entirely."""
+    out = []
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        tokens = set(fn.name.lower().strip("_").split("_"))
+        if fn.name not in _HTTP_HANDLER_NAMES:
+            if not tokens & _SERVING_TOKENS or tokens & _BUILDER_TOKENS:
+                continue
+        for node in _walk_shallow(fn):
+            if isinstance(node, ast.Call) and _is_jit_build(mod, node):
+                out.append(Diagnostic(
+                    "TPU309",
+                    f"jax.jit built inside request-path "
+                    f"'{fn.name}' — a fresh jit wrapper per request "
+                    f"re-traces and re-compiles the forward, bypassing "
+                    f"the compiled-forward cache",
+                    path=mod.anchor(node)))
+    return out
+
+
+def _is_jit_build(mod: ModuleInfo, node: ast.Call) -> bool:
+    """A ``jax.jit(...)`` / ``jit(...)`` call expression (building a new
+    wrapper), as opposed to CALLING an already-built jitted callable."""
+    return mod.is_jit_ref(node.func)
 
 
 # ------------------------------------------------------------ drivers

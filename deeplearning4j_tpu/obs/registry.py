@@ -39,6 +39,11 @@ def _escape_help(text: str) -> str:
     return text.replace("\\", "\\\\").replace("\n", "\\n")
 
 
+def _escape_label(value) -> str:
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt(v: float) -> str:
     if v == math.inf:
         return "+Inf"
@@ -106,6 +111,87 @@ class Gauge(Metric):
 
     def render(self) -> list[str]:
         return [f"{self.name} {_fmt(self._value)}"]
+
+
+class _LabeledMixin:
+    """Shared child bookkeeping for labeled metrics.  A labeled metric
+    owns one value per label-value tuple and renders one Prometheus
+    series per child (never a bare unlabeled series — mixing the two
+    under one name is invalid exposition format)."""
+
+    label_names: tuple
+    _children: dict
+
+    def _key(self, labels: dict) -> tuple:
+        # Prometheus client semantics: every declared label must be
+        # supplied (a forgotten status=... must not mint an invisible
+        # `status=""` series), and undeclared labels are a bug
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f"metric {self.name} has labels {self.label_names}, "
+                f"got {sorted(labels)}")
+        return tuple(str(labels[n]) for n in self.label_names)
+
+    def labeled_value(self, **labels) -> float:
+        with self._lock:
+            return self._children.get(self._key(labels), 0.0)
+
+    def _series(self, key: tuple) -> str:
+        pairs = ",".join(f'{n}="{_escape_label(v)}"'
+                         for n, v in zip(self.label_names, key))
+        return f"{self.name}{{{pairs}}}"
+
+    def render(self) -> list[str]:
+        with self._lock:
+            items = sorted(self._children.items())
+        return [f"{self._series(k)} {_fmt(v)}" for k, v in items]
+
+
+class LabeledCounter(_LabeledMixin, Counter):
+    """Counter with label dimensions, e.g.
+    ``tpudl_serve_requests_total{status="ok"}``.  ``value`` is the total
+    across every label combination."""
+
+    def __init__(self, name: str, help: str = "",
+                 label_names: Sequence[str] = ("status",)):
+        super().__init__(name, help)
+        self.label_names = tuple(label_names)
+        self._children: dict[tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        key = self._key(labels)
+        with self._lock:
+            self._children[key] = self._children.get(key, 0.0) + amount
+            self._value += amount
+
+
+class LabeledGauge(_LabeledMixin, Gauge):
+    """Gauge with label dimensions, e.g.
+    ``tpudl_serve_model_version{model="mnist"}``.  ``value`` is the most
+    recently set child value."""
+
+    def __init__(self, name: str, help: str = "",
+                 label_names: Sequence[str] = ("model",)):
+        super().__init__(name, help)
+        self.label_names = tuple(label_names)
+        self._children: dict[tuple, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._children[key] = float(value)
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._children[key] = self._children.get(key, 0.0) + amount
+            self._value = self._children[key]
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
 
 
 class Histogram(Metric):
@@ -200,6 +286,13 @@ class MetricsRegistry:
                         f"histogram {name!r} already registered with "
                         f"buckets {existing.buckets}, requested "
                         f"{tuple(want)}")
+                want_labels = kwargs.get("label_names")
+                if want_labels is not None \
+                        and tuple(want_labels) != existing.label_names:
+                    raise ValueError(
+                        f"metric {name!r} already registered with labels "
+                        f"{existing.label_names}, requested "
+                        f"{tuple(want_labels)}")
                 return existing
             m = cls(name, help, **kwargs)
             self._metrics[name] = m
@@ -210,6 +303,18 @@ class MetricsRegistry:
 
     def gauge(self, name: str, help: str = "") -> Gauge:
         return self._get_or_create(Gauge, name, help)
+
+    def labeled_counter(self, name: str, help: str = "",
+                        label_names: Sequence[str] = ("status",)
+                        ) -> LabeledCounter:
+        return self._get_or_create(LabeledCounter, name, help,
+                                   label_names=tuple(label_names))
+
+    def labeled_gauge(self, name: str, help: str = "",
+                      label_names: Sequence[str] = ("model",)
+                      ) -> LabeledGauge:
+        return self._get_or_create(LabeledGauge, name, help,
+                                   label_names=tuple(label_names))
 
     def histogram(self, name: str, help: str = "",
                   buckets: Sequence[float] = DEFAULT_TIME_BUCKETS) -> Histogram:
@@ -335,6 +440,31 @@ def install_standard_metrics(registry: Optional[MetricsRegistry] = None) -> dict
         r.counter("tpudl_resilience_faults_injected_total",
                   "Faults fired by the active FaultPlan (test/drill "
                   "runs only)"),
+        r.labeled_counter("tpudl_serve_requests_total",
+                          "Inference requests by terminal status "
+                          "(ok/error/shed/expired/cancelled)",
+                          ("status",)),
+        r.counter("tpudl_serve_shed_total",
+                  "Requests rejected immediately because the engine's "
+                  "bounded queue was full (load shedding)"),
+        r.counter("tpudl_serve_batches_total",
+                  "Micro-batches dispatched by inference engines"),
+        r.counter("tpudl_serve_recompiles_total",
+                  "New XLA traces of serving forward functions (growth "
+                  "past one per shape bucket means the bucket set is "
+                  "churning)"),
+        r.gauge("tpudl_serve_batch_size",
+                "Rows in the most recently dispatched micro-batch "
+                "(bucket-padded size)"),
+        r.gauge("tpudl_serve_queue_depth",
+                "Requests waiting in the engine queue after the most "
+                "recent submit"),
+        r.histogram("tpudl_serve_latency_seconds",
+                    "End-to-end request latency (submit to result "
+                    "ready, queue wait + batching delay + device time)"),
+        r.labeled_gauge("tpudl_serve_model_version",
+                        "Version currently serving per deployed model "
+                        "name", ("model",)),
     ]
     return {m.name: m for m in metrics}
 

@@ -28,8 +28,8 @@ over ICI/DCN inside jit-compiled programs:
                           accumulator (EncodedGradientsAccumulator +
                           encodeThresholdP1..P3/encodeBitmap parity) for the
                           optional DCN path; C++ kernel in ``native/``.
-- ``inference``         — ParallelInference parity: dynamic batching queue
-                          over jit'd replicas.
+- ``inference``         — ParallelInference parity: a compatibility shim
+                          over ``serve.InferenceEngine`` micro-batching.
 - ``launcher``          — multi-host SPMD bootstrap (jax.distributed),
                           replacing Spark orchestration.
 """

@@ -1,83 +1,72 @@
-"""ParallelInference — dynamic-batching inference server.
+"""ParallelInference — compatibility shim over ``tpudl.serve``.
 
-Parity with DL4J ``deeplearning4j-scaleout-parallelwrapper
+Parity surface of DL4J ``deeplearning4j-scaleout-parallelwrapper
 .../inference/ParallelInference.java`` (+ ``BatchedInferenceObservable``):
-callers submit single inputs from many threads; a worker drains the queue,
-concatenates up to ``batch_limit`` inputs, runs ONE jit'd forward, and
-scatters results back to the waiting callers.
+callers submit single inputs from many threads, a worker batches them
+through ONE jit'd forward and scatters results back.  The batching loop
+that used to live here is now the serve subsystem's
+:class:`~deeplearning4j_tpu.serve.engine.InferenceEngine` — same
+surface, plus deadline-bounded flushing, bucket-padded compiled-shape
+reuse, bounded-queue load shedding, and the ``tpudl_serve_*`` metrics/
+spans (docs/serving.md).
+
+Fixed relative to the old loop (folded into the rewrite):
+
+- **worker exceptions propagate** — any failure on the worker thread
+  (not just the forward call) resolves the waiting ``Future`` with the
+  exception instead of killing the worker and stranding every later
+  caller;
+- **queue_limit is honored under burst** — the queue is a hard bound:
+  by default a submit against a full queue blocks the submitting
+  thread (the historical contract, bounded memory); with ``shed=True``
+  it fails immediately with
+  :class:`~deeplearning4j_tpu.serve.engine.Overloaded`.
 
 On TPU one jit'd replica saturates the chip, so the reference's
-device-affine replica threads collapse to a single worker per device;
-replicas across devices come from running one ParallelInference per
+device-affine replica threads stay collapsed to a single worker per
+device; replicas across devices come from running one engine per
 process in SPMD (or sharding the batch axis via ParallelWrapper's mesh).
 """
 
 from __future__ import annotations
 
-import queue
-import threading
 from concurrent.futures import Future
-from typing import Optional
 
-import jax
-import jax.numpy as jnp
 import numpy as np
+
+from deeplearning4j_tpu.serve.engine import InferenceEngine, Overloaded
+
+__all__ = ["ParallelInference", "Overloaded"]
 
 
 class ParallelInference:
     def __init__(self, model, batch_limit: int = 32, queue_limit: int = 64,
-                 timeout_ms: float = 5.0):
+                 timeout_ms: float = 5.0, shed: bool = False):
         """model: anything with ``output(x)`` (MultiLayerNetwork /
         ComputationGraph) — called with [B, ...] batches."""
         self.model = model
         self.batch_limit = batch_limit
+        self.queue_limit = queue_limit
         self.timeout_s = timeout_ms / 1000.0
-        self._queue: queue.Queue = queue.Queue(maxsize=queue_limit)
-        self._shutdown = threading.Event()
-        self._worker = threading.Thread(target=self._run, daemon=True)
-        self._worker.start()
+        self.shed = shed
+        self._engine = InferenceEngine(
+            model, name="parallel_inference", max_batch=batch_limit,
+            max_latency_ms=timeout_ms, queue_limit=queue_limit)
+
+    @property
+    def engine(self) -> InferenceEngine:
+        """The underlying serve engine (metrics, buckets, shutdown)."""
+        return self._engine
 
     def output(self, x) -> np.ndarray:
         """Blocking single-example (or small-batch) inference."""
-        return self.output_async(x).result()
+        return np.asarray(self.output_async(x).result())
 
     def output_async(self, x) -> Future:
-        future: Future = Future()
-        self._queue.put((np.asarray(x), future))
-        return future
-
-    def _run(self):
-        while not self._shutdown.is_set():
-            try:
-                first = self._queue.get(timeout=0.1)
-            except queue.Empty:
-                continue
-            pending = [first]
-            total = first[0].shape[0]
-            # drain quickly-arriving requests up to the batch limit
-            while total < self.batch_limit:
-                try:
-                    item = self._queue.get(timeout=self.timeout_s)
-                    pending.append(item)
-                    total += item[0].shape[0]
-                except queue.Empty:
-                    break
-            try:
-                batch = np.concatenate([x for x, _ in pending], axis=0)
-                out = np.asarray(self.model.output(batch))
-                offset = 0
-                for x, future in pending:
-                    n = x.shape[0]
-                    future.set_result(out[offset:offset + n])
-                    offset += n
-            except BaseException as e:
-                for _, future in pending:
-                    if not future.done():
-                        future.set_exception(e)
+        return self._engine.submit(np.asarray(x), block=not self.shed)
 
     def shutdown(self):
-        self._shutdown.set()
-        self._worker.join(timeout=2.0)
+        self._engine.shutdown(drain=True)
 
     def __enter__(self):
         return self

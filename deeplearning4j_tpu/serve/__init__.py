@@ -1,0 +1,31 @@
+"""tpudl.serve — production inference serving.
+
+The north-star serving subsystem ("serves heavy traffic from millions
+of users"), composing the earlier PRs' substrate into one path:
+
+- :class:`InferenceEngine` — dynamic micro-batching (size flush OR
+  deadline flush), sticky shape buckets with a row mask so compiled
+  signatures are reused across ragged request sizes (PR-3 bucketing),
+  process-cached compiled forward (``train.step_cache``), bounded
+  queue with explicit :class:`Overloaded` load shedding, per-request
+  deadlines.
+- :class:`ModelRegistry` — versioned deploy/hot-swap/rollback, loading
+  models only through the PR-4 verified checkpoint path (a corrupt zip
+  is refused before anything flips; the current version keeps serving).
+- :class:`ModelServer` — stdlib HTTP JSON endpoint
+  (``POST /v1/models/<name>:predict``, ``GET /v1/models``,
+  ``GET /healthz`` readiness, ``GET /metrics``).
+
+``parallel.ParallelInference`` is a compatibility shim over
+:class:`InferenceEngine`.  See docs/serving.md.
+"""
+
+from deeplearning4j_tpu.serve.engine import (DeadlineExceeded, EngineClosed,
+                                             InferenceEngine, Overloaded)
+from deeplearning4j_tpu.serve.registry import ModelRegistry, ModelVersion
+from deeplearning4j_tpu.serve.server import ModelServer
+
+__all__ = [
+    "DeadlineExceeded", "EngineClosed", "InferenceEngine", "ModelRegistry",
+    "ModelServer", "ModelVersion", "Overloaded",
+]

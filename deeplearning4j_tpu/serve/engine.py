@@ -1,0 +1,385 @@
+"""Dynamic micro-batching inference engine — the serving hot path.
+
+DL4J's ``ParallelInference`` batches whatever happens to be queued when
+the worker wakes up; production TPU serving needs the three properties
+it lacks (TFX/TensorFlow-Serving design, PAPERS.md):
+
+1. **Deadline-bounded micro-batching** — requests accumulate until
+   ``max_batch`` rows are queued (size flush) OR ``max_latency_ms`` has
+   passed since the oldest request in the forming batch (deadline
+   flush).  Throughput comes from the batch; the tail latency bound
+   comes from the deadline.
+2. **Compiled-shape reuse** — ragged request sizes pad up to a static
+   bucket set (powers of two up to ``max_batch`` by default, sticky-
+   extended like the PR-3 device feeder), so mixed-size traffic runs
+   through at most one XLA program per bucket instead of one per
+   distinct row count.  The jit-wrapped forward itself is shared
+   process-wide through :mod:`deeplearning4j_tpu.train.step_cache`
+   keyed by (net class, config sha, dtype policy) — hot-swapping a
+   same-architecture model reuses the already-compiled program, so a
+   swap costs zero recompiles.
+3. **Backpressure with explicit load shedding** — the request queue is
+   bounded; a submit against a full queue fails *immediately* with
+   :class:`Overloaded` (never unbounded growth), and a request can
+   carry a deadline after which it is cancelled instead of dispatched.
+
+Padded rows are tracked with a row-validity mask and sliced off before
+results are scattered back to callers, so batched outputs equal
+per-request outputs (inference mode is row-independent: no dropout,
+BatchNorm uses running statistics).
+
+Observability: a ``serve`` span per dispatched batch (queue-wait vs
+device-time attribution) and the ``tpudl_serve_*`` metrics —
+see docs/serving.md for the full table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.data.device_pipeline import _pad_rows, choose_bucket
+from deeplearning4j_tpu.obs import tracing
+from deeplearning4j_tpu.obs.registry import get_registry
+from deeplearning4j_tpu.train import step_cache
+
+
+class Overloaded(RuntimeError):
+    """Request shed at submit time: the engine's bounded queue is full.
+    Deliberately immediate — the caller (or its load balancer) should
+    retry elsewhere/later rather than pile onto this replica."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """Request expired in the queue before it could be dispatched."""
+
+
+class EngineClosed(RuntimeError):
+    """Submit against an engine that has been shut down (e.g. the old
+    version's engine after a registry hot-swap finished draining)."""
+
+
+@dataclasses.dataclass
+class _Request:
+    x: np.ndarray
+    mask: Optional[np.ndarray]
+    future: Future
+    t_submit: float                   # perf_counter at submit
+    deadline: Optional[float]         # absolute perf_counter deadline
+
+    @property
+    def n(self) -> int:
+        return int(self.x.shape[0])
+
+
+def _default_buckets(max_batch: int) -> tuple[int, ...]:
+    """Powers of two up to (and always including) ``max_batch`` — a
+    bounded compile budget of ~log2(max_batch) programs."""
+    buckets = []
+    b = 1
+    while b < max_batch:
+        buckets.append(b)
+        b *= 2
+    buckets.append(int(max_batch))
+    return tuple(buckets)
+
+
+def _pure_forward_net(model) -> bool:
+    """True for nets whose forward is a pure function of (params, state,
+    x, mask) with one input — the MultiLayerNetwork family.  Those get a
+    process-cached jit forward; ComputationGraph (multi-input ``output``)
+    and duck-typed models fall back to ``model.output``."""
+    return (hasattr(model, "_forward") and not hasattr(model, "layer_params")
+            and getattr(model, "params_", None) is not None)
+
+
+def _build_forward(net):
+    """Build the jit forward for a pure-forward net.  Cached process-wide
+    via step_cache: reuse across engines (and across hot-swapped nets of
+    the same architecture) is sound because params/state are arguments,
+    not closure state."""
+    import jax
+
+    @jax.jit
+    def _fwd(params, state, x, mask):
+        y, _, _ = net._forward(params, state, x, train=False, mask=mask)
+        return y
+
+    return _fwd
+
+
+class InferenceEngine:
+    """Micro-batching inference front-end for one model instance.
+
+    Thread model: callers submit from any thread; ONE worker thread
+    drains the bounded queue, forms batches, and runs the compiled
+    forward (on TPU a single jit'd forward saturates the chip — replicas
+    across devices come from running one engine per device/process).
+    """
+
+    _SHUTDOWN = object()
+
+    def __init__(self, model, name: str = "default", max_batch: int = 32,
+                 max_latency_ms: float = 5.0, queue_limit: int = 128,
+                 buckets: Optional[Sequence[int]] = None,
+                 bucketing: bool = True):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if queue_limit < 1:
+            raise ValueError(f"queue_limit must be >= 1, got {queue_limit}")
+        self.model = model
+        self.name = name
+        self.max_batch = int(max_batch)
+        self.max_latency_s = float(max_latency_ms) / 1e3
+        self.queue_limit = int(queue_limit)
+        self.bucketing = bool(bucketing)
+        self.buckets: tuple[int, ...] = (
+            tuple(sorted(int(b) for b in buckets)) if buckets
+            else _default_buckets(self.max_batch))
+        self._queue: queue.Queue = queue.Queue(maxsize=self.queue_limit)
+        self._closed = threading.Event()
+        self._fwd = None
+        if _pure_forward_net(model):
+            sig = step_cache.net_signature(model)
+            key = sig + ("serve_forward",) if sig is not None else None
+            self._fwd = step_cache.get_or_build(
+                key, lambda: _build_forward(model))
+        self._worker = threading.Thread(
+            target=self._run, daemon=True, name=f"tpudl-serve-{name}")
+        self._worker.start()
+
+    # ------------------------------------------------------------- submit
+    def submit(self, x, mask=None, deadline_ms: Optional[float] = None,
+               block: bool = False,
+               timeout_s: Optional[float] = None) -> Future:
+        """Enqueue one request of ``[n, ...]`` examples; returns a Future
+        resolving to the ``[n, ...]`` outputs.
+
+        Queue-full policy: ``block=False`` (serving default) sheds with
+        :class:`Overloaded`; ``block=True`` (the historical
+        ``ParallelInference`` contract) blocks the submitting thread —
+        memory stays bounded either way.  ``deadline_ms`` bounds the
+        time the request may wait before dispatch."""
+        if self._closed.is_set():
+            raise EngineClosed(f"engine {self.name!r} is shut down")
+        x = np.asarray(x)
+        if x.ndim == 0:
+            raise ValueError("request must have a leading example dim")
+        req = _Request(
+            x, None if mask is None else np.asarray(mask), Future(),
+            time.perf_counter(),
+            None if deadline_ms is None
+            else time.perf_counter() + float(deadline_ms) / 1e3)
+        reg = get_registry()
+        try:
+            if block:
+                self._queue.put(req, timeout=timeout_s)
+            else:
+                self._queue.put_nowait(req)
+        except queue.Full:
+            reg.counter("tpudl_serve_shed_total").inc()
+            reg.labeled_counter("tpudl_serve_requests_total").inc(
+                status="shed")
+            raise Overloaded(
+                f"engine {self.name!r} queue full "
+                f"({self.queue_limit} waiting)") from None
+        # close the submit/shutdown race: if shutdown won and the worker
+        # is already gone, nobody will ever serve this queue — fail the
+        # leftovers (ours included) instead of stranding the Future
+        if self._closed.is_set() and not self._worker.is_alive():
+            self._fail_leftovers()
+        if not req.future.done():
+            reg.gauge("tpudl_serve_queue_depth").set(self._queue.qsize())
+        return req.future
+
+    def predict(self, x, mask=None, deadline_ms: Optional[float] = None,
+                timeout_s: Optional[float] = None) -> np.ndarray:
+        """Blocking submit + wait."""
+        return self.submit(x, mask=mask,
+                           deadline_ms=deadline_ms).result(timeout=timeout_s)
+
+    # ------------------------------------------------------------- worker
+    def _run(self) -> None:
+        carry = None       # request that would have overflowed max_batch
+        while True:
+            item = carry if carry is not None else self._queue.get()
+            carry = None
+            if item is self._SHUTDOWN:
+                return
+            batch = [item]
+            rows = item.n
+            flush_at = time.perf_counter() + self.max_latency_s
+            while rows < self.max_batch:
+                remaining = flush_at - time.perf_counter()
+                if remaining <= 0:
+                    break                      # deadline flush
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break                      # deadline flush (idle)
+                if nxt is self._SHUTDOWN:
+                    self._dispatch(batch)
+                    return
+                if rows + nxt.n > self.max_batch:
+                    carry = nxt                # opens the NEXT batch
+                    break                      # size flush (full)
+                batch.append(nxt)
+                rows += nxt.n
+            self._dispatch(batch)              # size flush when loop ended
+
+    def _bucket_for(self, n: int) -> int:
+        bucket = choose_bucket(n, self.buckets)
+        if bucket not in self.buckets:
+            # oversize request defines a new sticky bucket (feeder
+            # semantics) — later tails pad up to the compiled shape
+            self.buckets = tuple(sorted(self.buckets + (bucket,)))
+        return bucket
+
+    def _concat_masks(self, live: list) -> Optional[np.ndarray]:
+        """Caller-provided masks, concatenated; requests without one get
+        all-ones rows shaped like the present masks' trailing dims."""
+        if not any(r.mask is not None for r in live):
+            return None
+        tail = next(r.mask.shape[1:] for r in live if r.mask is not None)
+        parts = [r.mask if r.mask is not None
+                 else np.ones((r.n,) + tail, np.float32) for r in live]
+        return np.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+
+    def _forward(self, features, mask):
+        if self._fwd is not None:
+            return self._fwd(self.model.params_, self.model.state_,
+                             features, mask)
+        if mask is not None:
+            return self.model.output(features, mask=mask)
+        return self.model.output(features)
+
+    def _dispatch(self, batch: list) -> None:
+        """Run one micro-batch end to end; every future in ``batch`` is
+        resolved (result, deadline error, cancellation, or the forward's
+        exception) — the worker itself never dies."""
+        reg = get_registry()
+        requests_c = reg.labeled_counter("tpudl_serve_requests_total")
+        now = time.perf_counter()
+        live = []
+        for req in batch:
+            if req.deadline is not None and now > req.deadline:
+                requests_c.inc(status="expired")
+                req.future.set_exception(DeadlineExceeded(
+                    f"request expired in queue after "
+                    f"{1e3 * (now - req.t_submit):.1f} ms"))
+            elif not req.future.set_running_or_notify_cancel():
+                requests_c.inc(status="cancelled")
+            else:
+                live.append(req)
+        if not live:
+            return
+        rows = sum(r.n for r in live)
+        queue_wait_s = now - min(r.t_submit for r in live)
+        try:
+            features = (np.concatenate([r.x for r in live], axis=0)
+                        if len(live) > 1 else live[0].x)
+            mask = self._concat_masks(live)
+            bucket, padded = rows, 0
+            if self.bucketing:
+                bucket = self._bucket_for(rows)
+                padded = bucket - rows
+                if padded:
+                    features = _pad_rows(features, bucket)
+                    if mask is not None:
+                        mask = _pad_rows(mask, bucket)
+            traces_before = step_cache.jit_cache_entries(self._fwd)
+            with tracing.span("serve", model=self.name, rows=rows,
+                              requests=len(live), bucket=bucket,
+                              queue_wait_ms=round(queue_wait_s * 1e3, 3)
+                              ) as sp:
+                t0 = time.perf_counter()
+                out = np.asarray(tracing.device_sync(
+                    self._forward(features, mask)))
+                sp.set_attribute(
+                    "device_ms", round((time.perf_counter() - t0) * 1e3, 3))
+                if padded:
+                    sp.set_attribute("padded", padded)
+        except BaseException as e:
+            for req in live:
+                requests_c.inc(status="error")
+                if not req.future.done():
+                    req.future.set_exception(e)
+            return
+        retraced = step_cache.jit_cache_entries(self._fwd) - traces_before
+        if retraced > 0:
+            reg.counter("tpudl_serve_recompiles_total").inc(retraced)
+        reg.counter("tpudl_serve_batches_total").inc()
+        reg.gauge("tpudl_serve_batch_size").set(bucket)
+        latency_h = reg.histogram("tpudl_serve_latency_seconds")
+        end = time.perf_counter()
+        offset = 0
+        for req in live:
+            req.future.set_result(out[offset:offset + req.n])
+            offset += req.n
+            requests_c.inc(status="ok")
+            latency_h.observe(end - req.t_submit)
+
+    # ----------------------------------------------------------- lifecycle
+    @property
+    def compiled_programs(self) -> int:
+        """Traced XLA programs behind this engine's forward (0 for
+        fallback models) — the ≤1-per-bucket invariant's measurement."""
+        return step_cache.jit_cache_entries(self._fwd)
+
+    def shutdown(self, drain: bool = True, timeout_s: float = 30.0) -> None:
+        """Stop the engine.  ``drain=True`` (default, and what the
+        registry's hot-swap uses) serves everything already queued
+        before the worker exits; ``drain=False`` fails queued requests
+        with :class:`EngineClosed`.  New submits fail immediately either
+        way."""
+        if self._closed.is_set():
+            self._worker.join(timeout=timeout_s)
+            return
+        self._closed.set()
+        if not drain:
+            reg = get_registry()
+            requests_c = reg.labeled_counter("tpudl_serve_requests_total")
+            while True:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if req is self._SHUTDOWN:
+                    continue
+                requests_c.inc(status="error")
+                req.future.set_exception(
+                    EngineClosed(f"engine {self.name!r} shut down"))
+        self._queue.put(self._SHUTDOWN)
+        self._worker.join(timeout=timeout_s)
+        # a submit that raced the closed flag may have landed BEHIND the
+        # sentinel — no future may ever be stranded, so fail leftovers
+        # (submit runs the same sweep when it loses the race even later)
+        self._fail_leftovers()
+
+    def _fail_leftovers(self) -> None:
+        """Fail every request still queued after the worker has exited.
+        Safe to run concurrently from shutdown and late submitters —
+        ``get_nowait`` hands each request to exactly one sweeper."""
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if req is self._SHUTDOWN or req.future.done():
+                continue
+            get_registry().labeled_counter(
+                "tpudl_serve_requests_total").inc(status="error")
+            req.future.set_exception(
+                EngineClosed(f"engine {self.name!r} shut down"))
+
+    def __enter__(self) -> "InferenceEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

@@ -1,0 +1,207 @@
+"""Versioned model registry — verified loads, atomic hot-swap, rollback.
+
+Models enter the registry ONLY through the PR-4 verified checkpoint
+path (:func:`deeplearning4j_tpu.io.model_serializer.restore_model`,
+which replays zip CRCs + manifest sha256 digests): a truncated or
+bit-rotted zip raises
+:class:`~deeplearning4j_tpu.resilience.checkpoint.CheckpointCorruptError`
+at :meth:`ModelRegistry.deploy` time, *before* anything is swapped —
+the currently-serving version keeps serving.
+
+Hot-swap protocol (``deploy`` onto an existing name):
+
+1. load + verify the new zip, build its :class:`InferenceEngine`
+   (compiling, if needed, happens off the serving path — a
+   same-architecture swap reuses the step-cached compiled forward);
+2. flip the current-version pointer — new requests route to the new
+   engine atomically;
+3. drain the old engine (everything already queued completes on the
+   OLD version — zero dropped or garbled in-flight requests), then
+   retire it.
+
+``rollback`` re-deploys the previous version's zip through the same
+verified path (the file is re-verified — disk may have rotted since),
+producing a NEW version number, k8s-rollout-undo style.
+
+Readiness: :meth:`ready` is False while any swap is in flight — the
+HTTP server's ``/healthz`` turns 503 so a load balancer steers traffic
+away during the flip window.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+import time
+from typing import Iterator, Optional
+
+from deeplearning4j_tpu.obs.registry import get_registry
+from deeplearning4j_tpu.serve.engine import EngineClosed, InferenceEngine
+
+SERVING = "serving"
+RETIRED = "retired"
+
+
+@dataclasses.dataclass
+class ModelVersion:
+    """One deployed (name, version): the loaded net rides inside the
+    engine; retired versions keep only their zip path for rollback."""
+
+    name: str
+    version: int
+    path: str
+    status: str
+    deployed_at: float
+    engine: Optional[InferenceEngine] = None
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "version": self.version,
+                "path": self.path, "status": self.status,
+                "deployed_at": self.deployed_at}
+
+
+class ModelRegistry:
+    """Name → current :class:`ModelVersion` map with swap/rollback.
+
+    ``engine_defaults`` (max_batch, max_latency_ms, queue_limit,
+    buckets, bucketing) apply to every deploy unless overridden per
+    call."""
+
+    def __init__(self, **engine_defaults):
+        self._lock = threading.Lock()
+        self._current: dict[str, ModelVersion] = {}
+        self._history: dict[str, list[ModelVersion]] = {}
+        self._next_version: dict[str, int] = {}
+        self._swaps_in_flight = 0
+        self.engine_defaults = dict(engine_defaults)
+
+    # ---------------------------------------------------------- swaps
+    @contextlib.contextmanager
+    def _swap(self) -> Iterator[None]:
+        """Readiness window: /healthz reports 503 while any swap runs."""
+        with self._lock:
+            self._swaps_in_flight += 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._swaps_in_flight -= 1
+
+    def ready(self) -> bool:
+        with self._lock:
+            return self._swaps_in_flight == 0
+
+    # --------------------------------------------------------- deploy
+    def deploy(self, name: str, path: str, **engine_kw) -> ModelVersion:
+        """Load ``path`` through the verified serializer and make it the
+        current version of ``name``.  Raises ``CheckpointCorruptError``
+        (corrupt zip) or the serializer's errors WITHOUT touching the
+        currently-serving version."""
+        from deeplearning4j_tpu.io.model_serializer import restore_model
+        # verified load happens OUTSIDE the swap window: readiness only
+        # flips for the engine-build + pointer-flip + drain
+        net = restore_model(path, load_updater=False)
+        kw = {**self.engine_defaults, **engine_kw}
+        with self._swap():
+            engine = InferenceEngine(net, name=name, **kw)
+            with self._lock:
+                version = self._next_version.get(name, 0) + 1
+                self._next_version[name] = version
+                entry = ModelVersion(name, version, str(path), SERVING,
+                                     time.time(), engine)
+                old = self._current.get(name)
+                self._current[name] = entry
+                self._history.setdefault(name, []).append(entry)
+            if old is not None:
+                # in-flight requests complete on the old version, then
+                # it retires (its net is released with the engine)
+                old.engine.shutdown(drain=True)
+                old.status = RETIRED
+                old.engine = None
+        get_registry().labeled_gauge("tpudl_serve_model_version").set(
+            version, model=name)
+        return entry
+
+    def rollback(self, name: str) -> ModelVersion:
+        """Redeploy the newest retired version's zip (re-verified) as a
+        new version number."""
+        with self._lock:
+            history = self._history.get(name, [])
+            previous = next((mv for mv in reversed(history)
+                             if mv.status == RETIRED), None)
+        if previous is None:
+            raise LookupError(f"model {name!r} has no previous version "
+                              f"to roll back to")
+        return self.deploy(name, previous.path)
+
+    def undeploy(self, name: str) -> None:
+        """Remove ``name`` entirely (drains its engine)."""
+        with self._lock:
+            entry = self._current.pop(name, None)
+        if entry is not None and entry.engine is not None:
+            entry.engine.shutdown(drain=True)
+            entry.status = RETIRED
+            entry.engine = None
+
+    def close(self) -> None:
+        for name in list(self._current):
+            self.undeploy(name)
+
+    # ---------------------------------------------------------- lookup
+    def get(self, name: str) -> ModelVersion:
+        with self._lock:
+            entry = self._current.get(name)
+        if entry is None:
+            raise KeyError(f"no model deployed under {name!r}")
+        return entry
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._current)
+
+    def models(self) -> list[dict]:
+        """Status rows for ``GET /v1/models``."""
+        with self._lock:
+            current = dict(self._current)
+            history = {n: list(h) for n, h in self._history.items()}
+        rows = []
+        for name in sorted(current):
+            row = current[name].to_dict()
+            row["history"] = [
+                {"version": mv.version, "status": mv.status}
+                for mv in history.get(name, [])]
+            rows.append(row)
+        return rows
+
+    # --------------------------------------------------------- predict
+    def predict(self, name: str, x, mask=None,
+                deadline_ms: Optional[float] = None,
+                timeout_s: Optional[float] = None):
+        """Route one request to the current version of ``name``.  A
+        submit that races a hot-swap's drain retries against the freshly
+        flipped engine — callers never observe the swap as an error."""
+        return self.predict_versioned(name, x, mask=mask,
+                                      deadline_ms=deadline_ms,
+                                      timeout_s=timeout_s)[0]
+
+    def predict_versioned(self, name: str, x, mask=None,
+                          deadline_ms: Optional[float] = None,
+                          timeout_s: Optional[float] = None):
+        """Like :meth:`predict`, but returns ``(outputs, version)`` with
+        the version of the entry whose engine actually answered — the
+        truthful attribution during a swap window, where the *current*
+        version may already be newer than the one that served."""
+        for _ in range(8):
+            entry = self.get(name)
+            engine = entry.engine
+            if engine is None:          # retired between lookup and read
+                continue
+            try:
+                out = engine.predict(x, mask=mask, deadline_ms=deadline_ms,
+                                     timeout_s=timeout_s)
+                return out, entry.version
+            except EngineClosed:
+                continue                # swap drained this engine; refetch
+        raise EngineClosed(
+            f"model {name!r}: engine kept closing across retries")

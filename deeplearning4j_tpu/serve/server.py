@@ -1,0 +1,157 @@
+"""HTTP front-end for the inference engine — stdlib only.
+
+Same design as the ``obs.ui_server`` dashboard: a
+``ThreadingHTTPServer`` with a closure handler, no new dependencies.
+Endpoints (TF-Serving-shaped paths):
+
+- ``POST /v1/models/<name>:predict`` — body ``{"instances": [...],
+  "deadline_ms": optional}`` → ``{"predictions": [...],
+  "model_version": n}``.  Error mapping: unknown model → 404, bad
+  JSON/payload → 400, :class:`~deeplearning4j_tpu.serve.engine.
+  Overloaded` → 429 (load shed — retry against another replica),
+  deadline/timeout → 504, anything else → 500.
+- ``GET /v1/models`` — every deployed model with version, status and
+  version history.
+- ``GET /v1/models/<name>`` — one model's row.
+- ``GET /healthz`` — 200 when ready, 503 while a hot-swap is in
+  flight (load balancers steer away during the flip window).
+- ``GET /metrics`` — Prometheus text exposition of the process-wide
+  registry (the same scrape surface the training dashboard exposes).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.obs.registry import (get_registry,
+                                             install_standard_metrics)
+from deeplearning4j_tpu.serve.engine import (DeadlineExceeded, EngineClosed,
+                                             Overloaded)
+from deeplearning4j_tpu.serve.registry import ModelRegistry
+
+_PREDICT_SUFFIX = ":predict"
+
+
+def error_status(exc: BaseException) -> int:
+    """HTTP status for a predict-path failure (load-shedding semantics:
+    429 means 'this replica is saturated', 504 means 'gave up waiting')."""
+    if isinstance(exc, Overloaded):
+        return 429
+    if isinstance(exc, (DeadlineExceeded, concurrent.futures.TimeoutError,
+                        TimeoutError)):
+        return 504
+    if isinstance(exc, KeyError):
+        return 404
+    if isinstance(exc, (ValueError, TypeError)):
+        return 400
+    return 500
+
+
+class ModelServer:
+    """JSON inference server over a :class:`ModelRegistry`."""
+
+    def __init__(self, registry: ModelRegistry, port: int = 0,
+                 host: str = "127.0.0.1",
+                 request_timeout_s: Optional[float] = 30.0):
+        self.registry = registry
+        self.request_timeout_s = request_timeout_s
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silence request logging
+                pass
+
+            def _send(self, code: int, obj) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?")[0].rstrip("/") or "/"
+                if path == "/healthz":
+                    if server.registry.ready():
+                        return self._send(200, {"status": "ok"})
+                    return self._send(503, {"status": "swapping"})
+                if path == "/metrics":
+                    install_standard_metrics()
+                    body = get_registry().render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path == "/v1/models":
+                    return self._send(
+                        200, {"models": server.registry.models()})
+                if path.startswith("/v1/models/"):
+                    name = path[len("/v1/models/"):]
+                    try:
+                        entry = server.registry.get(name)
+                    except KeyError:
+                        return self._send(
+                            404, {"error": f"no model {name!r}"})
+                    return self._send(200, entry.to_dict())
+                return self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                path = self.path.split("?")[0]
+                if not (path.startswith("/v1/models/")
+                        and path.endswith(_PREDICT_SUFFIX)):
+                    return self._send(404, {"error": "not found"})
+                name = path[len("/v1/models/"):-len(_PREDICT_SUFFIX)]
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length) if length else b""
+                try:
+                    payload = json.loads(raw.decode() or "{}")
+                    instances = payload["instances"]
+                except (ValueError, KeyError, UnicodeDecodeError):
+                    return self._send(
+                        400, {"error": "body must be JSON with an "
+                                       "'instances' array"})
+                try:
+                    x = np.asarray(instances, dtype=np.float32)
+                    # version of the entry that ACTUALLY answered — the
+                    # current pointer may already be newer mid-swap
+                    out, version = server.registry.predict_versioned(
+                        name, x, deadline_ms=payload.get("deadline_ms"),
+                        timeout_s=server.request_timeout_s)
+                except BaseException as e:
+                    return self._send(error_status(e),
+                                      {"error": f"{type(e).__name__}: {e}"})
+                return self._send(200, {
+                    "predictions": np.asarray(out).tolist(),
+                    "model_version": version})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name="tpudl-model-server")
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}/"
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self) -> "ModelServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

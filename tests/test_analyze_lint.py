@@ -297,3 +297,50 @@ def test_swallowed_exception_clean_cases(tmp_path):
         """)
     assert report.by_rule("TPU308") == []
     assert report.exit_code() == 0
+
+
+# ------------------------------------------------------------ TPU309
+def test_jit_built_in_request_path(tmp_path):
+    report = _lint_source(tmp_path, """
+        import jax
+
+        def handle_predict(model, requests):
+            for x in requests:
+                fwd = jax.jit(model.apply)     # compiled per request
+                out = fwd(x)
+            return out
+
+        class Handler:
+            def do_POST(self):
+                fn = jax.jit(self.model.apply)  # per-request handler
+                return fn(self.body)
+
+        def serve_one(model, x):
+            return jax.jit(model.apply)(x)      # inline, no loop needed
+        """)
+    hits = report.by_rule("TPU309")
+    assert len(hits) == 3
+    assert report.exit_code() == 1
+    assert "re-compiles" in hits[0].message
+
+
+def test_jit_in_setup_paths_is_fine(tmp_path):
+    report = _lint_source(tmp_path, """
+        import jax
+
+        def make_predict_fn(model):
+            return jax.jit(model.apply)        # one-time builder
+
+        def build_infer_step(model):
+            return jax.jit(model.apply)        # one-time builder
+
+        def serve_loop(engine, requests):
+            for x in requests:
+                engine.predict(x)              # CALLS cached forward
+
+        def load_weights(path):
+            fwd = jax.jit(lambda p, x: x)      # no serving token
+            return fwd
+        """)
+    assert report.by_rule("TPU309") == []
+    assert report.exit_code() == 0

@@ -7,9 +7,10 @@ import pytest
 from examples import (bert_mlm_finetune, char_rnn_textgen,
                       data_parallel_training, early_stopping,
                       fault_tolerant_training, lenet_cifar10,
-                      lstm_uci_har, mlp_mnist, multislice_dcn_training,
-                      pipeline_parallel_bert, training_dashboard,
-                      transfer_learning, word2vec_embeddings)
+                      lstm_uci_har, mlp_mnist, model_serving,
+                      multislice_dcn_training, pipeline_parallel_bert,
+                      training_dashboard, transfer_learning,
+                      word2vec_embeddings)
 
 
 def test_mlp_mnist_example():
@@ -79,6 +80,14 @@ def test_dashboard_example_writes_report(tmp_path):
 def test_multislice_dcn_example():
     losses = multislice_dcn_training.main(steps=6, verbose=False)
     assert losses[-1] < losses[0]
+
+
+def test_model_serving_example(tmp_path):
+    result = model_serving.main(train_epochs=1, workdir=str(tmp_path),
+                                verbose=False)
+    # deploy → hot-swap → rollback: three versions answered over HTTP
+    assert result["versions_served"] == [1, 2, 3]
+    assert result["final_version"] == 3
 
 
 def test_fault_tolerant_training_example(tmp_path):

@@ -86,6 +86,47 @@ def test_prometheus_text_format(registry):
     assert text.endswith("\n")
 
 
+def test_labeled_counter_and_gauge_semantics(registry):
+    c = registry.labeled_counter("tpudl_test_requests_total", "requests",
+                                 ("status",))
+    c.inc(status="ok")
+    c.inc(2, status="ok")
+    c.inc(status="shed")
+    assert c.labeled_value(status="ok") == 3
+    assert c.labeled_value(status="shed") == 1
+    assert c.value == 4                      # total across labels
+    with pytest.raises(ValueError):
+        c.inc(-1, status="ok")
+    with pytest.raises(ValueError):
+        c.inc(status="ok", bogus="x")        # undeclared label name
+    with pytest.raises(ValueError):
+        c.inc()                              # missing declared label
+    g = registry.labeled_gauge("tpudl_test_version", "per-model version",
+                               ("model",))
+    g.set(3, model="a")
+    g.set(7, model="b")
+    assert g.labeled_value(model="a") == 3
+    assert g.labeled_value(model="b") == 7
+    # idempotent re-registration; label mismatch is a hard error
+    assert registry.labeled_counter("tpudl_test_requests_total") is c
+    with pytest.raises(ValueError):
+        registry.labeled_counter("tpudl_test_requests_total",
+                                 label_names=("other",))
+
+
+def test_labeled_metrics_prometheus_render(registry):
+    c = registry.labeled_counter("tpudl_test_requests_total", "reqs",
+                                 ("status",))
+    c.inc(5, status="ok")
+    c.inc(status='we"ird\nvalue')
+    text = registry.render_prometheus()
+    lines = text.splitlines()
+    assert "# TYPE tpudl_test_requests_total counter" in lines
+    assert 'tpudl_test_requests_total{status="ok"} 5' in lines
+    # label values escaped per the exposition format
+    assert 'tpudl_test_requests_total{status="we\\"ird\\nvalue"} 1' in lines
+
+
 def test_standard_metrics_install_and_lint(registry):
     from deeplearning4j_tpu.obs.check import lint
     installed = install_standard_metrics(registry)

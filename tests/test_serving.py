@@ -1,0 +1,456 @@
+"""tpudl.serve — inference engine, model registry, HTTP server.
+
+Acceptance (ISSUE 5): dynamic-batched outputs match per-request outputs
+to 1e-6 under ragged shapes with ≤1 compile per bucket; hot-swap during
+concurrent traffic loses zero in-flight requests; a truncated checkpoint
+is refused at deploy and the previous version keeps serving; queue
+saturation sheds with ``Overloaded`` (bounded memory) and increments
+``tpudl_serve_shed_total``.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.obs.registry import (MetricsRegistry, get_registry,
+                                             set_registry)
+from deeplearning4j_tpu.resilience import faults
+from deeplearning4j_tpu.resilience.checkpoint import CheckpointCorruptError
+from deeplearning4j_tpu.serve import (DeadlineExceeded, InferenceEngine,
+                                      ModelRegistry, ModelServer, Overloaded)
+from deeplearning4j_tpu.serve.server import error_status
+from deeplearning4j_tpu.train import Sgd
+
+N_IN, N_OUT = 8, 4
+
+
+def _net(seed=11):
+    return MultiLayerNetwork(
+        NeuralNetConfiguration.builder()
+        .seed(seed).updater(Sgd(0.1)).weight_init("xavier").list()
+        .layer(DenseLayer(n_out=16, activation="relu"))
+        .layer(OutputLayer(n_out=N_OUT, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(N_IN))
+        .build()).init()
+
+
+def _data(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(n, N_IN)).astype(np.float32)
+
+
+@pytest.fixture
+def metrics():
+    """Isolated process-wide registry per test."""
+    prev = set_registry(MetricsRegistry())
+    yield get_registry()
+    set_registry(prev)
+
+
+class _BlockingModel:
+    """Fallback-path model whose forward blocks on an event — the
+    deterministic way to fill the engine queue."""
+
+    def __init__(self):
+        self.release = threading.Event()
+
+    def output(self, x):
+        self.release.wait(timeout=30)
+        return np.zeros((x.shape[0], 2), np.float32)
+
+
+# ---------------------------------------------------------------- batching
+def test_size_flush_beats_deadline(metrics):
+    net = _net(21)
+    x = _data(16, 1)
+    with InferenceEngine(net, name="sz", max_batch=4, max_latency_ms=5000,
+                         queue_limit=32) as eng:
+        eng.predict(x[:4], timeout_s=60)   # compile bucket 4 up front
+        before = metrics.counter("tpudl_serve_batches_total").value
+        t0 = time.perf_counter()
+        futures = [eng.submit(x[i:i + 1]) for i in range(4)]
+        for f in futures:
+            f.result(timeout=60)
+        elapsed = time.perf_counter() - t0
+        # 4 rows hit max_batch → flushed long before the 5s deadline
+        assert elapsed < 2.0
+        assert metrics.counter("tpudl_serve_batches_total").value \
+            == before + 1
+        assert metrics.gauge("tpudl_serve_batch_size").value == 4
+
+
+def test_deadline_flush_for_partial_batch(metrics):
+    net = _net(22)
+    x = _data(4, 2)
+    with InferenceEngine(net, name="dl", max_batch=64, max_latency_ms=150,
+                         queue_limit=32) as eng:
+        eng.predict(x[:1], timeout_s=60)   # compile bucket 1 up front
+        t0 = time.perf_counter()
+        out = eng.submit(x[:1]).result(timeout=60)
+        elapsed = time.perf_counter() - t0
+        # nothing else arrived: the batch waited out the 150ms deadline
+        assert elapsed >= 0.1
+        assert out.shape == (1, N_OUT)
+        assert metrics.labeled_counter(
+            "tpudl_serve_requests_total").labeled_value(status="ok") >= 2
+
+
+def test_ragged_batched_outputs_match_per_request(metrics):
+    """Mixed-size concurrent traffic through sticky buckets: every
+    request's rows equal the unbatched forward to 1e-6, with at most
+    one compile per bucket."""
+    net = _net(23)
+    x = _data(48, 3)
+    expected = np.asarray(net.output(x))
+    sizes = [1, 3, 2, 4, 3, 5, 2, 4, 1, 6, 3, 2]      # sums to 36
+    with InferenceEngine(net, name="rb", max_batch=8, max_latency_ms=10,
+                         queue_limit=64, buckets=(4, 8)) as eng:
+        futures, offset = [], 0
+        for n in sizes:
+            futures.append((offset, n, eng.submit(x[offset:offset + n])))
+            offset += n
+        for off, n, fut in futures:
+            got = fut.result(timeout=60)
+            assert got.shape == (n, N_OUT)
+            np.testing.assert_allclose(got, expected[off:off + n],
+                                       rtol=1e-6, atol=1e-6)
+        # rows per dispatch never exceed max_batch → only buckets {4, 8}
+        # were used → at most one XLA program per bucket
+        assert set(eng.buckets) == {4, 8}
+        assert eng.compiled_programs <= 2
+        assert metrics.counter("tpudl_serve_recompiles_total").value <= 2
+
+
+def test_caller_masks_ride_along(metrics):
+    """Per-request feature masks concatenate and bucket-pad with the
+    features; requests without a mask get all-ones rows."""
+    net = _net(24)
+    x = _data(8, 4)
+    mask = np.ones((2,), np.float32)
+    with InferenceEngine(net, name="mk", max_batch=8, max_latency_ms=20,
+                         queue_limit=16) as eng:
+        f1 = eng.submit(x[:2], mask=mask)
+        f2 = eng.submit(x[2:5])                      # no mask
+        out1, out2 = f1.result(timeout=60), f2.result(timeout=60)
+    np.testing.assert_allclose(out1, np.asarray(net.output(x[:2])),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(out2, np.asarray(net.output(x[2:5])),
+                               rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------------------ load shedding
+def test_shed_on_full_queue(metrics):
+    model = _BlockingModel()
+    eng = InferenceEngine(model, name="shed", max_batch=1, max_latency_ms=1,
+                          queue_limit=2)
+    try:
+        first = eng.submit(np.zeros((1, 4), np.float32))
+        time.sleep(0.2)        # worker picks up `first`, blocks in forward
+        held = [eng.submit(np.zeros((1, 4), np.float32)) for _ in range(2)]
+        with pytest.raises(Overloaded):
+            eng.submit(np.zeros((1, 4), np.float32))
+        assert metrics.counter("tpudl_serve_shed_total").value == 1
+        assert metrics.labeled_counter(
+            "tpudl_serve_requests_total").labeled_value(status="shed") == 1
+        model.release.set()
+        # bounded queue, zero stranded futures: everything held resolves
+        assert first.result(timeout=30).shape == (1, 2)
+        for f in held:
+            assert f.result(timeout=30).shape == (1, 2)
+    finally:
+        model.release.set()
+        eng.shutdown()
+
+
+def test_request_deadline_cancellation(metrics):
+    model = _BlockingModel()
+    eng = InferenceEngine(model, name="ddl", max_batch=1, max_latency_ms=1,
+                          queue_limit=8)
+    try:
+        blocked = eng.submit(np.zeros((1, 4), np.float32))
+        time.sleep(0.1)
+        doomed = eng.submit(np.zeros((1, 4), np.float32), deadline_ms=50)
+        time.sleep(0.2)        # deadline passes while the worker is busy
+        model.release.set()
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=30)
+        blocked.result(timeout=30)
+        assert metrics.labeled_counter(
+            "tpudl_serve_requests_total").labeled_value(status="expired") == 1
+    finally:
+        model.release.set()
+        eng.shutdown()
+
+
+def test_worker_exception_propagates_to_future(metrics):
+    class Exploding:
+        def output(self, x):
+            raise ValueError("boom")
+
+    eng = InferenceEngine(Exploding(), name="ex", max_batch=2,
+                          max_latency_ms=1, queue_limit=8)
+    try:
+        fut = eng.submit(np.zeros((1, 4), np.float32))
+        with pytest.raises(ValueError, match="boom"):
+            fut.result(timeout=30)
+        assert metrics.labeled_counter(
+            "tpudl_serve_requests_total").labeled_value(status="error") == 1
+        # the worker survived: a second request still gets an answer
+        with pytest.raises(ValueError, match="boom"):
+            eng.submit(np.zeros((1, 4), np.float32)).result(timeout=30)
+    finally:
+        eng.shutdown()
+
+
+# ------------------------------------------------------------- registry
+def test_hot_swap_under_concurrent_load(tmp_path, metrics):
+    """Deploy v2 while clients hammer v1: zero dropped requests, every
+    response is a valid output of exactly one of the two versions, and
+    the version gauge flips."""
+    net1, net2 = _net(31), _net(32)
+    x = _data(16, 5)
+    exp1 = np.asarray(net1.output(x))
+    exp2 = np.asarray(net2.output(x))
+    p1, p2 = str(tmp_path / "v1.zip"), str(tmp_path / "v2.zip")
+    net1.save(p1)
+    net2.save(p2)
+
+    registry = ModelRegistry(max_batch=8, max_latency_ms=2, queue_limit=512)
+    registry.deploy("m", p1)
+    assert metrics.labeled_gauge(
+        "tpudl_serve_model_version").labeled_value(model="m") == 1
+
+    errors: list = []
+    results: list = []
+    stop = threading.Event()
+
+    def client(cid):
+        rng = np.random.default_rng(cid)
+        count = 0
+        while not (stop.is_set() and count >= 20):
+            i = int(rng.integers(0, x.shape[0]))
+            try:
+                out = registry.predict("m", x[i:i + 1], timeout_s=30)
+                results.append((i, np.asarray(out)[0]))
+            except BaseException as e:   # noqa: BLE001 — test collects all
+                errors.append(e)
+            count += 1
+            if count > 500:
+                break
+
+    threads = [threading.Thread(target=client, args=(c,)) for c in range(6)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    registry.deploy("m", p2)          # hot swap mid-traffic
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+
+    assert not errors, errors[:3]
+    assert len(results) >= 120        # clients really ran
+    for i, row in results:
+        ok1 = np.allclose(row, exp1[i], rtol=1e-5, atol=1e-5)
+        ok2 = np.allclose(row, exp2[i], rtol=1e-5, atol=1e-5)
+        assert ok1 or ok2, f"garbled response for row {i}"
+    assert registry.get("m").version == 2
+    assert metrics.labeled_gauge(
+        "tpudl_serve_model_version").labeled_value(model="m") == 2
+    assert registry.ready()
+    registry.close()
+
+
+def test_corrupt_checkpoint_deploy_refused(tmp_path, metrics):
+    """FaultPlan-truncated zip is refused at deploy; v1 keeps serving."""
+    net1, net2 = _net(41), _net(42)
+    x = _data(4, 6)
+    p1, p2 = str(tmp_path / "v1.zip"), str(tmp_path / "v2.zip")
+    net1.save(p1)
+    with faults.inject("checkpoint.write@0:truncate:200"):
+        net2.save(p2)                 # published, then torn on disk
+
+    registry = ModelRegistry(max_batch=4, max_latency_ms=2)
+    registry.deploy("m", p1)
+    with pytest.raises(CheckpointCorruptError):
+        registry.deploy("m", p2)
+    entry = registry.get("m")
+    assert entry.version == 1 and entry.status == "serving"
+    assert registry.ready()
+    out = registry.predict("m", x[:2], timeout_s=30)
+    np.testing.assert_allclose(out, np.asarray(net1.output(x[:2])),
+                               rtol=1e-5, atol=1e-6)
+    assert metrics.labeled_gauge(
+        "tpudl_serve_model_version").labeled_value(model="m") == 1
+    registry.close()
+
+
+def test_rollback_redeploys_previous_zip(tmp_path, metrics):
+    net1, net2 = _net(51), _net(52)
+    x = _data(4, 7)
+    p1, p2 = str(tmp_path / "v1.zip"), str(tmp_path / "v2.zip")
+    net1.save(p1)
+    net2.save(p2)
+    registry = ModelRegistry(max_batch=4, max_latency_ms=2)
+    registry.deploy("m", p1)
+    registry.deploy("m", p2)
+    rolled = registry.rollback("m")
+    assert rolled.version == 3 and rolled.path == p1
+    out = registry.predict("m", x[:2], timeout_s=30)
+    np.testing.assert_allclose(out, np.asarray(net1.output(x[:2])),
+                               rtol=1e-5, atol=1e-6)
+    with pytest.raises(KeyError):
+        registry.get("nope")
+    registry.close()
+
+
+def test_swap_reuses_compiled_forward(tmp_path, metrics):
+    """Same-architecture hot swap costs zero recompiles: both versions
+    hit the step-cached forward keyed by (config sha, dtype policy)."""
+    net = _net(61)
+    p1, p2 = str(tmp_path / "v1.zip"), str(tmp_path / "v2.zip")
+    net.save(p1)
+    it = ArrayDataSetIterator(_data(32, 8),
+                              np.eye(N_OUT, dtype=np.float32)[
+                                  np.random.default_rng(0).integers(
+                                      0, N_OUT, 32)], 16)
+    net.fit(it, epochs=1)             # v2 = same config, moved weights
+    net.save(p2)
+    registry = ModelRegistry(max_batch=4, max_latency_ms=2)
+    registry.deploy("m", p1)
+    x = _data(4, 9)
+    registry.predict("m", x, timeout_s=30)        # compile bucket 4
+    compiles_before = registry.get("m").engine.compiled_programs
+    registry.deploy("m", p2)
+    out2 = registry.predict("m", x, timeout_s=30)
+    assert registry.get("m").engine.compiled_programs == compiles_before
+    assert metrics.counter("tpudl_serve_recompiles_total").value \
+        == compiles_before
+    np.testing.assert_allclose(
+        out2, np.asarray(
+            MultiLayerNetwork.load(p2, load_updater=False).output(x)),
+        rtol=1e-5, atol=1e-6)
+    registry.close()
+
+
+# ----------------------------------------------------------- HTTP server
+def test_http_endpoints(tmp_path, metrics):
+    net = _net(71)
+    x = _data(4, 10)
+    p = str(tmp_path / "m.zip")
+    net.save(p)
+    registry = ModelRegistry(max_batch=4, max_latency_ms=2)
+    registry.deploy("mnist", p)
+    with ModelServer(registry) as srv:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=30)
+
+        def req(method, path, body=None):
+            conn.request(method, path, body=body)
+            r = conn.getresponse()
+            return r.status, json.loads(r.read().decode())
+
+        status, body = req("GET", "/healthz")
+        assert status == 200 and body["status"] == "ok"
+
+        status, body = req("GET", "/v1/models")
+        assert status == 200
+        assert body["models"][0]["name"] == "mnist"
+        assert body["models"][0]["version"] == 1
+
+        status, body = req("GET", "/v1/models/mnist")
+        assert status == 200 and body["status"] == "serving"
+
+        payload = json.dumps({"instances": x[:2].tolist()})
+        status, body = req("POST", "/v1/models/mnist:predict", payload)
+        assert status == 200 and body["model_version"] == 1
+        np.testing.assert_allclose(np.asarray(body["predictions"],
+                                              np.float32),
+                                   np.asarray(net.output(x[:2])),
+                                   rtol=1e-4, atol=1e-5)
+
+        status, body = req("POST", "/v1/models/nope:predict", payload)
+        assert status == 404
+
+        status, body = req("POST", "/v1/models/mnist:predict", "{broken")
+        assert status == 400
+        status, body = req("POST", "/v1/models/mnist:predict",
+                           json.dumps({"inputs": [1]}))
+        assert status == 400
+
+        # /metrics is the same scrape surface the dashboard exposes,
+        # labeled serve series included
+        conn.request("GET", "/metrics")
+        r = conn.getresponse()
+        text = r.read().decode()
+        assert r.status == 200
+        assert 'tpudl_serve_requests_total{status="ok"}' in text
+        assert 'tpudl_serve_model_version{model="mnist"} 1' in text
+    registry.close()
+
+
+def test_healthz_503_while_swap_in_flight(tmp_path, metrics):
+    net = _net(72)
+    p = str(tmp_path / "m.zip")
+    net.save(p)
+    registry = ModelRegistry(max_batch=4, max_latency_ms=2)
+    registry.deploy("m", p)
+    with ModelServer(registry) as srv:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=30)
+        with registry._swap():        # the deploy-time readiness window
+            conn.request("GET", "/healthz")
+            r = conn.getresponse()
+            assert r.status == 503
+            assert json.loads(r.read())["status"] == "swapping"
+        conn.request("GET", "/healthz")
+        assert conn.getresponse().status == 200
+    registry.close()
+
+
+def test_error_status_mapping():
+    assert error_status(Overloaded("x")) == 429
+    assert error_status(DeadlineExceeded("x")) == 504
+    assert error_status(TimeoutError()) == 504
+    assert error_status(KeyError("m")) == 404
+    assert error_status(ValueError("bad")) == 400
+    assert error_status(RuntimeError("other")) == 500
+
+
+# ----------------------------------------------------- ParallelInference
+def test_parallel_inference_shim_shed_mode(metrics):
+    from deeplearning4j_tpu.parallel import ParallelInference
+    model = _BlockingModel()
+    pi = ParallelInference(model, batch_limit=1, queue_limit=1,
+                           timeout_ms=1, shed=True)
+    try:
+        first = pi.output_async(np.zeros((1, 4), np.float32))
+        time.sleep(0.2)
+        held = pi.output_async(np.zeros((1, 4), np.float32))
+        with pytest.raises(Overloaded):
+            pi.output_async(np.zeros((1, 4), np.float32))
+        model.release.set()
+        first.result(timeout=30)
+        held.result(timeout=30)
+        assert pi.engine.queue_limit == 1
+    finally:
+        model.release.set()
+        pi.shutdown()
+
+
+def test_parallel_inference_shim_propagates_submit_side_errors(metrics):
+    class Exploding:
+        def output(self, x):
+            raise RuntimeError("forward failed")
+
+    with pytest.raises(RuntimeError, match="forward failed"):
+        from deeplearning4j_tpu.parallel import ParallelInference
+        with ParallelInference(Exploding(), batch_limit=4,
+                               timeout_ms=1) as pi:
+            pi.output(np.zeros((1, 4), np.float32))
