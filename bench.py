@@ -128,6 +128,12 @@ def bench_resnet50(batch: int = 256, image: int = 224, steps: int = 12,
 
     # warmup (compile) + phase attribution ride the same tracer
     tracer, phases = _phase_spans(trainer, batch_ds, key, steps, warmup)
+    # the warmup queued the step's background cost analysis — a REAL
+    # duplicate XLA compile that would contend with the very steps it
+    # grades; let it land before entering the measured region (generous
+    # timeout: a ResNet-50 TPU compile outlives drain's 60s default)
+    from deeplearning4j_tpu.obs import costmodel
+    costmodel.drain(timeout_s=300.0)
     step_s = _timed_region(lambda: trainer.fit_batch(batch_ds, key),
                            float, steps)
     get_registry().histogram("tpudl_bench_step_seconds").observe(step_s)
@@ -139,8 +145,16 @@ def bench_resnet50(batch: int = 256, image: int = 224, steps: int = 12,
     img_per_sec = batch * steps / dt
     n_chips = max(len(jax.devices()), 1)
     per_chip = img_per_sec / n_chips
-    # utilization lines (VERDICT r2 weak #2/#3: every row carries MFU)
-    mfu = per_chip * RESNET50_TRAIN_GFLOP_PER_IMG / 1e3 / V5E_PEAK_BF16_TFLOPS
+    # utilization lines from the MEASURED program: the trainer's cost
+    # model pulled FLOPs/bytes from the compiled step's cost_analysis;
+    # feed it the bench's own best-of step time so mfu/hbm_util come
+    # from the compiler's accounting, not hand-derived constants
+    costmodel.observe_step(trainer._last_step_fn, step_s,
+                           sig=getattr(trainer, "_last_step_sig", None))
+    perf = costmodel.bench_detail() or {}
+    # hand-derived fallback lines kept for cross-checking the model
+    mfu_proxy = (per_chip * RESNET50_TRAIN_GFLOP_PER_IMG / 1e3
+                 / V5E_PEAK_BF16_TFLOPS)
     hbm = per_chip * RESNET50_TRAIN_MB_PER_IMG / 1e3
     return {
         "metric": "resnet50_train_images_per_sec_per_chip",
@@ -151,7 +165,11 @@ def bench_resnet50(batch: int = 256, image: int = 224, steps: int = 12,
             "batch": batch, "image": image, "steps": steps,
             "step_time_ms": round(1000 * dt / steps, 2),
             "phases": phases,
-            "mfu": round(mfu, 3),
+            "mfu": perf.get("mfu", round(mfu_proxy, 3)),
+            "hbm_util": perf.get("hbm_util"),
+            "arith_intensity": perf.get("arith_intensity"),
+            "perf": perf,
+            "mfu_hand_proxy": round(mfu_proxy, 3),
             "hbm_gbps_sustained": round(hbm, 1),
             "hbm_roof_fraction": round(hbm / V5E_HBM_GBPS, 3),
             "device": str(jax.devices()[0]),
@@ -209,6 +227,14 @@ def bench_bert_mlm(batch: int = 32, seq_len: int = 128, steps: int = 30,
         return loss
 
     step_s = _timed_region(run, jax.device_get, steps, repeats)
+    # measured roofline stamp: FLOPs/bytes from the compiled step's own
+    # cost_analysis (the analytic 6PT estimate below stays as the
+    # cross-check the estimate-vs-compiler gap is judged by)
+    from deeplearning4j_tpu.obs import costmodel
+    perf = costmodel.measure(
+        step, costmodel.abstractify((state[0], state[1], ids, labels,
+                                     weights, attn, key)),
+        step_s, kind="bench:bert_mlm") or {}
     # transformer train FLOPs ≈ 6·P·tokens + attention 12·L·T²·H·Dh·3
     # (fwd+bwd); the 6PT term dominates at seq 128.  The word-embedding
     # table's matmul is the MLM decode — credited only for the positions
@@ -225,7 +251,12 @@ def bench_bert_mlm(batch: int = 32, seq_len: int = 128, steps: int = 30,
             "batch": batch, "seq_len": seq_len,
             "max_predictions": config.max_predictions,
             "tflops_per_step": round(flops / 1e12, 2),
-            "mfu": round(flops / step_s / 1e12 / V5E_PEAK_BF16_TFLOPS, 3),
+            "mfu": perf.get("mfu", round(
+                flops / step_s / 1e12 / V5E_PEAK_BF16_TFLOPS, 3)),
+            "hbm_util": perf.get("hbm_util"),
+            "arith_intensity": perf.get("arith_intensity"),
+            "mfu_analytic": round(
+                flops / step_s / 1e12 / V5E_PEAK_BF16_TFLOPS, 3),
             # nominal peak (197) is not reachable on this part: an 8192³
             # bf16 matmul (zero overhead, measured in-program via
             # lax.scan) sustains ~130 TFLOP/s — see bench/PROFILE.md
@@ -335,6 +366,9 @@ def bench_dcn_multislice(steps: int = 6, batch: int = 32) -> dict:
     net0.init()
     tr0 = Trainer(net0)
     key = jax.random.key(2)
+    from deeplearning4j_tpu.obs import costmodel
+    tr0.fit_batch(half, key)            # compile + queue cost analysis
+    costmodel.drain(timeout_s=300.0)    # keep its duplicate compile out
     plain_s = wall(lambda: tr0.fit_batch(half, key), steps)
 
     out = {"grad_mb": None, "plain_step_ms": round(plain_s * 1e3, 2)}
@@ -358,6 +392,7 @@ def bench_dcn_multislice(steps: int = 6, batch: int = 32) -> dict:
         try:
             for _ in range(6):      # τ burn-in toward the target sparsity
                 trainer.fit_batch(data, key)
+            costmodel.drain(timeout_s=300.0)   # codec analyses out of the region
             s = wall(lambda: trainer.fit_batch(data, key), steps)
             ws = trainer.last_wire_stats[0]
             out["grad_mb"] = round(ws["dense_bytes"] / 2 ** 20, 1)
@@ -440,6 +475,8 @@ def _bench_net_step(net, features, labels, steps=20, warmup=3, repeats=3):
     for _ in range(warmup):
         loss = trainer.fit_batch(batch, key)
     float(loss)
+    from deeplearning4j_tpu.obs import costmodel
+    costmodel.drain()   # background cost analysis out of the timed region
     return round(_timed_region(lambda: trainer.fit_batch(batch, key),
                                float, steps, repeats) * 1000, 2)
 
@@ -499,17 +536,21 @@ def bench_serving(timeout_s: float = 300.0) -> dict:
 
 def _probe_device(timeout_s: float = 30.0) -> tuple[str, str] | None:
     """Touch the accelerator in a SUBPROCESS with a hard timeout: a down
-    TPU tunnel makes backend init HANG (not raise), which would leave the
-    whole bench run recording nothing.  Returns None when the device
+    TPU tunnel makes backend init HANG (not raise) in some environments
+    and silently FALL BACK to CPU in others — either way the TPU bench
+    has nothing to measure.  Returns None when a real accelerator
     answers, else ``(status, message)`` where status is ``"skipped"``
-    (probe timed out — tunnel down, nothing to measure; BENCH_r05 burned
-    5 minutes at the old 300s timeout to report rc=1) or ``"error"``
-    (device answered with a failure worth a non-zero exit)."""
+    (probe timed out or answered with a CPU — tunnel down; BENCH_r05
+    burned 5 minutes at the old 300s timeout to report rc=1, and the
+    CPU-fallback mode would grind the full suite for hours to report a
+    meaningless vs_baseline) or ``"error"`` (device answered with a
+    failure worth a non-zero exit)."""
     import subprocess
     try:
         p = subprocess.run(
             [sys.executable, "-c",
-             "import jax; print(len(jax.devices()), jax.devices()[0])"],
+             "import jax; d = jax.devices(); "
+             "print(d[0].platform, len(d), d[0])"],
             capture_output=True, timeout=timeout_s)
     except subprocess.TimeoutExpired:
         return ("skipped",
@@ -517,6 +558,11 @@ def _probe_device(timeout_s: float = 30.0) -> tuple[str, str] | None:
     if p.returncode != 0:
         return ("error", f"device probe failed (rc={p.returncode}): "
                          f"{p.stderr.decode()[-200:]}")
+    answer = p.stdout.decode().strip()
+    if answer.startswith("cpu"):
+        return ("skipped",
+                f"TPU tunnel down: jax fell back to CPU ({answer!r}) — "
+                f"nothing TPU-measurable; CPU rows still run")
     return None
 
 
@@ -539,6 +585,16 @@ def main():
             detail["serving"] = bench_serving()
         except Exception as e:
             detail["serving"] = {"error": str(e)[:200]}
+        # a tunnel-down round still reports roofline numbers: lift the
+        # cost_analysis-derived stamp out of whichever CPU record
+        # produced one (feed_overlap trains a real net under the cost
+        # model; serving measures its compiled forward)
+        for record in (detail.get("feed_overlap"), detail.get("serving")):
+            if isinstance(record, dict) and record.get("mfu") is not None:
+                for key in ("mfu", "hbm_util", "arith_intensity"):
+                    detail[key] = record.get(key)
+                detail["perf"] = record.get("perf")
+                break
         print(json.dumps({"metric": "resnet50_train_images_per_sec_per_chip",
                           "value": 0.0, "unit": "images/sec/chip",
                           "vs_baseline": 0.0, "status": status, "error": err,
@@ -579,6 +635,12 @@ def main():
                 result["detail"]["serving"] = bench_serving()
             except Exception as e:
                 result["detail"]["serving"] = {"error": str(e)[:200]}
+            try:  # per-compiled-program cost breakdown (top-K by FLOPs)
+                from deeplearning4j_tpu.obs import costmodel
+                result["detail"]["perf_top_programs"] = \
+                    costmodel.top_programs(5)
+            except Exception:
+                pass
             print(json.dumps(result))
             return 0
         except Exception as e:  # OOM etc. → halve the batch and retry

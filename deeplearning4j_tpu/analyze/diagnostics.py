@@ -190,6 +190,19 @@ _RULE_LIST = [
         "Build the jit-wrapped forward once at setup (serve.engine "
         "caches one compiled forward per model config via "
         "train.step_cache) and close over it in the handler."),
+    RuleInfo(
+        "TPU310", "span-or-dump-misuse", ERROR,
+        "tracing.span(...) opened without a with block, or a flight-"
+        "recorder dump/record call inside a jit-compiled function "
+        "(host I/O in traced code)",
+        "span() returns a context manager — called bare, the span never "
+        "opens, never closes, and silently records nothing; a flight-"
+        "recorder dump/record inside a @jit function runs file I/O at "
+        "TRACE time (once, at compile — not per step), so the black box "
+        "it pretends to keep is never written during execution.",
+        "Open spans as 'with tracing.span(...):'; move flight-recorder "
+        "calls outside the jit boundary (record around the step call, "
+        "not inside the traced function)."),
 ]
 
 RULES: dict[str, RuleInfo] = {r.id: r for r in _RULE_LIST}

@@ -13,7 +13,8 @@ without a sync fence), TPU303 (Python control flow on traced args),
 TPU304 (bare shard_map/pmap imports bypassing utils/jax_compat),
 TPU307 (per-batch host transfer in a training loop), TPU308 (swallowed
 exception in a training loop), TPU309 (jax.jit built per request in a
-serving handler).  Registry-backed rules that ride along in
+serving handler), TPU310 (span opened without `with` / flight-recorder
+I/O inside jit).  Registry-backed rules that ride along in
 ``lint_package``/``--self``: TPU305 (metric names — the former
 ``obs.check`` lint) and TPU306 (op-spec catalog integrity).
 """
@@ -597,6 +598,148 @@ def _is_jit_build(mod: ModuleInfo, node: ast.Call) -> bool:
     return mod.is_jit_ref(node.func)
 
 
+# flight-recorder functions whose body is host file/ring I/O — calling
+# them inside traced code runs once at trace time, not per step
+_FLIGHT_IO_NAMES = {"dump", "record", "progress"}
+
+
+def _span_import_aliases(mod: ModuleInfo) -> tuple[set, set, set, set]:
+    """(names bound to obs.tracing.span, local names bound to the
+    tracing MODULE, names bound to flight-IO functions, local names
+    bound to the flight_recorder MODULE — from both
+    ``from ... import X [as y]`` and ``import ...X as y``) for TPU310.
+    Receiver matching uses these real bindings, never guessed
+    identifiers: an unrelated local object that happens to be called
+    ``recorder`` or ``tracing`` must not flag."""
+    span_names: set[str] = set()
+    span_modules: set[str] = set()
+    flight_names: set[str] = set()
+    flight_modules: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom):
+            m = node.module or ""
+            for alias in node.names:
+                bound = alias.asname or alias.name
+                if alias.name == "span" and m.endswith("tracing"):
+                    span_names.add(bound)
+                elif alias.name == "span" and m.endswith(".obs"):
+                    span_names.add(bound)
+                elif alias.name == "tracing":
+                    span_modules.add(bound)
+                elif m.endswith("flight_recorder") \
+                        and alias.name in _FLIGHT_IO_NAMES:
+                    flight_names.add(bound)
+                elif alias.name == "flight_recorder":
+                    flight_modules.add(bound)
+                elif alias.name == "obs":
+                    # ``from deeplearning4j_tpu import obs`` — the
+                    # submodules are reached as obs.tracing / obs.<fr>
+                    span_modules.add(bound + ".tracing")
+                    flight_modules.add(bound + ".flight_recorder")
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                # un-aliased dotted imports are reached by their FULL
+                # dotted path — record that chain, not just the root
+                bound = alias.asname or alias.name
+                if alias.name.endswith("flight_recorder"):
+                    flight_modules.add(bound)
+                elif alias.name.endswith("tracing"):
+                    span_modules.add(bound)
+                elif alias.name.endswith(".obs"):
+                    span_modules.add(bound + ".tracing")
+                    flight_modules.add(bound + ".flight_recorder")
+    return span_names, span_modules, flight_names, flight_modules
+
+
+def _dotted_receiver(expr: ast.expr) -> Optional[str]:
+    """Flatten a Name / dotted-Attribute chain to ``a.b.c`` (None for
+    anything dynamic — a subscripted or called receiver never matches)."""
+    parts: list[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_span_call(node: ast.Call, span_names: set,
+                  span_modules: set) -> bool:
+    f = node.func
+    if isinstance(f, ast.Name) and f.id in span_names:
+        return True
+    return (isinstance(f, ast.Attribute) and f.attr == "span"
+            and _dotted_receiver(f.value) in span_modules)
+
+
+def _is_flight_io_call(node: ast.Call, flight_names: set,
+                       flight_modules: set) -> bool:
+    f = node.func
+    if isinstance(f, ast.Name) and f.id in flight_names:
+        return True
+    return (isinstance(f, ast.Attribute) and f.attr in _FLIGHT_IO_NAMES
+            and _dotted_receiver(f.value) in flight_modules)
+
+
+@register_lint_rule("TPU310")
+def _rule_span_or_dump_misuse(mod: ModuleInfo) -> list[Diagnostic]:
+    """Two host-I/O-in-the-wrong-place shapes with one ID:
+
+    1. ``tracing.span(...)`` evaluated outside a ``with`` item — the
+       generator-backed context manager is never entered, so the span
+       neither opens nor records (a silently-dead instrumentation
+       line).  Context exprs of ``with``, ``stack.enter_context(...)``
+       arguments and ``return span(...)`` factories are fine.
+    2. a flight-recorder ``dump``/``record``/``progress`` call inside a
+       jit-compiled function — file/ring I/O in traced code fires once
+       at trace time and never again.
+    """
+    span_names, span_modules, flight_names, flight_modules = \
+        _span_import_aliases(mod)
+    if not (span_names or span_modules or flight_names or flight_modules):
+        return []   # imports neither tracing nor flight_recorder —
+                    # skip the three full-tree scan walks below
+    out = []
+    # -- span-without-with: collect allowed span-call positions
+    allowed: set[int] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                allowed.add(id(item.context_expr))
+        elif isinstance(node, ast.Call) and isinstance(node.func,
+                                                      ast.Attribute) \
+                and node.func.attr == "enter_context":
+            for arg in node.args:
+                allowed.add(id(arg))
+        elif isinstance(node, ast.Return) and node.value is not None:
+            allowed.add(id(node.value))   # factory: caller will `with` it
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) \
+                and _is_span_call(node, span_names, span_modules) \
+                and id(node) not in allowed:
+            out.append(Diagnostic(
+                "TPU310",
+                "span(...) called outside a with block — the context "
+                "manager is never entered, so the span neither opens "
+                "nor records anything",
+                path=mod.anchor(node)))
+    # -- flight-recorder I/O inside jit-compiled functions
+    for fn in mod.jit_functions:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and _is_flight_io_call(node, flight_names,
+                                           flight_modules):
+                out.append(Diagnostic(
+                    "TPU310",
+                    f"flight-recorder host I/O inside jit-compiled "
+                    f"'{getattr(fn, 'name', '<lambda>')}' runs at trace "
+                    f"time only — the black box is never written during "
+                    f"execution",
+                    path=mod.anchor(node)))
+    return out
+
+
 # ------------------------------------------------------------ drivers
 def iter_python_files(paths: Iterable[str]) -> tuple[list[str], list[str]]:
     """(python files to lint, unusable input paths).  Explicitly-named
@@ -654,8 +797,7 @@ def check_metric_names(registry=None) -> Report:
     Installs the standard catalog into the registry (idempotent) and
     validates every registered name."""
     from deeplearning4j_tpu.obs.registry import (
-        METRIC_NAME_RE, Counter, Histogram, get_registry,
-        install_standard_metrics)
+        METRIC_NAME_RE, get_registry, install_standard_metrics)
     r = registry if registry is not None else get_registry()
     install_standard_metrics(r)
     report = Report()
@@ -668,9 +810,11 @@ def check_metric_names(registry=None) -> Report:
                        f"violates tpudl_<area>_<name> "
                        f"({METRIC_NAME_RE.pattern})", path=name)
             continue
-        if isinstance(metric, Counter) and not name.endswith("_total"):
+        # prom_type covers the labeled variants too (LabeledHistogram is
+        # not a Histogram subclass but renders histogram series)
+        if metric.prom_type == "counter" and not name.endswith("_total"):
             report.add("TPU305", "counters must end in _total", path=name)
-        if isinstance(metric, Histogram) and not (
+        if metric.prom_type == "histogram" and not (
                 name.endswith("_seconds") or name.endswith("_bytes")):
             report.add("TPU305", "histograms must end in _seconds or _bytes",
                        path=name)

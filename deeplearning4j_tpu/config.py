@@ -72,6 +72,14 @@ class Config:
       dumps land.
     - ``profiling``: capture a ``jax.profiler`` trace (HLO-level,
       Perfetto-viewable) around ``Trainer.fit`` into ``trace_dir``.
+    - ``costmodel``: roofline cost model (``obs.costmodel``) — pull
+      FLOPs/bytes from each compiled step via XLA ``cost_analysis`` and
+      publish per-step MFU / HBM-utilization gauges (``tpudl_perf_*``).
+      The step path itself only pays dict lookups, but the analysis is
+      an AOT *duplicate* of the program's XLA compile, run once per
+      program on a background worker (host CPU seconds-to-minutes for
+      big models; a persistent-cache hit when ``compile_cache_dir`` is
+      set).  On by default; ``DL4J_TPU_COSTMODEL=0`` disables.
     """
 
     debug: bool = False
@@ -87,6 +95,7 @@ class Config:
     profiling: bool = False
     tracing: bool = False
     trace_dir: str = "traces"
+    costmodel: bool = True
 
     @classmethod
     def from_env(cls) -> "Config":

@@ -10,9 +10,12 @@ from deeplearning4j_tpu.obs.listeners import (
 from deeplearning4j_tpu.obs.metrics import MetricsWriter
 from deeplearning4j_tpu.obs.profiler import check_finite, StepTimer
 from deeplearning4j_tpu.obs.registry import (
-    Counter, Gauge, Histogram, MetricsRegistry,
+    Counter, Gauge, Histogram, LabeledCounter, LabeledGauge,
+    LabeledHistogram, MetricsRegistry,
     get_registry, set_registry, install_standard_metrics,
     record_device_memory)
+from deeplearning4j_tpu.obs import costmodel, flight_recorder
+from deeplearning4j_tpu.obs.flight_recorder import FlightRecorder, Watchdog
 from deeplearning4j_tpu.obs.stats import (
     StatsListener, InMemoryStatsStorage, FileStatsStorage,
     render_html_report, render_html)
@@ -36,6 +39,13 @@ __all__ = [
     "Counter",
     "Gauge",
     "Histogram",
+    "LabeledCounter",
+    "LabeledGauge",
+    "LabeledHistogram",
+    "costmodel",
+    "flight_recorder",
+    "FlightRecorder",
+    "Watchdog",
     "MetricsRegistry",
     "get_registry",
     "set_registry",

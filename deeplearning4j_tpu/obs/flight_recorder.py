@@ -1,0 +1,475 @@
+"""Flight recorder — every failure leaves a black box.
+
+All five MULTICHIP rounds died rc=124 with one warning line of tail;
+nothing recorded what the gang was doing when it stalled.  This module
+is the black box: a bounded in-process ring buffer of recent events
+(steps, DCN exchanges, serve dispatches, finished spans, notes), plus a
+watchdog thread and dump triggers, so a hang or crash leaves a per-host
+JSONL report instead of silence.
+
+- :func:`record` appends an event to the ring (deque, O(1), lock-free
+  enough for step loops); :func:`progress` additionally stamps a
+  liveness site for the watchdog.
+- :func:`dump` writes the black box: a header (reason, pid, host), a
+  stack trace of EVERY live thread, the ring's recent events, a
+  snapshot of the metrics registry, the cost model's top programs, and
+  device state — one JSON object per line, appended to a per-host file.
+- :class:`Watchdog` fires when NO instrumented site has made progress
+  within ``deadline_s``.  It arms on the *first* progress stamp, so a
+  process that never touches an instrumented site (a plain collective
+  worker) is never killed by it — the launcher's wall timeout backstops
+  those.  On fire it dumps, prints the stall report to stderr (the only
+  channel a harness tail captures), and optionally ``os._exit``\\ s with
+  :data:`WATCHDOG_EXIT_CODE` so a gang member converts a silent rc=124
+  into a structured per-host stall report.
+- :func:`install_handlers` chains dumps onto ``sys.excepthook``,
+  ``threading.excepthook`` and ``SIGTERM``; :func:`install_from_env`
+  is the one-call child-process form ``spawn_local_cluster`` wires via
+  ``DL4J_TPU_FLIGHT_DUMP`` / ``DL4J_TPU_WATCHDOG_S``.
+
+The ring records regardless of tracing; when tracing is ON, finished
+spans are mirrored into the ring too (span hook registered at import),
+so a dump carries the last N spans with durations and attributes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Any, Callable, Optional
+
+DUMP_ENV = "DL4J_TPU_FLIGHT_DUMP"
+WATCHDOG_ENV = "DL4J_TPU_WATCHDOG_S"
+WATCHDOG_FIRES_ENV = "DL4J_TPU_WATCHDOG_FIRES"
+WATCHDOG_GRACE_ENV = "DL4J_TPU_WATCHDOG_GRACE_S"
+WATCHDOG_EXIT_CODE = 87      # distinct from rc=124 (harness) / rc=1 (error)
+
+RING_CAPACITY = 512
+SPAN_ATTR_LIMIT = 8          # attrs kept per mirrored span event
+
+
+class FlightRecorder:
+    """Bounded ring of recent events + liveness stamps + dump writer."""
+
+    def __init__(self, capacity: int = RING_CAPACITY):
+        self._ring: deque = deque(maxlen=capacity)
+        # reentrant: the SIGTERM/excepthook dump runs on the main thread
+        # and must not deadlock when the signal lands while that same
+        # thread is inside record()/progress() holding this lock
+        self._lock = threading.RLock()
+        self._progress: dict[str, float] = {}     # site → monotonic stamp
+        self._progress_count = 0
+
+    # ------------------------------------------------------------ events
+    def record(self, kind: str, **data: Any) -> None:
+        event = {"t": time.time(), "mono": time.monotonic(), "kind": kind}
+        event.update(data)
+        with self._lock:
+            self._ring.append(event)
+
+    def progress(self, site: str, **data: Any) -> None:
+        """Liveness stamp: the watchdog considers the process healthy as
+        long as SOME site keeps stamping.  Data-carrying stamps also
+        land in the ring as ``progress`` events; bare stamps only touch
+        the liveness table (hot-path sites stamp every step — echoing
+        each one into the ring would halve the useful event history)."""
+        now = time.monotonic()
+        with self._lock:
+            self._progress[site] = now
+            self._progress_count += 1
+        if data:
+            self.record("progress", site=site, **data)
+
+    def events(self, last_n: Optional[int] = None) -> list[dict]:
+        with self._lock:
+            items = list(self._ring)
+        return items if last_n is None else items[-last_n:]
+
+    def last_progress(self) -> tuple[Optional[str], Optional[float], int]:
+        """(most recent site, its monotonic stamp, total stamps)."""
+        with self._lock:
+            if not self._progress:
+                return None, None, self._progress_count
+            site = max(self._progress, key=self._progress.get)
+            return site, self._progress[site], self._progress_count
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._progress.clear()
+            self._progress_count = 0
+
+    # -------------------------------------------------------------- dump
+    def _thread_stacks(self) -> list[dict]:
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = []
+        for ident, frame in sys._current_frames().items():
+            out.append({
+                "type": "thread", "tid": ident,
+                "name": names.get(ident, "?"),
+                "stack": traceback.format_stack(frame),
+            })
+        return out
+
+    def _metrics_snapshot(self) -> dict:
+        try:
+            from deeplearning4j_tpu.obs.registry import get_registry
+            reg = get_registry()
+            return {name: getattr(reg.get(name), "value",
+                                  getattr(reg.get(name), "count", None))
+                    for name in reg.names()}
+        except Exception as e:
+            return {"error": repr(e)}
+
+    def _device_state(self) -> dict:
+        """Best-effort device facts.  Touches jax only if it is already
+        imported — a dump during a wedged backend init must not hang on
+        its own telemetry."""
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return {"note": "jax not imported"}
+        try:
+            devices = jax.local_devices()
+            out = {"n_local_devices": len(devices),
+                   "platform": devices[0].platform if devices else None,
+                   "device_kind": (getattr(devices[0], "device_kind", None)
+                                   if devices else None)}
+            stats = devices[0].memory_stats() if devices else None
+            if stats:
+                out["memory_stats"] = {k: int(v) for k, v in stats.items()
+                                       if isinstance(v, (int, float))}
+            return out
+        except Exception as e:
+            return {"error": repr(e)}
+
+    def dump(self, path: Optional[str] = None, reason: str = "explicit",
+             last_n: Optional[int] = None, detail: Optional[dict] = None
+             ) -> str:
+        """Write one black-box block (JSONL) and return the path.  Never
+        raises — a failing dump prints to stderr and returns the path it
+        tried."""
+        path = path or default_dump_path()
+        lines: list[dict] = [{
+            "type": "header", "reason": reason, "time": time.time(),
+            "pid": os.getpid(), "host": socket.gethostname(),
+            "argv": sys.argv[:4], "detail": detail or {},
+        }]
+        site, stamp, count = self.last_progress()
+        lines.append({"type": "liveness", "last_site": site,
+                      "stalled_for_s": (None if stamp is None else
+                                        round(time.monotonic() - stamp, 3)),
+                      "progress_stamps": count})
+        lines.extend(self._thread_stacks())
+        for event in self.events(last_n):
+            lines.append({"type": "event", **event})
+        lines.append({"type": "metrics", "values": self._metrics_snapshot()})
+        try:
+            from deeplearning4j_tpu.obs import costmodel
+            lines.append({"type": "cost_breakdown",
+                          "top_programs": costmodel.top_programs(5)})
+        except Exception:
+            pass
+        lines.append({"type": "device", **self._device_state()})
+        try:
+            directory = os.path.dirname(path)
+            if directory:
+                os.makedirs(directory, exist_ok=True)
+            with open(path, "a") as f:
+                for line in lines:
+                    f.write(json.dumps(line, default=str) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError as e:
+            print(f"flight-recorder: dump to {path} failed: {e}",
+                  file=sys.stderr)
+        return path
+
+
+_recorder = FlightRecorder()
+
+
+def get_recorder() -> FlightRecorder:
+    return _recorder
+
+
+def record(kind: str, **data: Any) -> None:
+    _recorder.record(kind, **data)
+
+
+def progress(site: str, **data: Any) -> None:
+    _recorder.progress(site, **data)
+
+
+def dump(path: Optional[str] = None, reason: str = "explicit",
+         **kw: Any) -> str:
+    return _recorder.dump(path, reason=reason, **kw)
+
+
+def default_dump_path() -> str:
+    """Per-host (really per-process) dump file: the env override wins
+    (the launcher points each gang child at its own file), else
+    ``config.trace_dir``."""
+    env = os.environ.get(DUMP_ENV)
+    if env:
+        return env
+    from deeplearning4j_tpu.config import get_config
+    return os.path.join(get_config().trace_dir,
+                        f"flight_{socket.gethostname()}_{os.getpid()}.jsonl")
+
+
+# ------------------------------------------------------------- span hook
+def _span_finished(span) -> None:
+    attrs = dict(list(span.attributes.items())[:SPAN_ATTR_LIMIT])
+    _recorder.record("span", name=span.name,
+                     duration_ms=round(span.duration_s * 1e3, 3),
+                     device_sync_ms=round(span.device_sync_s * 1e3, 3),
+                     trace_id=span.trace_id, span_id=span.span_id,
+                     attributes={k: (v if isinstance(v, (int, float, str,
+                                                         bool, type(None)))
+                                     else str(v)) for k, v in attrs.items()})
+
+
+def _register_span_hook() -> None:
+    from deeplearning4j_tpu.obs import tracing
+    tracing.add_span_hook(_span_finished)
+
+
+_register_span_hook()
+
+
+# -------------------------------------------------------------- watchdog
+class Watchdog:
+    """Fires once when no progress stamp lands within ``deadline_s``.
+
+    ``arm_on_first_progress`` (the gang-child default) starts the clock
+    at the first stamp, so uninstrumented workloads are never killed;
+    ``arm_on_first_progress=False`` starts it immediately (a process
+    that never reaches its first step is itself a stall).
+
+    ``fires_before_exit`` > 1 gives slow-but-alive phases grace: each
+    fire short of the threshold dumps + reports and RE-ARMS (the fire
+    counts as a synthetic stamp), and any real progress resets the
+    count — only ``fires_before_exit`` consecutive dead deadlines
+    ``os._exit``.  A legitimately long XLA compile between stamps then
+    costs a spurious dump, not the process."""
+
+    def __init__(self, deadline_s: float,
+                 recorder: Optional[FlightRecorder] = None,
+                 dump_path: Optional[str] = None,
+                 on_fire: Optional[Callable[[dict], None]] = None,
+                 exit_code: Optional[int] = None,
+                 arm_on_first_progress: bool = True,
+                 poll_s: Optional[float] = None,
+                 fires_before_exit: int = 1,
+                 exit_grace_s: Optional[float] = None):
+        self.deadline_s = float(deadline_s)
+        self.recorder = recorder or _recorder
+        self.dump_path = dump_path
+        self.on_fire = on_fire
+        self.exit_code = exit_code
+        self.arm_on_first_progress = arm_on_first_progress
+        self.poll_s = poll_s or max(0.2, min(2.0, self.deadline_s / 5.0))
+        self.fires_before_exit = max(1, int(fires_before_exit))
+        # gang members stall on the SAME collective, so sibling watchdogs
+        # fire within ~one poll interval of each other — but this child's
+        # os._exit kills the jax coordination service and the siblings
+        # insta-abort (absl fatal, no Python handlers) before their own
+        # dumps land.  Hold the exit one grace window so every stalled
+        # sibling writes its black box first.
+        self.exit_grace_s = (self.poll_s + 0.5 if exit_grace_s is None
+                             else max(0.0, float(exit_grace_s)))
+        self.fired = threading.Event()
+        self._stop = threading.Event()
+        self._t0 = time.monotonic()
+        self._fire_count = 0
+        self._last_fire = None          # monotonic time of last fire
+        self._last_stamp_seen = None    # progress stamp at last fire
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="tpudl-flight-watchdog")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            site, stamp, count = self.recorder.last_progress()
+            if stamp is None:
+                if self.arm_on_first_progress:
+                    continue            # not armed yet
+                stamp = self._t0        # armed since construction
+            if stamp != self._last_stamp_seen and self._last_stamp_seen \
+                    is not None:
+                self._fire_count = 0    # real progress since last fire
+            baseline = stamp if self._last_fire is None \
+                else max(stamp, self._last_fire)
+            stalled = time.monotonic() - baseline
+            if stalled >= self.deadline_s:
+                self._fire(site, time.monotonic() - stamp, stamp)
+                if self._fire_count >= self.fires_before_exit:
+                    return
+
+    def _fire(self, site: Optional[str], stalled_s: float,
+              stamp: Optional[float]) -> None:
+        self.fired.set()
+        self._fire_count += 1
+        self._last_fire = time.monotonic()
+        self._last_stamp_seen = stamp
+        final = self._fire_count >= self.fires_before_exit
+        facts = {"stalled_site": site, "stalled_for_s": round(stalled_s, 3),
+                 "deadline_s": self.deadline_s,
+                 "fire": self._fire_count,
+                 "fires_before_exit": self.fires_before_exit}
+        self.recorder.record("watchdog_fired", **facts)
+        path = self.recorder.dump(self.dump_path, reason="watchdog",
+                                  detail=facts)
+        print(f"flight-recorder watchdog: no progress for "
+              f"{stalled_s:.1f}s (deadline {self.deadline_s:.1f}s, last "
+              f"site {site!r}, fire {self._fire_count}/"
+              f"{self.fires_before_exit}) — black box dumped to {path}",
+              file=sys.stderr, flush=True)
+        if self.on_fire is not None:
+            try:
+                self.on_fire(facts)
+            except Exception:
+                pass
+        if final and self.exit_code is not None:
+            # a gang member must DIE visibly, not linger: the parent
+            # then collects this child's dump instead of timing out —
+            # but not before sibling watchdogs (firing within ~poll_s of
+            # this one) have written THEIR dumps; this exit tears down
+            # the coordination service and aborts them mid-flight
+            if self.exit_grace_s > 0:
+                time.sleep(self.exit_grace_s)
+            # the grace window can race a clean shutdown (stop() from a
+            # finishing main thread) or late real progress (the slow
+            # phase completed just past the deadline) — a process that
+            # is demonstrably alive must not be reported as a stall
+            if self._stop.is_set():
+                return
+            _, stamp_now, _ = self.recorder.last_progress()
+            if stamp_now is not None and stamp_now != stamp:
+                self._fire_count = 0    # late progress: re-arm
+                return
+            os._exit(self.exit_code)
+
+
+_watchdog: Optional[Watchdog] = None
+
+
+def start_watchdog(deadline_s: float, **kw: Any) -> Watchdog:
+    """Start (or replace) the process watchdog."""
+    global _watchdog
+    if _watchdog is not None:
+        _watchdog.stop()
+    _watchdog = Watchdog(deadline_s, **kw)
+    return _watchdog
+
+
+def stop_watchdog() -> None:
+    global _watchdog
+    if _watchdog is not None:
+        _watchdog.stop()
+        _watchdog = None
+
+
+# ------------------------------------------------------- crash triggers
+_handlers_installed = False
+
+
+def install_handlers(dump_path: Optional[str] = None) -> None:
+    """Chain black-box dumps onto unhandled exceptions (main + worker
+    threads) and SIGTERM.  Idempotent; previous hooks keep running."""
+    global _handlers_installed
+    if _handlers_installed:
+        return
+    _handlers_installed = True
+
+    prev_except = sys.excepthook
+
+    def _excepthook(exc_type, exc, tb):
+        _recorder.record("unhandled_exception", error=repr(exc))
+        _recorder.dump(dump_path, reason="unhandled_exception",
+                       detail={"error": repr(exc)})
+        prev_except(exc_type, exc, tb)
+
+    sys.excepthook = _excepthook
+
+    prev_thread = threading.excepthook
+
+    def _thread_hook(args):
+        _recorder.record("thread_exception", error=repr(args.exc_value),
+                         thread=getattr(args.thread, "name", "?"))
+        _recorder.dump(dump_path, reason="thread_exception",
+                       detail={"error": repr(args.exc_value)})
+        prev_thread(args)
+
+    threading.excepthook = _thread_hook
+
+    if threading.current_thread() is threading.main_thread():
+        try:
+            prev_term = signal.getsignal(signal.SIGTERM)
+
+            def _on_term(signum, frame):
+                _recorder.record("sigterm")
+                _recorder.dump(dump_path, reason="sigterm")
+                if callable(prev_term):
+                    prev_term(signum, frame)
+                elif prev_term is signal.SIG_IGN:
+                    return      # was deliberately ignored: dump, survive
+                else:
+                    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                    os.kill(os.getpid(), signal.SIGTERM)
+
+            signal.signal(signal.SIGTERM, _on_term)
+        except (ValueError, OSError):
+            pass    # non-main interpreter thread / restricted env
+
+
+def install_from_env() -> Optional[Watchdog]:
+    """Child-process bootstrap: ``DL4J_TPU_FLIGHT_DUMP`` installs the
+    crash handlers aimed at that file; ``DL4J_TPU_WATCHDOG_S``
+    additionally starts a stall watchdog that dumps and ``_exit``\\ s
+    with :data:`WATCHDOG_EXIT_CODE` (the spawn_local_cluster gang
+    contract)."""
+    dump_path = os.environ.get(DUMP_ENV)
+    deadline = os.environ.get(WATCHDOG_ENV)
+    if not dump_path and not deadline:
+        return None
+    install_handlers(dump_path)
+    if deadline:
+        grace = os.environ.get(WATCHDOG_GRACE_ENV)
+        return start_watchdog(
+            float(deadline), dump_path=dump_path,
+            exit_code=WATCHDOG_EXIT_CODE,
+            arm_on_first_progress=True,
+            fires_before_exit=int(os.environ.get(WATCHDOG_FIRES_ENV, "1")),
+            exit_grace_s=float(grace) if grace else None)
+    return None
+
+
+def read_dump(path: str) -> list[dict]:
+    """Parse a dump file back into its JSON lines (tolerant of trailing
+    partial lines from a killed writer)."""
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return out

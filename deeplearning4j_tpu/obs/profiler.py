@@ -26,20 +26,50 @@ class NonFiniteError(RuntimeError):
     pass
 
 
+@jax.jit
+def _finite_flags(leaves):
+    """ONE fused device reduction over every inexact leaf: (any NaN,
+    any Inf) as two scalars.  Re-traced per distinct leaf-list structure
+    (cached thereafter); the alternative — a ``jnp.any`` + host ``bool``
+    per leaf — costs one device→host sync per parameter tensor."""
+    nan = jnp.zeros((), jnp.bool_)
+    inf = jnp.zeros((), jnp.bool_)
+    for leaf in leaves:
+        nan = jnp.logical_or(nan, jnp.any(jnp.isnan(leaf)))
+        inf = jnp.logical_or(inf, jnp.any(jnp.isinf(leaf)))
+    return nan, inf
+
+
 def check_finite(tree: Any, label: str = "output") -> None:
-    """NAN_PANIC/INF_PANIC parity: raise on the first non-finite leaf.
-    Only called by the trainer when ``config.nan_panic``/``inf_panic`` is
-    set — it forces a device sync, so it's off by default."""
+    """NAN_PANIC/INF_PANIC parity: raise when any leaf holds a
+    non-finite value.  Only called by the trainer when
+    ``config.nan_panic``/``inf_panic`` is set — it forces a device sync,
+    so it's off by default.
+
+    The scan is batched: all leaves reduce on device in one fused
+    program and ONE (nan, inf) pair crosses to the host.  Only after a
+    hit does the slow per-leaf walk run, to name the offending path."""
     cfg = get_config()
     if not (cfg.nan_panic or cfg.inf_panic):
         return
-    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        if not hasattr(leaf, "dtype") or not jnp.issubdtype(leaf.dtype, jnp.inexact):
-            continue
-        if cfg.nan_panic and bool(jnp.any(jnp.isnan(leaf))):
+    flat = [(path, leaf) for path, leaf
+            in jax.tree_util.tree_flatten_with_path(tree)[0]
+            if hasattr(leaf, "dtype")
+            and jnp.issubdtype(leaf.dtype, jnp.inexact)]
+    if not flat:
+        return
+    nan_flag, inf_flag = _finite_flags([leaf for _, leaf in flat])
+    has_nan = cfg.nan_panic and bool(nan_flag)
+    has_inf = cfg.inf_panic and bool(inf_flag)
+    if not (has_nan or has_inf):
+        return
+    # failure path only: walk leaves to anchor the error message
+    for path, leaf in flat:
+        if has_nan and bool(jnp.any(jnp.isnan(leaf))):
             raise NonFiniteError(f"NaN detected in {label} at {path}")
-        if cfg.inf_panic and bool(jnp.any(jnp.isinf(leaf))):
+        if has_inf and bool(jnp.any(jnp.isinf(leaf))):
             raise NonFiniteError(f"Inf detected in {label} at {path}")
+    raise NonFiniteError(f"non-finite value detected in {label}")
 
 
 def enable_debug_nans(enable: bool = True) -> None:

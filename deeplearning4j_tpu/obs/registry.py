@@ -60,7 +60,10 @@ class Metric:
     def __init__(self, name: str, help: str = ""):
         self.name = name
         self.help = help
-        self._lock = threading.Lock()
+        # reentrant: the flight recorder's signal-path dump snapshots
+        # metric values from the main thread, which may have been
+        # interrupted while holding this very lock inside observe()/set()
+        self._lock = threading.RLock()
 
     def render(self) -> list[str]:
         raise NotImplementedError
@@ -194,6 +197,81 @@ class LabeledGauge(_LabeledMixin, Gauge):
         self.inc(-amount, **labels)
 
 
+class LabeledHistogram(_LabeledMixin, Metric):
+    """Histogram with label dimensions, e.g.
+    ``tpudl_perf_step_seconds{program="train:..."}`` — one full
+    bucket/sum/count series per label-value tuple.  ``count``/``sum``
+    aggregate across every child (the unlabeled totals)."""
+
+    prom_type = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Sequence[float] = DEFAULT_TIME_BUCKETS,
+                 label_names: Sequence[str] = ("program",)):
+        super().__init__(name, help)
+        b = sorted(float(x) for x in buckets)
+        if not b:
+            raise ValueError(f"histogram {name} needs at least one bucket")
+        self.buckets = tuple(b)
+        self.label_names = tuple(label_names)
+        # child key → one plain Histogram; all bucket accounting lives
+        # in Histogram so the two layouts can never diverge
+        self._children: dict[tuple, "Histogram"] = {}
+
+    def _child(self, key: tuple) -> "Histogram":
+        child = self._children.get(key)
+        if child is None:
+            child = Histogram(self.name, self.help, self.buckets)
+            self._children[key] = child
+        return child
+
+    def observe(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            child = self._child(key)
+        child.observe(value)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return sum(c.count for c in self._children.values())
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return sum(c.sum for c in self._children.values())
+
+    def labeled_count(self, **labels) -> int:
+        with self._lock:
+            child = self._children.get(self._key(labels))
+        return child.count if child else 0
+
+    def bucket_counts(self, **labels) -> dict:
+        """Cumulative counts keyed by upper bound for ONE labeled series."""
+        with self._lock:
+            child = self._children.get(self._key(labels))
+        if child is not None:
+            return child.bucket_counts()
+        out = {ub: 0 for ub in self.buckets}
+        out[math.inf] = 0
+        return out
+
+    def render(self) -> list[str]:
+        with self._lock:
+            items = sorted(self._children.items())
+        lines = []
+        for key, child in items:
+            pairs = ",".join(f'{n}="{_escape_label(v)}"'
+                             for n, v in zip(self.label_names, key))
+            buckets, total, count = child._snapshot()
+            for ub, cum in buckets.items():
+                lines.append(f'{self.name}_bucket{{{pairs},le="{_fmt(ub)}"}} '
+                             f'{cum}')
+            lines.append(f"{self.name}_sum{{{pairs}}} {_fmt(total)}")
+            lines.append(f"{self.name}_count{{{pairs}}} {count}")
+        return lines
+
+
 class Histogram(Metric):
     """Fixed-bucket histogram (cumulative buckets, Prometheus layout)."""
 
@@ -264,7 +342,7 @@ class MetricsRegistry:
 
     def __init__(self, validate_names: bool = True):
         self._metrics: dict[str, Metric] = {}
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()   # signal-path dump may re-enter
         self.validate_names = validate_names
 
     def _get_or_create(self, cls, name: str, help: str, **kwargs) -> Metric:
@@ -319,6 +397,14 @@ class MetricsRegistry:
     def histogram(self, name: str, help: str = "",
                   buckets: Sequence[float] = DEFAULT_TIME_BUCKETS) -> Histogram:
         return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def labeled_histogram(self, name: str, help: str = "",
+                          buckets: Sequence[float] = DEFAULT_TIME_BUCKETS,
+                          label_names: Sequence[str] = ("program",)
+                          ) -> LabeledHistogram:
+        return self._get_or_create(LabeledHistogram, name, help,
+                                   buckets=buckets,
+                                   label_names=tuple(label_names))
 
     def get(self, name: str) -> Optional[Metric]:
         return self._metrics.get(name)
@@ -465,6 +551,40 @@ def install_standard_metrics(registry: Optional[MetricsRegistry] = None) -> dict
         r.labeled_gauge("tpudl_serve_model_version",
                         "Version currently serving per deployed model "
                         "name", ("model",)),
+        r.gauge("tpudl_perf_mfu",
+                "Model FLOPs utilization of the most recent measured "
+                "step: XLA cost_analysis FLOPs / step wall time / "
+                "backend peak FLOP/s (obs.costmodel)"),
+        r.gauge("tpudl_perf_hbm_util",
+                "HBM-bandwidth utilization of the most recent measured "
+                "step: cost_analysis bytes accessed / step wall time / "
+                "backend peak bytes/s"),
+        r.gauge("tpudl_perf_arith_intensity",
+                "Arithmetic intensity (FLOPs per byte of memory "
+                "traffic) of the most recently analyzed compiled "
+                "program"),
+        r.gauge("tpudl_perf_roofline_fraction",
+                "Achieved FLOP/s as a fraction of the roofline ceiling "
+                "at the program's arithmetic intensity "
+                "(min(peak_flops, AI x peak_bw))"),
+        r.gauge("tpudl_perf_peak_flops",
+                "Backend peak FLOP/s assumed by the cost model "
+                "(per-device; from the peak table or "
+                "DL4J_TPU_PEAK_TFLOPS)"),
+        r.gauge("tpudl_perf_peak_hbm_bytes",
+                "Backend peak memory bandwidth in bytes/s assumed by "
+                "the cost model (or DL4J_TPU_PEAK_HBM_GBPS)"),
+        r.labeled_gauge("tpudl_perf_program_flops",
+                        "cost_analysis FLOPs per execution of each "
+                        "analyzed compiled program", ("program",)),
+        r.labeled_gauge("tpudl_perf_program_bytes",
+                        "cost_analysis bytes accessed per execution of "
+                        "each analyzed compiled program", ("program",)),
+        r.labeled_histogram("tpudl_perf_step_seconds",
+                            "Measured wall time per execution of each "
+                            "cost-model-analyzed program (the "
+                            "denominator of MFU/HBM utilization)",
+                            label_names=("program",)),
     ]
     return {m.name: m for m in metrics}
 

@@ -115,6 +115,22 @@ NULL_SPAN = _NullSpan()
 _current_span: contextvars.ContextVar[Optional[Span]] = \
     contextvars.ContextVar("dl4j_tpu_current_span", default=None)
 
+# observers notified on every finished span (the flight recorder mirrors
+# spans into its ring here); hooks must be cheap and never raise
+_span_hooks: list = []
+
+
+def add_span_hook(hook) -> None:
+    """Register ``hook(span)`` to run on every finished span (any
+    tracer).  Idempotent per function object."""
+    if hook not in _span_hooks:
+        _span_hooks.append(hook)
+
+
+def remove_span_hook(hook) -> None:
+    if hook in _span_hooks:
+        _span_hooks.remove(hook)
+
 
 def _new_id() -> str:
     return uuid.uuid4().hex[:16]
@@ -174,6 +190,11 @@ class Tracer:
                 self.spans.append(s)
             else:
                 self.dropped += 1
+        for hook in _span_hooks:
+            try:
+                hook(s)
+            except Exception:
+                pass   # telemetry observers must never break the traced code
 
     def clear(self) -> None:
         with self._lock:

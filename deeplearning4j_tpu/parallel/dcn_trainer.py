@@ -57,7 +57,7 @@ import jax.flatten_util  # registers jax.flatten_util (not a jax re-export)
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.obs import tracing
+from deeplearning4j_tpu.obs import flight_recorder, tracing
 from deeplearning4j_tpu.obs.registry import get_registry
 from deeplearning4j_tpu.parallel import mesh as mesh_mod
 from deeplearning4j_tpu.parallel.compression import (
@@ -307,6 +307,10 @@ class MultiSliceTrainer:
         (overlap mode), where the ambient contextvar doesn't reach."""
         import time as _time
         t0 = _time.perf_counter()
+        # liveness stamp BEFORE the wire: a stalled exchange then shows
+        # up as "last site dcn.exchange, stalled for Ns" in the
+        # flight-recorder dump instead of a silent rc=124
+        flight_recorder.progress("dcn.exchange")
         with tracing.span("exchange", parent=parent, slice=rank,
                           wire_bytes=int(compact.size) * 4):
             grank = self.rank_offset + rank
@@ -324,8 +328,13 @@ class MultiSliceTrainer:
                               for m in ordered])
             # H2D on the IO thread (overlapped too in overlap mode)
             out = mesh_mod.replicate(self.meshes[rank], jnp.asarray(stack))
-        get_registry().histogram("tpudl_dcn_exchange_seconds").observe(
-            _time.perf_counter() - t0)
+        dt = _time.perf_counter() - t0
+        get_registry().histogram("tpudl_dcn_exchange_seconds").observe(dt)
+        flight_recorder.progress("dcn.exchange")
+        flight_recorder.record("exchange", slice=rank,
+                               rank=self.rank_offset + rank,
+                               wire_bytes=int(compact.size) * 4,
+                               duration_ms=round(dt * 1e3, 3))
         return out
 
     def _slice_step_device(self, rank, features, labels, fmask, lmask, rng):
@@ -338,6 +347,20 @@ class MultiSliceTrainer:
             batch = mesh_mod.shard_batch(
                 m, {"f": features, "l": labels, "fm": fmask, "lm": lmask})
             alg = self.algorithms[rank]
+            # roofline cost model: abstract signature captured before the
+            # call (the residual buffer is donated), analyzed after
+            from deeplearning4j_tpu.obs import costmodel
+            analyze_args = None
+            # per-signature entries: a ragged tail retraces a second
+            # program, whose cost facts must not inherit the first's
+            sig = costmodel.shape_sig(
+                (batch["f"], batch["l"], batch["fm"], batch["lm"]))
+            if costmodel.should_analyze(self._grad_encode_fn, sig=sig):
+                analyze_args = costmodel.abstractify(
+                    (self.slice_params[rank], self.slice_state[rank],
+                     batch["f"], batch["l"], batch["fm"], batch["lm"],
+                     self.slice_residual[rank], rng,
+                     jnp.float32(alg.current())))
             with tracing.span("encode", slice=rank):
                 loss, new_state, msg, new_residual, res_linf = \
                     self._grad_encode_fn(
@@ -348,6 +371,11 @@ class MultiSliceTrainer:
                 self.slice_residual[rank] = new_residual
                 self.slice_state[rank] = new_state
                 msg_np = np.asarray(msg)  # the ONLY bulk D2H: 3+2cap int32s
+            if analyze_args is not None:
+                # duplicate XLA compile → background worker, never the
+                # slice-step path
+                costmodel.schedule_analysis(self._grad_encode_fn,
+                                            analyze_args, sig=sig)
             compact = compact_device_message(msg_np, self.capacity)
             alg.update(int(msg_np[0]), self.grad_size)
             self._record_wire(rank, msg_np, compact, float(res_linf))
@@ -430,6 +458,7 @@ class MultiSliceTrainer:
         from deeplearning4j_tpu.train.trainer import _batch_masks
         self._ensure_ready()
         faults.fire("trainer.step", index=self.iteration)
+        flight_recorder.progress("trainer.step")
         n = self.n_slices
         feats = np.asarray(batch.features)
         labels = np.asarray(batch.labels)
@@ -458,6 +487,9 @@ class MultiSliceTrainer:
             mean_loss = float(np.mean(losses))
             sp.set_attribute("score", mean_loss)
         self.last_wire_stats = list(self._wire_tmp)
+        flight_recorder.progress("trainer.step")
+        flight_recorder.record("step", iteration=self.iteration,
+                               slices=n, score=mean_loss)
         self.bus.dispatch("iteration_done", self.net, self.iteration, 0,
                           mean_loss)
         self.iteration += 1

@@ -51,6 +51,8 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count={local_devices}")
 import jax
 jax.config.update("jax_platforms", "cpu")  # sitecustomize may pin a TPU platform
+from deeplearning4j_tpu.obs import flight_recorder as _fr
+_fr.install_from_env()   # black box: crash handlers + gang-deadline watchdog
 jax.distributed.initialize(coordinator_address="127.0.0.1:{port}",
                            num_processes={n}, process_id={pid})
 with open({fn_path!r}, "rb") as f:
@@ -66,7 +68,26 @@ class ClusterTimeoutError(RuntimeError):
     NOT retryable: its message embeds every child's stderr tail, which
     routinely contains coordinator-join noise ('connection refused')
     that must not be mistaken for a startup flake — re-running a
-    timed-out gang would multiply an already-spent timeout."""
+    timed-out gang would multiply an already-spent timeout.
+
+    ``flight_dumps`` maps process id → that child's parsed flight-
+    recorder dump lines (empty when the child never dumped)."""
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        self.flight_dumps: dict = {}
+
+
+class ClusterStallError(RuntimeError):
+    """One or more gang members' flight-recorder watchdogs fired (no
+    step/exchange progress within the gang deadline): the per-host
+    black boxes are attached as ``flight_dumps`` (pid → parsed JSONL
+    lines with thread stacks, recent spans/events, metric snapshot).
+    NOT retryable — a deterministic stall would just stall again."""
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        self.flight_dumps: dict = {}
 
 
 # stderr fingerprints of a flaky STARTUP (stale coordinator port, racing
@@ -80,7 +101,7 @@ _STARTUP_FLAKE_MARKERS = ("address already in use", "failed to bind",
 
 def _is_startup_flake(e: BaseException) -> bool:
     from deeplearning4j_tpu.resilience.retry import default_retryable
-    if isinstance(e, ClusterTimeoutError):
+    if isinstance(e, (ClusterTimeoutError, ClusterStallError)):
         return False
     if default_retryable(e):
         return True
@@ -89,7 +110,8 @@ def _is_startup_flake(e: BaseException) -> bool:
         marker in msg for marker in _STARTUP_FLAKE_MARKERS)
 
 
-def _terminate_then_kill(procs, grace: float = 3.0) -> list[str]:
+def _terminate_then_kill(procs, grace: float = 3.0,
+                         first_pid: int = 0) -> list[str]:
     """Stop every child (TERM, grace period, then KILL) and return each
     one's captured stderr tail — a timed-out gang must leave no orphans
     and no silent diagnostics."""
@@ -110,15 +132,49 @@ def _terminate_then_kill(procs, grace: float = 3.0) -> list[str]:
         except (subprocess.TimeoutExpired, ValueError, OSError):
             stderr = b""
         rc = proc.poll()
-        tails.append(f"process {pid} rc={rc} stderr tail: "
+        tails.append(f"process {first_pid + pid} rc={rc} stderr tail: "
                      f"{(stderr or b'').decode(errors='replace')[-800:]}")
     return tails
 
 
+def _collect_flight_dumps(workdir: str, n_processes: int) -> dict:
+    """pid → parsed flight-recorder dump lines for every child that
+    wrote one (missing/empty dumps → absent)."""
+    from deeplearning4j_tpu.obs import flight_recorder
+    dumps = {}
+    for pid in range(n_processes):
+        lines = flight_recorder.read_dump(
+            os.path.join(workdir, f"flight_{pid}.jsonl"))
+        if lines:
+            dumps[pid] = lines
+    return dumps
+
+
+def _dump_summary(dumps: dict) -> str:
+    """One readable line per dumped child for the raised error message
+    (the full parsed dumps ride on the exception's ``flight_dumps``)."""
+    if not dumps:
+        return "no flight-recorder dumps found"
+    lines = []
+    for pid, entries in sorted(dumps.items()):
+        header = next((e for e in entries if e.get("type") == "header"), {})
+        live = next((e for e in entries if e.get("type") == "liveness"), {})
+        threads = sum(1 for e in entries if e.get("type") == "thread")
+        events = sum(1 for e in entries if e.get("type") == "event")
+        lines.append(
+            f"process {pid} black box: reason={header.get('reason')} "
+            f"last_site={live.get('last_site')} "
+            f"stalled_for_s={live.get('stalled_for_s')} "
+            f"({threads} thread stacks, {events} ring events)")
+    return "\n".join(lines)
+
+
 def _spawn_once(fn: Callable, n_processes: int, port: int,
                 local_devices: int, timeout: float,
-                extra_env: Optional[dict]) -> list:
-    from deeplearning4j_tpu.obs import tracing
+                extra_env: Optional[dict],
+                gang_deadline: Optional[float],
+                gang_fires: int = 1) -> list:
+    from deeplearning4j_tpu.obs import flight_recorder, tracing
     from deeplearning4j_tpu.resilience import faults
     faults.fire("launcher.spawn")
     workdir = tempfile.mkdtemp(prefix="dl4j_tpu_cluster_")
@@ -137,6 +193,16 @@ def _spawn_once(fn: Callable, n_processes: int, port: int,
         env = dict(os.environ)
         env.pop("XLA_FLAGS", None)  # template sets its own
         env.update(trace_env)
+        # every child gets a black box: crash/SIGTERM dumps always, plus
+        # a stall watchdog when a gang deadline is set.  Tracing is
+        # turned on alongside so the dump's ring carries the last N
+        # spans, not just raw events.
+        env[flight_recorder.DUMP_ENV] = os.path.join(
+            workdir, f"flight_{pid}.jsonl")
+        if gang_deadline is not None:
+            env[flight_recorder.WATCHDOG_ENV] = str(float(gang_deadline))
+            env[flight_recorder.WATCHDOG_FIRES_ENV] = str(int(gang_fires))
+            env.setdefault("DL4J_TPU_TRACING", "1")
         if extra_env:
             env.update(extra_env)
         procs.append(subprocess.Popen([sys.executable, "-c", script], env=env,
@@ -144,6 +210,7 @@ def _spawn_once(fn: Callable, n_processes: int, port: int,
                                       stderr=subprocess.PIPE))
     results = []
     errors = []
+    stalled = []
     # ONE wall-clock budget for the whole gang: jax.distributed blocks
     # until every process joins, so child 0 timing out means they all did
     deadline = time.monotonic() + timeout
@@ -152,29 +219,74 @@ def _spawn_once(fn: Callable, n_processes: int, port: int,
             _, stderr = proc.communicate(
                 timeout=max(0.1, deadline - time.monotonic()))
         except subprocess.TimeoutExpired:
-            # a hung gang member: stop EVERY child (terminate → grace →
-            # kill) and surface each one's stderr — the raised error must
-            # say which process wedged and why, not just "timed out"
+            # a hung gang member past even the watchdog: stop EVERY child
+            # (terminate → grace → kill) and surface each one's stderr
+            # AND whatever black boxes landed — the raised error must say
+            # which process wedged and why, not just "timed out"
             tails = _terminate_then_kill(procs)
-            raise ClusterTimeoutError(
+            dumps = _collect_flight_dumps(workdir, n_processes)
+            err = ClusterTimeoutError(
                 f"local cluster timed out after {timeout:.0f}s waiting for "
                 f"process {pid}; all {n_processes} children stopped:\n"
-                + "\n".join(tails))
-        if proc.returncode != 0:
+                + "\n".join(stalled + tails) + "\n" + _dump_summary(dumps))
+            err.flight_dumps = dumps
+            raise err
+        if proc.returncode == flight_recorder.WATCHDOG_EXIT_CODE:
+            stalled.append(f"process {pid} stalled (flight-recorder "
+                           f"watchdog, gang deadline "
+                           f"{gang_deadline}s): {stderr.decode()[-400:]}")
+            # one stalled member wedges every sibling on its collectives
+            # and the gang is going to raise regardless — stop the rest
+            # instead of letting them burn the remaining wall clock.
+            # But the siblings are stalled on the SAME exchange: their
+            # own watchdogs fire within ~a poll interval of this one, so
+            # first give every still-alive sibling one short window to
+            # write its black box (killed pre-dump = no thread stacks
+            # for that child, and per-child dumps are the point)
+            rest = procs[pid + 1:]
+            if rest:
+                grace_deadline = time.monotonic() + min(
+                    5.0, gang_deadline or 5.0)
+                while time.monotonic() < grace_deadline and any(
+                        p.poll() is None and not os.path.exists(
+                            os.path.join(workdir, f"flight_{q}.jsonl"))
+                        for q, p in enumerate(rest, start=pid + 1)):
+                    time.sleep(0.05)
+                time.sleep(0.2)     # let an in-flight dump write finish
+                errors.extend(
+                    f"stopped after sibling stall: {tail}"
+                    for tail in _terminate_then_kill(rest,
+                                                     first_pid=pid + 1))
+            break
+        elif proc.returncode != 0:
             errors.append(f"process {pid} rc={proc.returncode}: "
                           f"{stderr.decode()[-800:]}")
         elif os.path.exists(out_paths[pid]):
             with open(out_paths[pid], "rb") as f:
                 results.append(pickle.load(f))
+    if stalled:
+        # one stalled member wedges the whole gang (collectives block);
+        # siblings usually die of the same watchdog — report them all,
+        # with every child's black box attached
+        dumps = _collect_flight_dumps(workdir, n_processes)
+        err = ClusterStallError(
+            "local cluster stalled:\n" + "\n".join(stalled + errors)
+            + "\n" + _dump_summary(dumps))
+        err.flight_dumps = dumps
+        raise err
     if errors:
-        raise RuntimeError("local cluster failed:\n" + "\n".join(errors))
+        dumps = _collect_flight_dumps(workdir, n_processes)
+        err = RuntimeError("local cluster failed:\n" + "\n".join(errors))
+        err.flight_dumps = dumps
+        raise err
     return results
 
 
 def spawn_local_cluster(fn: Callable, n_processes: int = 2, port: int = 12655,
                         local_devices: int = 1, timeout: float = 120.0,
                         extra_env: Optional[dict] = None,
-                        startup_retries: int = 2) -> list:
+                        startup_retries: int = 2,
+                        gang_deadline: Optional[float] = None) -> list:
     """Run ``fn(process_index, process_count)`` in N fresh local processes
     under a real jax.distributed runtime (CPU, loopback).  Returns each
     process's pickled return value.  ``fn`` must be picklable (module-level
@@ -187,11 +299,39 @@ def spawn_local_cluster(fn: Callable, n_processes: int = 2, port: int = 12655,
     to ``startup_retries`` times on a shifted port with backoff
     (``resilience.retry``, site ``launcher.spawn``).
 
+    Flight recorder: every child dumps a black box (thread stacks, the
+    last N spans/events, metric snapshot) on crash or SIGTERM.
+    ``gang_deadline`` additionally arms a per-child stall watchdog: a
+    child whose instrumented sites (``trainer.step``, ``dcn.exchange``,
+    ...) make no progress for that long dumps its box and exits, and
+    the raised :class:`ClusterStallError` / :class:`ClusterTimeoutError`
+    carries every child's parsed dump as ``.flight_dumps`` — the next
+    rc=124 is a per-host stall report, not silence.  When not passed,
+    the deadline defaults to half the wall budget with one grace fire
+    (first dead deadline dumps + re-arms; the second exits 87 still
+    inside ``timeout``), so a legitimately slow XLA compile between
+    stamps never kills a healthy gang; an explicit ``gang_deadline``
+    is one-strike.  The watchdog arms on a child's FIRST progress
+    stamp, so workers that never touch an instrumented site are only
+    bounded by ``timeout``.  Pass ``gang_deadline=0`` to disable the
+    watchdog.
+
     When tracing is active in the launching process, its span context is
     handed to every worker via ``DL4J_TPU_TRACE_CONTEXT`` — worker spans
     parent under the launcher's current span, so one Chrome trace shows
     the whole cluster."""
     from deeplearning4j_tpu.resilience.retry import RetryPolicy, with_retries
+    gang_fires = 1
+    if gang_deadline is None:
+        # silently-armed default: half the wall budget with ONE grace
+        # fire, so a child whose XLA compile legitimately outlives one
+        # deadline costs a spurious dump, not the gang — a genuine stall
+        # still exits 87 at 2×deadline, inside the wall clock.  Callers
+        # who pass an explicit deadline asked for one-strike semantics.
+        gang_deadline = max(5.0, (timeout - 15.0) / 2.0)
+        gang_fires = 2
+    elif gang_deadline <= 0:
+        gang_deadline = None
     attempt = {"n": 0}
 
     def _once():
@@ -200,7 +340,7 @@ def spawn_local_cluster(fn: Callable, n_processes: int = 2, port: int = 12655,
         # a fresh port per retry: the usual flake is the previous gang's
         # coordinator socket lingering in TIME_WAIT
         return _spawn_once(fn, n_processes, port + i * 97, local_devices,
-                           timeout, extra_env)
+                           timeout, extra_env, gang_deadline, gang_fires)
 
     policy = RetryPolicy(max_attempts=1 + max(0, startup_retries),
                          base_delay_s=0.2, jitter=0.0,

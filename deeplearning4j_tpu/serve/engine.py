@@ -45,7 +45,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from deeplearning4j_tpu.data.device_pipeline import _pad_rows, choose_bucket
-from deeplearning4j_tpu.obs import tracing
+from deeplearning4j_tpu.obs import costmodel, flight_recorder, tracing
 from deeplearning4j_tpu.obs.registry import get_registry
 from deeplearning4j_tpu.train import step_cache
 
@@ -72,6 +72,7 @@ class _Request:
     future: Future
     t_submit: float                   # perf_counter at submit
     deadline: Optional[float]         # absolute perf_counter deadline
+    trace_id: Optional[str] = None    # X-Trace-Id propagated end to end
 
     @property
     def n(self) -> int:
@@ -157,7 +158,8 @@ class InferenceEngine:
     # ------------------------------------------------------------- submit
     def submit(self, x, mask=None, deadline_ms: Optional[float] = None,
                block: bool = False,
-               timeout_s: Optional[float] = None) -> Future:
+               timeout_s: Optional[float] = None,
+               trace_id: Optional[str] = None) -> Future:
         """Enqueue one request of ``[n, ...]`` examples; returns a Future
         resolving to the ``[n, ...]`` outputs.
 
@@ -165,7 +167,10 @@ class InferenceEngine:
         :class:`Overloaded`; ``block=True`` (the historical
         ``ParallelInference`` contract) blocks the submitting thread —
         memory stays bounded either way.  ``deadline_ms`` bounds the
-        time the request may wait before dispatch."""
+        time the request may wait before dispatch.  ``trace_id`` (the
+        HTTP layer's ``X-Trace-Id``) rides through to the ``serve`` span
+        and the flight-recorder ring, so one request is findable across
+        the front-end, the batcher, and a black-box dump."""
         if self._closed.is_set():
             raise EngineClosed(f"engine {self.name!r} is shut down")
         x = np.asarray(x)
@@ -175,7 +180,8 @@ class InferenceEngine:
             x, None if mask is None else np.asarray(mask), Future(),
             time.perf_counter(),
             None if deadline_ms is None
-            else time.perf_counter() + float(deadline_ms) / 1e3)
+            else time.perf_counter() + float(deadline_ms) / 1e3,
+            trace_id=trace_id)
         reg = get_registry()
         try:
             if block:
@@ -199,10 +205,11 @@ class InferenceEngine:
         return req.future
 
     def predict(self, x, mask=None, deadline_ms: Optional[float] = None,
-                timeout_s: Optional[float] = None) -> np.ndarray:
+                timeout_s: Optional[float] = None,
+                trace_id: Optional[str] = None) -> np.ndarray:
         """Blocking submit + wait."""
-        return self.submit(x, mask=mask,
-                           deadline_ms=deadline_ms).result(timeout=timeout_s)
+        return self.submit(x, mask=mask, deadline_ms=deadline_ms,
+                           trace_id=trace_id).result(timeout=timeout_s)
 
     # ------------------------------------------------------------- worker
     def _run(self) -> None:
@@ -293,37 +300,76 @@ class InferenceEngine:
                     features = _pad_rows(features, bucket)
                     if mask is not None:
                         mask = _pad_rows(mask, bucket)
+            trace_ids = [r.trace_id for r in live if r.trace_id]
             traces_before = step_cache.jit_cache_entries(self._fwd)
+            analyze_args = None
+            # per-bucket cost entries: one forward fn holds one compiled
+            # program PER bucket, and bucket-B's wall time must be
+            # attributed bucket-B's FLOPs, not the first-analyzed one's
+            if self._fwd is not None \
+                    and costmodel.should_analyze(self._fwd, sig=bucket):
+                analyze_args = costmodel.abstractify(
+                    (self.model.params_, self.model.state_, features, mask))
             with tracing.span("serve", model=self.name, rows=rows,
                               requests=len(live), bucket=bucket,
                               queue_wait_ms=round(queue_wait_s * 1e3, 3)
                               ) as sp:
+                if trace_ids:
+                    sp.set_attribute("trace_ids", ",".join(trace_ids))
                 t0 = time.perf_counter()
                 out = np.asarray(tracing.device_sync(
                     self._forward(features, mask)))
-                sp.set_attribute(
-                    "device_ms", round((time.perf_counter() - t0) * 1e3, 3))
+                device_s = time.perf_counter() - t0
+                sp.set_attribute("device_ms", round(device_s * 1e3, 3))
                 if padded:
                     sp.set_attribute("padded", padded)
         except BaseException as e:
+            flight_recorder.record("serve_error", model=self.name,
+                                   requests=len(live), error=repr(e)[:200])
             for req in live:
                 requests_c.inc(status="error")
                 if not req.future.done():
                     req.future.set_exception(e)
             return
-        retraced = step_cache.jit_cache_entries(self._fwd) - traces_before
-        if retraced > 0:
-            reg.counter("tpudl_serve_recompiles_total").inc(retraced)
-        reg.counter("tpudl_serve_batches_total").inc()
-        reg.gauge("tpudl_serve_batch_size").set(bucket)
-        latency_h = reg.histogram("tpudl_serve_latency_seconds")
         end = time.perf_counter()
+        try:
+            # telemetry first (a caller returning from result() must see
+            # the batch's metrics settled) but GUARDED: the worker's
+            # "every Future resolves" contract must survive an
+            # observability failure (e.g. the cost-model analyzer thread
+            # failing to start under fd/thread pressure)
+            retraced = step_cache.jit_cache_entries(self._fwd) \
+                - traces_before
+            if retraced > 0:
+                reg.counter("tpudl_serve_recompiles_total").inc(retraced)
+            if analyze_args is not None:
+                costmodel.schedule_analysis(
+                    self._fwd, analyze_args,
+                    kind=(costmodel.program_kind(self._fwd)
+                          or f"serve:{type(self.model).__name__}"),
+                    sig=bucket)
+            if retraced == 0:
+                # steady-state micro-batch: serving self-reports MFU/HBM
+                # utilization of its compiled forward too
+                costmodel.observe_step(self._fwd, device_s, sig=bucket)
+            flight_recorder.progress("serve.dispatch")
+            flight_recorder.record(
+                "serve", model=self.name, rows=rows, requests=len(live),
+                bucket=bucket, device_ms=round(device_s * 1e3, 3),
+                queue_wait_ms=round(queue_wait_s * 1e3, 3),
+                **({"trace_ids": trace_ids} if trace_ids else {}))
+            reg.counter("tpudl_serve_batches_total").inc()
+            reg.gauge("tpudl_serve_batch_size").set(bucket)
+            latency_h = reg.histogram("tpudl_serve_latency_seconds")
+            for req in live:
+                requests_c.inc(status="ok")
+                latency_h.observe(end - req.t_submit)
+        except Exception:
+            pass
         offset = 0
         for req in live:
             req.future.set_result(out[offset:offset + req.n])
             offset += req.n
-            requests_c.inc(status="ok")
-            latency_h.observe(end - req.t_submit)
 
     # ----------------------------------------------------------- lifecycle
     @property

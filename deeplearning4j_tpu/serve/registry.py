@@ -177,21 +177,26 @@ class ModelRegistry:
     # --------------------------------------------------------- predict
     def predict(self, name: str, x, mask=None,
                 deadline_ms: Optional[float] = None,
-                timeout_s: Optional[float] = None):
+                timeout_s: Optional[float] = None,
+                trace_id: Optional[str] = None):
         """Route one request to the current version of ``name``.  A
         submit that races a hot-swap's drain retries against the freshly
         flipped engine — callers never observe the swap as an error."""
         return self.predict_versioned(name, x, mask=mask,
                                       deadline_ms=deadline_ms,
-                                      timeout_s=timeout_s)[0]
+                                      timeout_s=timeout_s,
+                                      trace_id=trace_id)[0]
 
     def predict_versioned(self, name: str, x, mask=None,
                           deadline_ms: Optional[float] = None,
-                          timeout_s: Optional[float] = None):
+                          timeout_s: Optional[float] = None,
+                          trace_id: Optional[str] = None):
         """Like :meth:`predict`, but returns ``(outputs, version)`` with
         the version of the entry whose engine actually answered — the
         truthful attribution during a swap window, where the *current*
-        version may already be newer than the one that served."""
+        version may already be newer than the one that served.
+        ``trace_id`` propagates into the engine's serve span / flight
+        ring (the ``X-Trace-Id`` path)."""
         for _ in range(8):
             entry = self.get(name)
             engine = entry.engine
@@ -199,7 +204,7 @@ class ModelRegistry:
                 continue
             try:
                 out = engine.predict(x, mask=mask, deadline_ms=deadline_ms,
-                                     timeout_s=timeout_s)
+                                     timeout_s=timeout_s, trace_id=trace_id)
                 return out, entry.version
             except EngineClosed:
                 continue                # swap drained this engine; refetch

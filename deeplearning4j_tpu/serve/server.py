@@ -17,6 +17,12 @@ Endpoints (TF-Serving-shaped paths):
   flight (load balancers steer away during the flip window).
 - ``GET /metrics`` — Prometheus text exposition of the process-wide
   registry (the same scrape surface the training dashboard exposes).
+
+Request tracing: ``POST :predict`` honors an ``X-Trace-Id`` request
+header (minting one when absent), propagates it into the engine's
+``serve`` span and the flight-recorder ring, and echoes it on every
+response including errors — one id follows a request across client
+logs, spans, and black-box dumps.
 """
 
 from __future__ import annotations
@@ -24,6 +30,7 @@ from __future__ import annotations
 import concurrent.futures
 import json
 import threading
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -67,11 +74,14 @@ class ModelServer:
             def log_message(self, *a):  # silence request logging
                 pass
 
-            def _send(self, code: int, obj) -> None:
+            def _send(self, code: int, obj,
+                      trace_id: Optional[str] = None) -> None:
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                if trace_id:
+                    self.send_header("X-Trace-Id", trace_id)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -106,10 +116,18 @@ class ModelServer:
                 return self._send(404, {"error": "not found"})
 
             def do_POST(self):
+                # per-request trace id: honor the caller's X-Trace-Id or
+                # mint one; it rides into the engine's serve span / the
+                # flight-recorder ring and echoes back on EVERY response
+                # (including errors and the early 404), so one request is
+                # findable across client logs, spans and black-box dumps
+                trace_id = (self.headers.get("X-Trace-Id")
+                            or uuid.uuid4().hex[:16])
                 path = self.path.split("?")[0]
                 if not (path.startswith("/v1/models/")
                         and path.endswith(_PREDICT_SUFFIX)):
-                    return self._send(404, {"error": "not found"})
+                    return self._send(404, {"error": "not found"},
+                                      trace_id=trace_id)
                 name = path[len("/v1/models/"):-len(_PREDICT_SUFFIX)]
                 length = int(self.headers.get("Content-Length") or 0)
                 raw = self.rfile.read(length) if length else b""
@@ -119,20 +137,23 @@ class ModelServer:
                 except (ValueError, KeyError, UnicodeDecodeError):
                     return self._send(
                         400, {"error": "body must be JSON with an "
-                                       "'instances' array"})
+                                       "'instances' array"},
+                        trace_id=trace_id)
                 try:
                     x = np.asarray(instances, dtype=np.float32)
                     # version of the entry that ACTUALLY answered — the
                     # current pointer may already be newer mid-swap
                     out, version = server.registry.predict_versioned(
                         name, x, deadline_ms=payload.get("deadline_ms"),
-                        timeout_s=server.request_timeout_s)
+                        timeout_s=server.request_timeout_s,
+                        trace_id=trace_id)
                 except BaseException as e:
                     return self._send(error_status(e),
-                                      {"error": f"{type(e).__name__}: {e}"})
+                                      {"error": f"{type(e).__name__}: {e}"},
+                                      trace_id=trace_id)
                 return self._send(200, {
                     "predictions": np.asarray(out).tolist(),
-                    "model_version": version})
+                    "model_version": version}, trace_id=trace_id)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]

@@ -92,9 +92,24 @@ def sharding_signature(shardings) -> str:
     return str(treedef) + "|" + "|".join(str(l) for l in leaves)
 
 
+def _cost_label(key: tuple) -> str:
+    """Human label for the roofline cost breakdown: ``kind:NetClass``
+    (key layout: (net class, conf sha, dtypes..., ..., kind))."""
+    kind = str(key[-1]) if key else "step"
+    cls = str(key[0]) if key else ""
+    return f"{kind}:{cls}" if cls else kind
+
+
 def get_or_build(key: Optional[tuple], builder: Callable[[], Any]) -> Any:
     """Return the cached step for ``key``, building (and caching) it on
-    first sight.  ``key=None`` bypasses the cache entirely."""
+    first sight.  ``key=None`` bypasses the cache entirely.
+
+    Every step that passes through here is tagged for the roofline cost
+    model (``obs.costmodel``) with its cache-key kind — this is the one
+    point every compiled step funnels through, so the per-program cost
+    breakdown gets real names (``train:MultiLayerNetwork``, ``eval:...``,
+    ``dcn_grad_encode:...``) for free."""
+    from deeplearning4j_tpu.obs import costmodel
     if key is None:
         return builder()
     reg = get_registry()
@@ -116,6 +131,7 @@ def get_or_build(key: Optional[tuple], builder: Callable[[], Any]) -> Any:
         reg.counter("tpudl_train_step_cache_misses_total").inc()
         while len(_CACHE) > MAX_ENTRIES:
             _CACHE.popitem(last=False)
+    costmodel.tag_program(fn, _cost_label(key))
     return fn
 
 

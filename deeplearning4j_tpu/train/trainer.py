@@ -28,7 +28,7 @@ from deeplearning4j_tpu.config import get_config
 from deeplearning4j_tpu.data.device_pipeline import (
     DeviceFeeder, FedBatch, ensure_feature_mask, pad_segment)
 from deeplearning4j_tpu.nn.losses import mean_score
-from deeplearning4j_tpu.obs import tracing
+from deeplearning4j_tpu.obs import costmodel, flight_recorder, tracing
 from deeplearning4j_tpu.obs.listeners import ListenerBus
 from deeplearning4j_tpu.obs.profiler import check_finite
 from deeplearning4j_tpu.obs.registry import get_registry, record_device_memory
@@ -287,6 +287,10 @@ class Trainer:
     # pytree of NamedSharding for the opt_state, set by subclasses BEFORE
     # the first step is built (ParallelWrapper's ZeRO-1 mode)
     _opt_state_shardings = None
+    # which jit program (and how many calls of it) the last fit_batch/
+    # tbptt pass ran — the cost model's per-step MFU denominator pairing
+    _last_step_fn = None
+    _last_step_calls = 1
 
     def _step_key(self, kind: str) -> Optional[tuple]:
         """Step-cache key for this trainer's config, or None (no cache)."""
@@ -366,6 +370,19 @@ class Trainer:
                     if l.wants_stats_now(net.iteration)]
         args = (net.params_, net.state_, net.opt_state,
                 batch.features, batch.labels, fmask, lmask, rng)
+        # roofline cost model: capture the call's abstract signature
+        # BEFORE the donating step invalidates the input buffers; the
+        # analysis itself (a duplicate XLA compile) runs on the
+        # costmodel's background worker, never on the step path.  The
+        # batch-shape sig keeps bucketed tails from inheriting the main
+        # bucket's FLOPs.
+        sig = (costmodel.shape_sig((batch.features, batch.labels,
+                                    fmask, lmask))
+               if costmodel.enabled() else None)
+        analyze_args = (
+            costmodel.abstractify(args)
+            if not sampling and costmodel.should_analyze(self._step, sig=sig)
+            else None)
         if sampling:
             if self._stats_step is None:
                 self._stats_step = step_cache.get_or_build(
@@ -383,6 +400,14 @@ class Trainer:
         else:
             params, state, opt_state, loss = self._step(*args)
         net.params_, net.state_, net.opt_state = params, state, opt_state
+        self._last_step_fn = self._stats_step if sampling else self._step
+        self._last_step_calls = 1
+        self._last_step_sig = sig
+        if analyze_args is not None:
+            costmodel.schedule_analysis(
+                self._step, analyze_args,
+                kind=(costmodel.program_kind(self._step)
+                      or f"train:{type(net).__name__}"), sig=sig)
         cfg = get_config()
         if cfg.nan_panic or cfg.inf_panic:
             check_finite(params, "params after step")
@@ -423,13 +448,36 @@ class Trainer:
                    if isinstance(layer, BaseRecurrentLayer) else None
                    for layer in net.layers]
         loss = None
+        analyze_args = None
+        sig = None
+        n_segments = 0
         for seg_idx, seg in enumerate(_tbptt_segments(batch, length)):
             seg_rng = jax.random.fold_in(rng, seg_idx)
+            if seg_idx == 0 and costmodel.enabled():
+                # one shared segment shape by construction (masked tail
+                # padding), so the first segment's sig covers them all
+                sig = costmodel.shape_sig(
+                    (seg.features, seg.labels, seg.features_mask,
+                     seg.labels_mask))
+                if costmodel.should_analyze(self._tbptt_step, sig=sig):
+                    analyze_args = costmodel.abstractify(
+                        (net.params_, net.state_, net.opt_state, carries,
+                         seg.features, seg.labels, seg.features_mask,
+                         seg.labels_mask, seg_rng))
             params, state, opt_state, carries, loss = self._tbptt_step(
                 net.params_, net.state_, net.opt_state, carries,
                 seg.features, seg.labels, seg.features_mask,
                 seg.labels_mask, seg_rng)
             net.params_, net.state_, net.opt_state = params, state, opt_state
+            n_segments += 1
+        self._last_step_fn = self._tbptt_step
+        self._last_step_calls = n_segments
+        self._last_step_sig = sig
+        if analyze_args is not None:
+            costmodel.schedule_analysis(
+                self._tbptt_step, analyze_args,
+                kind=(costmodel.program_kind(self._tbptt_step)
+                      or f"tbptt:{type(net).__name__}"), sig=sig)
         cfg = get_config()
         if cfg.nan_panic or cfg.inf_panic:
             check_finite(net.params_, "params after tBPTT step")
@@ -448,6 +496,7 @@ class Trainer:
         # fault-injection site: a "crash" here models preemption BEFORE
         # the step commits — the last durable checkpoint stays authoritative
         faults.fire("trainer.step", index=net.iteration)
+        flight_recorder.progress("trainer.step")
         fed = isinstance(batch, FedBatch)
         data = batch.batch if fed else batch
         first = (data.features[0] if isinstance(data.features, (list, tuple))
@@ -488,8 +537,20 @@ class Trainer:
             reg.gauge("tpudl_train_compile_seconds").set(dt)
         else:
             reg.histogram("tpudl_train_step_seconds").observe(dt)
+            # steady-state step: self-report MFU / HBM utilization against
+            # the program's cost_analysis facts (compile steps would lie —
+            # their wall time is dominated by XLA, not execution)
+            costmodel.observe_step(self._last_step_fn, dt,
+                                   calls=self._last_step_calls,
+                                   sig=getattr(self, "_last_step_sig", None))
         reg.counter("tpudl_train_steps_total").inc()
         reg.counter("tpudl_train_examples_total").inc(n_examples)
+        flight_recorder.record("step", iteration=net.iteration,
+                               epoch=net.epoch,
+                               duration_ms=round(dt * 1e3, 3),
+                               examples=n_examples,
+                               compile=bool(retraced))
+        flight_recorder.progress("trainer.step")
         net._score = loss
         for listener in self.bus.listeners:
             if hasattr(listener, "record_batch"):

@@ -247,3 +247,33 @@ def hang_worker(pid, n):
 def trivial_worker(pid, n):
     """Minimal gang member for launcher startup-retry tests."""
     return {"pid": pid, "n": n}
+
+
+def stalled_exchange_worker(pid, n):
+    """Flight-recorder acceptance rig: one LOCAL MultiSliceTrainer slice
+    per process (no cross-process collectives — this jax's CPU backend
+    lacks them) whose dcn.exchange is stalled by an injected delay
+    (``DL4J_TPU_FAULT_PLAN=dcn.exchange@1:delay:...`` via extra_env).
+    Step 0 completes (progress stamps arm the watchdog), step 1 wedges
+    in the exchange — the gang-deadline watchdog must dump the black box
+    and exit, never return."""
+    import jax
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.parallel.dcn import InProcessTransport
+    from deeplearning4j_tpu.parallel.dcn_trainer import MultiSliceTrainer
+
+    net = _small_net(seed=13 + pid)
+    x, y = global_batch(n=8, seed=pid)
+    # local_devices: under jax.distributed the global device list holds
+    # the SIBLING's device too, and CPU lacks multiprocess collectives
+    trainer = MultiSliceTrainer(net, n_slices=1, data_per_slice=1,
+                                world_size=1,
+                                devices=jax.local_devices(),
+                                transports=[InProcessTransport(1)])
+    key = jax.random.key(0)
+    try:
+        for _ in range(4):
+            trainer.fit_batch(DataSet(x, y), key)
+    finally:
+        trainer.close()
+    return {"pid": pid, "completed": True}

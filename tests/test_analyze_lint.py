@@ -344,3 +344,120 @@ def test_jit_in_setup_paths_is_fine(tmp_path):
         """)
     assert report.by_rule("TPU309") == []
     assert report.exit_code() == 0
+
+
+# ------------------------------------------------------------ TPU310
+def test_span_without_with_block(tmp_path):
+    report = _lint_source(tmp_path, """
+        from deeplearning4j_tpu.obs import tracing
+        from deeplearning4j_tpu.obs.tracing import span
+
+        def step_loop(step, batches):
+            for b in batches:
+                tracing.span("step")           # never entered
+                loss = step(b)
+            return loss
+
+        def fit(step, batches):
+            s = span("fit", epochs=1)          # bare imported name
+            for b in batches:
+                step(b)
+        """)
+    hits = report.by_rule("TPU310")
+    assert len(hits) == 2
+    assert report.exit_code() == 1
+    assert "never entered" in hits[0].message
+
+
+def test_span_with_block_and_factories_are_fine(tmp_path):
+    report = _lint_source(tmp_path, """
+        import contextlib
+        from deeplearning4j_tpu.obs import tracing
+
+        def step_loop(step, batches):
+            with tracing.span("epoch"):
+                for b in batches:
+                    with tracing.span("step", n=1) as sp:
+                        step(b)
+
+        def stacked(step):
+            with contextlib.ExitStack() as stack:
+                stack.enter_context(tracing.span("outer"))
+                step()
+
+        def span_factory(name):
+            return tracing.span(name)          # caller will `with` it
+        """)
+    assert report.by_rule("TPU310") == []
+    assert report.exit_code() == 0
+
+
+def test_flight_recorder_io_inside_jit(tmp_path):
+    report = _lint_source(tmp_path, """
+        import jax
+        from deeplearning4j_tpu.obs import flight_recorder
+        from deeplearning4j_tpu.obs.flight_recorder import record
+
+        @jax.jit
+        def step(params, x):
+            flight_recorder.dump(reason="step")   # trace-time only
+            record("step", n=1)                   # trace-time only
+            return params
+
+        def drive(step, batches):
+            for b in batches:
+                step(b)
+                flight_recorder.record("step")    # host side: fine
+        """)
+    hits = report.by_rule("TPU310")
+    assert len(hits) == 2
+    assert report.exit_code() == 1
+    assert "trace time" in hits[0].message
+
+
+def test_flight_recorder_aliases_and_unrelated_receivers(tmp_path):
+    """Receiver matching follows real import bindings: a module alias
+    (``import ...flight_recorder as fr``) is caught, and an unrelated
+    local object that happens to be named ``recorder`` is not."""
+    report = _lint_source(tmp_path, """
+        import jax
+        import deeplearning4j_tpu.obs.flight_recorder as fr
+
+        @jax.jit
+        def step(params, x):
+            fr.record("step", n=1)                # trace-time only
+            return params
+
+        @jax.jit
+        def other_step(params, recorder):
+            recorder.record(params)               # NOT flight_recorder
+            return params
+        """)
+    hits = report.by_rule("TPU310")
+    assert len(hits) == 1
+    assert "step" in hits[0].message
+
+
+def test_flight_recorder_dotted_imports_are_caught(tmp_path):
+    """Un-aliased dotted imports reach the module by its FULL dotted
+    path — both ``import a.b.flight_recorder`` + a fully-dotted call and
+    ``from deeplearning4j_tpu import obs`` + ``obs.tracing.span`` must
+    flag, not just aliased/bare-name receivers."""
+    report = _lint_source(tmp_path, """
+        import jax
+        import deeplearning4j_tpu.obs.flight_recorder
+        from deeplearning4j_tpu import obs
+
+        @jax.jit
+        def step(params, x):
+            deeplearning4j_tpu.obs.flight_recorder.record("s")  # traced
+            return params
+
+        def step_loop(step, batches):
+            for b in batches:
+                obs.tracing.span("step")          # never entered
+                step(b)
+        """)
+    hits = report.by_rule("TPU310")
+    assert len(hits) == 2
+    assert report.exit_code() == 1

@@ -1,0 +1,247 @@
+"""Flight recorder: ring semantics, dump contents, watchdog behavior,
+and the ISSUE-6 acceptance rig — a fault-injected stalled DCN exchange
+under spawn_local_cluster raises within the gang deadline with a
+per-child black box (thread stacks + the last N spans) on the error."""
+
+import functools
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+import cluster_workers  # noqa: E402
+
+from deeplearning4j_tpu.obs import flight_recorder, tracing  # noqa: E402
+
+_ENV = {"PYTHONPATH": os.path.dirname(__file__) + os.pathsep +
+        os.environ.get("PYTHONPATH", "")}
+
+
+class TestRing:
+    def test_ring_is_bounded_and_ordered(self):
+        rec = flight_recorder.FlightRecorder(capacity=4)
+        for i in range(10):
+            rec.record("step", iteration=i)
+        events = rec.events()
+        assert len(events) == 4
+        assert [e["iteration"] for e in events] == [6, 7, 8, 9]
+        assert all(e["kind"] == "step" for e in events)
+
+    def test_progress_tracks_latest_site(self):
+        rec = flight_recorder.FlightRecorder()
+        rec.progress("trainer.step")
+        time.sleep(0.01)
+        rec.progress("dcn.exchange")
+        site, stamp, count = rec.last_progress()
+        assert site == "dcn.exchange"
+        assert count == 2
+        assert time.monotonic() - stamp < 5.0
+
+    def test_spans_mirror_into_the_global_ring(self):
+        rec = flight_recorder.get_recorder()
+        rec.clear()
+        with tracing.use_tracer(tracing.Tracer(enabled=True)):
+            with tracing.span("fit", model="m"):
+                with tracing.span("step", iteration=3):
+                    pass
+        names = [e["name"] for e in rec.events() if e["kind"] == "span"]
+        assert names == ["step", "fit"]      # finish order
+        step_ev = next(e for e in rec.events()
+                       if e["kind"] == "span" and e["name"] == "step")
+        assert step_ev["attributes"]["iteration"] == 3
+        assert step_ev["trace_id"]
+
+
+class TestDump:
+    def test_dump_schema(self, tmp_path):
+        rec = flight_recorder.FlightRecorder()
+        rec.record("step", iteration=7)
+        rec.progress("trainer.step")
+        path = rec.dump(str(tmp_path / "box.jsonl"), reason="explicit",
+                        detail={"why": "test"})
+        lines = flight_recorder.read_dump(path)
+        by_type = {}
+        for line in lines:
+            by_type.setdefault(line["type"], []).append(line)
+        assert by_type["header"][0]["reason"] == "explicit"
+        assert by_type["header"][0]["pid"] == os.getpid()
+        assert by_type["header"][0]["detail"] == {"why": "test"}
+        assert by_type["liveness"][0]["last_site"] == "trainer.step"
+        # every live thread contributes a stack; this test's own frame
+        # is in the main thread's stack
+        assert len(by_type["thread"]) >= 1
+        assert any("test_dump_schema" in "".join(t["stack"])
+                   for t in by_type["thread"])
+        assert any(e.get("kind") == "step" and e.get("iteration") == 7
+                   for e in by_type["event"])
+        assert isinstance(by_type["metrics"][0]["values"], dict)
+        assert "device" in by_type
+
+    def test_dump_appends_and_tolerates_partial_lines(self, tmp_path):
+        rec = flight_recorder.FlightRecorder()
+        path = str(tmp_path / "box.jsonl")
+        rec.dump(path, reason="first")
+        rec.dump(path, reason="second")
+        with open(path, "a") as f:
+            f.write('{"type": "torn')     # killed mid-write
+        lines = flight_recorder.read_dump(path)
+        reasons = [l["reason"] for l in lines if l["type"] == "header"]
+        assert reasons == ["first", "second"]
+
+
+class TestWatchdog:
+    def test_fires_on_stall_after_arming(self, tmp_path):
+        rec = flight_recorder.FlightRecorder()
+        fired = []
+        wd = flight_recorder.Watchdog(
+            0.5, recorder=rec, dump_path=str(tmp_path / "wd.jsonl"),
+            on_fire=fired.append, arm_on_first_progress=True, poll_s=0.05)
+        try:
+            # not armed yet: well past the deadline with no progress
+            time.sleep(0.8)
+            assert not wd.fired.is_set()
+            rec.progress("dcn.exchange")
+            time.sleep(1.0)
+            assert wd.fired.is_set()
+        finally:
+            wd.stop()
+        assert fired and fired[0]["stalled_site"] == "dcn.exchange"
+        lines = flight_recorder.read_dump(str(tmp_path / "wd.jsonl"))
+        header = next(l for l in lines if l["type"] == "header")
+        assert header["reason"] == "watchdog"
+
+    def test_does_not_fire_while_progress_flows(self, tmp_path):
+        rec = flight_recorder.FlightRecorder()
+        wd = flight_recorder.Watchdog(
+            0.4, recorder=rec, dump_path=str(tmp_path / "wd.jsonl"),
+            arm_on_first_progress=False, poll_s=0.05)
+        try:
+            for _ in range(10):
+                rec.progress("trainer.step")
+                time.sleep(0.1)
+            assert not wd.fired.is_set()
+        finally:
+            wd.stop()
+
+    def test_grace_fire_re_arms_instead_of_exiting(self, tmp_path):
+        """fires_before_exit=2 (the dryrun_multichip setting): one slow
+        phase costs a dump and a re-arm, not the process — only two
+        consecutive dead deadlines reach the final (exiting) fire."""
+        rec = flight_recorder.FlightRecorder()
+        fired = []
+        wd = flight_recorder.Watchdog(
+            0.4, recorder=rec, dump_path=str(tmp_path / "wd.jsonl"),
+            on_fire=fired.append, arm_on_first_progress=False,
+            poll_s=0.05, fires_before_exit=2)
+        try:
+            rec.progress("multichip.phase")
+            time.sleep(0.7)               # one dead deadline → grace fire
+            assert len(fired) == 1
+            assert fired[0]["fire"] == 1
+            rec.progress("multichip.phase")   # "compile finished"
+            time.sleep(0.25)
+            assert len(fired) == 1        # progress reset the count
+            time.sleep(0.7)               # dead again → fire 1 of 2 again
+            time.sleep(0.5)               # still dead → final fire
+            assert len(fired) >= 3
+            assert any(f["fire"] >= 2 for f in fired)
+        finally:
+            wd.stop()
+        reasons = [l["detail"]["fire"] for l in
+                   flight_recorder.read_dump(str(tmp_path / "wd.jsonl"))
+                   if l["type"] == "header"]
+        assert reasons[0] == 1 and max(reasons) >= 2
+
+    def test_grace_window_aborts_exit_on_late_progress(self, tmp_path,
+                                                       monkeypatch):
+        """The final fire holds the exit for exit_grace_s (so sibling
+        black boxes land first) — real progress inside that window means
+        the process is alive and must NOT be reported as a stall."""
+        exits = []
+        monkeypatch.setattr(flight_recorder.os, "_exit",
+                            lambda code: exits.append(code))
+        rec = flight_recorder.FlightRecorder()
+        wd = flight_recorder.Watchdog(
+            0.4, recorder=rec, dump_path=str(tmp_path / "wd.jsonl"),
+            exit_code=87, arm_on_first_progress=False, poll_s=0.05,
+            exit_grace_s=1.0)
+        try:
+            assert wd.fired.wait(timeout=5)
+            rec.progress("trainer.step")    # lands inside the grace
+            time.sleep(1.2)                 # past the grace re-check
+            assert exits == []              # late progress: re-armed
+        finally:
+            wd.stop()
+        assert exits == []
+
+    def test_grace_window_aborts_exit_on_clean_stop(self, tmp_path,
+                                                    monkeypatch):
+        """stop() racing the final fire (a main thread finishing just
+        past the deadline) must win over the pending os._exit."""
+        exits = []
+        monkeypatch.setattr(flight_recorder.os, "_exit",
+                            lambda code: exits.append(code))
+        rec = flight_recorder.FlightRecorder()
+        wd = flight_recorder.Watchdog(
+            0.4, recorder=rec, dump_path=str(tmp_path / "wd.jsonl"),
+            exit_code=87, arm_on_first_progress=False, poll_s=0.05,
+            exit_grace_s=1.0)
+        assert wd.fired.wait(timeout=5)
+        wd.stop()                           # clean shutdown in the grace
+        time.sleep(1.2)                     # past the would-be exit
+        assert exits == []
+
+
+class TestClusterStall:
+    def test_stalled_exchange_raises_with_per_child_black_boxes(self):
+        """ISSUE 6 acceptance: a faults.py delay at dcn.exchange under
+        spawn_local_cluster raises within the gang deadline, and the
+        error carries a flight-recorder dump per child with thread
+        stacks and the last N spans."""
+        from deeplearning4j_tpu.parallel.launcher import (
+            ClusterStallError, spawn_local_cluster)
+        n = 2
+        t0 = time.monotonic()
+        with pytest.raises(ClusterStallError) as excinfo:
+            spawn_local_cluster(
+                cluster_workers.stalled_exchange_worker,
+                n_processes=n, port=12741, local_devices=1,
+                timeout=120.0, gang_deadline=5.0, startup_retries=0,
+                extra_env={**_ENV,
+                           "DL4J_TPU_FAULT_PLAN":
+                               "dcn.exchange@1:delay:300"})
+        elapsed = time.monotonic() - t0
+        # the watchdog beat the 120s wall budget by a wide margin
+        assert elapsed < 90.0, f"stall took {elapsed:.0f}s to surface"
+        err = excinfo.value
+        assert "stalled" in str(err)
+        assert len(err.flight_dumps) == n, (
+            f"expected a black box per child, got "
+            f"{sorted(err.flight_dumps)}: {err}")
+        for pid, lines in err.flight_dumps.items():
+            header = next(l for l in lines if l["type"] == "header")
+            assert header["reason"] == "watchdog"
+            liveness = next(l for l in lines if l["type"] == "liveness")
+            # the stall happened in (or right after entering) the
+            # exchange; either way the last stamped site names it
+            assert liveness["last_site"] in ("dcn.exchange",
+                                             "trainer.step")
+            assert liveness["stalled_for_s"] >= 4.0
+            stacks = [l for l in lines if l["type"] == "thread"]
+            assert stacks, f"child {pid} dump has no thread stacks"
+            joined = "".join("".join(t["stack"]) for t in stacks)
+            # the wedged exchange thread is visible in the stacks
+            assert "_exchange" in joined or "fire" in joined
+            # gang mode turns tracing on: the ring carries recent spans
+            spans = [l for l in lines
+                     if l["type"] == "event" and l.get("kind") == "span"]
+            assert spans, f"child {pid} dump has no span events"
+            assert any(e["name"] in ("step", "slice", "encode", "exchange")
+                       for e in spans)
+            # step 0 completed before the injected stall
+            steps = [l for l in lines
+                     if l["type"] == "event" and l.get("kind") == "step"]
+            assert steps

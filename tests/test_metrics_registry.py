@@ -127,6 +127,85 @@ def test_labeled_metrics_prometheus_render(registry):
     assert 'tpudl_test_requests_total{status="we\\"ird\\nvalue"} 1' in lines
 
 
+def test_labeled_histogram_semantics(registry):
+    h = registry.labeled_histogram("tpudl_test_prog_seconds", "per-program",
+                                   buckets=(0.01, 0.1, 1.0),
+                                   label_names=("program",))
+    for v in (0.005, 0.05, 0.5):
+        h.observe(v, program="train")
+    h.observe(5.0, program="serve")
+    assert h.labeled_count(program="train") == 3
+    assert h.labeled_count(program="serve") == 1
+    assert h.count == 4                       # aggregate across children
+    assert abs(h.sum - 5.555) < 1e-9
+    counts = h.bucket_counts(program="train")
+    assert counts[0.01] == 1
+    assert counts[0.1] == 2                   # cumulative
+    assert counts[math.inf] == 3
+    assert h.bucket_counts(program="serve")[1.0] == 0
+    with pytest.raises(ValueError):
+        h.observe(1.0)                        # missing declared label
+    with pytest.raises(ValueError):
+        h.observe(1.0, program="x", extra="y")
+    # idempotent re-registration; bucket/label mismatches are hard errors
+    assert registry.labeled_histogram("tpudl_test_prog_seconds",
+                                      buckets=(0.01, 0.1, 1.0)) is h
+    with pytest.raises(ValueError):
+        registry.labeled_histogram("tpudl_test_prog_seconds",
+                                   buckets=(0.5,))
+
+
+def test_labeled_histogram_prometheus_render(registry):
+    h = registry.labeled_histogram("tpudl_test_prog_seconds", "per-program",
+                                   buckets=(0.5,), label_names=("program",))
+    h.observe(0.25, program="train")
+    h.observe(2.0, program="train")
+    text = registry.render_prometheus()
+    lines = text.splitlines()
+    assert "# TYPE tpudl_test_prog_seconds histogram" in lines
+    assert ('tpudl_test_prog_seconds_bucket{program="train",le="0.5"} 1'
+            in lines)
+    assert ('tpudl_test_prog_seconds_bucket{program="train",le="+Inf"} 2'
+            in lines)
+    assert 'tpudl_test_prog_seconds_sum{program="train"} 2.25' in lines
+    assert 'tpudl_test_prog_seconds_count{program="train"} 2' in lines
+
+
+def test_perf_family_installed_and_exposed(registry):
+    """The tpudl_perf_* roofline family is part of the standard catalog
+    and renders as one valid exposition (gauges + the labeled series)."""
+    installed = install_standard_metrics(registry)
+    for name in ("tpudl_perf_mfu", "tpudl_perf_hbm_util",
+                 "tpudl_perf_arith_intensity",
+                 "tpudl_perf_roofline_fraction", "tpudl_perf_peak_flops",
+                 "tpudl_perf_peak_hbm_bytes", "tpudl_perf_program_flops",
+                 "tpudl_perf_program_bytes", "tpudl_perf_step_seconds"):
+        assert name in installed, name
+    registry.gauge("tpudl_perf_mfu").set(0.42)
+    registry.labeled_gauge("tpudl_perf_program_flops",
+                           label_names=("program",)).set(
+        1e9, program="train:Net")
+    registry.labeled_histogram("tpudl_perf_step_seconds").observe(
+        0.01, program="train:Net")
+    text = registry.render_prometheus()
+    assert "tpudl_perf_mfu 0.42" in text
+    assert 'tpudl_perf_program_flops{program="train:Net"} 1000000000' in text
+    assert ('tpudl_perf_step_seconds_count{program="train:Net"} 1'
+            in text)
+
+
+def test_every_standard_metric_has_a_docs_row():
+    """Anti-drift (the obs.check pattern, both directions): the metric
+    catalog table in docs/observability.md and install_standard_metrics
+    agree exactly — a new metric without a docs row (or a stale docs
+    row) fails here, not in a dashboard.  One source of truth:
+    selfcheck's own parity check."""
+    from deeplearning4j_tpu.obs.selfcheck import check_metric_doc_parity
+    problems: list = []
+    check_metric_doc_parity(problems)
+    assert problems == []
+
+
 def test_standard_metrics_install_and_lint(registry):
     from deeplearning4j_tpu.obs.check import lint
     installed = install_standard_metrics(registry)

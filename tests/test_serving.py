@@ -454,3 +454,57 @@ def test_parallel_inference_shim_propagates_submit_side_errors(metrics):
         with ParallelInference(Exploding(), batch_limit=4,
                                timeout_ms=1) as pi:
             pi.output(np.zeros((1, 4), np.float32))
+
+
+def test_trace_id_propagates_to_span_ring_and_response(tmp_path, metrics):
+    """X-Trace-Id rides the whole path: request header → engine serve
+    span + flight-recorder ring → response header (echoed on errors
+    too); absent header → a trace id is minted and echoed."""
+    from deeplearning4j_tpu.obs import flight_recorder, tracing
+    net = _net(73)
+    p = str(tmp_path / "m.zip")
+    net.save(p)
+    registry = ModelRegistry(max_batch=4, max_latency_ms=2)
+    registry.deploy("mnist", p)
+    flight_recorder.get_recorder().clear()
+    tracer = tracing.Tracer(enabled=True)
+    with tracing.use_tracer(tracer), ModelServer(registry) as srv:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=30)
+        payload = json.dumps({"instances": _data(2, 1).tolist()})
+        conn.request("POST", "/v1/models/mnist:predict", body=payload,
+                     headers={"X-Trace-Id": "req-abc-123"})
+        r = conn.getresponse()
+        r.read()
+        assert r.status == 200
+        assert r.getheader("X-Trace-Id") == "req-abc-123"
+
+        # errors echo the id too
+        conn.request("POST", "/v1/models/nope:predict", body=payload,
+                     headers={"X-Trace-Id": "req-err-9"})
+        r = conn.getresponse()
+        r.read()
+        assert r.status == 404
+        assert r.getheader("X-Trace-Id") == "req-err-9"
+
+        # even the pre-dispatch 404 (path not a :predict route) echoes it
+        conn.request("POST", "/v1/other", body=payload,
+                     headers={"X-Trace-Id": "req-err-10"})
+        r = conn.getresponse()
+        r.read()
+        assert r.status == 404
+        assert r.getheader("X-Trace-Id") == "req-err-10"
+
+        # no header → minted and echoed
+        conn.request("POST", "/v1/models/mnist:predict", body=payload)
+        r = conn.getresponse()
+        r.read()
+        minted = r.getheader("X-Trace-Id")
+        assert minted and len(minted) >= 8
+    registry.close()
+    serve_spans = [s for s in tracer.spans if s.name == "serve"]
+    assert any("req-abc-123" in s.attributes.get("trace_ids", "")
+               for s in serve_spans)
+    ring = flight_recorder.get_recorder().events()
+    serve_events = [e for e in ring if e["kind"] == "serve"]
+    assert any("req-abc-123" in e.get("trace_ids", [])
+               for e in serve_events)
