@@ -534,6 +534,16 @@ def bench_serving(timeout_s: float = 300.0) -> dict:
     return _cpu_subbench("serving.py", timeout_s)
 
 
+def bench_multichip(timeout_s: float = 540.0) -> dict:
+    """Multichip scaling record (ROADMAP item 2's deliverable, CPU
+    form): a real spawn_local_cluster gang whose per-worker throughput
+    is measured from FEDERATED telemetry (RemoteStatsRouter → the
+    coordinator UIServer) — reports measured
+    ``per_chip_scaling_efficiency`` and ``straggler_skew``.  A CPU
+    subprocess, so the row lands even when the TPU tunnel is down."""
+    return _cpu_subbench("multichip.py", timeout_s)
+
+
 def _probe_device(timeout_s: float = 30.0) -> tuple[str, str] | None:
     """Touch the accelerator in a SUBPROCESS with a hard timeout: a down
     TPU tunnel makes backend init HANG (not raise) in some environments
@@ -585,6 +595,12 @@ def main():
             detail["serving"] = bench_serving()
         except Exception as e:
             detail["serving"] = {"error": str(e)[:200]}
+        try:  # CPU-runnable: the multichip scaling row survives too —
+              # a tunnel-down round still measures the gang (rc=0, not
+              # the rc=1 the old device-only records produced)
+            detail["multichip"] = bench_multichip()
+        except Exception as e:
+            detail["multichip"] = {"error": str(e)[:200]}
         # a tunnel-down round still reports roofline numbers: lift the
         # cost_analysis-derived stamp out of whichever CPU record
         # produced one (feed_overlap trains a real net under the cost
@@ -635,6 +651,10 @@ def main():
                 result["detail"]["serving"] = bench_serving()
             except Exception as e:
                 result["detail"]["serving"] = {"error": str(e)[:200]}
+            try:  # multichip: federated-telemetry scaling + straggler skew
+                result["detail"]["multichip"] = bench_multichip()
+            except Exception as e:
+                result["detail"]["multichip"] = {"error": str(e)[:200]}
             try:  # per-compiled-program cost breakdown (top-K by FLOPs)
                 from deeplearning4j_tpu.obs import costmodel
                 result["detail"]["perf_top_programs"] = \

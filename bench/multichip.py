@@ -1,0 +1,158 @@
+"""Multichip scaling bench — federated telemetry measures the gang.
+
+ROADMAP item 2's explicit deliverable: a multichip bench record that
+COMPLETES under timeout and reports per-chip scaling efficiency.  Five
+MULTICHIP rounds of the real-pod form died rc=124; this row is the
+CPU-runnable form (the same `spawn_local_cluster` gang the tests use —
+real multi-process jax.distributed over loopback), so it lands even
+with the TPU tunnel down, and its numbers come from the telemetry
+federation rather than per-process stopwatches:
+
+- a coordinator ``UIServer`` runs in THIS process; every gang member's
+  ``RemoteStatsRouter`` (injected via ``spawn_local_cluster``'s
+  ``remote_ui``) stamps its steps onto it;
+- per-worker throughput = 1 / median federated step time;
+- ``per_chip_scaling_efficiency`` = (aggregate N-worker throughput / N)
+  / single-worker throughput measured the same way;
+- ``straggler_skew`` = max worker median step time / cluster median of
+  medians (1.0 = perfectly even gang).
+
+Prints ONE json line.  Env knobs: ``DL4J_TPU_MULTICHIP_WORKERS`` (4),
+``DL4J_TPU_MULTICHIP_STEPS`` (16), ``DL4J_TPU_MULTICHIP_PORT`` (24211).
+"""
+
+import functools
+import json
+import os
+import sys
+
+# the gang children unpickle the worker fn by module path: make this
+# file importable as `multichip` in the children too (the established
+# tests/cluster_workers.py pattern)
+_HERE = os.path.dirname(os.path.abspath(__file__))
+if _HERE not in sys.path:
+    sys.path.insert(0, _HERE)
+_REPO = os.path.dirname(_HERE)
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def train_worker(pid, n, steps=16):
+    """One gang member: train a small MLP for ``steps`` steps; every
+    step stamps onto the coordinator via the env-injected router (the
+    launcher bootstraps it — no telemetry code here)."""
+    import numpy as np
+    import jax
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.train import Sgd
+    from deeplearning4j_tpu.train.trainer import Trainer
+
+    conf = (NeuralNetConfiguration.builder().seed(7 + pid)
+            .updater(Sgd(0.05)).list()
+            .layer(DenseLayer(n_out=64, activation="tanh"))
+            .layer(OutputLayer(n_out=5, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(16)).build())
+    net = MultiLayerNetwork(conf).init()
+    trainer = Trainer(net)
+    rng = np.random.default_rng(pid)
+    x = rng.normal(size=(64, 16)).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 64)]
+    batch = DataSet(x, y)
+    key = jax.random.key(pid)
+    for _ in range(steps):
+        key, sub = jax.random.split(key)
+        trainer.step_batch(batch, sub)
+    return {"pid": pid, "steps": steps}
+
+
+def _fetch_json(url):
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+def _run_gang(server, n_workers, steps, port):
+    """One federated gang run; returns the coordinator's summary of it.
+    A fresh ClusterStore per run keeps the baseline's telemetry out of
+    the N-worker medians."""
+    from deeplearning4j_tpu.obs.remote import ClusterStore
+    from deeplearning4j_tpu.parallel.launcher import spawn_local_cluster
+    server.cluster = ClusterStore()
+    # reference the worker through the IMPORTED module, not __main__:
+    # the gang children unpickle `multichip.train_worker` via the
+    # PYTHONPATH handed to them below
+    import multichip as _self
+    fn = functools.partial(_self.train_worker, steps=steps)
+    spawn_local_cluster(fn, n_processes=n_workers, port=port,
+                        timeout=420.0, remote_ui=server.url,
+                        extra_env={"PYTHONPATH": _HERE + os.pathsep
+                                   + os.environ.get("PYTHONPATH", "")})
+    return _fetch_json(server.url + "cluster.json")
+
+
+def _throughputs(summary):
+    """worker → steps/s from the federated median step time (None when a
+    worker never reported a measurable median)."""
+    out = {}
+    for name, w in summary.get("workers", {}).items():
+        med = w.get("median_step_ms")
+        out[name] = (1e3 / med) if med else None
+    return out
+
+
+def main():
+    n_workers = int(os.environ.get("DL4J_TPU_MULTICHIP_WORKERS", "4"))
+    steps = int(os.environ.get("DL4J_TPU_MULTICHIP_STEPS", "16"))
+    port = int(os.environ.get("DL4J_TPU_MULTICHIP_PORT", "24211"))
+    from deeplearning4j_tpu.obs.ui_server import UIServer
+    server = UIServer(port=0)
+    try:
+        # single-worker baseline under the IDENTICAL harness (same spawn,
+        # same distributed runtime, same telemetry path)
+        base_summary = _run_gang(server, 1, steps, port)
+        base_tp = [t for t in _throughputs(base_summary).values() if t]
+        if not base_tp:
+            raise RuntimeError(f"baseline run produced no federated step "
+                               f"timings: {base_summary}")
+        baseline = base_tp[0]
+
+        gang_summary = _run_gang(server, n_workers, steps, port + 173)
+        tps = _throughputs(gang_summary)
+        measured = [t for t in tps.values() if t]
+        if len(measured) < n_workers:
+            raise RuntimeError(f"only {len(measured)}/{n_workers} workers "
+                               f"reported step timings: {gang_summary}")
+        aggregate = sum(measured)
+        efficiency = (aggregate / n_workers) / baseline
+        skew = gang_summary.get("straggler_skew") or 1.0
+        print(json.dumps({
+            "metric": "multichip_scaling_efficiency",
+            "value": round(efficiency, 4),
+            "unit": "fraction",
+            "n_workers": n_workers,
+            "steps_per_worker": steps,
+            "per_chip_scaling_efficiency": round(efficiency, 4),
+            "straggler_skew": round(skew, 4),
+            "detail": {
+                "baseline_steps_per_s": round(baseline, 3),
+                "aggregate_steps_per_s": round(aggregate, 3),
+                "workers": gang_summary.get("workers", {}),
+                "source": "federated_telemetry",
+                "note": ("CPU loopback gang (all workers share the host's "
+                         "cores, so efficiency < 1 is expected and real); "
+                         "throughput = 1/median federated step time per "
+                         "worker, scraped from the coordinator's "
+                         "/cluster.json"),
+            },
+        }))
+        return 0
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

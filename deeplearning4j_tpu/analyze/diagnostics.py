@@ -203,6 +203,21 @@ _RULE_LIST = [
         "Open spans as 'with tracing.span(...):'; move flight-recorder "
         "calls outside the jit boundary (record around the step call, "
         "not inside the traced function)."),
+    RuleInfo(
+        "TPU311", "net-io-in-step-path", ERROR,
+        "direct network I/O (urllib/socket/http.client) inside a "
+        "step/listener/fit-path function — telemetry must go through "
+        "the buffered RemoteStatsRouter",
+        "A synchronous connect/request on the step or listener path "
+        "blocks training on the network: a slow or dead coordinator "
+        "turns into stalled steps (or a dead gang), and a per-step "
+        "round-trip serializes dispatch.  obs.remote.RemoteStatsRouter "
+        "buffers records and does all network I/O on a background "
+        "thread with bounded retries and bounded drop.",
+        "Append to a RemoteStatsRouter (obs.remote.notify_step / "
+        "router.put) instead of calling urlopen/socket in the "
+        "step/listener function; do one-shot network setup outside "
+        "the training path."),
 ]
 
 RULES: dict[str, RuleInfo] = {r.id: r for r in _RULE_LIST}

@@ -14,9 +14,11 @@ TPU304 (bare shard_map/pmap imports bypassing utils/jax_compat),
 TPU307 (per-batch host transfer in a training loop), TPU308 (swallowed
 exception in a training loop), TPU309 (jax.jit built per request in a
 serving handler), TPU310 (span opened without `with` / flight-recorder
-I/O inside jit).  Registry-backed rules that ride along in
-``lint_package``/``--self``: TPU305 (metric names — the former
-``obs.check`` lint) and TPU306 (op-spec catalog integrity).
+I/O inside jit), TPU311 (direct network I/O in a step/listener-path
+function — telemetry goes through the buffered RemoteStatsRouter).
+Registry-backed rules that ride along in ``lint_package``/``--self``:
+TPU305 (metric names — the former ``obs.check`` lint) and TPU306
+(op-spec catalog integrity).
 """
 
 from __future__ import annotations
@@ -736,6 +738,92 @@ def _rule_span_or_dump_misuse(mod: ModuleInfo) -> list[Diagnostic]:
                     f"'{getattr(fn, 'name', '<lambda>')}' runs at trace "
                     f"time only — the black box is never written during "
                     f"execution",
+                    path=mod.anchor(node)))
+    return out
+
+
+# whole-name tokens marking a function as part of the step/listener/
+# fit path for TPU311 — the code that runs per training iteration,
+# where a synchronous network round-trip stalls the device
+_STEP_PATH_TOKENS = {"fit", "step", "train", "epoch", "iteration",
+                     "listener", "stats"}
+# connection-establishing / request-issuing callables; attribute reads
+# like socket.gethostname() are host-local and deliberately not listed
+_NET_CALL_NAMES = {"urlopen", "create_connection", "create_server",
+                   "socketpair", "HTTPConnection", "HTTPSConnection"}
+_NET_MODULE_HEADS = {"socket", "urllib", "http"}
+
+
+def _net_import_names(mod: ModuleInfo) -> tuple[set, set]:
+    """(module aliases bound to socket/urllib*/http.client trees, names
+    bound directly to their request/connect callables)."""
+    modules: set[str] = set()
+    names: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                head = alias.name.split(".")[0]
+                if head in _NET_MODULE_HEADS:
+                    # `import urllib.request` binds `urllib`; aliased
+                    # dotted imports bind the alias to the full chain
+                    modules.add(alias.asname or head)
+        elif isinstance(node, ast.ImportFrom):
+            head = (node.module or "").split(".")[0]
+            if head not in _NET_MODULE_HEADS:
+                continue
+            for alias in node.names:
+                bound = alias.asname or alias.name
+                if alias.name in _NET_CALL_NAMES or alias.name == "socket":
+                    names.add(bound)
+                else:
+                    # `from urllib import request` binds a submodule
+                    modules.add(bound)
+    return modules, names
+
+
+def _is_net_call(node: ast.Call, modules: set, names: set) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Name) and f.id in names:
+        return f.id
+    if isinstance(f, ast.Attribute):
+        recv = _dotted_receiver(f.value)
+        if recv is not None and recv.split(".")[0] in modules \
+                and (f.attr in _NET_CALL_NAMES or f.attr == "socket"):
+            return f"{recv}.{f.attr}"
+    return None
+
+
+@register_lint_rule("TPU311")
+def _rule_net_io_in_step_path(mod: ModuleInfo) -> list[Diagnostic]:
+    """Direct network I/O inside step/listener/fit-token functions: a
+    synchronous urlopen/connect on the per-iteration path blocks the
+    training loop on the network.  Telemetry belongs in the buffered
+    ``obs.remote.RemoteStatsRouter`` (background thread, bounded retry,
+    bounded drop) — which is why ``obs/remote.py`` itself is exempt."""
+    norm = mod.path.replace(os.sep, "/")
+    if norm.endswith("obs/remote.py"):
+        return []   # the router's flush thread is WHERE the I/O belongs
+    modules, names = _net_import_names(mod)
+    if not modules and not names:
+        return []
+    out = []
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        tokens = set(fn.name.lower().strip("_").split("_"))
+        if not tokens & _STEP_PATH_TOKENS:
+            continue
+        for node in _walk_shallow(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            what = _is_net_call(node, modules, names)
+            if what:
+                out.append(Diagnostic(
+                    "TPU311",
+                    f"{what}() network I/O inside step/listener-path "
+                    f"'{fn.name}' — a slow or dead peer stalls the "
+                    f"training loop; route telemetry through the "
+                    f"buffered RemoteStatsRouter",
                     path=mod.anchor(node)))
     return out
 

@@ -125,6 +125,18 @@ class CheckpointListener(TrainingListener):
         self._last_save_time = time.time()
         return path
 
+    def save_now(self, model, iteration: Optional[int] = None,
+                 epoch: Optional[int] = None) -> str:
+        """Checkpoint immediately, outside the periodic schedule — the
+        hook the health monitor's ``checkpoint`` action uses to make the
+        last pre-anomaly state durable.  Counters default to the
+        model's own."""
+        return self._save(model,
+                          iteration=(model.iteration if iteration is None
+                                     else iteration),
+                          epoch=(getattr(model, "epoch", 0) if epoch is None
+                                 else epoch))
+
     def flush(self) -> None:
         """Wait for pending background saves; re-raise any failure."""
         if self._async is not None:

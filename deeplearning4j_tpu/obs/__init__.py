@@ -14,8 +14,11 @@ from deeplearning4j_tpu.obs.registry import (
     LabeledHistogram, MetricsRegistry,
     get_registry, set_registry, install_standard_metrics,
     record_device_memory)
-from deeplearning4j_tpu.obs import costmodel, flight_recorder
+from deeplearning4j_tpu.obs import costmodel, flight_recorder, health, remote
 from deeplearning4j_tpu.obs.flight_recorder import FlightRecorder, Watchdog
+from deeplearning4j_tpu.obs.health import (HealthConfig, HealthHalt,
+                                           HealthMonitor)
+from deeplearning4j_tpu.obs.remote import ClusterStore, RemoteStatsRouter
 from deeplearning4j_tpu.obs.stats import (
     StatsListener, InMemoryStatsStorage, FileStatsStorage,
     render_html_report, render_html)
@@ -44,8 +47,15 @@ __all__ = [
     "LabeledHistogram",
     "costmodel",
     "flight_recorder",
+    "health",
+    "remote",
     "FlightRecorder",
     "Watchdog",
+    "HealthConfig",
+    "HealthHalt",
+    "HealthMonitor",
+    "ClusterStore",
+    "RemoteStatsRouter",
     "MetricsRegistry",
     "get_registry",
     "set_registry",

@@ -1,48 +1,28 @@
-"""Metric-name lint — ``python -m deeplearning4j_tpu.obs.check``.
+"""Deprecated shim — ``python -m deeplearning4j_tpu.obs.check``.
 
 .. deprecated::
-    This module is now a thin shim over the ``tpudl.analyze`` rule
-    registry — the check lives in
-    :func:`deeplearning4j_tpu.analyze.lint.check_metric_names` as rule
-    ``TPU305`` and runs as part of
-    ``python -m deeplearning4j_tpu.analyze --self``.  This entry point
-    stays so existing CI invocations keep working; prefer the analyze
-    CLI for new wiring.
-
-Verifies that every metric registered in the process-wide registry
-(after installing the framework's standard catalog) matches the
-documented ``tpudl_<area>_<name>`` convention, and that counters/
-histograms follow the suffix rules (``_total`` for counters,
-``_seconds``/``_bytes`` for duration/size histograms).
+    The metric-name lint lives in
+    :mod:`deeplearning4j_tpu.obs.selfcheck` (:func:`metric_lint` /
+    :func:`metric_lint_main`, backed by the ``tpudl.analyze`` TPU305
+    rule).  Prefer ``python -m deeplearning4j_tpu.obs.selfcheck`` (the
+    full observability self-check) or
+    ``python -m deeplearning4j_tpu.analyze --self``; this entry point
+    stays only so existing CI invocations keep working.
 """
 
 from __future__ import annotations
 
 import sys
+import warnings
 
-from deeplearning4j_tpu.obs.registry import get_registry
+from deeplearning4j_tpu.obs.selfcheck import (metric_lint as lint,
+                                              metric_lint_main as main)
 
-
-def lint(registry=None) -> list[str]:
-    """Returns a list of human-readable violations (empty = clean).
-    Delegates to the TPU305 rule in ``tpudl.analyze``."""
-    from deeplearning4j_tpu.analyze.lint import check_metric_names
-    report = check_metric_names(registry)
-    return [f"{d.path}: {d.message}" for d in report.sorted()]
-
-
-def main(argv=None) -> int:
-    problems = lint()
-    names = get_registry().names()
-    if problems:
-        print(f"obs.check: {len(problems)} metric-name violation(s) [TPU305]:")
-        for p in problems:
-            print(f"  - {p}")
-        return 1
-    print(f"obs.check: {len(names)} registered metric names OK "
-          f"(tpudl_<area>_<name>)")
-    return 0
-
+warnings.warn(
+    "deeplearning4j_tpu.obs.check is deprecated; use "
+    "`python -m deeplearning4j_tpu.obs.selfcheck` (full self-check) or "
+    "`python -m deeplearning4j_tpu.analyze --self` (TPU305)",
+    DeprecationWarning, stacklevel=2)
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -8,7 +8,7 @@ wire counters, and the bench harness.  The UI server exposes it at
 target exists wherever a training dashboard does.
 
 Naming convention (enforced at registration, linted by
-``python -m deeplearning4j_tpu.obs.check``)::
+``python -m deeplearning4j_tpu.obs.selfcheck`` — rule TPU305)::
 
     tpudl_<area>_<name>
 
@@ -446,8 +446,8 @@ def install_standard_metrics(registry: Optional[MetricsRegistry] = None) -> dict
     """Register the framework's standard metric set (the catalog in
     docs/observability.md) and return it keyed by name.  Idempotent;
     called lazily by the instrumentation sites and eagerly by the
-    ``obs.check`` lint so the full catalog is always visible to both the
-    scrape endpoint and the linter."""
+    ``obs.selfcheck`` lint so the full catalog is always visible to both
+    the scrape endpoint and the linter."""
     r = registry or get_registry()
     metrics = [
         r.counter("tpudl_train_steps_total",
@@ -585,6 +585,51 @@ def install_standard_metrics(registry: Optional[MetricsRegistry] = None) -> dict
                             "cost-model-analyzed program (the "
                             "denominator of MFU/HBM utilization)",
                             label_names=("program",)),
+        r.counter("tpudl_cluster_records_pushed_total",
+                  "Telemetry records delivered to the coordinator by "
+                  "this worker's RemoteStatsRouter"),
+        r.counter("tpudl_cluster_push_failures_total",
+                  "Router push batches that exhausted their retries "
+                  "(coordinator down/stalled)"),
+        r.counter("tpudl_cluster_records_dropped_total",
+                  "Telemetry records lost to router buffer overflow or "
+                  "failed pushes (bounded loss, never an exception)"),
+        r.counter("tpudl_cluster_records_ingested_total",
+                  "Telemetry records accepted by this coordinator's "
+                  "/remote/stats endpoint"),
+        r.gauge("tpudl_cluster_workers",
+                "Workers that have reported to this coordinator"),
+        r.labeled_gauge("tpudl_cluster_worker_iteration",
+                        "Most recent training iteration reported per "
+                        "worker", ("worker",)),
+        r.labeled_gauge("tpudl_cluster_worker_mfu",
+                        "Most recent self-reported MFU per worker "
+                        "(obs.costmodel via the router)", ("worker",)),
+        r.labeled_gauge("tpudl_cluster_worker_last_score",
+                        "Most recent training loss reported per worker",
+                        ("worker",)),
+        r.labeled_gauge("tpudl_cluster_worker_last_seen_time",
+                        "Unix time of the last record (incl. heartbeats) "
+                        "from each worker — liveness age = now - this",
+                        ("worker",)),
+        r.labeled_histogram("tpudl_cluster_step_seconds",
+                            "Federated per-worker step wall time as "
+                            "reported over the router",
+                            label_names=("worker",)),
+        r.counter("tpudl_health_checks_total",
+                  "HealthMonitor check passes (loss stream + sampled "
+                  "stats)"),
+        r.labeled_counter("tpudl_health_anomalies_total",
+                          "Health verdicts by kind (non_finite_loss/"
+                          "loss_spike/grad_explosion/grad_vanish/"
+                          "non_finite_grad/update_ratio/dead_units/"
+                          "straggler)", ("kind",)),
+        r.labeled_counter("tpudl_health_actions_total",
+                          "Anomaly responses taken by action "
+                          "(warn/dump/checkpoint/halt)", ("action",)),
+        r.gauge("tpudl_health_loss_zscore",
+                "Robust z-score (median/MAD) of the most recent loss "
+                "against the rolling window"),
     ]
     return {m.name: m for m in metrics}
 

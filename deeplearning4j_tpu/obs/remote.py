@@ -1,0 +1,533 @@
+"""Cluster telemetry federation — every chip reports in.
+
+Parity: the reference's ``RemoteUIStatsStorageRouter``
+(``deeplearning4j-ui`` ``org/deeplearning4j/ui/storage/remote/
+RemoteUIStatsStorageRouter.java``): worker processes route their
+StatsListener records to ONE ``UIServer`` over HTTP so a whole cluster
+is watched from a single dashboard instead of N blind silos.
+
+Two halves:
+
+- **Worker side** — :class:`RemoteStatsRouter`: a bounded in-memory
+  buffer drained by a background thread that POSTs JSON batches to the
+  coordinator's ``/remote/stats`` endpoint with
+  :mod:`~deeplearning4j_tpu.resilience.retry` backoff.  Producers
+  (``Trainer.step_batch``, ``MultiSliceTrainer``, ``StatsListener`` via
+  the storage protocol, the heartbeat ticker) only ever append to the
+  buffer — a push NEVER runs on the step path, never blocks, and never
+  raises: an unreachable coordinator costs dropped telemetry (counted in
+  ``tpudl_cluster_records_dropped_total``), not a training step.
+  Direct ``urllib``/``socket`` I/O in step/listener functions is linted
+  against (TPU311) — this router is the sanctioned channel.
+- **Coordinator side** — :class:`ClusterStore`: per-worker liveness,
+  step-time windows, MFU and score, fed by the ``UIServer``'s ingest
+  endpoint; renders the ``/cluster`` dashboard, exports per-worker
+  series onto ``/metrics`` with a ``worker`` label, and runs the
+  cluster-level health checks (straggler detection via
+  :mod:`deeplearning4j_tpu.obs.health`).
+
+Wiring: ``spawn_local_cluster(..., remote_ui=server.url)`` injects
+``DL4J_TPU_REMOTE_UI`` + a per-child ``DL4J_TPU_WORKER_ID`` into every
+gang member; the child bootstrap calls :func:`install_from_env`, after
+which every ``Trainer``/``MultiSliceTrainer`` step in that process
+stamps per-worker progress automatically (:func:`notify_step`).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import socket
+import statistics
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+ENDPOINT_ENV = "DL4J_TPU_REMOTE_UI"
+WORKER_ENV = "DL4J_TPU_WORKER_ID"
+
+INGEST_PATH = "/remote/stats"
+# per-worker record history kept by the coordinator (dashboard replay)
+STORE_RECORDS = 256
+# step-time window for medians / straggler math
+STEP_WINDOW = 64
+
+
+def _jsonable(value: Any) -> Any:
+    """Best-effort JSON coercion at FLUSH time — device scalars are
+    float()ed here, on the router's background thread, so a worker can
+    buffer a live jax scalar without paying the device sync on the step
+    path."""
+    if value is None or isinstance(value, (bool, int, str)):
+        return value
+    if isinstance(value, float):
+        return value if math.isfinite(value) else repr(value)
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    try:
+        f = float(value)
+        return f if math.isfinite(f) else repr(f)
+    except Exception:
+        return str(value)
+
+
+class RemoteStatsRouter:
+    """Buffered, non-blocking push channel to a coordinator UIServer.
+
+    Implements the StatsStorage protocol (``put``/``all``) so a
+    ``StatsListener(storage=router)`` federates its full stats records;
+    ``put_event``/``heartbeat`` are the lighter-weight progress surface
+    the trainers use.  The buffer is bounded: overflow drops the OLDEST
+    records and counts them — backpressure from a slow coordinator must
+    never reach the training loop.
+    """
+
+    def __init__(self, endpoint: str, worker: Optional[str] = None,
+                 flush_interval_s: float = 0.25,
+                 heartbeat_interval_s: float = 1.0,
+                 max_buffer: int = 1024, batch_size: int = 64,
+                 timeout_s: float = 2.0, retry_policy=None):
+        self.endpoint = endpoint.rstrip("/")
+        self.worker = worker or os.environ.get(WORKER_ENV) \
+            or f"{socket.gethostname()}:{os.getpid()}"
+        self.flush_interval_s = flush_interval_s
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.max_buffer = max(1, int(max_buffer))
+        self.batch_size = max(1, int(batch_size))
+        self.timeout_s = timeout_s
+        if retry_policy is None:
+            from deeplearning4j_tpu.resilience.retry import RetryPolicy
+            # every push error is worth one quick retry (URLError wraps
+            # errno-less socket failures the default classifier would
+            # pass on), but the deadline keeps a dead coordinator from
+            # turning the flush thread into a hot retry loop
+            retry_policy = RetryPolicy(max_attempts=2, base_delay_s=0.05,
+                                       max_delay_s=0.25, deadline_s=2.0,
+                                       retryable=lambda e: True)
+        self._retry_policy = retry_policy
+        self._buf: deque = deque()
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._dropped = 0
+        self._pushed = 0
+        self._failures = 0
+        self._last_heartbeat = 0.0
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="tpudl-remote-router")
+        self._thread.start()
+
+    # ------------------------------------------------------ producer side
+    def put(self, record: dict) -> None:
+        """StatsStorage protocol: buffer one record (non-blocking)."""
+        with self._lock:
+            self._buf.append(record)
+            if len(self._buf) > self.max_buffer:
+                self._buf.popleft()
+                self._dropped += 1
+        self._wake.set()
+
+    def all(self) -> list:
+        """StatsStorage protocol.  The authoritative record history lives
+        on the COORDINATOR (:class:`ClusterStore`); the router keeps no
+        local replay, so this is always empty."""
+        return []
+
+    def put_event(self, kind: str, **data: Any) -> None:
+        record = {"type": kind, "time": time.time()}
+        record.update(data)
+        self.put(record)
+
+    def heartbeat(self) -> None:
+        self.put_event("heartbeat")
+
+    # ------------------------------------------------------ consumer side
+    @property
+    def dropped(self) -> int:
+        """Records lost to buffer overflow or exhausted push retries —
+        bounded by design, never an exception."""
+        return self._dropped
+
+    @property
+    def pushed(self) -> int:
+        return self._pushed
+
+    @property
+    def push_failures(self) -> int:
+        return self._failures
+
+    def _pop_batch(self) -> list:
+        with self._lock:
+            n = min(len(self._buf), self.batch_size)
+            return [self._buf.popleft() for _ in range(n)]
+
+    def _post(self, payload: bytes) -> None:
+        import urllib.request
+        req = urllib.request.Request(
+            self.endpoint + INGEST_PATH, data=payload,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            resp.read()
+
+    def _flush_once(self) -> int:
+        """Drain one batch; returns the number of records handled (sent
+        or dropped).  All failure handling is metric-counted, never
+        raised — this runs on the router thread only."""
+        from deeplearning4j_tpu.obs.registry import get_registry
+        from deeplearning4j_tpu.resilience.retry import with_retries
+        batch = self._pop_batch()
+        if not batch:
+            return 0
+        payload = json.dumps({
+            "worker": self.worker,
+            "records": [_jsonable(r) for r in batch],
+        }).encode()
+        reg = get_registry()
+        try:
+            with_retries(lambda: self._post(payload),
+                         policy=self._retry_policy, site="remote.push")
+            self._pushed += len(batch)
+            reg.counter("tpudl_cluster_records_pushed_total").inc(len(batch))
+        except Exception:
+            # the coordinator is down/stalled: count the loss and move
+            # on — re-queueing would just re-lose them and starve newer
+            # records out of the bounded buffer
+            self._failures += 1
+            self._dropped += len(batch)
+            reg.counter("tpudl_cluster_push_failures_total").inc()
+            reg.counter("tpudl_cluster_records_dropped_total").inc(len(batch))
+        return len(batch)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(self.flush_interval_s)
+            self._wake.clear()
+            now = time.monotonic()
+            if now - self._last_heartbeat >= self.heartbeat_interval_s:
+                self._last_heartbeat = now
+                self.put_event("heartbeat")
+            while self._flush_once():
+                if self._stop.is_set():
+                    break
+        # final drain: one bounded attempt per remaining batch
+        while self._flush_once():
+            pass
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Flush what the coordinator will take within ``timeout`` and
+        stop the thread.  Never raises."""
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=timeout)
+
+
+# ------------------------------------------------------- process router
+_router: Optional[RemoteStatsRouter] = None
+_router_lock = threading.Lock()
+
+
+def install(endpoint: str, **kwargs: Any) -> RemoteStatsRouter:
+    """Install (replacing any previous) the process-wide router that
+    :func:`notify_step` / :func:`notify_event` feed."""
+    global _router
+    with _router_lock:
+        if _router is not None:
+            _router.close(timeout=1.0)
+        _router = RemoteStatsRouter(endpoint, **kwargs)
+        return _router
+
+
+def install_from_env() -> Optional[RemoteStatsRouter]:
+    """Child-process bootstrap: ``DL4J_TPU_REMOTE_UI`` names the
+    coordinator endpoint (``spawn_local_cluster`` injects it, plus a
+    per-child ``DL4J_TPU_WORKER_ID``).  No-op without the env var."""
+    endpoint = os.environ.get(ENDPOINT_ENV, "").strip()
+    if not endpoint:
+        return None
+    return install(endpoint)
+
+
+def get_router() -> Optional[RemoteStatsRouter]:
+    return _router
+
+
+def close_router(timeout: float = 5.0) -> None:
+    global _router
+    with _router_lock:
+        if _router is not None:
+            _router.close(timeout=timeout)
+            _router = None
+
+
+def notify_step(iteration: int, epoch: int = 0,
+                duration_s: Optional[float] = None, score: Any = None,
+                examples: Optional[int] = None, **extra: Any) -> None:
+    """Per-step progress stamp from a trainer.  Buffer-append only (the
+    device-scalar ``score`` is float()ed later on the router thread);
+    a no-op when no router is installed, so the single-process step
+    path pays one ``is None`` check."""
+    router = _router
+    if router is None:
+        return
+    from deeplearning4j_tpu.obs.registry import get_registry
+    reg = get_registry()
+    router.put_event("step", iteration=int(iteration), epoch=int(epoch),
+                     step_seconds=duration_s, score=score,
+                     examples=examples, mfu=reg.gauge("tpudl_perf_mfu").value,
+                     **extra)
+
+
+def notify_event(kind: str, **data: Any) -> None:
+    router = _router
+    if router is not None:
+        router.put_event(kind, **data)
+
+
+# ========================================================= coordinator
+class _WorkerState:
+    __slots__ = ("first_seen", "last_seen", "steps", "iteration", "epoch",
+                 "score", "mfu", "step_window", "records", "straggler",
+                 "last_step_s", "first_step_time", "last_step_time")
+
+    def __init__(self):
+        now = time.time()
+        self.first_seen = now
+        self.last_seen = now
+        # producer-side stamps of the first/last *step* record — receipt
+        # times collapse to ~0 when a batch flush delivers many steps at
+        # once, so rates must come from the worker's own clock
+        self.first_step_time = None
+        self.last_step_time = None
+        self.steps = 0
+        self.iteration = -1
+        self.epoch = 0
+        self.score = None
+        self.mfu = None
+        self.last_step_s = None
+        self.step_window: deque = deque(maxlen=STEP_WINDOW)
+        self.records: deque = deque(maxlen=STORE_RECORDS)
+        self.straggler = False
+
+
+def _median(values) -> Optional[float]:
+    vals = [v for v in values if v is not None]
+    return statistics.median(vals) if vals else None
+
+
+class ClusterStore:
+    """Coordinator-side federation state: one :class:`_WorkerState` per
+    reporting worker, fed by the UIServer's ``/remote/stats`` ingest.
+    Updates the ``tpudl_cluster_*`` metric family (per-worker series
+    carry a ``worker`` label on ``/metrics``) and runs the cluster
+    health checks from :mod:`deeplearning4j_tpu.obs.health`."""
+
+    def __init__(self, straggler_factor: float = 2.0,
+                 min_straggler_samples: int = 4):
+        self._workers: dict[str, _WorkerState] = {}
+        self._lock = threading.Lock()
+        self.straggler_factor = float(straggler_factor)
+        self.min_straggler_samples = int(min_straggler_samples)
+
+    def workers(self) -> list[str]:
+        with self._lock:
+            return sorted(self._workers)
+
+    # ------------------------------------------------------------ ingest
+    def ingest(self, worker: str, records: list) -> int:
+        from deeplearning4j_tpu.obs.registry import get_registry
+        reg = get_registry()
+        n = 0
+        with self._lock:
+            state = self._workers.get(worker)
+            if state is None:
+                state = self._workers[worker] = _WorkerState()
+                reg.gauge("tpudl_cluster_workers").set(len(self._workers))
+            for record in records:
+                if not isinstance(record, dict):
+                    continue
+                try:
+                    n += self._ingest_one(state, worker, record, reg)
+                except (TypeError, ValueError):
+                    # one malformed record (a null iteration, a string
+                    # step time) must not 500 the batch or poison the
+                    # worker state — skip it, keep its siblings
+                    continue
+        if n:
+            reg.counter("tpudl_cluster_records_ingested_total").inc(n)
+        self._check_stragglers()
+        return n
+
+    def _ingest_one(self, state: "_WorkerState", worker: str,
+                    record: dict, reg) -> int:
+        """Apply ONE record to the worker state; returns 1 (counted).
+        Coercions happen before any mutation, so a malformed field
+        (raising TypeError/ValueError to ``ingest``) leaves the worker
+        state untouched, not half-updated."""
+        kind = record.get("type")
+        if kind == "step":
+            iteration = int(record.get("iteration", state.iteration + 1))
+            epoch = int(record.get("epoch", state.epoch))
+            state.last_seen = time.time()
+            state.steps += 1
+            state.iteration = iteration
+            state.epoch = epoch
+            stamp = record.get("time")
+            if isinstance(stamp, (int, float)) and math.isfinite(stamp):
+                if state.first_step_time is None:
+                    state.first_step_time = float(stamp)
+                state.last_step_time = float(stamp)
+            dt = record.get("step_seconds")
+            if isinstance(dt, (int, float)) and dt >= 0:
+                state.last_step_s = float(dt)
+                state.step_window.append(float(dt))
+                reg.labeled_histogram(
+                    "tpudl_cluster_step_seconds",
+                    label_names=("worker",)).observe(float(dt),
+                                                     worker=worker)
+            score = record.get("score")
+            if isinstance(score, (int, float)) \
+                    and math.isfinite(score):
+                state.score = float(score)
+                reg.labeled_gauge(
+                    "tpudl_cluster_worker_last_score",
+                    label_names=("worker",)).set(state.score,
+                                                 worker=worker)
+            mfu = record.get("mfu")
+            if isinstance(mfu, (int, float)) and mfu > 0:
+                state.mfu = float(mfu)
+                reg.labeled_gauge(
+                    "tpudl_cluster_worker_mfu",
+                    label_names=("worker",)).set(state.mfu,
+                                                 worker=worker)
+            reg.labeled_gauge(
+                "tpudl_cluster_worker_iteration",
+                label_names=("worker",)).set(state.iteration,
+                                             worker=worker)
+        else:
+            state.last_seen = time.time()
+            if kind != "heartbeat":
+                # full stats / init / score / phase records: keep the
+                # bounded replay for the dashboard
+                state.records.append(record)
+        reg.labeled_gauge(
+            "tpudl_cluster_worker_last_seen_time",
+            label_names=("worker",)).set(state.last_seen,
+                                         worker=worker)
+        return 1
+
+    # ------------------------------------------------------------ health
+    def _medians(self) -> dict:
+        with self._lock:
+            return {w: _median(s.step_window) for w, s in
+                    self._workers.items()
+                    if len(s.step_window) >= self.min_straggler_samples}
+
+    def _check_stragglers(self) -> None:
+        from deeplearning4j_tpu.obs import health
+        medians = self._medians()
+        flagged = set(health.stragglers(medians,
+                                        factor=self.straggler_factor))
+        with self._lock:
+            for worker, state in self._workers.items():
+                now_flagged = worker in flagged
+                if now_flagged and not state.straggler:
+                    health.report_anomaly(
+                        "straggler",
+                        f"worker {worker} median step "
+                        f"{medians.get(worker, 0):.4f}s is >"
+                        f"{self.straggler_factor}x the cluster median",
+                        worker=worker)
+                state.straggler = now_flagged
+
+    # ----------------------------------------------------------- summary
+    def straggler_skew(self) -> Optional[float]:
+        """max worker median step time / cluster median of medians —
+        1.0 means a perfectly even gang."""
+        medians = [m for m in self._medians().values() if m]
+        overall = _median(medians)
+        if not medians or not overall:
+            return None
+        return max(medians) / overall
+
+    def summary(self) -> dict:
+        now = time.time()
+        with self._lock:
+            workers = {}
+            for name, s in sorted(self._workers.items()):
+                # the raw window median — unlike the straggler check,
+                # the dashboard shows a number as soon as one step lands
+                med = _median(s.step_window)
+                # rate from the worker's own step stamps (n-1 intervals
+                # between n steps); median fallback when records carried
+                # no producer clock
+                if (s.steps > 1 and s.first_step_time is not None
+                        and s.last_step_time > s.first_step_time):
+                    rate = ((s.steps - 1)
+                            / (s.last_step_time - s.first_step_time))
+                elif med:
+                    rate = 1.0 / med
+                else:
+                    rate = None
+                workers[name] = {
+                    "steps": s.steps,
+                    "iteration": s.iteration,
+                    "epoch": s.epoch,
+                    "score": s.score,
+                    "mfu": s.mfu,
+                    "last_step_ms": (None if s.last_step_s is None
+                                     else round(s.last_step_s * 1e3, 3)),
+                    "median_step_ms": (None if med is None
+                                       else round(med * 1e3, 3)),
+                    "steps_per_s": (round(rate, 3)
+                                    if rate is not None else None),
+                    "liveness_age_s": round(now - s.last_seen, 3),
+                    "straggler": s.straggler,
+                    "records": len(s.records),
+                }
+        return {"n_workers": len(workers),
+                "straggler_skew": self.straggler_skew(),
+                "workers": workers}
+
+    def records_for(self, worker: str) -> list:
+        with self._lock:
+            state = self._workers.get(worker)
+            return list(state.records) if state else []
+
+    # -------------------------------------------------------------- html
+    def render_html(self, refresh_seconds: int = 5) -> str:
+        import html as _html
+        summary = self.summary()
+        skew = summary["straggler_skew"]
+        refresh = (f"<meta http-equiv='refresh' "
+                   f"content='{refresh_seconds}'>" if refresh_seconds else "")
+        rows = []
+        for name, w in summary["workers"].items():
+            flag = " &#9888; straggler" if w["straggler"] else ""
+            style = " style='background:#fdecea'" if w["straggler"] else ""
+            rows.append(
+                f"<tr{style}><td>{_html.escape(name)}{flag}</td>"
+                f"<td>{w['steps']}</td><td>{w['iteration']}</td>"
+                f"<td>{w['median_step_ms'] if w['median_step_ms'] is not None else '—'}</td>"
+                f"<td>{w['last_step_ms'] if w['last_step_ms'] is not None else '—'}</td>"
+                f"<td>{w['mfu'] if w['mfu'] is not None else '—'}</td>"
+                f"<td>{w['score'] if w['score'] is not None else '—'}</td>"
+                f"<td>{w['liveness_age_s']}</td></tr>")
+        return (
+            f"<html><head><meta charset='utf-8'>{refresh}"
+            f"<title>Cluster telemetry</title>"
+            "<style>body{font-family:sans-serif;margin:24px} "
+            "table{border-collapse:collapse} td,th{border:1px solid #ccc;"
+            "padding:4px 10px;text-align:right} th{background:#f5f5f5} "
+            "td:first-child{text-align:left}</style></head><body>"
+            f"<h1>Cluster telemetry</h1>"
+            f"<p>{summary['n_workers']} worker(s) reporting; straggler "
+            f"skew {'—' if skew is None else round(skew, 3)} "
+            f"(max worker median step time / cluster median).</p>"
+            "<table><tr><th>worker</th><th>steps</th><th>iteration</th>"
+            "<th>median step ms</th><th>last step ms</th><th>MFU</th>"
+            "<th>last score</th><th>liveness age s</th></tr>"
+            + "".join(rows) + "</table></body></html>")

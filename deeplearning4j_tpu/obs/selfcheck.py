@@ -13,7 +13,16 @@ consistent on a bare CPU box:
    ``lowered.compile().cost_analysis()``: FLOPs/bytes are positive and
    the MFU/HBM/arith-intensity stamp computes on the CPU fallback peaks;
 4. **flight-recorder smoke** — events + a dump round-trip: the dump
-   carries thread stacks, ring events and a metrics snapshot.
+   carries thread stacks, ring events and a metrics snapshot;
+5. **federation smoke** — a loopback ``RemoteStatsRouter`` →
+   ``UIServer`` ingest round-trip: pushed step records appear in the
+   ``/cluster.json`` summary and as ``worker``-labeled series on
+   ``/metrics`` (the tpudl_cluster_* families stay wired end-to-end).
+
+This module also absorbs the deprecated ``obs.check`` entry point: the
+metric-name lint lives here as :func:`metric_lint` /
+:func:`metric_lint_main` (``obs/check.py`` is a one-line shim with a
+DeprecationWarning).
 
 Exit 0 = all pass; 1 = failures (printed).  Wired into tier-1 via
 ``tests/test_obs_selfcheck.py``.
@@ -25,6 +34,31 @@ import os
 import re
 import sys
 import tempfile
+
+
+# ---------------------------------------------- the former obs.check lint
+def metric_lint(registry=None) -> list[str]:
+    """Human-readable metric-name violations (empty = clean) — delegates
+    to the TPU305 rule in ``tpudl.analyze`` (the single source of the
+    naming convention)."""
+    from deeplearning4j_tpu.analyze.lint import check_metric_names
+    report = check_metric_names(registry)
+    return [f"{d.path}: {d.message}" for d in report.sorted()]
+
+
+def metric_lint_main(argv=None) -> int:
+    """The old ``python -m deeplearning4j_tpu.obs.check`` behavior."""
+    from deeplearning4j_tpu.obs.registry import get_registry
+    problems = metric_lint()
+    if problems:
+        print(f"obs metric lint: {len(problems)} metric-name "
+              f"violation(s) [TPU305]:")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    print(f"obs metric lint: {len(get_registry().names())} registered "
+          f"metric names OK (tpudl_<area>_<name>)")
+    return 0
 
 
 def _doc_metric_names(doc_text: str) -> set:
@@ -115,12 +149,66 @@ def check_flight_recorder_smoke(problems: list) -> None:
         problems.append("flight recorder: ring event missing from dump")
 
 
+def check_federation_smoke(problems: list) -> None:
+    """Loopback router → UIServer ingest round-trip: the whole
+    federation path (buffered push, HTTP ingest, ClusterStore summary,
+    worker-labeled /metrics series) on 127.0.0.1."""
+    import json
+    import time
+    import urllib.request
+
+    from deeplearning4j_tpu.obs.remote import RemoteStatsRouter
+    from deeplearning4j_tpu.obs.ui_server import UIServer
+
+    server = UIServer(port=0)
+    router = RemoteStatsRouter(server.url, worker="selfcheck",
+                               flush_interval_s=0.05)
+    try:
+        for i in range(3):
+            router.put_event("step", iteration=i, step_seconds=0.01,
+                             score=1.0)
+        summary = {}
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(server.url + "cluster.json",
+                                        timeout=2) as resp:
+                summary = json.loads(resp.read())
+            if summary.get("workers", {}).get("selfcheck",
+                                              {}).get("steps") == 3:
+                break
+            time.sleep(0.05)
+        worker = summary.get("workers", {}).get("selfcheck")
+        if not worker or worker.get("steps") != 3:
+            problems.append(f"federation: /cluster.json never showed the "
+                            f"3 pushed steps (got {summary})")
+            return
+        if worker.get("median_step_ms") is None:
+            problems.append("federation: worker summary has no "
+                            "median_step_ms")
+        with urllib.request.urlopen(server.url + "metrics",
+                                    timeout=2) as resp:
+            body = resp.read().decode()
+        if 'tpudl_cluster_worker_iteration{worker="selfcheck"}' not in body:
+            problems.append("federation: /metrics exposition lacks the "
+                            "worker-labeled tpudl_cluster_worker_iteration "
+                            "series")
+        if router.dropped:
+            problems.append(f"federation: loopback push dropped "
+                            f"{router.dropped} records")
+    except Exception as e:
+        problems.append(f"federation: loopback round-trip failed: {e!r}")
+    finally:
+        router.close(timeout=2.0)
+        server.stop()
+
+
 def main(argv=None) -> int:
     problems: list[str] = []
     check_registry_lint(problems)
     check_metric_doc_parity(problems)
     check_costmodel_smoke(problems)
     check_flight_recorder_smoke(problems)
+    check_federation_smoke(problems)
     if problems:
         print(f"obs.selfcheck: {len(problems)} problem(s):")
         for p in problems:
@@ -130,7 +218,8 @@ def main(argv=None) -> int:
     n = len(get_registry().names())
     print(f"obs.selfcheck OK: registry lint clean ({n} metrics), "
           f"metric-doc parity holds, cost_analysis smoke passed, "
-          f"flight-recorder dump round-trips")
+          f"flight-recorder dump round-trips, router→UIServer "
+          f"federation round-trips on loopback")
     return 0
 
 

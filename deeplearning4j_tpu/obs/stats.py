@@ -51,6 +51,10 @@ def _stats_of(vec):
         "mean_magnitude": jnp.mean(jnp.abs(vec)),
         "min": lo,
         "max": hi,
+        # dead-unit signal for obs.health: fraction of ~zero entries
+        # (a gradient tree living below 1e-8 marks a dead layer/unit)
+        "zero_fraction": jnp.mean((jnp.abs(vec) < 1e-8)
+                                  .astype(jnp.float32)),
         "hist_counts": counts,
         "hist_min": lo,
         "hist_max": lo + span,

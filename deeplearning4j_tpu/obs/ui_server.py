@@ -3,7 +3,11 @@
 Parity: the reference's ``deeplearning4j-ui`` ``UIServer`` /
 ``VertxUIServer`` (``org/deeplearning4j/ui/api/UIServer.java``): a
 singleton HTTP server that StatsStorage instances attach to, serving an
-auto-refreshing training dashboard.
+auto-refreshing training dashboard.  Since the telemetry-federation PR
+it is also the cluster COORDINATOR: workers' ``RemoteStatsRouter``\\ s
+(``RemoteUIStatsStorageRouter`` parity, :mod:`obs.remote`) push stats
+records, step stamps and liveness heartbeats to the ingest endpoint, so
+one dashboard watches the whole gang.
 
 Design: the reference embeds a Vert.x server + a JS front-end; here a
 stdlib ``ThreadingHTTPServer`` renders the same content server-side via
@@ -14,20 +18,28 @@ update; ``<meta refresh>`` makes it hands-free).  Endpoints:
 - ``/``            dashboard (first attached storage, auto-refresh)
 - ``/train/<i>``   dashboard for attached storage i
 - ``/data/<i>.json`` raw records (the UI's JSON API surface)
+- ``/cluster``     federated per-worker dashboard (step time, MFU,
+  liveness age, straggler flags) — see docs/observability.md
+- ``/cluster.json`` the same as machine-readable summary
+- ``POST /remote/stats`` worker-telemetry ingest (RemoteStatsRouter
+  batches); accepted records update the ``tpudl_cluster_*`` series
 - ``/metrics``     Prometheus text exposition of the process-wide
-  metrics registry (``obs.registry``) — the scrape target
+  metrics registry (``obs.registry``) — the scrape target, now
+  including the per-worker ``worker``-labeled cluster series
 - ``/healthz``     liveness
 """
 
 from __future__ import annotations
 
 import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from deeplearning4j_tpu.obs.registry import (get_registry,
                                              install_standard_metrics)
+from deeplearning4j_tpu.obs.remote import INGEST_PATH, ClusterStore
 from deeplearning4j_tpu.obs.stats import render_html
 
 
@@ -36,10 +48,18 @@ class UIServer:
 
     _instance: Optional["UIServer"] = None
 
-    def __init__(self, port: int = 0, refresh_seconds: int = 5):
+    def __init__(self, port: int = 0, refresh_seconds: int = 5,
+                 cluster: Optional[ClusterStore] = None,
+                 host: Optional[str] = None):
+        if host is None:
+            # loopback by default; a coordinator that federates workers
+            # on OTHER hosts binds "0.0.0.0" (or a specific interface)
+            host = os.environ.get("DL4J_TPU_UI_HOST", "127.0.0.1")
+        self.host = host
         self._storages: list = []
         self._lock = threading.Lock()
         self.refresh_seconds = refresh_seconds
+        self.cluster = cluster or ClusterStore()
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -52,6 +72,34 @@ class UIServer:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_POST(self):
+                path = self.path.split("?")[0].rstrip("/")
+                if path != INGEST_PATH:
+                    return self._send(b"not found", "text/plain", 404)
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    payload = json.loads(self.rfile.read(length) or b"{}")
+                    worker = str(payload["worker"])
+                    records = payload.get("records", [])
+                    if not isinstance(records, list):
+                        raise ValueError("records must be a list")
+                except (KeyError, ValueError, TypeError) as e:
+                    return self._send(
+                        json.dumps({"error": f"bad ingest payload: "
+                                             f"{e}"}).encode(),
+                        "application/json", 400)
+                try:
+                    n = server.cluster.ingest(worker, records)
+                except Exception as e:
+                    # the garbage-ingest contract: a typed 400, never an
+                    # unhandled-exception connection reset
+                    return self._send(
+                        json.dumps({"error": f"ingest failed: "
+                                             f"{e!r}"}).encode(),
+                        "application/json", 400)
+                return self._send(json.dumps({"ok": n}).encode(),
+                                  "application/json")
 
             def do_GET(self):
                 with server._lock:
@@ -66,12 +114,21 @@ class UIServer:
                     body = get_registry().render_prometheus().encode()
                     return self._send(
                         body, "text/plain; version=0.0.4; charset=utf-8")
+                if path == "/cluster":
+                    html = server.cluster.render_html(
+                        refresh_seconds=server.refresh_seconds)
+                    return self._send(html.encode(), "text/html")
+                if path == "/cluster.json":
+                    return self._send(
+                        json.dumps(server.cluster.summary()).encode(),
+                        "application/json")
                 if path.startswith("/data/") and path.endswith(".json"):
                     idx = path[len("/data/"):-len(".json")]
                     if idx.isdigit() and int(idx) < len(storages):
                         recs = storages[int(idx)].all()
                         return self._send(json.dumps(recs).encode(),
                                           "application/json")
+                    # a stale bookmark after detach must 404, not 500
                     return self._send(b"not found", "text/plain", 404)
                 idx = 0
                 if path.startswith("/train/"):
@@ -89,7 +146,7 @@ class UIServer:
                                    refresh_seconds=server.refresh_seconds)
                 return self._send(html.encode(), "text/html")
 
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
@@ -99,13 +156,31 @@ class UIServer:
 
     @classmethod
     def get_instance(cls, port: int = 0) -> "UIServer":
-        if cls._instance is None:
-            cls._instance = UIServer(port=port)
+        """Return the process-wide singleton, creating it on first call.
+
+        When an instance already exists, an explicit ``port`` is a
+        contract, not a hint: ``port=0`` (or the instance's own port)
+        returns the running instance; any OTHER port raises
+        ``RuntimeError`` — silently returning a server on a different
+        port than the caller asked for is how dashboards go missing."""
+        inst = cls._instance
+        if inst is not None:
+            if port and port != inst.port:
+                raise RuntimeError(
+                    f"UIServer already running on port {inst.port}; "
+                    f"cannot honor get_instance(port={port}) — use the "
+                    f"running instance, stop() it first, or construct "
+                    f"UIServer(port=...) directly for a non-singleton "
+                    f"server")
+            return inst
+        cls._instance = UIServer(port=port)
         return cls._instance
 
     @property
     def url(self) -> str:
-        return f"http://127.0.0.1:{self.port}/"
+        # wildcard binds aren't connectable addresses — advertise loopback
+        host = "127.0.0.1" if self.host in ("", "0.0.0.0", "::") else self.host
+        return f"http://{host}:{self.port}/"
 
     def attach(self, storage) -> None:
         with self._lock:

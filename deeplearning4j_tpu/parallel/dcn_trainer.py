@@ -58,6 +58,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.obs import flight_recorder, tracing
+from deeplearning4j_tpu.obs import remote as obs_remote
 from deeplearning4j_tpu.obs.registry import get_registry
 from deeplearning4j_tpu.parallel import mesh as mesh_mod
 from deeplearning4j_tpu.parallel.compression import (
@@ -475,6 +476,8 @@ class MultiSliceTrainer:
                 else self._slice_step)
         self._wire_tmp = [None] * n
         rngs = jax.random.split(rng, n)
+        import time as _time
+        step_t0 = _time.perf_counter()
         with tracing.span("step", iteration=self.iteration,
                           slices=n) as sp:
             # slice spans run on pool threads where the ambient context
@@ -490,6 +493,11 @@ class MultiSliceTrainer:
         flight_recorder.progress("trainer.step")
         flight_recorder.record("step", iteration=self.iteration,
                                slices=n, score=mean_loss)
+        # per-worker progress onto the coordinator's /cluster dashboard
+        # (buffered router — no network I/O on this path)
+        obs_remote.notify_step(self.iteration,
+                               duration_s=_time.perf_counter() - step_t0,
+                               score=mean_loss, slices=n)
         self.bus.dispatch("iteration_done", self.net, self.iteration, 0,
                           mean_loss)
         self.iteration += 1

@@ -52,14 +52,27 @@ os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count={loca
 import jax
 jax.config.update("jax_platforms", "cpu")  # sitecustomize may pin a TPU platform
 from deeplearning4j_tpu.obs import flight_recorder as _fr
+from deeplearning4j_tpu.obs import remote as _remote
 _fr.install_from_env()   # black box: crash handlers + gang-deadline watchdog
+_remote.install_from_env()   # telemetry federation: heartbeats + step stamps
 jax.distributed.initialize(coordinator_address="127.0.0.1:{port}",
                            num_processes={n}, process_id={pid})
 with open({fn_path!r}, "rb") as f:
     fn = pickle.load(f)
-result = fn(jax.process_index(), jax.process_count())
-with open({out_path!r}, "wb") as f:
-    pickle.dump(result, f)
+try:
+    result = fn(jax.process_index(), jax.process_count())
+    with open({out_path!r}, "wb") as f:
+        pickle.dump(result, f)
+finally:
+    # ALSO on the failure path: an in-flight background cost analysis
+    # (a real XLA compile on a worker thread) racing interpreter +
+    # distributed shutdown aborts the process with a C++ terminate —
+    # which would replace the Python traceback the launcher's stderr
+    # tail surfaces; and a failing worker's buffered telemetry (the
+    # steps leading up to the failure) is the telemetry worth flushing
+    from deeplearning4j_tpu.obs import costmodel as _cm
+    _cm.drain(timeout_s=60.0)
+    _remote.close_router()
 """
 
 
@@ -173,8 +186,10 @@ def _spawn_once(fn: Callable, n_processes: int, port: int,
                 local_devices: int, timeout: float,
                 extra_env: Optional[dict],
                 gang_deadline: Optional[float],
-                gang_fires: int = 1) -> list:
+                gang_fires: int = 1,
+                remote_ui: Optional[str] = None) -> list:
     from deeplearning4j_tpu.obs import flight_recorder, tracing
+    from deeplearning4j_tpu.obs import remote as obs_remote
     from deeplearning4j_tpu.resilience import faults
     faults.fire("launcher.spawn")
     workdir = tempfile.mkdtemp(prefix="dl4j_tpu_cluster_")
@@ -203,6 +218,11 @@ def _spawn_once(fn: Callable, n_processes: int, port: int,
             env[flight_recorder.WATCHDOG_ENV] = str(float(gang_deadline))
             env[flight_recorder.WATCHDOG_FIRES_ENV] = str(int(gang_fires))
             env.setdefault("DL4J_TPU_TRACING", "1")
+        if remote_ui:
+            # telemetry federation: every child routes stats/heartbeats
+            # to the coordinator UIServer under its own worker label
+            env[obs_remote.ENDPOINT_ENV] = remote_ui
+            env[obs_remote.WORKER_ENV] = f"w{pid}"
         if extra_env:
             env.update(extra_env)
         procs.append(subprocess.Popen([sys.executable, "-c", script], env=env,
@@ -286,7 +306,8 @@ def spawn_local_cluster(fn: Callable, n_processes: int = 2, port: int = 12655,
                         local_devices: int = 1, timeout: float = 120.0,
                         extra_env: Optional[dict] = None,
                         startup_retries: int = 2,
-                        gang_deadline: Optional[float] = None) -> list:
+                        gang_deadline: Optional[float] = None,
+                        remote_ui: Optional[str] = None) -> list:
     """Run ``fn(process_index, process_count)`` in N fresh local processes
     under a real jax.distributed runtime (CPU, loopback).  Returns each
     process's pickled return value.  ``fn`` must be picklable (module-level
@@ -319,8 +340,18 @@ def spawn_local_cluster(fn: Callable, n_processes: int = 2, port: int = 12655,
     When tracing is active in the launching process, its span context is
     handed to every worker via ``DL4J_TPU_TRACE_CONTEXT`` — worker spans
     parent under the launcher's current span, so one Chrome trace shows
-    the whole cluster."""
+    the whole cluster.
+
+    Telemetry federation: ``remote_ui`` (a coordinator ``UIServer`` URL,
+    default: the launcher's own ``DL4J_TPU_REMOTE_UI``) is injected into
+    every child as ``DL4J_TPU_REMOTE_UI`` plus a per-child
+    ``DL4J_TPU_WORKER_ID`` (``w<pid>``); the child bootstrap installs a
+    :class:`~deeplearning4j_tpu.obs.remote.RemoteStatsRouter`, so every
+    gang member's steps, heartbeats and stats land on the coordinator's
+    ``/cluster`` dashboard and ``worker``-labeled ``/metrics`` series."""
     from deeplearning4j_tpu.resilience.retry import RetryPolicy, with_retries
+    if remote_ui is None:
+        remote_ui = os.environ.get("DL4J_TPU_REMOTE_UI") or None
     gang_fires = 1
     if gang_deadline is None:
         # silently-armed default: half the wall budget with ONE grace
@@ -340,7 +371,8 @@ def spawn_local_cluster(fn: Callable, n_processes: int = 2, port: int = 12655,
         # a fresh port per retry: the usual flake is the previous gang's
         # coordinator socket lingering in TIME_WAIT
         return _spawn_once(fn, n_processes, port + i * 97, local_devices,
-                           timeout, extra_env, gang_deadline, gang_fires)
+                           timeout, extra_env, gang_deadline, gang_fires,
+                           remote_ui=remote_ui)
 
     policy = RetryPolicy(max_attempts=1 + max(0, startup_retries),
                          base_delay_s=0.2, jitter=0.0,

@@ -13,6 +13,10 @@ index at which it fires, and an action:
 - ``delay``     — sleep ``arg`` seconds (a slow DCN exchange / stuck ETL)
 - ``truncate``  — chop ``arg`` bytes off the end of the file a site just
   published (torn-disk simulation; applied by :func:`corrupt`)
+- ``nan``       — poison the site's next reported value (a numeric
+  blowup stand-in: the trainer replaces the step's loss with NaN so the
+  health-monitor detection path runs end-to-end; checked by
+  :func:`poison`, never raises)
 
 Plans come from code (``install_fault_plan`` / the :func:`inject`
 context manager) or from the environment (``DL4J_TPU_FAULT_PLAN``), so a
@@ -117,7 +121,7 @@ class FaultPlan:
         rules are step-deterministic under retries and restarts)."""
         idx = self._next_index(site) if index is None else index
         for rule in self.rules:
-            if rule.site != site or rule.action == "truncate" \
+            if rule.site != site or rule.action in ("truncate", "nan") \
                     or not rule.matches(idx):
                 continue
             self._record(rule)
@@ -129,6 +133,24 @@ class FaultPlan:
             else:
                 raise InjectedFault(
                     f"injected {rule.action} at {site}[{idx}]")
+
+    def poison(self, site: str, index: Optional[int] = None) -> bool:
+        """True when a ``nan`` rule matches this site event — the
+        instrumentation point then corrupts the value it was about to
+        report (the trainer NaNs the step loss).  Separate from
+        :meth:`fire` because poisoning must not raise and must not
+        consume the site's shared event counter when an explicit index
+        is in use."""
+        rules = [r for r in self.rules
+                 if r.site == site and r.action == "nan"]
+        if not rules:
+            return False
+        idx = self._next_index(site + "#nan") if index is None else index
+        for rule in rules:
+            if rule.matches(idx):
+                self._record(rule)
+                return True
+        return False
 
     def corrupt(self, site: str, path: str) -> bool:
         """Apply any matching ``truncate`` rule to a file the site just
@@ -201,3 +223,9 @@ def fire(site: str, index: Optional[int] = None) -> None:
 def corrupt(site: str, path: str) -> bool:
     plan = get_fault_plan()
     return plan.corrupt(site, path) if plan is not None else False
+
+
+def poison(site: str, index: Optional[int] = None) -> bool:
+    """Value-poisoning check — False when no plan is active."""
+    plan = get_fault_plan()
+    return plan.poison(site, index) if plan is not None else False

@@ -29,6 +29,7 @@ from deeplearning4j_tpu.data.device_pipeline import (
     DeviceFeeder, FedBatch, ensure_feature_mask, pad_segment)
 from deeplearning4j_tpu.nn.losses import mean_score
 from deeplearning4j_tpu.obs import costmodel, flight_recorder, tracing
+from deeplearning4j_tpu.obs import remote as obs_remote
 from deeplearning4j_tpu.obs.listeners import ListenerBus
 from deeplearning4j_tpu.obs.profiler import check_finite
 from deeplearning4j_tpu.obs.registry import get_registry, record_device_memory
@@ -493,6 +494,10 @@ class Trainer:
         OFF the step stays sync-free — the latency histogram then records
         dispatch wall time only."""
         net = self.net
+        # the step clock starts BEFORE the fault site: an injected delay
+        # models a slow step, so it must show in the reported step time
+        # (the federated straggler check judges exactly that number)
+        t0 = time.perf_counter()
         # fault-injection site: a "crash" here models preemption BEFORE
         # the step commits — the last durable checkpoint stays authoritative
         faults.fire("trainer.step", index=net.iteration)
@@ -506,7 +511,6 @@ class Trainer:
         n_examples = batch.n_examples if fed else int(first.shape[0])
         compile_step = not self._compiled
         traces_before = step_cache.jit_cache_entries(*self._jit_step_fns())
-        t0 = time.perf_counter()
         with tracing.span("step", iteration=net.iteration,
                           epoch=net.epoch) as sp:
             if net.conf.backprop_type == "tbptt" \
@@ -551,6 +555,17 @@ class Trainer:
                                examples=n_examples,
                                compile=bool(retraced))
         flight_recorder.progress("trainer.step")
+        # fault site: a "nan" rule poisons the reported loss (numeric-
+        # blowup stand-in) so health-monitor detection runs end-to-end
+        if faults.poison("trainer.step", index=net.iteration):
+            loss = float("nan")
+        # cluster federation: stamp this worker's progress onto the
+        # coordinator's dashboard (buffer-append only — the router's
+        # background thread does the network I/O; see obs/remote.py)
+        obs_remote.notify_step(net.iteration, epoch=net.epoch,
+                               duration_s=dt, score=loss,
+                               examples=n_examples,
+                               compile=bool(retraced))
         net._score = loss
         for listener in self.bus.listeners:
             if hasattr(listener, "record_batch"):

@@ -233,6 +233,33 @@ def dcn_multislice_fit_worker(pid, n, phase="full", workdir="/tmp",
             "dense_bytes_per_step": trainer.grad_size * 4}
 
 
+def telemetry_train_worker(pid, n, steps=8, straggler_pid=None,
+                           delay_s=0.3):
+    """Telemetry-federation acceptance rig: train a small net for
+    ``steps`` steps; every step stamps onto the coordinator via the
+    launcher-injected RemoteStatsRouter (no telemetry code here — the
+    Trainer's own notify_step wiring is what is under test).  When this
+    process is ``straggler_pid``, a ``delay@trainer.step`` fault makes
+    every step slow — the COORDINATOR must flag it as a straggler from
+    the federated step times alone."""
+    import jax
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.resilience import faults
+    from deeplearning4j_tpu.train.trainer import Trainer
+
+    if straggler_pid is not None and pid == straggler_pid:
+        faults.install_fault_plan(faults.FaultPlan.parse(
+            f"trainer.step@0:delay:{delay_s}:{steps}"))
+    net = _small_net(seed=31 + pid)
+    x, y = global_batch(n=16, seed=pid)
+    trainer = Trainer(net)
+    key = jax.random.key(pid)
+    for _ in range(steps):
+        key, sub = jax.random.split(key)
+        trainer.step_batch(DataSet(x, y), sub)
+    return {"pid": pid, "steps": steps}
+
+
 def hang_worker(pid, n):
     """Fault drill: announce on stderr, then wedge — the launcher's
     timeout path must terminate-then-kill the gang and surface this

@@ -165,11 +165,23 @@ def test_metric_suffix_rules():
     assert "_total" in messages and "_seconds" in messages
 
 
-def test_obs_check_shim_still_works():
-    from deeplearning4j_tpu.obs.check import lint
+def test_obs_check_shim_warns_and_still_works():
+    """The deprecated ``obs.check`` alias: importing it raises a
+    DeprecationWarning and its ``lint`` is selfcheck's metric_lint."""
+    import importlib
+    import sys as _sys
+    import warnings
+
+    from deeplearning4j_tpu.obs import selfcheck
+    _sys.modules.pop("deeplearning4j_tpu.obs.check", None)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        check = importlib.import_module("deeplearning4j_tpu.obs.check")
+    assert any(issubclass(w.category, DeprecationWarning) for w in caught)
+    assert check.lint is selfcheck.metric_lint
     registry = MetricsRegistry(validate_names=False)
     registry.counter("tpudl_test_rogue")
-    problems = lint(registry)
+    problems = check.lint(registry)
     assert any("_total" in p for p in problems)
 
 
@@ -461,3 +473,81 @@ def test_flight_recorder_dotted_imports_are_caught(tmp_path):
     hits = report.by_rule("TPU310")
     assert len(hits) == 2
     assert report.exit_code() == 1
+
+
+# ------------------------------------------------------------ TPU311
+def test_net_io_in_step_path(tmp_path):
+    report = _lint_source(tmp_path, """
+        import socket
+        import urllib.request
+        from http.client import HTTPConnection
+
+        def step_batch(self, batch):
+            urllib.request.urlopen("http://ui:9090/remote/stats",
+                                   data=b"{}")
+            return batch
+
+        def iteration_done(self, model, it, epoch, score):
+            conn = HTTPConnection("coordinator", 9090)
+            conn.request("POST", "/remote/stats")
+
+        def fit_loop(step, batches):
+            sock = socket.create_connection(("telemetry", 4317))
+            for b in batches:
+                step(b)
+        """)
+    hits = report.by_rule("TPU311")
+    assert len(hits) == 3
+    assert report.exit_code() == 1
+    assert "RemoteStatsRouter" in hits[0].message
+
+
+def test_net_io_outside_step_path_is_fine(tmp_path):
+    """Network I/O in non-step-path functions (setup, serving handlers
+    with their own rules, plain helpers) and host-local socket attribute
+    reads are not TPU311's business."""
+    report = _lint_source(tmp_path, """
+        import socket
+        import urllib.request
+
+        def fetch_config(url):
+            return urllib.request.urlopen(url).read()
+
+        def make_coordinator_endpoint(port):
+            return socket.create_server(("127.0.0.1", port))
+
+        def step_batch(self, batch):
+            host = socket.gethostname()        # host-local, no connect
+            return batch, host
+        """)
+    assert report.by_rule("TPU311") == []
+    assert report.exit_code() == 0
+
+
+def test_net_io_aliased_and_from_imports_are_caught(tmp_path):
+    report = _lint_source(tmp_path, """
+        import urllib.request as _rq
+        from urllib.request import urlopen
+        from urllib import request
+
+        def on_epoch_end(self, model, epoch, info):
+            urlopen("http://ui/remote/stats")
+
+        def stats_push(records):
+            _rq.urlopen("http://ui/remote/stats")
+            request.urlopen("http://ui/remote/stats")
+        """)
+    hits = report.by_rule("TPU311")
+    assert len(hits) == 3
+
+
+def test_obs_remote_itself_is_exempt(tmp_path):
+    """The router's flush thread is WHERE the network I/O belongs."""
+    (tmp_path / "obs").mkdir()
+    report = _lint_source(tmp_path, """
+        import urllib.request
+
+        def _flush_step_batch(self, payload):
+            urllib.request.urlopen(self.endpoint, data=payload)
+        """, name="obs/remote.py")
+    assert report.by_rule("TPU311") == []

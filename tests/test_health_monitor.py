@@ -1,0 +1,246 @@
+"""HealthMonitor: the stats stream gets a judge.  ISSUE-7 acceptance:
+a faults-injected NaN at trainer.step is detected within ONE step,
+increments tpudl_health_anomalies_total, and fires a flight-recorder
+dump whose header names the anomaly."""
+
+import math
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+import cluster_workers  # noqa: E402
+
+from deeplearning4j_tpu.data.dataset import DataSet  # noqa: E402
+from deeplearning4j_tpu.obs import flight_recorder  # noqa: E402
+from deeplearning4j_tpu.obs.health import (HealthConfig,  # noqa: E402
+                                           HealthHalt, HealthMonitor,
+                                           robust_zscore, stragglers)
+from deeplearning4j_tpu.obs.registry import (MetricsRegistry,  # noqa: E402
+                                             get_registry, set_registry)
+from deeplearning4j_tpu.resilience import faults  # noqa: E402
+from deeplearning4j_tpu.train.trainer import Trainer  # noqa: E402
+
+
+@pytest.fixture
+def registry():
+    prev = set_registry(MetricsRegistry())
+    yield get_registry()
+    set_registry(prev)
+
+
+def _trainer(monitor, seed=9):
+    net = cluster_workers._small_net(seed=seed)
+    return Trainer(net, listeners=[monitor]), net
+
+
+def _anomaly_count(registry, kind):
+    return registry.labeled_counter(
+        "tpudl_health_anomalies_total",
+        label_names=("kind",)).labeled_value(kind=kind)
+
+
+# ============================================== the NaN acceptance rig
+class TestNaNDetection:
+    def test_injected_nan_detected_within_one_step(self, registry,
+                                                   tmp_path):
+        dump = str(tmp_path / "health_box.jsonl")
+        monitor = HealthMonitor(actions=("warn", "dump"), dump_path=dump)
+        trainer, net = _trainer(monitor)
+        x, y = cluster_workers.global_batch(n=16, seed=0)
+        batch = DataSet(x, y)
+        key = jax.random.key(0)
+        with faults.inject("trainer.step@3:nan"):
+            for i in range(6):
+                key, sub = jax.random.split(key)
+                trainer.step_batch(batch, sub)
+                if i < 3:
+                    assert not monitor.anomalies       # healthy so far
+                if i == 3:
+                    # detected the SAME step the fault fired
+                    assert monitor.anomalies, "NaN not caught in-step"
+        kinds = [a["kind"] for a in monitor.anomalies]
+        assert kinds[0] == "non_finite_loss"
+        assert monitor.anomalies[0]["iteration"] == 3
+        assert _anomaly_count(registry, "non_finite_loss") >= 1
+        # the black box fired on a SEMANTIC anomaly; its header names it
+        lines = flight_recorder.read_dump(dump)
+        header = next(l for l in lines if l["type"] == "header")
+        assert header["reason"] == "health:non_finite_loss"
+        assert header["detail"]["kind"] == "non_finite_loss"
+        assert header["detail"]["iteration"] == 3
+        assert any(l["type"] == "thread" for l in lines)
+
+    def test_halt_action_stops_training(self, registry):
+        monitor = HealthMonitor(actions=("halt",))
+        trainer, net = _trainer(monitor)
+        x, y = cluster_workers.global_batch(n=16, seed=0)
+        key = jax.random.key(0)
+        with faults.inject("trainer.step@2:nan"):
+            with pytest.raises(HealthHalt) as err:
+                for _ in range(5):
+                    key, sub = jax.random.split(key)
+                    trainer.step_batch(DataSet(x, y), sub)
+        assert err.value.kind == "non_finite_loss"
+        assert net.iteration == 2      # halted before step 3 ever ran
+
+    def test_checkpoint_action_saves_now(self, registry, tmp_path):
+        from deeplearning4j_tpu.io.checkpoint import CheckpointListener
+        ckpt = CheckpointListener(str(tmp_path))
+        monitor = HealthMonitor(actions=("checkpoint",),
+                                checkpoint_listener=ckpt)
+        trainer, net = _trainer(monitor)
+        x, y = cluster_workers.global_batch(n=16, seed=0)
+        key = jax.random.key(0)
+        with faults.inject("trainer.step@1:nan"):
+            for _ in range(3):
+                key, sub = jax.random.split(key)
+                trainer.step_batch(DataSet(x, y), sub)
+        saved = [n for n in os.listdir(str(tmp_path))
+                 if n.startswith("checkpoint_iter")]
+        assert saved, "checkpoint action produced no checkpoint"
+        actions = registry.labeled_counter(
+            "tpudl_health_actions_total", label_names=("action",))
+        assert actions.labeled_value(action="checkpoint") >= 1
+
+    def test_checkpoint_action_requires_listener(self):
+        with pytest.raises(ValueError):
+            HealthMonitor(actions=("checkpoint",))
+        with pytest.raises(ValueError):
+            HealthMonitor(actions=("explode",))
+
+
+# ================================================== loss-stream checks
+class TestLossStream:
+    def test_loss_spike_zscore(self, registry):
+        monitor = HealthMonitor(
+            config=HealthConfig(min_samples=8, spike_zscore=8.0))
+        for i in range(20):
+            monitor.iteration_done(None, i, 0, 1.0 + 0.01 * (i % 3))
+        assert not monitor.anomalies
+        monitor.iteration_done(None, 20, 0, 50.0)     # 50x the median
+        kinds = [a["kind"] for a in monitor.anomalies]
+        assert kinds == ["loss_spike"]
+        assert _anomaly_count(registry, "loss_spike") == 1
+
+    def test_no_spike_during_warmup_or_smooth_descent(self, registry):
+        monitor = HealthMonitor(
+            config=HealthConfig(min_samples=8, spike_zscore=8.0))
+        # warmup: even a wild value is not judged before min_samples
+        monitor.iteration_done(None, 0, 0, 100.0)
+        monitor.iteration_done(None, 1, 0, 1.0)
+        # smooth descent never flags
+        for i in range(2, 40):
+            monitor.iteration_done(None, i, 0, 2.0 * 0.95 ** i + 0.01 * (i % 2))
+        assert not monitor.anomalies
+
+    def test_robust_zscore_helper(self):
+        window = [1.0, 1.1, 0.9, 1.05, 0.95, 1.0, 1.02]
+        assert robust_zscore(window, 1.0) < 1.0
+        assert robust_zscore(window, 10.0) > 8.0
+        assert robust_zscore([1.0, 1.0], 2.0) is None      # too small
+        assert robust_zscore([1.0, 1.0, 1.0], 1.0) is None  # flat, on-median
+        assert robust_zscore([1.0, 1.0, 1.0], 2.0) == math.inf
+
+
+# ================================================= stats-stream checks
+def _stats(grad_norm=1.0, zero_fraction=0.0, param_mm=1.0, update_mm=1e-3,
+           layer="0"):
+    return {
+        "params": {layer: {"norm": 10.0, "mean_magnitude": param_mm}},
+        "gradients": {layer: {"norm": grad_norm,
+                              "zero_fraction": zero_fraction}},
+        "updates": {layer: {"norm": 0.1, "mean_magnitude": update_mm}},
+    }
+
+
+class TestStatsStream:
+    def test_grad_explosion_and_vanish_bands(self, registry):
+        monitor = HealthMonitor(
+            config=HealthConfig(grad_norm_max=100.0, grad_norm_min=1e-6))
+        monitor.stats_ready(None, 0, 0, 1.0, _stats(grad_norm=1.0))
+        assert not monitor.anomalies
+        monitor.stats_ready(None, 1, 0, 1.0, _stats(grad_norm=1e5))
+        assert [a["kind"] for a in monitor.anomalies] == ["grad_explosion"]
+        monitor.stats_ready(None, 2, 0, 1.0, _stats(grad_norm=1e-9))
+        assert [a["kind"] for a in monitor.anomalies] == \
+            ["grad_explosion", "grad_vanish"]
+
+    def test_non_finite_grad(self, registry):
+        monitor = HealthMonitor()
+        monitor.stats_ready(None, 0, 0, 1.0,
+                            _stats(grad_norm=float("nan")))
+        assert [a["kind"] for a in monitor.anomalies] == ["non_finite_grad"]
+
+    def test_dead_units_fraction(self, registry):
+        monitor = HealthMonitor(
+            config=HealthConfig(dead_fraction_max=0.9))
+        monitor.stats_ready(None, 0, 0, 1.0, _stats(zero_fraction=0.5))
+        assert not monitor.anomalies
+        monitor.stats_ready(None, 1, 0, 1.0, _stats(zero_fraction=0.99))
+        assert [a["kind"] for a in monitor.anomalies] == ["dead_units"]
+        assert monitor.anomalies[0]["layer"] == "0"
+
+    def test_update_ratio_out_of_band(self, registry):
+        monitor = HealthMonitor(
+            config=HealthConfig(update_ratio_band=(-6.0, -1.0)))
+        monitor.stats_ready(None, 0, 0, 1.0,
+                            _stats(param_mm=1.0, update_mm=1e-3))
+        assert not monitor.anomalies
+        # updates as large as params: the LR is way too hot
+        monitor.stats_ready(None, 1, 0, 1.0,
+                            _stats(param_mm=1.0, update_mm=1.0))
+        assert [a["kind"] for a in monitor.anomalies] == ["update_ratio"]
+        # frozen: updates 1e-9 of params
+        monitor.stats_ready(None, 2, 0, 1.0,
+                            _stats(param_mm=1.0, update_mm=1e-9))
+        assert [a["kind"] for a in monitor.anomalies] == \
+            ["update_ratio", "update_ratio"]
+
+    def test_device_stats_carry_zero_fraction(self, registry):
+        """The on-device stats tree now includes the dead-unit signal
+        (obs.stats._stats_of), so the monitor's dead-unit check rides
+        the SAME fused program as the rest of the stats."""
+        from deeplearning4j_tpu.obs.stats import device_layer_stats
+        import jax.numpy as jnp
+        stats = device_layer_stats([{"w": jnp.asarray([0.0, 0.0, 0.0, 4.0])}])
+        assert float(stats["0"]["zero_fraction"]) == pytest.approx(0.75)
+
+    def test_monitor_rides_real_stats_sampling(self, registry):
+        """End-to-end: the monitor's wants_model_stats triggers the
+        trainer's stats step; a frozen-updates anomaly is detected from
+        REAL device stats (updater LR 0 → update:param ratio b0rked)."""
+        from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.train import Sgd
+        conf = (NeuralNetConfiguration.builder().seed(3)
+                .updater(Sgd(1e-12)).list()
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        monitor = HealthMonitor(frequency=2,
+                                config=HealthConfig(
+                                    update_ratio_band=(-6.0, -1.0)))
+        trainer = Trainer(net, listeners=[monitor])
+        x, y = cluster_workers.global_batch(n=16, seed=2)
+        key = jax.random.key(0)
+        for _ in range(3):
+            key, sub = jax.random.split(key)
+            trainer.step_batch(DataSet(x, y), sub)
+        assert any(a["kind"] == "update_ratio" for a in monitor.anomalies)
+
+
+# ==================================================== straggler helper
+def test_stragglers_helper():
+    assert stragglers({"a": 0.01, "b": 0.011, "c": 0.05}, factor=2.0) \
+        == ["c"]
+    assert stragglers({"a": 0.01, "b": 0.011}, factor=2.0) == []
+    assert stragglers({"a": 0.01}, factor=2.0) == []       # need >= 2
+    assert stragglers({"a": 0.01, "b": None, "c": 0.05}, factor=2.0) \
+        == ["c"]

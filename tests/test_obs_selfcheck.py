@@ -72,6 +72,11 @@ def test_bench_skip_path_runs_cpu_records_and_exits_zero(monkeypatch,
         bench, "bench_serving",
         lambda: {"metric": "serving_requests_per_s", "value": 100.0,
                  "mfu": 0.02, "hbm_util": 0.06, "arith_intensity": 3.7})
+    monkeypatch.setattr(
+        bench, "bench_multichip",
+        lambda: {"metric": "multichip_scaling_efficiency", "value": 0.8,
+                 "per_chip_scaling_efficiency": 0.8,
+                 "straggler_skew": 1.1, "n_workers": 4})
     rc = bench.main()
     out = capsys.readouterr().out
     assert rc == 0
@@ -79,6 +84,11 @@ def test_bench_skip_path_runs_cpu_records_and_exits_zero(monkeypatch,
     assert record["status"] == "skipped"
     assert record["detail"]["feed_overlap"]["speedup"] == 1.4
     assert record["detail"]["serving"]["value"] == 100.0
+    # the multichip scaling row rides the tunnel-down record too —
+    # federated telemetry is CPU-measurable, so rc=0 with data, not rc=1
+    multichip = record["detail"]["multichip"]
+    assert multichip["per_chip_scaling_efficiency"] == 0.8
+    assert multichip["straggler_skew"] == 1.1
     # the roofline stamp is lifted to the top-level detail
     assert record["detail"]["mfu"] == 0.012
     assert record["detail"]["hbm_util"] == 0.05
@@ -95,8 +105,10 @@ def test_bench_probe_error_still_exits_nonzero(monkeypatch, capsys):
                                                 "device probe failed"))
     monkeypatch.setattr(bench, "bench_feed_overlap", lambda: {"ok": 1})
     monkeypatch.setattr(bench, "bench_serving", lambda: {"ok": 1})
+    monkeypatch.setattr(bench, "bench_multichip", lambda: {"ok": 1})
     rc = bench.main()
     record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rc == 1
     assert record["status"] == "error"
     assert record["detail"]["feed_overlap"] == {"ok": 1}
+    assert record["detail"]["multichip"] == {"ok": 1}

@@ -66,3 +66,110 @@ def test_healthz_and_404(server):
     server.attach(InMemoryStatsStorage())
     with pytest.raises(urllib.error.HTTPError):
         _get(server.url + "train/7")
+
+
+# ----------------------------------------- ISSUE-7: concurrency contracts
+def test_attach_detach_racing_do_get(server):
+    """Attach/detach churning under a barrage of concurrent GETs: every
+    response is a clean 200 or 404, never a 500 from the handler racing
+    the storages list (do_GET snapshots under the lock)."""
+    import concurrent.futures
+    import threading
+    import urllib.error
+
+    stop = threading.Event()
+    errors = []
+
+    def churn():
+        storages = [_storage_with_records() for _ in range(3)]
+        while not stop.is_set():
+            for st in storages:
+                server.attach(st)
+            for st in storages:
+                server.detach(st)
+
+    def hammer():
+        for _ in range(40):
+            for path in ("", "train/1", "data/0.json", "data/2.json"):
+                try:
+                    status, _ = _get(server.url + path)
+                    if status not in (200, 404):
+                        errors.append((path, status))
+                except urllib.error.HTTPError as e:
+                    if e.code != 404:
+                        errors.append((path, e.code))
+                except Exception as e:      # connection reset = server died
+                    errors.append((path, repr(e)))
+
+    churner = threading.Thread(target=churn, daemon=True)
+    churner.start()
+    try:
+        with concurrent.futures.ThreadPoolExecutor(4) as pool:
+            list(pool.map(lambda _: hammer(), range(4)))
+    finally:
+        stop.set()
+        churner.join(timeout=5)
+    assert errors == []
+    # the server is still alive and coherent afterwards
+    assert _get(server.url + "healthz")[0] == 200
+
+
+def test_stale_data_index_after_detach_is_404(server):
+    """A bookmarked /data/<i>.json whose storage was detached must 404
+    (typed), never 500 or silently serve another session's records."""
+    import urllib.error
+
+    a, b = _storage_with_records(), _storage_with_records()
+    server.attach(a)
+    server.attach(b)
+    assert _get(server.url + "data/1.json")[0] == 200
+    server.detach(b)
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _get(server.url + "data/1.json")          # stale index
+    assert err.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _get(server.url + "data/notanumber.json")
+    assert err.value.code == 404
+
+
+def test_bind_host_configurable_for_cross_host_federation():
+    """The coordinator can bind a non-loopback interface (host= or
+    DL4J_TPU_UI_HOST) so remote workers can reach /remote/stats; the
+    advertised url never names an unconnectable wildcard address."""
+    server = UIServer(port=0, host="0.0.0.0")
+    try:
+        assert server.host == "0.0.0.0"
+        assert server.url.startswith("http://127.0.0.1:")
+        with urllib.request.urlopen(server.url + "healthz", timeout=5) as r:
+            assert json.loads(r.read())["status"] == "ok"
+    finally:
+        server.stop()
+    # default stays loopback-only
+    dflt = UIServer(port=0)
+    try:
+        assert dflt.host == "127.0.0.1"
+    finally:
+        dflt.stop()
+
+
+def test_get_instance_port_is_a_contract():
+    """get_instance(port=...) with a running instance: port=0 and the
+    instance's own port return it; any OTHER port raises rather than
+    silently ignoring the ask (documented return-or-raise)."""
+    inst = UIServer.get_instance(port=0)
+    try:
+        assert UIServer.get_instance() is inst
+        assert UIServer.get_instance(port=0) is inst
+        assert UIServer.get_instance(port=inst.port) is inst
+        other = inst.port + 1 if inst.port < 65535 else inst.port - 1
+        with pytest.raises(RuntimeError) as err:
+            UIServer.get_instance(port=other)
+        assert str(inst.port) in str(err.value)
+    finally:
+        inst.stop()
+    # stop() clears the singleton: a fresh ask constructs a new one
+    fresh = UIServer.get_instance(port=0)
+    try:
+        assert fresh is not inst
+    finally:
+        fresh.stop()
