@@ -17,8 +17,18 @@ federation rather than per-process stopwatches:
 - ``straggler_skew`` = max worker median step time / cluster median of
   medians (1.0 = perfectly even gang).
 
+Since the self-healing-gangs PR the record also carries a **recovery**
+section: a 2-worker gang runs under the
+:class:`~deeplearning4j_tpu.resilience.supervisor.ClusterSupervisor`
+with a fault-injected SIGKILL of one worker mid-fit; the supervisor
+tears down, respawns from the latest verified checkpoint, and the
+record reports the measured ``mttr_s`` (failure detection → first
+post-restart federated step), ``steps_replayed`` and
+``recovered: true`` — recovery time as a first-class efficiency number.
+
 Prints ONE json line.  Env knobs: ``DL4J_TPU_MULTICHIP_WORKERS`` (4),
-``DL4J_TPU_MULTICHIP_STEPS`` (16), ``DL4J_TPU_MULTICHIP_PORT`` (24211).
+``DL4J_TPU_MULTICHIP_STEPS`` (16), ``DL4J_TPU_MULTICHIP_PORT`` (24211),
+``DL4J_TPU_MULTICHIP_RECOVERY_STEPS`` (8).
 """
 
 import functools
@@ -69,6 +79,93 @@ def train_worker(pid, n, steps=16):
     return {"pid": pid, "steps": steps}
 
 
+def recovery_worker(pid, n, steps=8, workdir=None, kill_at=None):
+    """Supervised gang member for the recovery record: fit over a
+    ResumableIterator with per-iteration-pair checkpoints; in generation
+    0 the LAST worker SIGKILLs itself mid-fit (faults ``kill`` action —
+    real, uncatchable process death).  Respawned generations resume from
+    their own verified checkpoints via the supervisor-injected
+    ``DL4J_TPU_RESUME_FROM``."""
+    import os
+    import numpy as np
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import (ListDataSetIterator,
+                                                   ResumableIterator)
+    from deeplearning4j_tpu.io.checkpoint import CheckpointListener
+    from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.resilience import faults, supervisor
+    from deeplearning4j_tpu.train import Sgd
+    from deeplearning4j_tpu.train.trainer import Trainer
+
+    generation = int(os.environ.get(supervisor.GENERATION_ENV, "0"))
+    if kill_at is None:
+        kill_at = max(2, steps - 2)
+    if generation == 0 and pid == n - 1:
+        # the chaos: REAL SIGKILL before step kill_at commits — only in
+        # the first generation (the supervisor also strips the env fault
+        # plan on respawn; this programmatic plan is gated here)
+        faults.install_fault_plan(
+            faults.FaultPlan.parse(f"trainer.step@{kill_at}:kill"))
+
+    conf = (NeuralNetConfiguration.builder().seed(19 + pid)
+            .updater(Sgd(0.05)).list()
+            .layer(DenseLayer(n_out=32, activation="tanh"))
+            .layer(OutputLayer(n_out=5, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(16)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(37 + pid)
+    x = rng.normal(size=(steps * 16, 16)).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, steps * 16)]
+    batches = [DataSet(x[i:i + 16], y[i:i + 16])
+               for i in range(0, steps * 16, 16)]
+    iterator = ResumableIterator(ListDataSetIterator(batches))
+    ckpt_dir = os.path.join(workdir, f"w{pid}")
+    ckpt = CheckpointListener(ckpt_dir, save_every_n_iterations=2,
+                              keep_last=3, iterator=iterator)
+    resume = os.environ.get(supervisor.RESUME_ENV)
+    trainer = Trainer(net, listeners=[ckpt])
+    trainer.fit(iterator, epochs=1,
+                resume_from=(ckpt_dir if resume else None))
+    return {"pid": pid, "generation": generation,
+            "iteration": net.iteration}
+
+
+def _run_recovery(server, steps, port, workdir):
+    """The recovery row: a supervised 2-worker gang with an injected
+    SIGKILL; returns measured MTTR + steps replayed."""
+    from deeplearning4j_tpu.obs.remote import ClusterStore
+    from deeplearning4j_tpu.resilience.supervisor import ClusterSupervisor
+    server.cluster = ClusterStore()
+    import multichip as _self
+    fn = functools.partial(_self.recovery_worker, steps=steps,
+                           workdir=workdir)
+    sup = ClusterSupervisor(
+        fn, n_processes=2, checkpoint_dir=workdir, max_restarts=2,
+        port=port, timeout=300.0, remote_ui=server.url,
+        cluster_store=server.cluster,
+        extra_env={"PYTHONPATH": _HERE + os.pathsep
+                   + os.environ.get("PYTHONPATH", "")})
+    run = sup.run()
+    incident = run.incidents[0] if run.incidents else None
+    return {
+        "recovered": bool(run.incidents) and len(run.results) == 2,
+        "restarts": len(run.incidents),
+        "generations": run.generations,
+        "mttr_s": (None if incident is None or incident.mttr_s is None
+                   else round(incident.mttr_s, 3)),
+        "steps_replayed": (None if incident is None
+                           else incident.steps_replayed),
+        "reason": None if incident is None else incident.reason,
+        "note": ("2-worker supervised gang; one worker SIGKILLed "
+                 "mid-fit by the fault harness, gang respawned from "
+                 "the latest verified checkpoint; mttr_s = detection "
+                 "to first post-restart federated step"),
+    }
+
+
 def _fetch_json(url):
     import urllib.request
     with urllib.request.urlopen(url, timeout=5) as resp:
@@ -105,9 +202,12 @@ def _throughputs(summary):
 
 
 def main():
+    import tempfile
     n_workers = int(os.environ.get("DL4J_TPU_MULTICHIP_WORKERS", "4"))
     steps = int(os.environ.get("DL4J_TPU_MULTICHIP_STEPS", "16"))
     port = int(os.environ.get("DL4J_TPU_MULTICHIP_PORT", "24211"))
+    recovery_steps = int(os.environ.get("DL4J_TPU_MULTICHIP_RECOVERY_STEPS",
+                                        "8"))
     from deeplearning4j_tpu.obs.ui_server import UIServer
     server = UIServer(port=0)
     try:
@@ -129,6 +229,11 @@ def main():
         aggregate = sum(measured)
         efficiency = (aggregate / n_workers) / baseline
         skew = gang_summary.get("straggler_skew") or 1.0
+
+        # the self-healing row: kill-and-heal under the supervisor,
+        # measured from the same federated telemetry
+        recovery = _run_recovery(server, recovery_steps, port + 391,
+                                 tempfile.mkdtemp(prefix="dl4j_tpu_rec_"))
         print(json.dumps({
             "metric": "multichip_scaling_efficiency",
             "value": round(efficiency, 4),
@@ -137,6 +242,7 @@ def main():
             "steps_per_worker": steps,
             "per_chip_scaling_efficiency": round(efficiency, 4),
             "straggler_skew": round(skew, 4),
+            "recovery": recovery,
             "detail": {
                 "baseline_steps_per_s": round(baseline, 3),
                 "aggregate_steps_per_s": round(aggregate, 3),

@@ -218,6 +218,21 @@ _RULE_LIST = [
         "router.put) instead of calling urlopen/socket in the "
         "step/listener function; do one-shot network setup outside "
         "the training path."),
+    RuleInfo(
+        "TPU312", "exit-outside-supervision", ERROR,
+        "os._exit/sys.exit in library code outside the flight-recorder "
+        "watchdog and the cluster supervisor (CLI __main__ guards "
+        "exempt)",
+        "A stray exit kills the process without writing the black box "
+        "or surfacing a structured failure: the supervisor sees an "
+        "unexplained rc, the flight recorder never dumps, and gang "
+        "recovery loses exactly the evidence it restarts on.  "
+        "Deliberate process death belongs to the watchdog (rc=87 after "
+        "dumping) and the supervisor's teardown — nothing else.",
+        "Raise an exception (or return an exit code from main() and "
+        "let the 'if __name__ == \"__main__\"' guard call sys.exit); "
+        "leave process termination to obs/flight_recorder and "
+        "resilience/supervisor."),
 ]
 
 RULES: dict[str, RuleInfo] = {r.id: r for r in _RULE_LIST}

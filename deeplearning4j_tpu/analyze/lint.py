@@ -15,7 +15,9 @@ TPU307 (per-batch host transfer in a training loop), TPU308 (swallowed
 exception in a training loop), TPU309 (jax.jit built per request in a
 serving handler), TPU310 (span opened without `with` / flight-recorder
 I/O inside jit), TPU311 (direct network I/O in a step/listener-path
-function — telemetry goes through the buffered RemoteStatsRouter).
+function — telemetry goes through the buffered RemoteStatsRouter),
+TPU312 (os._exit/sys.exit outside the watchdog/supervisor — a stray
+exit defeats supervision and drops the black box).
 Registry-backed rules that ride along in ``lint_package``/``--self``:
 TPU305 (metric names — the former ``obs.check`` lint) and TPU306
 (op-spec catalog integrity).
@@ -825,6 +827,93 @@ def _rule_net_io_in_step_path(mod: ModuleInfo) -> list[Diagnostic]:
                     f"training loop; route telemetry through the "
                     f"buffered RemoteStatsRouter",
                     path=mod.anchor(node)))
+    return out
+
+
+# the two modules whose JOB is deliberate process death: the flight-
+# recorder watchdog (dump, then rc=87) and the cluster supervisor's
+# teardown path — everywhere else an exit defeats supervision
+_EXIT_EXEMPT_SUFFIXES = ("obs/flight_recorder.py", "resilience/supervisor.py")
+
+
+def _is_main_guard(test: ast.AST) -> bool:
+    """``__name__ == "__main__"`` (either operand order)."""
+    if not isinstance(test, ast.Compare) or len(test.ops) != 1 \
+            or not isinstance(test.ops[0], ast.Eq):
+        return False
+    operands = [test.left] + test.comparators
+    has_name = any(isinstance(o, ast.Name) and o.id == "__name__"
+                   for o in operands)
+    has_main = any(isinstance(o, ast.Constant) and o.value == "__main__"
+                   for o in operands)
+    return has_name and has_main
+
+
+@register_lint_rule("TPU312")
+def _rule_exit_outside_supervision(mod: ModuleInfo) -> list[Diagnostic]:
+    """``os._exit``/``sys.exit`` in library code: a stray exit kills the
+    process without dumping the black box and hands the supervisor an
+    unexplained rc — deliberate death belongs to the watchdog
+    (flight_recorder, rc=87 after dumping) and the supervisor.  The
+    ``if __name__ == "__main__": sys.exit(main())`` CLI idiom is exempt
+    (that exit IS the process's contract with its shell)."""
+    norm = mod.path.replace(os.sep, "/")
+    # segment-boundary match: exactly the two sanctioned modules — a
+    # jobs/flight_recorder.py must NOT inherit the exemption by string
+    # suffix accident
+    if any(norm == suffix or norm.endswith("/" + suffix)
+           for suffix in _EXIT_EXEMPT_SUFFIXES):
+        return []
+    os_aliases: set[str] = set()
+    sys_aliases: set[str] = set()
+    exit_names: set[str] = set()     # from os import _exit / from sys import exit
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                head = alias.name.split(".")[0]
+                if head == "os":
+                    os_aliases.add(alias.asname or "os")
+                elif head == "sys":
+                    sys_aliases.add(alias.asname or "sys")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "os":
+                for alias in node.names:
+                    if alias.name == "_exit":
+                        exit_names.add(alias.asname or "_exit")
+            elif node.module == "sys":
+                for alias in node.names:
+                    if alias.name == "exit":
+                        exit_names.add(alias.asname or "exit")
+    if not (os_aliases or sys_aliases or exit_names):
+        return []
+    allowed: set[int] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.If) and _is_main_guard(node.test):
+            for sub in ast.walk(node):
+                allowed.add(id(sub))
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or id(node) in allowed:
+            continue
+        f = node.func
+        what = None
+        if isinstance(f, ast.Name) and f.id in exit_names:
+            what = f.id
+        elif isinstance(f, ast.Attribute):
+            recv = _dotted_receiver(f.value)
+            if f.attr == "_exit" and recv in os_aliases:
+                what = f"{recv}._exit"
+            elif f.attr == "exit" and recv in sys_aliases:
+                what = f"{recv}.exit"
+        if what:
+            out.append(Diagnostic(
+                "TPU312",
+                f"{what}() in library code defeats supervision: the "
+                f"process dies without a flight-recorder dump and the "
+                f"cluster supervisor sees an unexplained exit — raise "
+                f"instead, or route deliberate death through the "
+                f"watchdog/supervisor",
+                path=mod.anchor(node)))
     return out
 
 

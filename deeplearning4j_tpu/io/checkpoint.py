@@ -26,6 +26,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import threading
 import time
 from typing import Optional
 
@@ -76,6 +77,12 @@ class CheckpointListener(TrainingListener):
         self.normalizer = normalizer
         self._last_save_time = time.time()
         os.makedirs(directory, exist_ok=True)
+        # index/prune bookkeeping is shared between the caller thread
+        # and the background save thread — and ``save_now`` (the health
+        # monitor's checkpoint action, the supervisor) may fire from yet
+        # another thread mid-save.  One lock keeps the index atomic and
+        # keep-last-K exact under that race.
+        self._index_lock = threading.Lock()
         # restart resilience: the index is rebuilt from what is actually
         # on disk, so keep-last-K pruning spans process restarts
         self._saved: list[str] = _scan_checkpoints(directory)
@@ -91,14 +98,21 @@ class CheckpointListener(TrainingListener):
 
     def _commit(self, path: str) -> None:
         """Post-write bookkeeping (runs on the save thread in background
-        mode): index update + keep-last-K pruning, both restart-safe."""
-        self._saved.append(path)
-        if self.keep_last is not None:
-            while len(self._saved) > self.keep_last:
-                old = self._saved.pop(0)
-                if os.path.exists(old):
-                    os.remove(old)
-        self._write_index()
+        mode): index update + keep-last-K pruning, both restart-safe and
+        thread-safe — ``save_now`` racing a periodic background save
+        must never tear the index or double-remove a pruned zip."""
+        with self._index_lock:
+            if path in self._saved:
+                # save_now re-published an existing iteration's zip
+                # (atomic replace): refresh recency, don't double-list
+                self._saved.remove(path)
+            self._saved.append(path)
+            if self.keep_last is not None:
+                while len(self._saved) > self.keep_last:
+                    old = self._saved.pop(0)
+                    if os.path.exists(old):
+                        os.remove(old)
+            self._write_index()
 
     def _save(self, model, iteration: int, epoch: int) -> str:
         name = f"checkpoint_iter{iteration}_epoch{epoch}.zip"

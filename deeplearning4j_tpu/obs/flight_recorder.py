@@ -415,6 +415,21 @@ def install_handlers(dump_path: Optional[str] = None) -> None:
     threading.excepthook = _thread_hook
 
     if threading.current_thread() is threading.main_thread():
+        # dump-request signal: SIGUSR1 dumps the black box WITHOUT dying.
+        # This is the supervisor's teardown channel — once
+        # jax.distributed initializes, TSL's preemption notifier owns
+        # SIGTERM at the sigaction level (the Python handler below never
+        # runs in a gang child), so "dump, then terminate" must be two
+        # separate signals: USR1 collects the evidence, TERM/KILL stops
+        # the process.
+        try:
+            def _on_usr1(signum, frame):
+                _recorder.record("dump_request")
+                _recorder.dump(dump_path, reason="dump_request")
+
+            signal.signal(signal.SIGUSR1, _on_usr1)
+        except (ValueError, OSError, AttributeError):
+            pass    # non-main thread / restricted env / no SIGUSR1
         try:
             prev_term = signal.getsignal(signal.SIGTERM)
 

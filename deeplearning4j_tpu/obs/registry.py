@@ -526,6 +526,18 @@ def install_standard_metrics(registry: Optional[MetricsRegistry] = None) -> dict
         r.counter("tpudl_resilience_faults_injected_total",
                   "Faults fired by the active FaultPlan (test/drill "
                   "runs only)"),
+        r.counter("tpudl_resilience_resumes_total",
+                  "Trainer training-state restorations from a verified "
+                  "checkpoint (resume_from / supervisor respawns)"),
+        r.gauge("tpudl_resilience_resumed_iteration",
+                "Iteration restored by the most recent resume (steps "
+                "replayed = crash iteration minus this)"),
+        r.counter("tpudl_resilience_gang_restarts_total",
+                  "Supervised gang respawns after a worker death or "
+                  "stall (ClusterSupervisor)"),
+        r.histogram("tpudl_resilience_gang_mttr_seconds",
+                    "Recovery time per gang incident: failure detection "
+                    "to the first post-restart federated step"),
         r.labeled_counter("tpudl_serve_requests_total",
                           "Inference requests by terminal status "
                           "(ok/error/shed/expired/cancelled)",
@@ -616,6 +628,14 @@ def install_standard_metrics(registry: Optional[MetricsRegistry] = None) -> dict
                             "Federated per-worker step wall time as "
                             "reported over the router",
                             label_names=("worker",)),
+        r.counter("tpudl_cluster_stale_records_total",
+                  "Records dropped at ingest because they carried a "
+                  "pre-restart generation (a dead predecessor's "
+                  "buffered telemetry)"),
+        r.labeled_gauge("tpudl_cluster_worker_generation",
+                        "Restart generation currently reporting per "
+                        "worker (bumped by the ClusterSupervisor on "
+                        "each respawn)", ("worker",)),
         r.counter("tpudl_health_checks_total",
                   "HealthMonitor check passes (loss stream + sampled "
                   "stats)"),

@@ -47,12 +47,20 @@ from typing import Any, Optional
 
 ENDPOINT_ENV = "DL4J_TPU_REMOTE_UI"
 WORKER_ENV = "DL4J_TPU_WORKER_ID"
+# restart generation: a supervised worker that is respawned re-registers
+# with generation+1, and the coordinator DISCARDS its pre-crash state —
+# a rebooted worker must not inherit its dead predecessor's step window
+# (which would flag it as a straggler forever) or feed stale samples
+# into straggler_skew / median_step_ms
+GENERATION_ENV = "DL4J_TPU_WORKER_GENERATION"
 
 INGEST_PATH = "/remote/stats"
 # per-worker record history kept by the coordinator (dashboard replay)
 STORE_RECORDS = 256
 # step-time window for medians / straggler math
 STEP_WINDOW = 64
+# restart annotations kept for the /cluster dashboard
+RESTART_ANNOTATIONS = 64
 
 
 def _jsonable(value: Any) -> Any:
@@ -90,10 +98,17 @@ class RemoteStatsRouter:
                  flush_interval_s: float = 0.25,
                  heartbeat_interval_s: float = 1.0,
                  max_buffer: int = 1024, batch_size: int = 64,
-                 timeout_s: float = 2.0, retry_policy=None):
+                 timeout_s: float = 2.0, retry_policy=None,
+                 generation: Optional[int] = None):
         self.endpoint = endpoint.rstrip("/")
         self.worker = worker or os.environ.get(WORKER_ENV) \
             or f"{socket.gethostname()}:{os.getpid()}"
+        # restart generation rides on every push so the coordinator can
+        # tell a respawned worker from its dead predecessor (the
+        # supervisor stamps DL4J_TPU_WORKER_GENERATION per respawn)
+        if generation is None:
+            generation = int(os.environ.get(GENERATION_ENV, "0") or 0)
+        self.generation = int(generation)
         self.flush_interval_s = flush_interval_s
         self.heartbeat_interval_s = heartbeat_interval_s
         self.max_buffer = max(1, int(max_buffer))
@@ -184,6 +199,7 @@ class RemoteStatsRouter:
             return 0
         payload = json.dumps({
             "worker": self.worker,
+            "generation": self.generation,
             "records": [_jsonable(r) for r in batch],
         }).encode()
         reg = get_registry()
@@ -291,12 +307,16 @@ def notify_event(kind: str, **data: Any) -> None:
 class _WorkerState:
     __slots__ = ("first_seen", "last_seen", "steps", "iteration", "epoch",
                  "score", "mfu", "step_window", "records", "straggler",
-                 "last_step_s", "first_step_time", "last_step_time")
+                 "last_step_s", "first_step_time", "last_step_time",
+                 "generation", "restarts", "resumed_iteration")
 
-    def __init__(self):
+    def __init__(self, generation: int = 0, restarts: int = 0):
         now = time.time()
         self.first_seen = now
         self.last_seen = now
+        self.generation = generation
+        self.restarts = restarts          # generation bumps seen so far
+        self.resumed_iteration = None     # from the trainer's resume event
         # producer-side stamps of the first/last *step* record — receipt
         # times collapse to ~0 when a batch flush delivers many steps at
         # once, so rates must come from the worker's own clock
@@ -328,6 +348,7 @@ class ClusterStore:
     def __init__(self, straggler_factor: float = 2.0,
                  min_straggler_samples: int = 4):
         self._workers: dict[str, _WorkerState] = {}
+        self._restarts: deque = deque(maxlen=RESTART_ANNOTATIONS)
         self._lock = threading.Lock()
         self.straggler_factor = float(straggler_factor)
         self.min_straggler_samples = int(min_straggler_samples)
@@ -337,15 +358,41 @@ class ClusterStore:
             return sorted(self._workers)
 
     # ------------------------------------------------------------ ingest
-    def ingest(self, worker: str, records: list) -> int:
+    def ingest(self, worker: str, records: list, generation: int = 0) -> int:
         from deeplearning4j_tpu.obs.registry import get_registry
         reg = get_registry()
+        generation = int(generation)
         n = 0
         with self._lock:
             state = self._workers.get(worker)
             if state is None:
-                state = self._workers[worker] = _WorkerState()
+                state = self._workers[worker] = _WorkerState(generation)
                 reg.gauge("tpudl_cluster_workers").set(len(self._workers))
+            elif generation > state.generation:
+                # the worker was respawned by the supervisor: START OVER.
+                # Its pre-crash step window must stop feeding the
+                # straggler math and median_step_ms (the dead
+                # predecessor's samples would flag the fresh worker
+                # forever), and liveness restarts from this registration.
+                self._restarts.append({
+                    "worker": worker, "time": time.time(),
+                    "from_generation": state.generation,
+                    "to_generation": generation,
+                    "last_iteration": state.iteration,
+                })
+                state = self._workers[worker] = _WorkerState(
+                    generation, restarts=state.restarts + 1)
+            elif generation < state.generation:
+                # a dying predecessor's buffered telemetry arriving
+                # after its replacement registered: drop it — mixing
+                # pre-crash samples into the post-restart series is
+                # exactly what the generation counter exists to prevent
+                reg.counter("tpudl_cluster_stale_records_total").inc(
+                    len(records))
+                return 0
+            reg.labeled_gauge(
+                "tpudl_cluster_worker_generation",
+                label_names=("worker",)).set(generation, worker=worker)
             for record in records:
                 if not isinstance(record, dict):
                     continue
@@ -409,9 +456,16 @@ class ClusterStore:
                                              worker=worker)
         else:
             state.last_seen = time.time()
+            if kind == "resume":
+                # the trainer restored a checkpoint: remember the resume
+                # point so the supervisor (and the dashboard) can report
+                # steps replayed per incident
+                it = record.get("iteration")
+                if isinstance(it, (int, float)) and math.isfinite(it):
+                    state.resumed_iteration = int(it)
             if kind != "heartbeat":
-                # full stats / init / score / phase records: keep the
-                # bounded replay for the dashboard
+                # full stats / init / score / phase / resume records:
+                # keep the bounded replay for the dashboard
                 state.records.append(record)
         reg.labeled_gauge(
             "tpudl_cluster_worker_last_seen_time",
@@ -487,10 +541,15 @@ class ClusterStore:
                     "liveness_age_s": round(now - s.last_seen, 3),
                     "straggler": s.straggler,
                     "records": len(s.records),
+                    "generation": s.generation,
+                    "restarts": s.restarts,
+                    "resumed_iteration": s.resumed_iteration,
                 }
+            restarts = list(self._restarts)
         return {"n_workers": len(workers),
                 "straggler_skew": self.straggler_skew(),
-                "workers": workers}
+                "workers": workers,
+                "restarts": restarts}
 
     def records_for(self, worker: str) -> list:
         with self._lock:
@@ -508,14 +567,36 @@ class ClusterStore:
         for name, w in summary["workers"].items():
             flag = " &#9888; straggler" if w["straggler"] else ""
             style = " style='background:#fdecea'" if w["straggler"] else ""
+            gen = w["generation"]
+            if w["restarts"]:
+                gen = f"{gen} (&#8635;{w['restarts']})"
             rows.append(
                 f"<tr{style}><td>{_html.escape(name)}{flag}</td>"
+                f"<td>{gen}</td>"
                 f"<td>{w['steps']}</td><td>{w['iteration']}</td>"
                 f"<td>{w['median_step_ms'] if w['median_step_ms'] is not None else '—'}</td>"
                 f"<td>{w['last_step_ms'] if w['last_step_ms'] is not None else '—'}</td>"
                 f"<td>{w['mfu'] if w['mfu'] is not None else '—'}</td>"
                 f"<td>{w['score'] if w['score'] is not None else '—'}</td>"
                 f"<td>{w['liveness_age_s']}</td></tr>")
+        # restart annotations: gang-recovery history for triage (each
+        # annotation pairs with the supervisor incident's flight-dump
+        # bundle — see docs/fault_tolerance.md "Gang recovery")
+        notes = ""
+        if summary["restarts"]:
+            import datetime
+            items = []
+            for r in summary["restarts"]:
+                stamp = datetime.datetime.fromtimestamp(
+                    r["time"]).strftime("%H:%M:%S")
+                items.append(
+                    f"<li>{stamp} — worker {_html.escape(str(r['worker']))} "
+                    f"restarted: generation {r['from_generation']} &rarr; "
+                    f"{r['to_generation']} (last pre-crash iteration "
+                    f"{r['last_iteration']}); flight dumps ride the "
+                    f"supervisor incident for generation "
+                    f"{r['from_generation']}</li>")
+            notes = ("<h2>Restarts</h2><ul>" + "".join(items) + "</ul>")
         return (
             f"<html><head><meta charset='utf-8'>{refresh}"
             f"<title>Cluster telemetry</title>"
@@ -527,7 +608,8 @@ class ClusterStore:
             f"<p>{summary['n_workers']} worker(s) reporting; straggler "
             f"skew {'—' if skew is None else round(skew, 3)} "
             f"(max worker median step time / cluster median).</p>"
-            "<table><tr><th>worker</th><th>steps</th><th>iteration</th>"
+            "<table><tr><th>worker</th><th>generation</th><th>steps</th>"
+            "<th>iteration</th>"
             "<th>median step ms</th><th>last step ms</th><th>MFU</th>"
             "<th>last score</th><th>liveness age s</th></tr>"
-            + "".join(rows) + "</table></body></html>")
+            + "".join(rows) + "</table>" + notes + "</body></html>")

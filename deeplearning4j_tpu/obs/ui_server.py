@@ -82,6 +82,10 @@ class UIServer:
                     payload = json.loads(self.rfile.read(length) or b"{}")
                     worker = str(payload["worker"])
                     records = payload.get("records", [])
+                    # restart generation (0 for unsupervised workers):
+                    # lets the store discard a dead predecessor's stale
+                    # window when a respawned worker re-registers
+                    generation = int(payload.get("generation", 0) or 0)
                     if not isinstance(records, list):
                         raise ValueError("records must be a list")
                 except (KeyError, ValueError, TypeError) as e:
@@ -90,7 +94,8 @@ class UIServer:
                                              f"{e}"}).encode(),
                         "application/json", 400)
                 try:
-                    n = server.cluster.ingest(worker, records)
+                    n = server.cluster.ingest(worker, records,
+                                              generation=generation)
                 except Exception as e:
                     # the garbage-ingest contract: a typed 400, never an
                     # unhandled-exception connection reset

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import signal
 import subprocess
 import sys
 import tempfile
@@ -123,11 +124,13 @@ def _is_startup_flake(e: BaseException) -> bool:
         marker in msg for marker in _STARTUP_FLAKE_MARKERS)
 
 
-def _terminate_then_kill(procs, grace: float = 3.0,
-                         first_pid: int = 0) -> list[str]:
+def _terminate_then_kill(procs, grace: float = 3.0, first_pid: int = 0,
+                         tail_fn=None) -> list[str]:
     """Stop every child (TERM, grace period, then KILL) and return each
     one's captured stderr tail — a timed-out gang must leave no orphans
-    and no silent diagnostics."""
+    and no silent diagnostics.  ``tail_fn(pid) -> str`` supplies the
+    tail when the children's output goes to files (GangHandle) instead
+    of pipes."""
     for proc in procs:
         if proc.poll() is None:
             proc.terminate()
@@ -140,13 +143,21 @@ def _terminate_then_kill(procs, grace: float = 3.0,
                 proc.kill()
     tails = []
     for pid, proc in enumerate(procs):
-        try:
-            _, stderr = proc.communicate(timeout=5.0)
-        except (subprocess.TimeoutExpired, ValueError, OSError):
-            stderr = b""
+        if tail_fn is not None:
+            try:
+                proc.wait(timeout=5.0)
+            except (subprocess.TimeoutExpired, ValueError, OSError):
+                pass
+            text = tail_fn(first_pid + pid)
+        else:
+            try:
+                _, stderr = proc.communicate(timeout=5.0)
+            except (subprocess.TimeoutExpired, ValueError, OSError):
+                stderr = b""
+            text = (stderr or b"").decode(errors="replace")
         rc = proc.poll()
         tails.append(f"process {first_pid + pid} rc={rc} stderr tail: "
-                     f"{(stderr or b'').decode(errors='replace')[-800:]}")
+                     f"{text[-800:]}")
     return tails
 
 
@@ -182,124 +193,281 @@ def _dump_summary(dumps: dict) -> str:
     return "\n".join(lines)
 
 
+class GangHandle:
+    """A RUNNING local gang — the restartable handle the
+    :class:`~deeplearning4j_tpu.resilience.supervisor.ClusterSupervisor`
+    drives.  Construction spawns the child processes and returns
+    immediately; callers either block in :meth:`wait` (the
+    ``spawn_local_cluster`` path — identical semantics to the historical
+    one-shot spawn) or poll :meth:`poll_exits` from a supervision loop,
+    then :meth:`shutdown` the survivors and :meth:`collect_flight_dumps`
+    when a member dies.
+
+    ``child_env`` is the per-child env hook (``pid -> dict``), applied
+    LAST so a supervisor can stamp per-worker identity (worker id,
+    gang generation, resume pointer) over both the launcher defaults
+    and the shared ``extra_env``."""
+
+    def __init__(self, fn: Callable, n_processes: int, port: int,
+                 local_devices: int = 1, timeout: float = 120.0,
+                 extra_env: Optional[dict] = None,
+                 gang_deadline: Optional[float] = None,
+                 gang_fires: int = 1,
+                 remote_ui: Optional[str] = None,
+                 child_env: Optional[Callable[[int], dict]] = None):
+        from deeplearning4j_tpu.obs import flight_recorder, tracing
+        from deeplearning4j_tpu.obs import remote as obs_remote
+        from deeplearning4j_tpu.resilience import faults
+        faults.fire("launcher.spawn")
+        self.n_processes = n_processes
+        self.timeout = timeout
+        self.gang_deadline = gang_deadline
+        self.workdir = tempfile.mkdtemp(prefix="dl4j_tpu_cluster_")
+        fn_path = os.path.join(self.workdir, "fn.pkl")
+        with open(fn_path, "wb") as f:
+            pickle.dump(fn, f)
+        self.procs: list = []
+        self.out_paths: list[str] = []
+        trace_env = tracing.propagation_env()
+        for pid in range(n_processes):
+            out_path = os.path.join(self.workdir, f"out_{pid}.pkl")
+            self.out_paths.append(out_path)
+            script = _WORKER_TEMPLATE.format(
+                n=n_processes, pid=pid, port=port, fn_path=fn_path,
+                out_path=out_path, local_devices=local_devices)
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)  # template sets its own
+            env.update(trace_env)
+            # every child gets a black box: crash/SIGTERM dumps always,
+            # plus a stall watchdog when a gang deadline is set.
+            # Tracing is turned on alongside so the dump's ring carries
+            # the last N spans, not just raw events.
+            env[flight_recorder.DUMP_ENV] = os.path.join(
+                self.workdir, f"flight_{pid}.jsonl")
+            if gang_deadline is not None:
+                env[flight_recorder.WATCHDOG_ENV] = str(float(gang_deadline))
+                env[flight_recorder.WATCHDOG_FIRES_ENV] = str(int(gang_fires))
+                env.setdefault("DL4J_TPU_TRACING", "1")
+            if remote_ui:
+                # telemetry federation: every child routes stats/
+                # heartbeats to the coordinator UIServer under its own
+                # worker label
+                env[obs_remote.ENDPOINT_ENV] = remote_ui
+                env[obs_remote.WORKER_ENV] = f"w{pid}"
+            if extra_env:
+                env.update(extra_env)
+            if child_env is not None:
+                env.update({k: str(v) for k, v in child_env(pid).items()})
+            # children write to FILES, not pipes: the supervision loop
+            # only polls exit codes, so a pipe nobody drains would wedge
+            # a chatty child on a full 64KB buffer — and even the
+            # blocking wait() drains sequentially (child N+1 could fill
+            # its pipe while child N is being waited on)
+            with open(os.path.join(self.workdir, f"stderr_{pid}.log"),
+                      "wb") as err_f:
+                self.procs.append(subprocess.Popen(
+                    [sys.executable, "-c", script], env=env,
+                    stdout=err_f, stderr=err_f))
+        # ONE wall-clock budget for the whole gang: jax.distributed
+        # blocks until every process joins, so child 0 timing out means
+        # they all did
+        self.started_at = time.monotonic()
+        self.deadline = self.started_at + timeout
+
+    # ------------------------------------------------- supervision surface
+    def poll_exits(self) -> dict:
+        """pid → return code for every child (None = still running).
+        Non-blocking; the supervisor's detection loop."""
+        return {pid: proc.poll() for pid, proc in enumerate(self.procs)}
+
+    def running(self) -> bool:
+        return any(proc.poll() is None for proc in self.procs)
+
+    def stderr_tail(self, pid: int, limit: int = 800) -> str:
+        """Last ``limit`` chars of the child's combined stdout/stderr
+        file (children write to files so nothing ever blocks on an
+        undrained pipe)."""
+        try:
+            with open(os.path.join(self.workdir, f"stderr_{pid}.log"),
+                      "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - 4 * limit))
+                return f.read().decode(errors="replace")[-limit:]
+        except OSError:
+            return ""
+
+    def request_dumps(self, grace: float = 3.0) -> None:
+        """Ask every still-alive child for its black box (SIGUSR1 → the
+        flight recorder dumps and SURVIVES), then wait up to ``grace``
+        for the dump files to GROW past their pre-signal size and go
+        quiet — a dump written earlier in the generation (a watchdog
+        grace fire, a health-monitor action) must not satisfy the wait
+        and let teardown kill a child mid-append.  Separate from
+        :meth:`shutdown` because jax's TSL preemption notifier owns
+        SIGTERM in gang children — a SIGTERM never reaches the Python
+        dump handler, so evidence must be collected before the stop
+        signal.  Limitation: CPython runs signal handlers between
+        bytecodes on the main thread, so a sibling wedged inside a
+        native collective cannot answer — that state is the stall
+        watchdog's job (it dumps from its own thread and exits 87)."""
+        def sizes():
+            out = {}
+            for pid, p in enumerate(self.procs):
+                try:
+                    out[pid] = os.path.getsize(
+                        os.path.join(self.workdir, f"flight_{pid}.jsonl"))
+                except OSError:
+                    out[pid] = -1
+            return out
+
+        before = sizes()
+        alive = []
+        for pid, p in enumerate(self.procs):
+            if p.poll() is None:
+                alive.append(pid)
+                try:
+                    p.send_signal(signal.SIGUSR1)
+                except (ProcessLookupError, OSError):
+                    pass
+        if not alive:
+            return
+        deadline = time.monotonic() + grace
+        prev = before
+        while time.monotonic() < deadline:
+            time.sleep(0.1)
+            now = sizes()
+            grown = all(now[pid] > before[pid] for pid in alive
+                        if self.procs[pid].poll() is None)
+            settled = all(now[pid] == prev[pid] for pid in alive)
+            if grown and settled:
+                return          # every reachable child dumped, writes quiet
+            prev = now
+
+    def shutdown(self, grace: float = 3.0) -> list[str]:
+        """Terminate-then-kill every remaining child; returns each
+        child's stderr tail (already-exited children just report)."""
+        return _terminate_then_kill(self.procs, grace=grace,
+                                    tail_fn=self.stderr_tail)
+
+    def abort_timeout(self, reason: str,
+                      extra_lines: Optional[list] = None
+                      ) -> "ClusterTimeoutError":
+        """Stop the whole gang and build the ``ClusterTimeoutError`` for
+        a blown wall budget — one construction shared by the blocking
+        :meth:`wait` and the supervisor's watch loop, so the message
+        shape and the ``flight_dumps`` attachment can't drift."""
+        tails = self.shutdown()
+        dumps = self.collect_flight_dumps()
+        err = ClusterTimeoutError(
+            reason + "\n" + "\n".join((extra_lines or []) + tails)
+            + "\n" + _dump_summary(dumps))
+        err.flight_dumps = dumps
+        return err
+
+    def collect_flight_dumps(self) -> dict:
+        return _collect_flight_dumps(self.workdir, self.n_processes)
+
+    def results(self) -> list:
+        """Return values of the children that completed (out pickles
+        present).  Call after a clean gang exit."""
+        results = []
+        for path in self.out_paths:
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    results.append(pickle.load(f))
+        return results
+
+    # ----------------------------------------------- blocking collection
+    def wait(self) -> list:
+        """Block until the gang finishes; return every child's result or
+        raise (``ClusterTimeoutError`` / ``ClusterStallError`` /
+        ``RuntimeError``) with flight dumps attached — the historical
+        ``spawn_local_cluster`` semantics."""
+        from deeplearning4j_tpu.obs import flight_recorder
+        procs, workdir = self.procs, self.workdir
+        n_processes, timeout = self.n_processes, self.timeout
+        gang_deadline = self.gang_deadline
+        results = []
+        errors = []
+        stalled = []
+        for pid, proc in enumerate(procs):
+            try:
+                proc.wait(timeout=max(0.1, self.deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                # a hung gang member past even the watchdog: stop EVERY
+                # child (terminate → grace → kill) and surface each
+                # one's stderr AND whatever black boxes landed — the
+                # raised error must say which process wedged and why,
+                # not just "timed out"
+                raise self.abort_timeout(
+                    f"local cluster timed out after {timeout:.0f}s waiting "
+                    f"for process {pid}; all {n_processes} children "
+                    f"stopped:", extra_lines=stalled)
+            if proc.returncode == flight_recorder.WATCHDOG_EXIT_CODE:
+                stalled.append(f"process {pid} stalled (flight-recorder "
+                               f"watchdog, gang deadline "
+                               f"{gang_deadline}s): "
+                               f"{self.stderr_tail(pid, limit=400)}")
+                # one stalled member wedges every sibling on its
+                # collectives and the gang is going to raise regardless —
+                # stop the rest instead of letting them burn the
+                # remaining wall clock.  But the siblings are stalled on
+                # the SAME exchange: their own watchdogs fire within ~a
+                # poll interval of this one, so first give every
+                # still-alive sibling one short window to write its black
+                # box (killed pre-dump = no thread stacks for that child,
+                # and per-child dumps are the point)
+                rest = procs[pid + 1:]
+                if rest:
+                    grace_deadline = time.monotonic() + min(
+                        5.0, gang_deadline or 5.0)
+                    while time.monotonic() < grace_deadline and any(
+                            p.poll() is None and not os.path.exists(
+                                os.path.join(workdir, f"flight_{q}.jsonl"))
+                            for q, p in enumerate(rest, start=pid + 1)):
+                        time.sleep(0.05)
+                    time.sleep(0.2)     # let an in-flight dump write finish
+                    errors.extend(
+                        f"stopped after sibling stall: {tail}"
+                        for tail in _terminate_then_kill(
+                            rest, first_pid=pid + 1,
+                            tail_fn=self.stderr_tail))
+                break
+            elif proc.returncode != 0:
+                errors.append(f"process {pid} rc={proc.returncode}: "
+                              f"{self.stderr_tail(pid)}")
+            elif os.path.exists(self.out_paths[pid]):
+                with open(self.out_paths[pid], "rb") as f:
+                    results.append(pickle.load(f))
+        if stalled:
+            # one stalled member wedges the whole gang (collectives
+            # block); siblings usually die of the same watchdog — report
+            # them all, with every child's black box attached
+            dumps = _collect_flight_dumps(workdir, n_processes)
+            err = ClusterStallError(
+                "local cluster stalled:\n" + "\n".join(stalled + errors)
+                + "\n" + _dump_summary(dumps))
+            err.flight_dumps = dumps
+            raise err
+        if errors:
+            dumps = _collect_flight_dumps(workdir, n_processes)
+            err = RuntimeError("local cluster failed:\n" + "\n".join(errors))
+            err.flight_dumps = dumps
+            raise err
+        return results
+
+
 def _spawn_once(fn: Callable, n_processes: int, port: int,
                 local_devices: int, timeout: float,
                 extra_env: Optional[dict],
                 gang_deadline: Optional[float],
                 gang_fires: int = 1,
                 remote_ui: Optional[str] = None) -> list:
-    from deeplearning4j_tpu.obs import flight_recorder, tracing
-    from deeplearning4j_tpu.obs import remote as obs_remote
-    from deeplearning4j_tpu.resilience import faults
-    faults.fire("launcher.spawn")
-    workdir = tempfile.mkdtemp(prefix="dl4j_tpu_cluster_")
-    fn_path = os.path.join(workdir, "fn.pkl")
-    with open(fn_path, "wb") as f:
-        pickle.dump(fn, f)
-    procs = []
-    out_paths = []
-    trace_env = tracing.propagation_env()
-    for pid in range(n_processes):
-        out_path = os.path.join(workdir, f"out_{pid}.pkl")
-        out_paths.append(out_path)
-        script = _WORKER_TEMPLATE.format(n=n_processes, pid=pid, port=port,
-                                         fn_path=fn_path, out_path=out_path,
-                                         local_devices=local_devices)
-        env = dict(os.environ)
-        env.pop("XLA_FLAGS", None)  # template sets its own
-        env.update(trace_env)
-        # every child gets a black box: crash/SIGTERM dumps always, plus
-        # a stall watchdog when a gang deadline is set.  Tracing is
-        # turned on alongside so the dump's ring carries the last N
-        # spans, not just raw events.
-        env[flight_recorder.DUMP_ENV] = os.path.join(
-            workdir, f"flight_{pid}.jsonl")
-        if gang_deadline is not None:
-            env[flight_recorder.WATCHDOG_ENV] = str(float(gang_deadline))
-            env[flight_recorder.WATCHDOG_FIRES_ENV] = str(int(gang_fires))
-            env.setdefault("DL4J_TPU_TRACING", "1")
-        if remote_ui:
-            # telemetry federation: every child routes stats/heartbeats
-            # to the coordinator UIServer under its own worker label
-            env[obs_remote.ENDPOINT_ENV] = remote_ui
-            env[obs_remote.WORKER_ENV] = f"w{pid}"
-        if extra_env:
-            env.update(extra_env)
-        procs.append(subprocess.Popen([sys.executable, "-c", script], env=env,
-                                      stdout=subprocess.PIPE,
-                                      stderr=subprocess.PIPE))
-    results = []
-    errors = []
-    stalled = []
-    # ONE wall-clock budget for the whole gang: jax.distributed blocks
-    # until every process joins, so child 0 timing out means they all did
-    deadline = time.monotonic() + timeout
-    for pid, proc in enumerate(procs):
-        try:
-            _, stderr = proc.communicate(
-                timeout=max(0.1, deadline - time.monotonic()))
-        except subprocess.TimeoutExpired:
-            # a hung gang member past even the watchdog: stop EVERY child
-            # (terminate → grace → kill) and surface each one's stderr
-            # AND whatever black boxes landed — the raised error must say
-            # which process wedged and why, not just "timed out"
-            tails = _terminate_then_kill(procs)
-            dumps = _collect_flight_dumps(workdir, n_processes)
-            err = ClusterTimeoutError(
-                f"local cluster timed out after {timeout:.0f}s waiting for "
-                f"process {pid}; all {n_processes} children stopped:\n"
-                + "\n".join(stalled + tails) + "\n" + _dump_summary(dumps))
-            err.flight_dumps = dumps
-            raise err
-        if proc.returncode == flight_recorder.WATCHDOG_EXIT_CODE:
-            stalled.append(f"process {pid} stalled (flight-recorder "
-                           f"watchdog, gang deadline "
-                           f"{gang_deadline}s): {stderr.decode()[-400:]}")
-            # one stalled member wedges every sibling on its collectives
-            # and the gang is going to raise regardless — stop the rest
-            # instead of letting them burn the remaining wall clock.
-            # But the siblings are stalled on the SAME exchange: their
-            # own watchdogs fire within ~a poll interval of this one, so
-            # first give every still-alive sibling one short window to
-            # write its black box (killed pre-dump = no thread stacks
-            # for that child, and per-child dumps are the point)
-            rest = procs[pid + 1:]
-            if rest:
-                grace_deadline = time.monotonic() + min(
-                    5.0, gang_deadline or 5.0)
-                while time.monotonic() < grace_deadline and any(
-                        p.poll() is None and not os.path.exists(
-                            os.path.join(workdir, f"flight_{q}.jsonl"))
-                        for q, p in enumerate(rest, start=pid + 1)):
-                    time.sleep(0.05)
-                time.sleep(0.2)     # let an in-flight dump write finish
-                errors.extend(
-                    f"stopped after sibling stall: {tail}"
-                    for tail in _terminate_then_kill(rest,
-                                                     first_pid=pid + 1))
-            break
-        elif proc.returncode != 0:
-            errors.append(f"process {pid} rc={proc.returncode}: "
-                          f"{stderr.decode()[-800:]}")
-        elif os.path.exists(out_paths[pid]):
-            with open(out_paths[pid], "rb") as f:
-                results.append(pickle.load(f))
-    if stalled:
-        # one stalled member wedges the whole gang (collectives block);
-        # siblings usually die of the same watchdog — report them all,
-        # with every child's black box attached
-        dumps = _collect_flight_dumps(workdir, n_processes)
-        err = ClusterStallError(
-            "local cluster stalled:\n" + "\n".join(stalled + errors)
-            + "\n" + _dump_summary(dumps))
-        err.flight_dumps = dumps
-        raise err
-    if errors:
-        dumps = _collect_flight_dumps(workdir, n_processes)
-        err = RuntimeError("local cluster failed:\n" + "\n".join(errors))
-        err.flight_dumps = dumps
-        raise err
-    return results
+    return GangHandle(fn, n_processes, port, local_devices=local_devices,
+                      timeout=timeout, extra_env=extra_env,
+                      gang_deadline=gang_deadline, gang_fires=gang_fires,
+                      remote_ui=remote_ui).wait()
 
 
 def spawn_local_cluster(fn: Callable, n_processes: int = 2, port: int = 12655,

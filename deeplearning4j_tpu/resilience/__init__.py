@@ -18,9 +18,14 @@ This package is that layer for tpudl:
   the device-feeder staging path and local-cluster startup.
 - :mod:`~deeplearning4j_tpu.resilience.faults` — a deterministic
   :class:`FaultPlan` (env/config-driven) that injects crashes, slow or
-  failing exchanges, feeder exceptions and truncated checkpoint files
-  at chosen steps — the harness that keeps the rest honest
-  (tests/test_resilience.py).
+  failing exchanges, feeder exceptions, truncated checkpoint files and
+  real process death (``kill``/``sigterm``) at chosen steps — the
+  harness that keeps the rest honest (tests/test_resilience.py).
+- :mod:`~deeplearning4j_tpu.resilience.supervisor` — the
+  :class:`ClusterSupervisor` that connects all of the above into
+  self-healing gangs: detect worker death/stall, tear down, respawn
+  from the latest verified checkpoint under a bounded restart budget,
+  shrink-or-halt past it — with MTTR and flight dumps per incident.
 
 See docs/fault_tolerance.md for the operational story.
 """
@@ -34,3 +39,6 @@ from deeplearning4j_tpu.resilience.faults import (  # noqa: F401
     get_fault_plan, inject, install_fault_plan)
 from deeplearning4j_tpu.resilience.retry import (  # noqa: F401
     RetryPolicy, TransientError, default_retryable, with_retries)
+from deeplearning4j_tpu.resilience.supervisor import (  # noqa: F401
+    ClusterSupervisor, GangFailedError, GangIncident, SupervisedRun,
+    supervise)

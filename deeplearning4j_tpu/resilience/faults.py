@@ -17,6 +17,11 @@ index at which it fires, and an action:
   blowup stand-in: the trainer replaces the step's loss with NaN so the
   health-monitor detection path runs end-to-end; checked by
   :func:`poison`, never raises)
+- ``kill``      — ``SIGKILL`` this process (REAL gang death, not a
+  Python exception: no handler runs, no black box is written — exactly
+  what a preemption or OOM-kill looks like to the supervisor)
+- ``sigterm``   — ``SIGTERM`` this process (a polite eviction: the
+  flight-recorder handler gets to dump before the default action kills)
 
 Plans come from code (``install_fault_plan`` / the :func:`inject`
 context manager) or from the environment (``DL4J_TPU_FAULT_PLAN``), so a
@@ -35,6 +40,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import os
+import signal
 import threading
 import time
 from typing import Optional
@@ -56,7 +62,7 @@ class InjectedCrash(InjectedFault):
 class FaultRule:
     site: str
     at: int                 # first event index (within the site) to fire on
-    action: str             # crash | error | delay | truncate
+    action: str     # crash | error | delay | truncate | nan | kill | sigterm
     arg: float = 0.0        # delay seconds / bytes to truncate
     times: int = 1          # consecutive events to fire on
 
@@ -116,7 +122,8 @@ class FaultPlan:
 
     def fire(self, site: str, index: Optional[int] = None) -> None:
         """Run the site's non-file actions for this event: ``delay``
-        sleeps, ``error``/``crash`` raise.  ``index`` overrides the
+        sleeps, ``error``/``crash`` raise, ``kill``/``sigterm`` signal
+        this process dead.  ``index`` overrides the
         site's own event counter (the trainer passes the global step so
         rules are step-deterministic under retries and restarts)."""
         idx = self._next_index(site) if index is None else index
@@ -130,6 +137,24 @@ class FaultPlan:
             elif rule.action == "crash":
                 raise InjectedCrash(
                     f"injected crash at {site}[{idx}]")
+            elif rule.action in ("kill", "sigterm"):
+                # REAL process death, deterministically placed: SIGKILL
+                # is uncatchable (the Python layer never sees it — the
+                # supervisor must recover from a worker that left no
+                # goodbye), SIGTERM runs the installed handlers (the
+                # flight recorder dumps, then the default action kills)
+                os.kill(os.getpid(), signal.SIGKILL
+                        if rule.action == "kill" else signal.SIGTERM)
+                # SIGTERM delivery can race the next bytecode; the sleep
+                # makes the death site deterministic.  Surviving it
+                # means the signal was CONSUMED (jax's TSL preemption
+                # notifier owns SIGTERM in gang children) — fail loudly
+                # rather than let the drill silently not happen.
+                time.sleep(5.0)
+                raise InjectedCrash(
+                    f"injected {rule.action} at {site}[{idx}] did not "
+                    f"kill the process (signal consumed — TSL preemption "
+                    f"notifier?); raising instead")
             else:
                 raise InjectedFault(
                     f"injected {rule.action} at {site}[{idx}]")

@@ -623,6 +623,19 @@ class Trainer:
             it_state.update({"epoch": self.net.epoch, "batch_index": skip})
             iterator.set_state(it_state)
         state["checkpoint_path"] = path
+        # surface the resume point: the supervisor computes steps
+        # replayed per incident as (last pre-crash iteration − this),
+        # and the coordinator's /cluster dashboard annotates the restart
+        resumed_iter = int(state.get("iteration", 0) or 0)
+        reg = get_registry()
+        reg.counter("tpudl_resilience_resumes_total").inc()
+        reg.gauge("tpudl_resilience_resumed_iteration").set(resumed_iter)
+        flight_recorder.record("resume", iteration=resumed_iter,
+                               epoch=int(state.get("epoch", 0) or 0),
+                               checkpoint=os.path.basename(path))
+        obs_remote.notify_event("resume", iteration=resumed_iter,
+                                epoch=int(state.get("epoch", 0) or 0),
+                                checkpoint=os.path.basename(path))
         return state
 
     def fit(self, iterator, epochs: int = 1, resume_from=None):

@@ -304,3 +304,135 @@ def stalled_exchange_worker(pid, n):
     finally:
         trainer.close()
     return {"pid": pid, "completed": True}
+
+
+def _supervised_conf(seed):
+    """Deterministic net WITH dropout — exact resume must replay the
+    RNG trajectory, not just the params."""
+    from deeplearning4j_tpu.nn import NeuralNetConfiguration, InputType
+    from deeplearning4j_tpu.nn.layers import (DenseLayer, DropoutLayer,
+                                              OutputLayer)
+    from deeplearning4j_tpu.train import Adam
+    return (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(DropoutLayer(dropout=0.8))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6)).build())
+
+
+def supervised_batches(pid, n_batches=6, batch=16):
+    from deeplearning4j_tpu.data.dataset import DataSet
+    rng = np.random.default_rng(11 + pid)
+    x = rng.normal(size=(n_batches * batch, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n_batches * batch)]
+    return [DataSet(x[i:i + batch], y[i:i + batch])
+            for i in range(0, n_batches * batch, batch)]
+
+
+def run_reference_fit(pid, epochs=2):
+    """The uninterrupted single-process run the supervised gang must
+    match to 1e-6 — same conf/data/seed as supervised_train_worker."""
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.obs.listeners import CollectScoresListener
+    from deeplearning4j_tpu.data.iterators import (ListDataSetIterator,
+                                                   ResumableIterator)
+    from deeplearning4j_tpu.train.trainer import Trainer
+    from deeplearning4j_tpu.utils.pytree import flat_param_vector
+    net = MultiLayerNetwork(_supervised_conf(42 + pid)).init()
+    scores = CollectScoresListener()
+    Trainer(net, listeners=[scores]).fit(
+        ResumableIterator(ListDataSetIterator(supervised_batches(pid))),
+        epochs=epochs)
+    return scores.scores, np.asarray(flat_param_vector(net.params_))
+
+
+def supervised_train_worker(pid, n, workdir=None, epochs=2, kill_at=None,
+                            kill_pid=None):
+    """THE kill-and-heal acceptance worker: a deterministic fit (dropout
+    active) with per-iteration checkpoints; in generation 0,
+    ``kill_pid`` SIGKILLs itself before step ``kill_at`` commits.  The
+    supervisor respawns the gang; respawned workers resume from their
+    own verified checkpoints (``DL4J_TPU_RESUME_FROM``) and report the
+    per-step losses they actually ran, so the test can pin the resumed
+    tail against the uninterrupted run to 1e-6."""
+    from deeplearning4j_tpu.data.iterators import (ListDataSetIterator,
+                                                   ResumableIterator)
+    from deeplearning4j_tpu.io.checkpoint import CheckpointListener
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.obs.listeners import CollectScoresListener
+    from deeplearning4j_tpu.resilience import faults, supervisor
+    from deeplearning4j_tpu.train.trainer import Trainer
+    from deeplearning4j_tpu.utils.pytree import flat_param_vector
+
+    generation = int(os.environ.get(supervisor.GENERATION_ENV, "0"))
+    if generation == 0 and kill_at is not None and pid == kill_pid:
+        faults.install_fault_plan(
+            faults.FaultPlan.parse(f"trainer.step@{kill_at}:kill"))
+    net = MultiLayerNetwork(_supervised_conf(42 + pid)).init()
+    iterator = ResumableIterator(ListDataSetIterator(
+        supervised_batches(pid)))
+    ckpt_dir = os.path.join(workdir, f"w{pid}")
+    ckpt = CheckpointListener(ckpt_dir, save_every_n_iterations=1,
+                              keep_last=3, iterator=iterator)
+    scores = CollectScoresListener()
+    resume = os.environ.get(supervisor.RESUME_ENV)
+    Trainer(net, listeners=[scores, ckpt]).fit(
+        iterator, epochs=epochs,
+        resume_from=(ckpt_dir if resume else None))
+    return {"pid": pid, "generation": generation,
+            "losses": list(scores.scores),
+            "end_iteration": net.iteration,
+            "params": np.asarray(flat_param_vector(net.params_))}
+
+
+def repeatedly_dying_worker(pid, n, die_pid=None, kill_at=2, steps=60):
+    """Budget-exhaustion rig: ``die_pid`` SIGKILLs itself EVERY
+    generation (installed programmatically, so the supervisor's env
+    stripping can't save it); siblings train slowly enough that
+    teardown SIGTERMs them mid-fit — their flight-recorder handlers
+    write the black boxes the raised error must carry."""
+    import jax
+    import time as _time
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.resilience import faults
+    from deeplearning4j_tpu.train.trainer import Trainer
+
+    if pid == die_pid:
+        faults.install_fault_plan(
+            faults.FaultPlan.parse(f"trainer.step@{kill_at}:kill"))
+    net = _small_net(seed=3 + pid)
+    x, y = global_batch(n=16, seed=pid)
+    batch = DataSet(x, y)
+    trainer = Trainer(net)
+    key = jax.random.key(pid)
+    for _ in range(steps):
+        key, sub = jax.random.split(key)
+        trainer.step_batch(batch, sub)
+        _time.sleep(0.1)       # stay alive until the supervisor's SIGTERM
+    return {"pid": pid, "steps": steps}
+
+
+def slot_gated_dying_worker(pid, n, steps=6, workdir=None):
+    """Shrink-degradation rig: the worker whose STABLE slot id (the
+    supervisor-assigned DL4J_TPU_WORKER_ID, not the process index) is
+    ``w1`` SIGKILLs itself every generation; the rest finish quickly.
+    Under degradation="shrink" the gang must continue without slot 1."""
+    import jax
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.obs import remote as obs_remote
+    from deeplearning4j_tpu.resilience import faults
+    from deeplearning4j_tpu.train.trainer import Trainer
+
+    slot = os.environ.get(obs_remote.WORKER_ENV, f"w{pid}")
+    if slot == "w1":
+        faults.install_fault_plan(
+            faults.FaultPlan.parse("trainer.step@2:kill"))
+    net = _small_net(seed=5 + pid)
+    x, y = global_batch(n=16, seed=pid)
+    trainer = Trainer(net)
+    key = jax.random.key(pid)
+    for _ in range(steps):
+        key, sub = jax.random.split(key)
+        trainer.step_batch(DataSet(x, y), sub)
+    return {"pid": pid, "slot": slot, "steps": steps}

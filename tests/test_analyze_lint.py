@@ -551,3 +551,89 @@ def test_obs_remote_itself_is_exempt(tmp_path):
             urllib.request.urlopen(self.endpoint, data=payload)
         """, name="obs/remote.py")
     assert report.by_rule("TPU311") == []
+
+
+# ------------------------------------------------------------ TPU312
+def test_exit_outside_supervision_flagged(tmp_path):
+    """A stray os._exit/sys.exit in library code defeats supervision:
+    no flight dump, an unexplained rc for the supervisor."""
+    report = _lint_source(tmp_path, """
+        import os
+        import sys
+
+        def _on_exchange_error(self, err):
+            os._exit(1)
+
+        def run_epoch(self, batches):
+            for b in batches:
+                if not self.step(b):
+                    sys.exit(2)
+        """)
+    hits = report.by_rule("TPU312")
+    assert len(hits) == 2
+    assert report.exit_code() == 1
+    assert "supervision" in hits[0].message
+
+
+def test_exit_under_main_guard_is_fine(tmp_path):
+    """The CLI idiom — sys.exit(main()) under the __main__ guard — is
+    the process's contract with its shell, not library control flow."""
+    report = _lint_source(tmp_path, """
+        import sys
+
+        def main():
+            return 0
+
+        if __name__ == "__main__":
+            sys.exit(main())
+        """)
+    assert report.by_rule("TPU312") == []
+    assert report.exit_code() == 0
+
+
+def test_exit_aliased_and_from_imports_are_caught(tmp_path):
+    report = _lint_source(tmp_path, """
+        import os as _o
+        import sys as _s
+        from os import _exit
+        from sys import exit as bail
+
+        def worker_loop():
+            _o._exit(3)
+
+        def drain():
+            _s.exit(1)
+            _exit(4)
+            bail(5)
+        """)
+    assert len(report.by_rule("TPU312")) == 4
+
+
+def test_watchdog_and_supervisor_modules_are_exempt(tmp_path):
+    """Deliberate process death has exactly two sanctioned homes."""
+    source = """
+        import os
+
+        def _fire(self):
+            os._exit(87)
+        """
+    (tmp_path / "obs").mkdir()
+    report = _lint_source(tmp_path, source, name="obs/flight_recorder.py")
+    assert report.by_rule("TPU312") == []
+    (tmp_path / "resilience").mkdir()
+    report = _lint_source(tmp_path, source,
+                          name="resilience/supervisor.py")
+    assert report.by_rule("TPU312") == []
+    # the exemption is a path-SEGMENT match: a module that merely
+    # string-suffix-matches a sanctioned path must still flag
+    (tmp_path / "jobs").mkdir()
+    report = _lint_source(tmp_path, source, name="jobs/flight_recorder.py")
+    assert len(report.by_rule("TPU312")) == 1
+    # ...and a module that merely IMPORTS os without exiting never flags
+    report = _lint_source(tmp_path, """
+        import os
+
+        def workdir():
+            return os.getcwd()
+        """)
+    assert report.by_rule("TPU312") == []

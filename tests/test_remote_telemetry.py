@@ -324,14 +324,18 @@ class TestClusterFederationE2E:
 
 # ============================================== multichip bench record
 def test_bench_multichip_record_measures_scaling(tmp_path):
-    """The ROADMAP-2 deliverable: bench/multichip.py completes on CPU
-    and reports measured per_chip_scaling_efficiency + straggler_skew
-    from federated telemetry (rc=0 — runs with the tunnel down)."""
+    """The ROADMAP-2 deliverable plus the ISSUE-8 recovery row:
+    bench/multichip.py completes on CPU (rc=0 — runs with the tunnel
+    down), reports measured per_chip_scaling_efficiency +
+    straggler_skew from federated telemetry, and the recovery record
+    shows a supervised kill-and-heal with measured mttr_s and
+    steps_replayed."""
     import subprocess
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = {**os.environ, "JAX_PLATFORMS": "cpu",
            "DL4J_TPU_MULTICHIP_WORKERS": "2",
            "DL4J_TPU_MULTICHIP_STEPS": "5",
+           "DL4J_TPU_MULTICHIP_RECOVERY_STEPS": "8",
            "DL4J_TPU_MULTICHIP_PORT": "24451"}
     env.pop("XLA_FLAGS", None)
     proc = subprocess.run(
@@ -348,3 +352,93 @@ def test_bench_multichip_record_measures_scaling(tmp_path):
     assert sorted(workers) == ["w0", "w1"]
     assert all(w["median_step_ms"] for w in workers.values())
     assert record["detail"]["source"] == "federated_telemetry"
+    # the ISSUE-8 recovery record: injected worker kill under the
+    # supervisor, measured MTTR + steps replayed, recovered: true
+    recovery = record["recovery"]
+    assert recovery["recovered"] is True
+    assert recovery["restarts"] == 1
+    assert recovery["reason"] == "killed"
+    assert recovery["mttr_s"] is not None and recovery["mttr_s"] > 0
+    assert recovery["steps_replayed"] is not None
+    assert recovery["steps_replayed"] >= 0
+
+
+# ==================================== restart generations (self-healing)
+class TestGenerationAwareStore:
+    def test_restart_resets_window_and_drops_stale_records(self, registry):
+        """A respawned worker re-registers under generation+1: its dead
+        predecessor's step window stops feeding straggler math and
+        median_step_ms, and the predecessor's late buffered records are
+        dropped (counted), never mixed into the new series."""
+        from deeplearning4j_tpu.obs.registry import install_standard_metrics
+        install_standard_metrics()
+        store = ClusterStore(straggler_factor=2.0)
+        # generation 0: w1 is pathologically slow → flagged straggler
+        for w, dt in (("w0", 0.01), ("w2", 0.01)):
+            store.ingest(w, [{"type": "step", "iteration": i,
+                              "step_seconds": dt} for i in range(6)])
+        store.ingest("w1", [{"type": "step", "iteration": i,
+                             "step_seconds": 0.08} for i in range(6)])
+        assert store.summary()["workers"]["w1"]["straggler"] is True
+        # the supervisor respawns w1; generation 1 is healthy
+        store.ingest("w1", [{"type": "resume", "iteration": 4}],
+                     generation=1)
+        store.ingest("w1", [{"type": "step", "iteration": i,
+                             "step_seconds": 0.01} for i in range(4, 10)],
+                     generation=1)
+        w1 = store.summary()["workers"]["w1"]
+        assert w1["generation"] == 1
+        assert w1["restarts"] == 1
+        assert w1["resumed_iteration"] == 4
+        # the pre-crash 80ms window is GONE: median reflects gen 1 only
+        assert w1["median_step_ms"] == pytest.approx(10.0)
+        assert w1["straggler"] is False
+        assert store.straggler_skew() == pytest.approx(1.0)
+        # a dying predecessor's buffered telemetry arrives late: dropped
+        n = store.ingest("w1", [{"type": "step", "iteration": 99,
+                                 "step_seconds": 0.5}], generation=0)
+        assert n == 0
+        assert store.summary()["workers"]["w1"]["median_step_ms"] \
+            == pytest.approx(10.0)
+        assert get_registry().counter(
+            "tpudl_cluster_stale_records_total").value == 1
+        # restart annotation recorded for the /cluster dashboard
+        notes = store.summary()["restarts"]
+        assert len(notes) == 1
+        assert notes[0]["worker"] == "w1"
+        assert notes[0]["from_generation"] == 0
+        assert notes[0]["to_generation"] == 1
+        assert notes[0]["last_iteration"] == 5
+        html = store.render_html(refresh_seconds=0)
+        assert "generation" in html and "Restarts" in html
+
+    def test_ingest_generation_rides_http_payload(self, registry):
+        """The router stamps its generation on every push; the UIServer
+        hands it to the store."""
+        server = UIServer(port=0)
+        router = RemoteStatsRouter(server.url, worker="gw",
+                                   flush_interval_s=0.02, generation=3)
+        try:
+            router.put_event("step", iteration=0, step_seconds=0.01)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                summary = json.loads(_get(server.url + "cluster.json"))
+                if summary["workers"].get("gw", {}).get("steps") == 1:
+                    break
+                time.sleep(0.02)
+            assert summary["workers"]["gw"]["generation"] == 3
+            body = _get(server.url + "metrics")
+            assert 'tpudl_cluster_worker_generation{worker="gw"} 3' in body
+        finally:
+            router.close(timeout=2)
+            server.stop()
+
+    def test_router_generation_defaults_from_env(self, registry, monkeypatch):
+        from deeplearning4j_tpu.obs import remote
+        monkeypatch.setenv(remote.GENERATION_ENV, "5")
+        router = RemoteStatsRouter("http://127.0.0.1:9", worker="ge",
+                                   flush_interval_s=10.0)
+        try:
+            assert router.generation == 5
+        finally:
+            router.close(timeout=1)

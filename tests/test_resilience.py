@@ -695,3 +695,75 @@ def test_write_model_snapshot_roundtrip(tmp_path):
     np.testing.assert_array_equal(np.asarray(a.params()),
                                   np.asarray(b.params()))
     assert read_training_state(live) == read_training_state(snap)
+
+
+# ================================================ process-death actions
+def test_fault_plan_parses_kill_and_sigterm():
+    plan = FaultPlan.parse("trainer.step@7:kill; dcn.exchange@2:sigterm")
+    assert {(r.site, r.action) for r in plan.rules} == \
+        {("trainer.step", "kill"), ("dcn.exchange", "sigterm")}
+
+
+def test_kill_and_sigterm_actions_are_real_process_death():
+    """``kill``/``sigterm`` are REAL signals, not Python exceptions: a
+    process that fires them dies with the signal's rc — exactly what
+    the ClusterSupervisor must classify and recover from.  SIGKILL in
+    particular is uncatchable: no handler, no black box, no goodbye."""
+    import signal as _signal
+    import subprocess
+    import sys as _sys
+    code = ("from deeplearning4j_tpu.resilience import faults\n"
+            "faults.install_fault_plan(faults.FaultPlan.parse('x@0:{a}'))\n"
+            "faults.fire('x')\n"
+            "print('survived')\n")
+    for action, sig in (("kill", _signal.SIGKILL),
+                        ("sigterm", _signal.SIGTERM)):
+        proc = subprocess.run(
+            [_sys.executable, "-c", code.format(a=action)],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == -sig, (action, proc.returncode)
+        assert "survived" not in proc.stdout
+
+
+# ========================================= save_now vs background saves
+def test_save_now_races_background_save_thread(tmp_path):
+    """Satellite: the HealthMonitor's ``checkpoint`` action
+    (``save_now``) can fire from another thread while a
+    ``background=True`` periodic save is mid-flight.  The
+    checkpoints.json index must never tear, keep-last-K must hold
+    exactly (no double-removes, no orphans), and every indexed zip must
+    verify."""
+    d = str(tmp_path)
+    net = MultiLayerNetwork(_conf()).init()
+    listener = CheckpointListener(d, save_every_n_iterations=1,
+                                  keep_last=3, background=True)
+    errors: list = []
+
+    def hammer():
+        try:
+            for i in range(1000, 1012):
+                listener.save_now(net, iteration=i, epoch=0)
+        except BaseException as e:       # surfaced to the main thread
+            errors.append(e)
+
+    t = threading.Thread(target=hammer)
+    t.start()
+    try:
+        for i in range(1, 25):
+            listener.iteration_done(net, i, 0, 0.5)
+    finally:
+        t.join(timeout=60)
+        listener.flush()
+        listener.close()
+    assert not errors, errors
+    index = json.load(open(os.path.join(d, "checkpoints.json")))
+    saved = index["checkpoints"]
+    assert len(saved) <= 3                       # keep-last-K honored
+    zips = sorted(n for n in os.listdir(d) if n.endswith(".zip"))
+    # no orphans, no phantoms: disk and index agree exactly
+    assert sorted(os.path.basename(p) for p in saved) == zips
+    for p in saved:
+        assert is_valid_checkpoint(p), f"torn checkpoint {p} in index"
+    # and the newest indexed checkpoint is loadable for resume
+    picked = CheckpointListener.last_checkpoint_in(d)
+    assert picked is not None

@@ -1,0 +1,244 @@
+"""Self-healing gangs (ISSUE 8): the ClusterSupervisor detects worker
+death (SIGKILL — real, uncatchable), tears the surviving gang down,
+respawns from the latest verified checkpoint, and the supervised run's
+per-step losses still match an uninterrupted run to 1e-6.
+
+Acceptance pins:
+- kill-and-heal: a faults-injected SIGKILL of one worker mid-fit leads
+  to automatic gang respawn from the latest verified checkpoint; the
+  completed run's per-step losses AND final params match the
+  uninterrupted run to 1e-6 (dropout active — the RNG trajectory is
+  really replayed);
+- restart-budget exhaustion raises :class:`GangFailedError` with every
+  incident's flight dumps attached;
+- the restart/degrade/halt decision (budget per worker slot, shrink
+  floor at ``min_workers``) is pinned at the unit level.
+"""
+
+import functools
+import json
+import os
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+import cluster_workers  # noqa: E402
+
+from deeplearning4j_tpu.obs.registry import (MetricsRegistry, get_registry,
+                                             set_registry)  # noqa: E402
+from deeplearning4j_tpu.obs.ui_server import UIServer  # noqa: E402
+from deeplearning4j_tpu.resilience import faults  # noqa: E402
+from deeplearning4j_tpu.resilience.retry import RetryPolicy  # noqa: E402
+from deeplearning4j_tpu.resilience.supervisor import (  # noqa: E402
+    GENERATION_ENV, RESUME_ENV, ClusterSupervisor, GangFailedError)
+
+_ENV = {"PYTHONPATH": os.path.dirname(os.path.abspath(__file__))
+        + os.pathsep + os.environ.get("PYTHONPATH", "")}
+
+
+@pytest.fixture
+def registry():
+    prev = set_registry(MetricsRegistry())
+    yield get_registry()
+    set_registry(prev)
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_fault_plan():
+    faults.clear_fault_plan()
+    yield
+    faults.clear_fault_plan()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.read().decode()
+
+
+# ========================================================= kill and heal
+def test_kill_and_heal_matches_uninterrupted_losses(tmp_path, registry):
+    """THE acceptance test: worker 1 SIGKILLs itself before step 7
+    commits (generation 0); the supervisor tears down, respawns both
+    workers resuming from their verified checkpoints, and every
+    worker's completed trajectory (replayed tail + final params)
+    matches the uninterrupted single-process run to 1e-6."""
+    refs = {pid: cluster_workers.run_reference_fit(pid) for pid in (0, 1)}
+
+    server = UIServer(port=0)
+    try:
+        fn = functools.partial(cluster_workers.supervised_train_worker,
+                               workdir=str(tmp_path), kill_at=7, kill_pid=1)
+        sup = ClusterSupervisor(
+            fn, n_processes=2, checkpoint_dir=str(tmp_path),
+            max_restarts=2, port=25011, timeout=240.0,
+            remote_ui=server.url, cluster_store=server.cluster,
+            extra_env=_ENV,
+            backoff=RetryPolicy(max_attempts=3, base_delay_s=0.0,
+                                jitter=0.0))
+        run = sup.run()
+
+        # --- recovery happened, exactly once, for the killed slot
+        assert run.recovered and len(run.incidents) == 1
+        incident = run.incidents[0]
+        assert incident.reason == "killed"
+        assert any(slot == 1 and rc is not None and rc < 0
+                   for slot, rc in incident.exits)
+        assert incident.restarted
+        assert incident.resumed_from is None  # gen 0 started from scratch
+        assert incident.mttr_s is not None and incident.mttr_s > 0
+        assert run.generations == 2 and run.slots == [0, 1]
+
+        # --- the 1e-6 contract, per worker
+        results = {r["pid"]: r for r in run.results}
+        assert sorted(results) == [0, 1]
+        for pid in (0, 1):
+            losses_ref, params_ref = refs[pid]
+            r = results[pid]
+            assert r["generation"] == 1
+            start = r["end_iteration"] - len(r["losses"])
+            np.testing.assert_allclose(r["losses"], losses_ref[start:],
+                                       atol=1e-6)
+            np.testing.assert_allclose(r["params"], params_ref, atol=1e-6)
+        # the killed worker actually replayed its tail from the resume
+        # point, not from scratch and not from nothing
+        assert 0 < len(results[1]["losses"]) < len(refs[1][0])
+
+        # --- generation-aware federation: the respawned workers
+        # re-registered under generation 1 and /cluster annotates it
+        summary = json.loads(_get(server.url + "cluster.json"))
+        for w in ("w0", "w1"):
+            assert summary["workers"][w]["generation"] == 1
+            assert summary["workers"][w]["restarts"] == 1
+        assert summary["restarts"], "restart annotations missing"
+        assert summary["restarts"][0]["to_generation"] == 1
+        html = _get(server.url + "cluster")
+        assert "generation" in html and "Restarts" in html
+        body = _get(server.url + "metrics")
+        assert 'tpudl_cluster_worker_generation{worker="w1"} 1' in body
+
+        # --- supervisor metrics
+        assert registry.counter(
+            "tpudl_resilience_gang_restarts_total").value == 1
+    finally:
+        server.stop()
+
+
+# ==================================================== budget exhaustion
+def test_restart_budget_exhaustion_raises_with_flight_dumps(registry):
+    """Worker slot 1 dies EVERY generation; with max_restarts=1 the
+    second death exhausts the budget and GangFailedError carries every
+    incident — including the SIGTERMed survivor's black boxes."""
+    fn = functools.partial(cluster_workers.repeatedly_dying_worker,
+                           die_pid=1, kill_at=2)
+    sup = ClusterSupervisor(
+        fn, n_processes=2, max_restarts=1, port=25211, timeout=120.0,
+        extra_env=_ENV,
+        backoff=RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter=0.0))
+    with pytest.raises(GangFailedError) as exc_info:
+        sup.run()
+    err = exc_info.value
+    assert len(err.incidents) == 2
+    assert all(i.reason == "killed" for i in err.incidents)
+    assert err.incidents[0].restarted
+    assert not err.incidents[1].restarted        # the budget was spent
+    assert "max_restarts=1" in str(err)
+    # per-incident flight dumps attached: the SIGKILLed worker can't
+    # dump (that's the point of SIGKILL), but the surviving sibling's
+    # SIGTERM handler writes its black box during teardown
+    assert err.flight_dumps, "no flight dumps attached to the failure"
+    headers = [line for dump in err.flight_dumps.values()
+               for line in dump if line.get("type") == "header"]
+    assert headers, "dumps carry no header lines"
+    assert registry.counter(
+        "tpudl_resilience_gang_restarts_total").value == 1
+
+
+# ============================================== degradation: the policy
+def test_budget_decision_restart_then_shrink_then_halt():
+    """The restart/degrade/halt flow, pinned without spawning: budget is
+    per worker slot; shrink drops only the exhausted slot; the
+    min_workers floor turns shrink into halt."""
+    sup = ClusterSupervisor(cluster_workers.trivial_worker, n_processes=3,
+                            max_restarts=1, degradation="shrink",
+                            min_workers=1)
+    restarts = {}
+    assert sup._apply_budget([1], [0, 1, 2], restarts) == \
+        ("restart", [0, 1, 2])
+    assert sup._apply_budget([1], [0, 1, 2], restarts) == \
+        ("shrink", [0, 2])
+    assert sup._apply_budget([0], [0, 2], restarts) == ("restart", [0, 2])
+    assert sup._apply_budget([0], [0, 2], restarts) == ("shrink", [2])
+    # last slot over budget: the min_workers floor forces halt
+    sup2 = ClusterSupervisor(cluster_workers.trivial_worker, n_processes=2,
+                             max_restarts=0, degradation="shrink",
+                             min_workers=2)
+    assert sup2._apply_budget([1], [0, 1], {}) == ("halt", [0, 1])
+
+
+def test_budget_decision_halt_policy():
+    sup = ClusterSupervisor(cluster_workers.trivial_worker, n_processes=2,
+                            max_restarts=1, degradation="halt")
+    restarts = {}
+    assert sup._apply_budget([0], [0, 1], restarts)[0] == "restart"
+    assert sup._apply_budget([0], [0, 1], restarts)[0] == "halt"
+    with pytest.raises(ValueError, match="degradation"):
+        ClusterSupervisor(cluster_workers.trivial_worker,
+                          degradation="explode")
+
+
+# ================================================== child env plumbing
+def test_child_env_plumbing(tmp_path):
+    """Respawned children get stable slot identity, the generation
+    stamp, the resume pointer (only when a verified checkpoint exists),
+    and a stripped fault plan."""
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    sup = ClusterSupervisor(cluster_workers.trivial_worker, n_processes=2,
+                            checkpoint_dir=str(tmp_path))
+    # generation 0, no checkpoint yet: no resume pointer, no stripping
+    env = sup._child_env(0, [0, 1], sup._latest_checkpoint())(1)
+    assert env["DL4J_TPU_WORKER_ID"] == "w1"
+    assert env[GENERATION_ENV] == "0"
+    assert RESUME_ENV not in env
+    assert faults.ENV_VAR not in env
+    # a verified checkpoint appears (per-worker subdir layout)
+    net = MultiLayerNetwork(cluster_workers._supervised_conf(1)).init()
+    net.save(str(tmp_path / "w0" / "checkpoint_iter3_epoch0.zip"))
+    found = sup._latest_checkpoint()
+    assert found and found.endswith("checkpoint_iter3_epoch0.zip")
+    env = sup._child_env(1, [0, 1], found)(0)
+    assert env["DL4J_TPU_WORKER_ID"] == "w0"
+    assert env[GENERATION_ENV] == "1"
+    assert env[RESUME_ENV] == str(tmp_path)
+    assert env[faults.ENV_VAR] == ""     # the drill fires exactly once
+    # after a shrink, process index 0 can own slot 2
+    env = sup._child_env(2, [2], found)(0)
+    assert env["DL4J_TPU_WORKER_ID"] == "w2"
+
+
+def test_classify_failures():
+    sup = ClusterSupervisor(cluster_workers.trivial_worker)
+    assert sup._classify([(1, -9)]) == "killed"
+    assert sup._classify([(0, 87)]) == "stalled"
+    assert sup._classify([(0, 1)]) == "crashed"
+    assert sup._classify([(0, 1), (1, 87)]) == "stalled"
+
+
+# ============================================= shrink degradation (e2e)
+@pytest.mark.slow
+def test_shrink_degradation_completes_with_healthy_subset():
+    """Slot 1 dies every generation; degradation="shrink" drops it once
+    the budget is spent and the remaining worker finishes the run."""
+    fn = functools.partial(cluster_workers.slot_gated_dying_worker, steps=4)
+    sup = ClusterSupervisor(
+        fn, n_processes=2, max_restarts=1, degradation="shrink",
+        min_workers=1, port=25411, timeout=120.0, extra_env=_ENV,
+        backoff=RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter=0.0))
+    run = sup.run()
+    assert run.slots == [0]
+    assert len(run.incidents) == 2
+    assert run.incidents[1].degraded_to == [0]
+    results = {r["slot"] for r in run.results}
+    assert results == {"w0"}
