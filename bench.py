@@ -534,6 +534,15 @@ def bench_serving(timeout_s: float = 300.0) -> dict:
     return _cpu_subbench("serving.py", timeout_s)
 
 
+def bench_online(timeout_s: float = 300.0) -> dict:
+    """Closed-loop continual-learning record (docs/online.md):
+    feedback→deploy latency, gate eval seconds, and rollback MTTR for
+    the tpudl.online loop — spool → fine-tune → eval gate → verified
+    hot-swap → watch-triggered rollback.  A CPU subprocess, so the row
+    lands even when the TPU tunnel is down."""
+    return _cpu_subbench("online.py", timeout_s)
+
+
 def bench_multichip(timeout_s: float = 540.0) -> dict:
     """Multichip scaling record (ROADMAP item 2's deliverable, CPU
     form): a real spawn_local_cluster gang whose per-worker throughput
@@ -601,6 +610,10 @@ def main():
             detail["multichip"] = bench_multichip()
         except Exception as e:
             detail["multichip"] = {"error": str(e)[:200]}
+        try:  # CPU-runnable: the continual-learning loop row too
+            detail["online"] = bench_online()
+        except Exception as e:
+            detail["online"] = {"error": str(e)[:200]}
         # a tunnel-down round still reports roofline numbers: lift the
         # cost_analysis-derived stamp out of whichever CPU record
         # produced one (feed_overlap trains a real net under the cost
@@ -655,6 +668,10 @@ def main():
                 result["detail"]["multichip"] = bench_multichip()
             except Exception as e:
                 result["detail"]["multichip"] = {"error": str(e)[:200]}
+            try:  # online loop: feedback→deploy, gate eval, rollback MTTR
+                result["detail"]["online"] = bench_online()
+            except Exception as e:
+                result["detail"]["online"] = {"error": str(e)[:200]}
             try:  # per-compiled-program cost breakdown (top-K by FLOPs)
                 from deeplearning4j_tpu.obs import costmodel
                 result["detail"]["perf_top_programs"] = \

@@ -233,6 +233,21 @@ _RULE_LIST = [
         "let the 'if __name__ == \"__main__\"' guard call sys.exit); "
         "leave process termination to obs/flight_recorder and "
         "resilience/supervisor."),
+    RuleInfo(
+        "TPU313", "deploy-outside-gate", ERROR,
+        "ModelRegistry.deploy/hot_swap called directly from online-loop "
+        "code, bypassing the eval gate (online/gate.py and tests "
+        "exempt)",
+        "The continual-learning loop's whole safety story is that a "
+        "candidate reaches serving ONLY through the eval gate: verified "
+        "load, candidate-vs-incumbent scoring on the held-out slice, "
+        "deploy on non-regression, post-deploy watch.  A direct "
+        "registry.deploy in loop code ships an unscored — possibly "
+        "NaN-poisoned or regressed — model to live traffic, and the "
+        "tpudl_online_* decision counters never see it.",
+        "Route the deploy through online.gate.GatedDeployer."
+        "deploy_if_better (or EvalGate + your own decision record); "
+        "only gate.py itself may touch ModelRegistry.deploy."),
 ]
 
 RULES: dict[str, RuleInfo] = {r.id: r for r in _RULE_LIST}

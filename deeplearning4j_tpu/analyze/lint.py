@@ -17,7 +17,9 @@ serving handler), TPU310 (span opened without `with` / flight-recorder
 I/O inside jit), TPU311 (direct network I/O in a step/listener-path
 function — telemetry goes through the buffered RemoteStatsRouter),
 TPU312 (os._exit/sys.exit outside the watchdog/supervisor — a stray
-exit defeats supervision and drops the black box).
+exit defeats supervision and drops the black box), TPU313
+(ModelRegistry.deploy called directly from online-loop code — a
+candidate reaches serving only through the eval gate).
 Registry-backed rules that ride along in ``lint_package``/``--self``:
 TPU305 (metric names — the former ``obs.check`` lint) and TPU306
 (op-spec catalog integrity).
@@ -915,6 +917,99 @@ def _rule_exit_outside_supervision(mod: ModuleInfo) -> list[Diagnostic]:
                 f"watchdog/supervisor",
                 path=mod.anchor(node)))
     return out
+
+
+# whole-name tokens marking a function (or its enclosing class) as part
+# of the continual-learning loop for TPU313 — the code that turns
+# feedback into candidates, where an ungated deploy ships an unscored
+# model to live traffic
+_ONLINE_LOOP_TOKENS = {"online", "continual", "finetune", "retrain",
+                       "candidate", "round", "loop"}
+# registry methods that flip what live traffic is served by
+_DEPLOY_ATTRS = {"deploy", "hot_swap"}
+# the one module whose JOB is the gated deploy (and tests, which
+# exercise ungated deploys on purpose)
+_GATE_EXEMPT_SUFFIX = "online/gate.py"
+
+
+def _is_test_path(norm: str) -> bool:
+    parts = norm.split("/")
+    return "tests" in parts or parts[-1].startswith("test_")
+
+
+def _imports_model_registry(mod: ModuleInfo) -> bool:
+    """True when the module binds ModelRegistry (any alias) or imports
+    the serve/serve.registry module tree — the precondition that keeps
+    an unrelated local object with a ``.deploy`` method from flagging."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom):
+            m = node.module or ""
+            if any(alias.name == "ModelRegistry" for alias in node.names):
+                return True
+            if m.endswith(".serve") and any(
+                    alias.name in ("registry", "ModelRegistry")
+                    for alias in node.names):
+                return True
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.endswith(".serve") \
+                        or alias.name.endswith("serve.registry"):
+                    return True
+    return False
+
+
+@register_lint_rule("TPU313")
+def _rule_deploy_outside_gate(mod: ModuleInfo) -> list[Diagnostic]:
+    """Direct ``<registry>.deploy(...)``/``hot_swap`` inside online-loop
+    code: the continual-learning loop may change what live traffic is
+    served ONLY through the eval gate (verified load + candidate-vs-
+    incumbent scoring + non-regression decision + watch).  Flags calls
+    in functions whose name — or whose enclosing class's name — carries
+    an online-loop token, in modules that import ModelRegistry."""
+    norm = mod.path.replace(os.sep, "/")
+    if norm == _GATE_EXEMPT_SUFFIX \
+            or norm.endswith("/" + _GATE_EXEMPT_SUFFIX) \
+            or _is_test_path(norm):
+        return []
+    if not _imports_model_registry(mod):
+        return []
+    # class-name tokens flow down to methods: OnlineTrainer.run_once is
+    # loop code even though "run_once" itself carries no token
+    class_tokens: dict[int, set] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef):
+            tokens = set(_snake_tokens(node.name))
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    class_tokens[id(sub)] = tokens
+    out = []
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        tokens = set(fn.name.lower().strip("_").split("_")) \
+            | class_tokens.get(id(fn), set())
+        if not tokens & _ONLINE_LOOP_TOKENS:
+            continue
+        for node in _walk_shallow(fn):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _DEPLOY_ATTRS:
+                out.append(Diagnostic(
+                    "TPU313",
+                    f"registry.{node.func.attr}() called directly from "
+                    f"online-loop '{fn.name}' — candidates reach serving "
+                    f"only through the eval gate "
+                    f"(online.gate.GatedDeployer.deploy_if_better)",
+                    path=mod.anchor(node)))
+    return out
+
+
+def _snake_tokens(name: str) -> list[str]:
+    """CamelCase / snake_case → lowercase whole-name tokens
+    (OnlineTrainer → ["online", "trainer"])."""
+    import re as _re
+    parts = _re.sub(r"([a-z0-9])([A-Z])", r"\1_\2", name)
+    return [t for t in parts.lower().strip("_").split("_") if t]
 
 
 # ------------------------------------------------------------ drivers

@@ -563,6 +563,46 @@ def install_standard_metrics(registry: Optional[MetricsRegistry] = None) -> dict
         r.labeled_gauge("tpudl_serve_model_version",
                         "Version currently serving per deployed model "
                         "name", ("model",)),
+        r.counter("tpudl_serve_feedback_accepted_total",
+                  "Feedback rows accepted into the spool by the HTTP "
+                  "front-end (:feedback endpoint + labeled-predict tap)"),
+        r.counter("tpudl_serve_feedback_rejected_total",
+                  "Feedback rows refused by the HTTP front-end (bad "
+                  "payload, unknown model, no spool configured) — spool "
+                  "loss made visible"),
+        r.counter("tpudl_online_candidates_total",
+                  "Fine-tune candidates the online loop produced "
+                  "(gated + aborted)"),
+        r.counter("tpudl_online_candidates_aborted_total",
+                  "Candidate fine-tunes aborted by the attached "
+                  "HealthMonitor before reaching the gate"),
+        r.counter("tpudl_online_deploys_total",
+                  "Candidates that passed the eval gate and hot-swapped "
+                  "into serving"),
+        r.counter("tpudl_online_refusals_total",
+                  "Candidates the eval gate refused (regression, "
+                  "non-finite score, failed verification)"),
+        r.counter("tpudl_online_rollbacks_total",
+                  "Automatic post-deploy rollbacks after a serve-metric "
+                  "regression in the watch window"),
+        r.gauge("tpudl_online_gate_delta",
+                "Candidate minus incumbent gate-metric score of the "
+                "most recent gate decision"),
+        r.histogram("tpudl_online_gate_seconds",
+                    "Wall time per gate evaluation (verify + score "
+                    "candidate and incumbent + decide)"),
+        r.counter("tpudl_online_spool_records_total",
+                  "Feedback records durably appended to the spool"),
+        r.counter("tpudl_online_spool_dropped_total",
+                  "Feedback records lost to buffer overflow, retention "
+                  "pruning, torn lines, or malformed payloads"),
+        r.gauge("tpudl_online_spool_depth",
+                "Spooled feedback records not yet assigned to a "
+                "fine-tune round"),
+        r.gauge("tpudl_online_staleness_seconds",
+                "Age of the oldest feedback record no fine-tune round "
+                "has consumed yet (how far behind live traffic the "
+                "online loop runs)"),
         r.gauge("tpudl_perf_mfu",
                 "Model FLOPs utilization of the most recent measured "
                 "step: XLA cost_analysis FLOPs / step wall time / "

@@ -1,0 +1,41 @@
+"""tpudl.online — closed-loop continual learning.
+
+ROADMAP item 5 ("close the loop"): the pieces built by the earlier PRs
+— exact-resume training (resilience), verified checkpoints + atomic
+hot-swap registry (serve), health monitoring (obs.health), flight
+recorder (obs.flight_recorder) — composed into one production loop:
+
+    serve traffic → feedback spool → replay source → background
+    fine-tune (health-guarded, exact-resume) → eval gate →
+    verified hot-swap → post-deploy watch → automatic rollback
+
+- :class:`~deeplearning4j_tpu.serve.feedback.FeedbackLog` — the write
+  half: serve's ``POST /v1/models/<name>:feedback`` (and the predict
+  path's labeled-traffic tap) spools records without ever blocking a
+  request.
+- :class:`FeedbackSource` — the spool as a resumable training stream:
+  round-stamped windows, reservoir/recency sampling, positions that
+  survive kills (the 1e-6 exact-resume contract holds over feedback
+  data).
+- :class:`OnlineTrainer` — the background fine-tune loop: resumes from
+  the latest verified checkpoint, aborts anomalous candidates via
+  :class:`~deeplearning4j_tpu.obs.health.HealthMonitor`, hands
+  survivors to the gate.
+- :class:`EvalGate` / :class:`GatedDeployer` / :class:`DeployWatch` —
+  candidate-vs-incumbent scoring on a held-out slice, deploy only on
+  non-regression through the registry's verified hot-swap, and
+  post-deploy rollback when live serve metrics regress.
+
+Every decision lands in the ``tpudl_online_*`` metric family and the
+flight-recorder ring.  See docs/online.md.
+"""
+
+from deeplearning4j_tpu.online.gate import (DeployWatch, EvalGate,
+                                            GateDecision, GatedDeployer)
+from deeplearning4j_tpu.online.loop import OnlineConfig, OnlineTrainer
+from deeplearning4j_tpu.online.source import FeedbackSource
+
+__all__ = [
+    "DeployWatch", "EvalGate", "FeedbackSource", "GateDecision",
+    "GatedDeployer", "OnlineConfig", "OnlineTrainer",
+]

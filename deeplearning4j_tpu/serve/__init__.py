@@ -13,8 +13,11 @@ of users"), composing the earlier PRs' substrate into one path:
   models only through the PR-4 verified checkpoint path (a corrupt zip
   is refused before anything flips; the current version keeps serving).
 - :class:`ModelServer` — stdlib HTTP JSON endpoint
-  (``POST /v1/models/<name>:predict``, ``GET /v1/models``,
-  ``GET /healthz`` readiness, ``GET /metrics``).
+  (``POST /v1/models/<name>:predict``, ``POST .../<name>:feedback``,
+  ``GET /v1/models``, ``GET /healthz`` readiness, ``GET /metrics``).
+- :class:`FeedbackLog` — bounded, never-blocking feedback spool: the
+  intake of the ``tpudl.online`` continual-learning loop
+  (docs/online.md).
 
 ``parallel.ParallelInference`` is a compatibility shim over
 :class:`InferenceEngine`.  See docs/serving.md.
@@ -22,10 +25,11 @@ of users"), composing the earlier PRs' substrate into one path:
 
 from deeplearning4j_tpu.serve.engine import (DeadlineExceeded, EngineClosed,
                                              InferenceEngine, Overloaded)
+from deeplearning4j_tpu.serve.feedback import FeedbackLog
 from deeplearning4j_tpu.serve.registry import ModelRegistry, ModelVersion
 from deeplearning4j_tpu.serve.server import ModelServer
 
 __all__ = [
-    "DeadlineExceeded", "EngineClosed", "InferenceEngine", "ModelRegistry",
-    "ModelServer", "ModelVersion", "Overloaded",
+    "DeadlineExceeded", "EngineClosed", "FeedbackLog", "InferenceEngine",
+    "ModelRegistry", "ModelServer", "ModelVersion", "Overloaded",
 ]

@@ -1,0 +1,170 @@
+"""Closed-loop continual learning: serve → feedback → fine-tune → gated
+hot-swap → forced rollback.
+
+Demonstrates the ``tpudl.online`` subsystem end to end (docs/online.md):
+
+1. train a deliberately-weak v1 classifier, deploy it, and stand up the
+   HTTP :class:`ModelServer` with a :class:`FeedbackLog` spool attached;
+2. serve live traffic: ``POST :predict`` requests flow through the
+   micro-batcher, labeled requests are tapped into the spool, and
+   ``POST /v1/models/<name>:feedback`` delivers explicit ground truth;
+3. a background :class:`OnlineTrainer` picks the feedback up, fine-tunes
+   from the latest verified checkpoint with a
+   :class:`~deeplearning4j_tpu.obs.health.HealthMonitor` attached,
+   eval-gates the candidate against the incumbent on a held-out slice,
+   and hot-swaps it through the registry's verified path — the serving
+   version flips with zero dropped requests;
+4. a post-deploy :class:`DeployWatch` window watches the live
+   ``tpudl_serve_*`` series; a forced error burst triggers the
+   automatic rollback to the previous version.
+
+Run: ``python -m examples.online_learning``
+"""
+
+import http.client
+import json
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.obs.registry import get_registry
+from deeplearning4j_tpu.online import (DeployWatch, EvalGate, OnlineConfig,
+                                       OnlineTrainer)
+from deeplearning4j_tpu.serve import FeedbackLog, ModelRegistry, ModelServer
+from deeplearning4j_tpu.train import Adam
+
+N_IN, N_CLASSES = 12, 3
+
+
+def _post(port, path, body):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("POST", path, body=json.dumps(body))
+    response = conn.getresponse()
+    out = json.loads(response.read().decode())
+    conn.close()
+    return response.status, out
+
+
+def main(feedback_records=64, verbose=True, workdir=None,
+         deploy_timeout_s=60.0):
+    workdir = workdir or tempfile.mkdtemp(prefix="tpudl_online_")
+    rng = np.random.default_rng(7)
+    w = rng.normal(size=(N_IN, N_CLASSES)).astype(np.float32)
+
+    def make_xy(n, seed):
+        r = np.random.default_rng(seed)
+        x = r.normal(size=(n, N_IN)).astype(np.float32)
+        return x, np.eye(N_CLASSES, dtype=np.float32)[np.argmax(x @ w, -1)]
+
+    # 1. a weak v1 (one pass over a little data), deployed + served
+    conf = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=24, activation="relu"))
+            .layer(OutputLayer(n_out=N_CLASSES, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(N_IN)).build())
+    net = MultiLayerNetwork(conf).init()
+    x0, y0 = make_xy(32, 1)
+    net.fit(ListDataSetIterator([DataSet(x0, y0)]), epochs=1)
+    base = os.path.join(workdir, "base.zip")
+    net.save(base)
+
+    registry = ModelRegistry(max_batch=8, max_latency_ms=2.0)
+    registry.deploy("clf", base)
+    feedback = FeedbackLog(os.path.join(workdir, "spool"))
+    server = ModelServer(registry, feedback=feedback)
+
+    hx, hy = make_xy(128, 3)
+    gate = EvalGate(ListDataSetIterator([DataSet(hx, hy)]),
+                    metric="accuracy", min_delta=0.02)
+    trainer = OnlineTrainer(
+        registry, "clf", feedback.directory,
+        os.path.join(workdir, "online"), gate, base,
+        config=OnlineConfig(min_records=feedback_records, batch_size=16,
+                            max_records_per_round=feedback_records,
+                            epochs_per_round=2, interval_s=0.0,
+                            poll_s=0.1))
+    result = {"workdir": workdir, "versions": []}
+    try:
+        # 2. live traffic: plain predicts + a labeled predict (tapped
+        # into the spool) + explicit :feedback posts
+        xq, yq = make_xy(feedback_records, 2)
+        status, body = _post(server.port, "/v1/models/clf:predict",
+                             {"instances": xq[:4].tolist()})
+        assert status == 200, body
+        result["versions"].append(body["model_version"])
+        status, body = _post(server.port, "/v1/models/clf:predict",
+                             {"instances": xq[:8].tolist(),
+                              "labels": yq[:8].tolist()})
+        assert status == 200, body
+        status, body = _post(server.port, "/v1/models/clf:feedback",
+                             {"instances": xq[8:].tolist(),
+                              "labels": yq[8:].tolist()})
+        assert status == 200 and body["accepted"] == feedback_records - 8, \
+            body
+        if verbose:
+            print(f"spooled {feedback_records} feedback records "
+                  f"(8 via the labeled-predict tap)")
+
+        # 3. the background loop notices, fine-tunes, gates, hot-swaps
+        trainer.start()
+        deadline = time.monotonic() + deploy_timeout_s
+        while time.monotonic() < deadline \
+                and registry.get("clf").version < 2:
+            time.sleep(0.1)
+        trainer.stop()
+        version = registry.get("clf").version
+        assert version >= 2, "gated deploy did not happen in time"
+        status, body = _post(server.port, "/v1/models/clf:predict",
+                             {"instances": xq[:4].tolist()})
+        assert status == 200, body
+        result["versions"].append(body["model_version"])
+        result["deploys"] = int(get_registry().counter(
+            "tpudl_online_deploys_total").value)
+        if verbose:
+            print(f"gated hot-swap: serving v{version} "
+                  f"(gate deploys so far: {result['deploys']})")
+
+        # 4. forced rollback: an error burst inside the watch window
+        requests = get_registry().labeled_counter(
+            "tpudl_serve_requests_total")
+        watch = DeployWatch(registry, "clf", window_s=15.0, poll_s=0.05,
+                            error_rate_max=0.25, min_requests=4)
+
+        def burst():
+            time.sleep(0.1)
+            requests.inc(16, status="error")
+            requests.inc(4, status="ok")
+
+        threading.Thread(target=burst, daemon=True).start()
+        verdict = watch.run()
+        assert verdict["rolled_back"], verdict
+        result["rolled_back"] = True
+        result["rollback_mttr_s"] = verdict["mttr_s"]
+        status, body = _post(server.port, "/v1/models/clf:predict",
+                             {"instances": xq[:4].tolist()})
+        assert status == 200, body
+        result["versions"].append(body["model_version"])
+        if verbose:
+            print(f"rollback after injected regression: serving "
+                  f"v{body['model_version']} "
+                  f"(mttr {verdict['mttr_s'] * 1e3:.1f} ms)")
+            print(f"versions served: {result['versions']}")
+    finally:
+        trainer.stop()
+        server.stop()
+        registry.close()
+        feedback.close()
+    return result
+
+
+if __name__ == "__main__":
+    main()

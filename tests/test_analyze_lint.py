@@ -637,3 +637,74 @@ def test_watchdog_and_supervisor_modules_are_exempt(tmp_path):
             return os.getcwd()
         """)
     assert report.by_rule("TPU312") == []
+
+
+# ------------------------------------------------------------ TPU313
+def test_deploy_outside_gate_flags_online_loop_function(tmp_path):
+    report = _lint_source(tmp_path, """
+        from deeplearning4j_tpu.serve import ModelRegistry
+
+        def online_retrain_round(registry, name, candidate):
+            registry.deploy(name, candidate)
+        """)
+    hits = report.by_rule("TPU313")
+    assert len(hits) == 1 and "deploy" in hits[0].message
+    assert report.exit_code() == 1
+
+
+def test_deploy_outside_gate_sees_through_class_names(tmp_path):
+    """OnlineTrainer.run_once is loop code even though the method name
+    itself carries no online token; hot_swap counts as a deploy."""
+    report = _lint_source(tmp_path, """
+        from deeplearning4j_tpu.serve.registry import ModelRegistry
+
+        class OnlineTrainer:
+            def run_once(self):
+                self.registry.hot_swap("m", "cand.zip")
+        """)
+    assert len(report.by_rule("TPU313")) == 1
+
+
+def test_deploy_outside_gate_exempts_gate_module_and_tests(tmp_path):
+    """online/gate.py IS the sanctioned deploy path; tests exercise
+    ungated deploys on purpose."""
+    source = """
+        from deeplearning4j_tpu.serve import ModelRegistry
+
+        def deploy_candidate_round(registry):
+            registry.deploy("m", "cand.zip")
+        """
+    (tmp_path / "online").mkdir()
+    report = _lint_source(tmp_path, source, name="online/gate.py")
+    assert report.by_rule("TPU313") == []
+    (tmp_path / "tests").mkdir()
+    report = _lint_source(tmp_path, source, name="tests/mod.py")
+    assert report.by_rule("TPU313") == []
+    report = _lint_source(tmp_path, source, name="test_deploys.py")
+    assert report.by_rule("TPU313") == []
+
+
+def test_deploy_outside_gate_needs_registry_import_and_loop_tokens(tmp_path):
+    """An unrelated object's .deploy, a module that never imports
+    ModelRegistry, and the gated deploy_if_better all stay clean."""
+    report = _lint_source(tmp_path, """
+        def online_round(orchestrator):
+            orchestrator.deploy("k8s-manifest")   # no ModelRegistry here
+        """)
+    assert report.by_rule("TPU313") == []
+    report = _lint_source(tmp_path, """
+        from deeplearning4j_tpu.serve import ModelRegistry
+
+        def setup_serving(registry, path):
+            registry.deploy("m", path)            # not loop code
+        """)
+    assert report.by_rule("TPU313") == []
+    report = _lint_source(tmp_path, """
+        from deeplearning4j_tpu.serve import ModelRegistry
+
+        class OnlineTrainer:
+            def run_once(self):
+                self.deployer.deploy_if_better("m", "cand.zip")   # gated
+        """)
+    assert report.by_rule("TPU313") == []
+    assert report.exit_code() == 0

@@ -8,9 +8,9 @@ from examples import (bert_mlm_finetune, char_rnn_textgen,
                       data_parallel_training, early_stopping,
                       fault_tolerant_training, lenet_cifar10,
                       lstm_uci_har, mlp_mnist, model_serving,
-                      multislice_dcn_training, pipeline_parallel_bert,
-                      training_dashboard, transfer_learning,
-                      word2vec_embeddings)
+                      multislice_dcn_training, online_learning,
+                      pipeline_parallel_bert, training_dashboard,
+                      transfer_learning, word2vec_embeddings)
 
 
 def test_mlp_mnist_example():
@@ -88,6 +88,16 @@ def test_model_serving_example(tmp_path):
     # deploy → hot-swap → rollback: three versions answered over HTTP
     assert result["versions_served"] == [1, 2, 3]
     assert result["final_version"] == 3
+
+
+def test_online_learning_example(tmp_path):
+    result = online_learning.main(feedback_records=48, verbose=False,
+                                  workdir=str(tmp_path))
+    # deploy → live feedback → background gated swap → forced rollback:
+    # three versions answered over HTTP, the last one a rollback
+    assert result["versions"] == [1, 2, 3]
+    assert result["rolled_back"] is True
+    assert result["deploys"] >= 1
 
 
 def test_fault_tolerant_training_example(tmp_path):

@@ -77,6 +77,11 @@ def test_bench_skip_path_runs_cpu_records_and_exits_zero(monkeypatch,
         lambda: {"metric": "multichip_scaling_efficiency", "value": 0.8,
                  "per_chip_scaling_efficiency": 0.8,
                  "straggler_skew": 1.1, "n_workers": 4})
+    monkeypatch.setattr(
+        bench, "bench_online",
+        lambda: {"metric": "online_feedback_to_deploy_seconds",
+                 "value": 0.21, "gate_eval_s": 0.1,
+                 "rollback_mttr_s": 0.006, "rolled_back": True})
     rc = bench.main()
     out = capsys.readouterr().out
     assert rc == 0
@@ -89,6 +94,12 @@ def test_bench_skip_path_runs_cpu_records_and_exits_zero(monkeypatch,
     multichip = record["detail"]["multichip"]
     assert multichip["per_chip_scaling_efficiency"] == 0.8
     assert multichip["straggler_skew"] == 1.1
+    # ... and so does the continual-learning loop row: feedback→deploy
+    # latency, gate eval seconds and rollback MTTR are CPU-measurable
+    online = record["detail"]["online"]
+    assert online["value"] == 0.21
+    assert online["gate_eval_s"] == 0.1
+    assert online["rollback_mttr_s"] == 0.006
     # the roofline stamp is lifted to the top-level detail
     assert record["detail"]["mfu"] == 0.012
     assert record["detail"]["hbm_util"] == 0.05
@@ -106,9 +117,11 @@ def test_bench_probe_error_still_exits_nonzero(monkeypatch, capsys):
     monkeypatch.setattr(bench, "bench_feed_overlap", lambda: {"ok": 1})
     monkeypatch.setattr(bench, "bench_serving", lambda: {"ok": 1})
     monkeypatch.setattr(bench, "bench_multichip", lambda: {"ok": 1})
+    monkeypatch.setattr(bench, "bench_online", lambda: {"ok": 1})
     rc = bench.main()
     record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rc == 1
     assert record["status"] == "error"
     assert record["detail"]["feed_overlap"] == {"ok": 1}
     assert record["detail"]["multichip"] == {"ok": 1}
+    assert record["detail"]["online"] == {"ok": 1}

@@ -1,0 +1,686 @@
+"""tpudl.online (ISSUE 9): closed-loop continual learning.
+
+Acceptance pins:
+- end-to-end scenario: with a model serving concurrent HTTP traffic,
+  injected labeled feedback triggers a background fine-tune whose
+  candidate (a) deploys via verified hot-swap when it improves the gate
+  metric with zero dropped/garbled in-flight requests, and (b) is
+  refused — incumbent keeps serving — when a faults-injected
+  regression (NaN poisoning / a corrupted candidate zip) makes it
+  worse; a post-deploy metric regression triggers automatic rollback;
+  every decision is visible in ``tpudl_online_*``.
+- resume semantics: a loop killed mid-fine-tune and restarted trains
+  no feedback record twice and skips none (per-step losses match the
+  uninterrupted round to 1e-6 — the spool position rides the exact-
+  resume contract from tests/test_resilience.py).
+"""
+
+import http.client
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import (ListDataSetIterator,
+                                               ResumableIterator)
+from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, DropoutLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.obs.listeners import CollectScoresListener
+from deeplearning4j_tpu.obs.registry import (MetricsRegistry, get_registry,
+                                             set_registry)
+from deeplearning4j_tpu.online import (DeployWatch, EvalGate, FeedbackSource,
+                                       GatedDeployer, OnlineConfig,
+                                       OnlineTrainer)
+from deeplearning4j_tpu.resilience import faults
+from deeplearning4j_tpu.resilience.faults import InjectedCrash
+from deeplearning4j_tpu.serve import FeedbackLog, ModelRegistry, ModelServer
+from deeplearning4j_tpu.serve import feedback as fb
+from deeplearning4j_tpu.train import Adam
+
+N_IN, N_OUT = 6, 3
+
+
+@pytest.fixture
+def metrics():
+    prev = set_registry(MetricsRegistry())
+    try:
+        yield get_registry()
+    finally:
+        set_registry(prev)
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_fault_plan():
+    faults.clear_fault_plan()
+    yield
+    faults.clear_fault_plan()
+
+
+_TEACHER = np.random.default_rng(99).normal(size=(N_IN, N_OUT)).astype(
+    np.float32)
+
+
+def _make_xy(n, seed):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, N_IN)).astype(np.float32)
+    y = np.eye(N_OUT, dtype=np.float32)[np.argmax(x @ _TEACHER, -1)]
+    return x, y
+
+
+def _conf(seed=42, dropout=False):
+    b = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+         .list()
+         .layer(DenseLayer(n_out=16, activation="tanh")))
+    if dropout:
+        b = b.layer(DropoutLayer(dropout=0.8))
+    return (b.layer(OutputLayer(n_out=N_OUT, activation="softmax",
+                                loss="mcxent"))
+            .set_input_type(InputType.feed_forward(N_IN)).build())
+
+
+def _holdout(seed=77, n=96):
+    x, y = _make_xy(n, seed)
+    return ListDataSetIterator([DataSet(x, y)])
+
+
+def _spool_with(tmp_path, n, seed, name="spool", **log_kw):
+    d = str(tmp_path / name)
+    log = FeedbackLog(d, **log_kw)
+    x, y = _make_xy(n, seed)
+    assert log.extend(x, y) == n
+    assert log.flush()
+    log.close()
+    return d
+
+
+# ================================================================ spool
+def test_spool_rotation_keeps_global_indices_stable(tmp_path, metrics):
+    d = str(tmp_path / "spool")
+    log = FeedbackLog(d, max_records_per_segment=8, max_segments=10)
+    x, y = _make_xy(20, 1)
+    log.extend(x, y)
+    assert log.flush()
+    log.close()
+    assert fb.record_count(d) == 20
+    segments = fb.list_segments(d)
+    assert [s for s, _ in segments] == [0, 8, 16]
+    records = fb.read_records(d)
+    assert [i for i, _ in records] == list(range(20))
+    np.testing.assert_allclose(records[13][1]["x"], x[13], atol=1e-6)
+    assert metrics.counter("tpudl_online_spool_records_total").value == 20
+    # a new log on the same directory resumes the global write position
+    log2 = FeedbackLog(d, max_records_per_segment=8)
+    assert log2.written() == 20
+    log2.close()
+
+
+def test_spool_retention_prunes_oldest_and_counts(tmp_path, metrics):
+    d = str(tmp_path / "spool")
+    log = FeedbackLog(d, max_records_per_segment=4, max_segments=2)
+    x, y = _make_xy(20, 2)
+    log.extend(x, y)
+    assert log.flush()
+    log.close()
+    # at most max_segments * max_records_per_segment survive on disk,
+    # later indices intact, pruned records counted as drops
+    records = fb.read_records(d)
+    assert records, "retention must not drop everything"
+    assert records[-1][0] == 19
+    assert len(records) <= 12    # 2 sealed segments + the active one
+    assert metrics.counter("tpudl_online_spool_dropped_total").value > 0
+
+
+def test_spool_torn_line_skipped_not_guessed(tmp_path, metrics):
+    d = _spool_with(tmp_path, 6, 3)
+    seg_path = fb.list_segments(d)[-1][1]
+    with open(seg_path, "a", encoding="utf-8") as f:
+        f.write('{"t": 1.0, "x": [0.1, 0.2')   # crash mid-append
+    records, torn = fb.read_segment(seg_path)
+    assert len(records) == 6 and torn == 1
+    assert fb.record_count(d) == 6
+    # ... and the writer resumes cleanly after the torn tail
+    log = FeedbackLog(d)
+    x, y = _make_xy(2, 4)
+    log.extend(x, y)
+    assert log.flush()
+    log.close()
+    assert fb.record_count(d) == 8
+
+
+def test_spool_append_never_blocks_when_writer_is_wedged(tmp_path, metrics,
+                                                        monkeypatch):
+    """The never-block contract: with the writer thread dead, appends
+    still return immediately; overflow drops the OLDEST buffered record
+    and counts it."""
+    monkeypatch.setattr(FeedbackLog, "_run", lambda self: None)
+    log = FeedbackLog(str(tmp_path / "spool"), max_buffer=4)
+    x, y = _make_xy(10, 5)
+    t0 = time.perf_counter()
+    for i in range(10):
+        assert log.append(x[i], y[i]) is True
+    assert time.perf_counter() - t0 < 1.0
+    assert log.pending() == 4
+    assert metrics.counter("tpudl_online_spool_dropped_total").value == 6
+    # malformed payloads are rejected (counted), never raised
+    assert log.append(object(), y[0]) is False
+    log.close(timeout_s=0.2)
+
+
+# =============================================================== source
+def test_source_rounds_partition_the_spool_exactly(tmp_path, metrics):
+    d = _spool_with(tmp_path, 25, 6)
+    src = FeedbackSource(d, batch_size=4, max_records_per_round=10)
+    seen = []
+    for r in range(3):
+        src.pin_round(r)
+        for _ in src:
+            seen.extend(src._last_batch_indices)
+    assert seen == list(range(25))          # no dup, no gap, in order
+    assert src.pending() == 0
+    assert src.consumed() == 25
+
+
+def test_source_round_stamp_pins_window_against_new_arrivals(tmp_path,
+                                                             metrics):
+    d = _spool_with(tmp_path, 12, 7)
+    def indices(source):
+        out = []
+        for _ in source:
+            out.append(source._last_batch_indices[:])
+        return out
+
+    src = FeedbackSource(d, batch_size=4, max_records_per_round=12)
+    src.pin_round(0)
+    first = indices(src)
+    # 8 more records arrive "during the crash"
+    log = FeedbackLog(d)
+    x, y = _make_xy(8, 8)
+    log.extend(x, y)
+    log.flush()
+    log.close()
+    # a restarted round 0 replays the IDENTICAL window
+    src2 = FeedbackSource(d, batch_size=4, max_records_per_round=12)
+    src2.pin_round(0)
+    assert indices(src2) == first
+    # the new arrivals belong to round 1
+    stamp = src2.stamp_round(1)
+    assert (stamp["start"], stamp["stop"]) == (12, 20)
+
+
+@pytest.mark.parametrize("sampling", ["reservoir", "recency"])
+def test_source_sampling_is_deterministic_per_round(tmp_path, metrics,
+                                                    sampling):
+    d = _spool_with(tmp_path, 30, 9)
+    kw = dict(batch_size=8, max_records_per_round=16, sampling=sampling,
+              seed=3)
+    src = FeedbackSource(d, **kw)
+    src.pin_round(0)
+    a = [src._last_batch_indices[:] for _ in src]
+    src2 = FeedbackSource(d, **kw)
+    src2.pin_round(0)
+    b = [src2._last_batch_indices[:] for _ in src2]
+    assert a == b and a, "sampled rounds must replay identically"
+
+
+def test_source_resumable_fast_forward_no_dup_no_skip(tmp_path, metrics):
+    """The record-level half of the exact-resume contract: break the
+    pass mid-round, restore the checkpointed position into a FRESH
+    iterator, and the consumed record indices concatenate to exactly
+    the uninterrupted pass."""
+    d = _spool_with(tmp_path, 24, 10)
+
+    def consume(it, src, upto=None):
+        out, n = [], 0
+        for _ in it:
+            out.append(src._last_batch_indices[:])
+            n += 1
+            if upto is not None and n >= upto:
+                break
+        return out
+
+    full_src = FeedbackSource(d, batch_size=4, max_records_per_round=24)
+    full_src.pin_round(0)
+    full = consume(ResumableIterator(full_src), full_src)
+
+    src_a = FeedbackSource(d, batch_size=4, max_records_per_round=24)
+    src_a.pin_round(0)
+    it_a = ResumableIterator(src_a)
+    part_a = consume(it_a, src_a, upto=3)        # "killed" after 3 batches
+    state = it_a.state() | {"batch_index": 3}
+
+    src_b = FeedbackSource(d, batch_size=4, max_records_per_round=24)
+    src_b.pin_round(0)
+    it_b = ResumableIterator(src_b)              # fresh process
+    it_b.set_state(state)
+    part_b = consume(it_b, src_b)
+    assert part_a + part_b == full
+
+
+# ================================================================= gate
+def _trained_zip(tmp_path, name, seed, records=96, epochs=2):
+    net = MultiLayerNetwork(_conf(seed)).init()
+    x, y = _make_xy(records, seed)
+    net.fit(ListDataSetIterator([DataSet(x[i:i + 16], y[i:i + 16])
+                                 for i in range(0, records, 16)]),
+            epochs=epochs)
+    path = str(tmp_path / name)
+    net.save(path)
+    return path
+
+
+def _untrained_zip(tmp_path, name, seed=1):
+    net = MultiLayerNetwork(_conf(seed)).init()
+    path = str(tmp_path / name)
+    net.save(path)
+    return path
+
+
+def test_gate_deploys_improvement_and_refuses_regression(tmp_path, metrics):
+    weak = _untrained_zip(tmp_path, "weak.zip")
+    strong = _trained_zip(tmp_path, "strong.zip", seed=2)
+    registry = ModelRegistry(max_batch=8, max_latency_ms=1.0)
+    try:
+        registry.deploy("m", weak)
+        deployer = GatedDeployer(registry, EvalGate(_holdout(),
+                                                    metric="accuracy"))
+        decision = deployer.deploy_if_better("m", strong)
+        assert decision.deploy and decision.version == 2
+        assert decision.candidate_score > decision.incumbent_score
+        assert metrics.counter("tpudl_online_deploys_total").value == 1
+        assert metrics.gauge("tpudl_online_gate_delta").value == \
+            pytest.approx(decision.delta)
+        # now the strong one is the incumbent: the weak zip is refused
+        decision = deployer.deploy_if_better("m", weak)
+        assert not decision.deploy
+        assert "regression" in decision.reason
+        assert registry.get("m").version == 2     # incumbent untouched
+        assert metrics.counter("tpudl_online_refusals_total").value == 1
+        assert metrics.histogram("tpudl_online_gate_seconds").count == 2
+    finally:
+        registry.close()
+
+
+def test_gate_refuses_corrupt_candidate_before_scoring(tmp_path, metrics):
+    base = _trained_zip(tmp_path, "base.zip", seed=3)
+    candidate = _trained_zip(tmp_path, "cand.zip", seed=4)
+    with open(candidate, "r+b") as f:
+        f.truncate(os.path.getsize(candidate) - 64)   # torn zip
+    registry = ModelRegistry(max_batch=8, max_latency_ms=1.0)
+    try:
+        registry.deploy("m", base)
+        deployer = GatedDeployer(registry, EvalGate(_holdout()))
+        decision = deployer.deploy_if_better("m", candidate)
+        assert not decision.deploy
+        assert "verification" in decision.reason
+        assert registry.get("m").version == 1
+        assert metrics.counter("tpudl_online_refusals_total").value == 1
+    finally:
+        registry.close()
+
+
+def test_gate_refuses_non_finite_candidate_score(tmp_path, metrics):
+    base = _trained_zip(tmp_path, "base.zip", seed=5)
+    import jax
+    net = MultiLayerNetwork(_conf(6)).init()
+    net.params_ = jax.tree_util.tree_map(lambda a: a * np.nan, net.params_)
+    poisoned = str(tmp_path / "poisoned.zip")
+    net.save(poisoned)
+    registry = ModelRegistry(max_batch=8, max_latency_ms=1.0)
+    try:
+        registry.deploy("m", base)
+        deployer = GatedDeployer(
+            registry, EvalGate(_holdout(), metric="loss"))
+        decision = deployer.deploy_if_better("m", poisoned)
+        assert not decision.deploy
+        assert "non-finite" in decision.reason
+        assert registry.get("m").version == 1
+    finally:
+        registry.close()
+
+
+# ========================================================== deploy watch
+def test_deploy_watch_rolls_back_on_error_burst(tmp_path, metrics):
+    v1 = _trained_zip(tmp_path, "v1.zip", seed=7)
+    v2 = _trained_zip(tmp_path, "v2.zip", seed=8)
+    registry = ModelRegistry(max_batch=8, max_latency_ms=1.0)
+    try:
+        registry.deploy("m", v1)
+        registry.deploy("m", v2)                 # the suspect deploy
+        requests = metrics.labeled_counter("tpudl_serve_requests_total")
+        watch = DeployWatch(registry, "m", window_s=10.0, poll_s=0.02,
+                            error_rate_max=0.25, min_requests=4)
+
+        def burst():
+            time.sleep(0.05)
+            requests.inc(9, status="error")
+            requests.inc(1, status="ok")
+
+        threading.Thread(target=burst, daemon=True).start()
+        verdict = watch.run()
+        assert verdict["rolled_back"]
+        assert "error rate" in verdict["reason"]
+        # rollback re-deploys v1's zip as a NEW version
+        assert registry.get("m").version == 3
+        assert registry.get("m").path == v1
+        assert metrics.counter("tpudl_online_rollbacks_total").value == 1
+    finally:
+        registry.close()
+
+
+def test_deploy_watch_clean_window_keeps_the_deploy(tmp_path, metrics):
+    v1 = _trained_zip(tmp_path, "v1.zip", seed=9)
+    registry = ModelRegistry(max_batch=8, max_latency_ms=1.0)
+    try:
+        registry.deploy("m", v1)
+        watch = DeployWatch(registry, "m", window_s=0.2, poll_s=0.02)
+        verdict = watch.run()
+        assert not verdict["rolled_back"]
+        assert registry.get("m").version == 1
+        assert metrics.counter("tpudl_online_rollbacks_total").value == 0
+    finally:
+        registry.close()
+
+
+# ============================================================ loop rounds
+def _online_setup(tmp_path, metrics, records=48, min_delta=1.0,
+                  base_seed=1, **cfg_kw):
+    base = _untrained_zip(tmp_path, "base.zip", seed=base_seed)
+    registry = ModelRegistry(max_batch=8, max_latency_ms=1.0)
+    registry.deploy("m", base)
+    spool = _spool_with(tmp_path, records, seed=11)
+    cfg = OnlineConfig(min_records=records, batch_size=8,
+                       max_records_per_round=records,
+                       checkpoint_every_n_iterations=1, **cfg_kw)
+    gate = EvalGate(_holdout(), metric="accuracy", min_delta=min_delta)
+    trainer = OnlineTrainer(registry, "m", spool,
+                            str(tmp_path / "online"), gate, base,
+                            config=cfg)
+    return registry, spool, trainer
+
+
+def test_loop_round_trains_gates_deploys_and_promotes(tmp_path, metrics):
+    registry, spool, trainer = _online_setup(tmp_path, metrics,
+                                             min_delta=0.0)
+    try:
+        decision = trainer.run_once()
+        assert decision["status"] == "deployed"
+        assert registry.get("m").version == 2
+        # the deployed candidate became the lineage head
+        assert "lineage" in trainer.lineage_head()
+        assert trainer.next_round() == 1
+        # no new feedback → the next round is a counted skip
+        assert trainer.run_once()["status"] == "skipped"
+        assert metrics.counter("tpudl_online_candidates_total").value == 1
+        assert metrics.counter("tpudl_online_deploys_total").value == 1
+        assert metrics.gauge("tpudl_online_spool_depth").value == 0
+    finally:
+        registry.close()
+
+
+def test_loop_aborts_nan_poisoned_candidate(tmp_path, metrics):
+    """faults 'nan' poisoning mid-fine-tune: the HealthMonitor halts the
+    fit, the candidate never reaches the gate, the incumbent serves."""
+    registry, spool, trainer = _online_setup(tmp_path, metrics)
+    try:
+        with faults.inject("trainer.step@2:nan"):
+            decision = trainer.run_once()
+        assert decision["status"] == "aborted"
+        assert decision["anomaly"] == "non_finite_loss"
+        assert registry.get("m").version == 1       # incumbent untouched
+        assert metrics.counter(
+            "tpudl_online_candidates_aborted_total").value == 1
+        assert metrics.counter("tpudl_online_deploys_total").value == 0
+        assert metrics.labeled_counter(
+            "tpudl_health_anomalies_total",
+            label_names=("kind",)).labeled_value(kind="non_finite_loss") == 1
+        # the aborted round advanced: the loop is not wedged on poison
+        assert trainer.next_round() == 1
+    finally:
+        registry.close()
+
+
+def test_loop_kill_mid_finetune_resumes_exactly(tmp_path, metrics):
+    """THE resume acceptance: kill the loop mid-fine-tune (dropout
+    active), restart it, and the resumed round's per-step losses
+    concatenate to the uninterrupted round's to 1e-6 — no feedback
+    record trained twice, none skipped."""
+    # uninterrupted twin: identical base/conf/spool content
+    base_u = str(tmp_path / "base_u.zip")
+    MultiLayerNetwork(_conf(21, dropout=True)).init().save(base_u)
+    spool_u = _spool_with(tmp_path, 48, seed=13, name="spool_u")
+    reg_u = ModelRegistry(max_batch=8, max_latency_ms=1.0)
+    reg_u.deploy("m", base_u)
+    scores_u = CollectScoresListener()
+    trainer_u = OnlineTrainer(
+        reg_u, "m", spool_u, str(tmp_path / "online_u"),
+        EvalGate(_holdout(), min_delta=1.0), base_u,
+        config=OnlineConfig(min_records=48, batch_size=8,
+                            max_records_per_round=48,
+                            checkpoint_every_n_iterations=1),
+        listeners=[scores_u])
+    decision_u = trainer_u.run_once()
+    reg_u.close()
+    assert decision_u["status"] in ("deployed", "refused")
+    assert len(scores_u.scores) == 6              # 48 records / batch 8
+
+    # interrupted twin: crash at step 3 of the fine-tune, then restart
+    base_i = str(tmp_path / "base_i.zip")
+    MultiLayerNetwork(_conf(21, dropout=True)).init().save(base_i)
+    spool_i = _spool_with(tmp_path, 48, seed=13, name="spool_i")
+    reg_i = ModelRegistry(max_batch=8, max_latency_ms=1.0)
+    reg_i.deploy("m", base_i)
+    scores_i = CollectScoresListener()
+
+    def make_trainer():
+        return OnlineTrainer(
+            reg_i, "m", spool_i, str(tmp_path / "online_i"),
+            EvalGate(_holdout(), min_delta=1.0), base_i,
+            config=OnlineConfig(min_records=48, batch_size=8,
+                                max_records_per_round=48,
+                                checkpoint_every_n_iterations=1),
+            listeners=[scores_i])
+
+    with faults.inject("trainer.step@3:crash"):
+        with pytest.raises(InjectedCrash):
+            make_trainer().run_once()
+    assert len(scores_i.scores) == 3              # steps 0..2 committed
+    # "new process": a FRESH OnlineTrainer on the same directories
+    decision_i = make_trainer().run_once()
+    reg_i.close()
+    assert decision_i["status"] == decision_u["status"]
+    assert len(scores_i.scores) == 6              # steps 3..5 only, once
+    np.testing.assert_allclose(scores_i.scores, scores_u.scores, atol=1e-6)
+    # spool position: the killed+resumed loop consumed exactly one
+    # round's window, same as the uninterrupted one
+    src = FeedbackSource(spool_i, batch_size=8, max_records_per_round=48)
+    assert src.consumed() == 48 and src.pending() == 0
+
+
+def test_loop_background_thread_triggers_and_supervision_budget(tmp_path,
+                                                                metrics):
+    registry, spool, trainer = _online_setup(tmp_path, metrics,
+                                             interval_s=0.0, poll_s=0.05)
+    try:
+        trainer.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and registry.get("m").version < 2:
+            time.sleep(0.05)
+        trainer.stop()
+        assert registry.get("m").version == 2
+        assert trainer.failed is None
+    finally:
+        trainer.stop()
+        registry.close()
+
+
+# ====================================================== end-to-end scenario
+def _post(port, path, body):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("POST", path, body=json.dumps(body))
+    response = conn.getresponse()
+    out = json.loads(response.read().decode())
+    conn.close()
+    return response.status, out
+
+
+def test_e2e_serve_feedback_finetune_gate_swap_rollback(tmp_path, metrics):
+    """The ISSUE-9 acceptance scenario, CPU-runnable."""
+    base = _untrained_zip(tmp_path, "base.zip", seed=31)
+    registry = ModelRegistry(max_batch=8, max_latency_ms=1.0,
+                             queue_limit=256)
+    registry.deploy("clf", base)
+    feedback = FeedbackLog(str(tmp_path / "spool"))
+    server = ModelServer(registry, feedback=feedback)
+    gate = EvalGate(_holdout(), metric="accuracy", min_delta=0.05)
+    trainer = OnlineTrainer(
+        registry, "clf", feedback.directory, str(tmp_path / "online"),
+        gate, base,
+        config=OnlineConfig(min_records=48, batch_size=8,
+                            max_records_per_round=48,
+                            checkpoint_every_n_iterations=2))
+
+    stop = threading.Event()
+    failures: list = []
+    versions_seen: set = set()
+
+    def client(seed):
+        rng = np.random.default_rng(seed)
+        while not stop.is_set():
+            n = int(rng.integers(1, 4))
+            x = rng.normal(size=(n, N_IN)).astype(np.float32).tolist()
+            try:
+                status, body = _post(server.port,
+                                     "/v1/models/clf:predict",
+                                     {"instances": x})
+                if status != 200 or len(body["predictions"]) != n:
+                    failures.append((status, body))
+                else:
+                    versions_seen.add(body["model_version"])
+            except Exception as e:            # noqa: BLE001 — recorded
+                failures.append(("exc", repr(e)))
+            time.sleep(0.005)
+
+    threads = [threading.Thread(target=client, args=(s,), daemon=True)
+               for s in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        # ---- (a) labeled feedback over HTTP → fine-tune → gated swap
+        x1, y1 = _make_xy(48, 41)
+        status, body = _post(server.port, "/v1/models/clf:feedback",
+                             {"instances": x1.tolist(),
+                              "labels": y1.tolist()})
+        assert status == 200 and body["accepted"] == 48
+        feedback.flush()
+        decision1 = trainer.run_once()
+        assert decision1["status"] == "deployed", decision1
+        # trained-on-teacher beats the untrained incumbent outright
+        assert decision1["gate"]["candidate_score"] > \
+            decision1["gate"]["incumbent_score"]
+        assert registry.get("clf").version == 2
+
+        # ---- (b) injected regression: NaN-poisoned fine-tune is
+        # refused before the gate; the incumbent keeps serving
+        x2, y2 = _make_xy(48, 42)
+        status, body = _post(server.port, "/v1/models/clf:feedback",
+                             {"instances": x2.tolist(),
+                              "labels": y2.tolist()})
+        assert status == 200 and body["accepted"] == 48
+        feedback.flush()
+        # round 2 resumes from the deployed candidate (iteration 6)
+        with faults.inject("trainer.step@8:nan"):
+            decision2 = trainer.run_once()
+        assert decision2["status"] == "aborted", decision2
+        assert registry.get("clf").version == 2   # incumbent serving
+
+        # ---- (b') corrupted candidate zip: refused at the gate
+        x3, y3 = _make_xy(48, 43)
+        _post(server.port, "/v1/models/clf:feedback",
+              {"instances": x3.tolist(), "labels": y3.tolist()})
+        feedback.flush()
+        with faults.inject("checkpoint.write@0:truncate:4000:50"):
+            decision3 = trainer.run_once()
+        assert decision3["status"] == "refused", decision3
+        assert "verification" in decision3["gate"]["reason"]
+        assert registry.get("clf").version == 2   # still the incumbent
+
+        # ---- post-deploy metric regression → automatic rollback
+        x4, y4 = _make_xy(48, 44)
+        _post(server.port, "/v1/models/clf:feedback",
+              {"instances": x4.tolist(), "labels": y4.tolist()})
+        feedback.flush()
+        decision4 = trainer.run_once()
+        assert decision4["status"] == "deployed", decision4
+        deployed_version = registry.get("clf").version
+        requests_c = metrics.labeled_counter("tpudl_serve_requests_total")
+        watch = DeployWatch(registry, "clf", window_s=20.0, poll_s=0.05,
+                            error_rate_max=0.9, min_requests=64)
+
+        def burst():
+            time.sleep(0.1)
+            requests_c.inc(4096, status="error")
+
+        threading.Thread(target=burst, daemon=True).start()
+        verdict = watch.run()
+        assert verdict["rolled_back"], verdict
+        assert registry.get("clf").version == deployed_version + 1
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        server.stop()
+        registry.close()
+        feedback.close()
+
+    # zero dropped/garbled in-flight requests across both hot-swaps,
+    # the aborted/refused rounds, and the rollback
+    assert failures == []
+    assert versions_seen, "traffic must have flowed"
+    # every decision is visible in the tpudl_online_* family
+    assert metrics.counter("tpudl_online_candidates_total").value == 4
+    assert metrics.counter("tpudl_online_deploys_total").value == 2
+    assert metrics.counter(
+        "tpudl_online_candidates_aborted_total").value == 1
+    assert metrics.counter("tpudl_online_refusals_total").value == 1
+    assert metrics.counter("tpudl_online_rollbacks_total").value == 1
+    assert metrics.counter("tpudl_online_spool_records_total").value == 192
+
+
+def test_spool_writer_survives_disk_failures(tmp_path, metrics,
+                                             monkeypatch):
+    """A disk hiccup (ENOSPC, yanked volume) must cost counted drops
+    and a reopen — never a silently dead writer behind 200 responses."""
+    real_open = FeedbackLog._open_active
+    fail = {"n": 2}
+
+    def flaky_open(self):
+        if fail["n"] > 0:
+            fail["n"] -= 1
+            raise OSError("disk full")
+        return real_open(self)
+
+    monkeypatch.setattr(FeedbackLog, "_open_active", flaky_open)
+    log = FeedbackLog(str(tmp_path / "spool"), flush_interval_s=0.02)
+    x, y = _make_xy(5, 14)
+    assert log.extend(x, y) == 5
+    assert log.flush(timeout_s=10)          # recovered and drained
+    log.close()
+    assert fb.record_count(str(tmp_path / "spool")) == 5
+    assert metrics.counter("tpudl_online_spool_records_total").value == 5
+
+
+def test_extend_rejects_unusable_weights_without_raising(tmp_path,
+                                                         metrics):
+    log = FeedbackLog(str(tmp_path / "spool"))
+    x, y = _make_xy(3, 15)
+    accepted = log.extend(x, y, weights=[1.0, "nope", 2.0])
+    assert accepted == 2
+    assert log.flush()
+    log.close()
+    assert fb.record_count(str(tmp_path / "spool")) == 2
+    assert metrics.counter("tpudl_online_spool_dropped_total").value == 1

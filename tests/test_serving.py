@@ -508,3 +508,182 @@ def test_trace_id_propagates_to_span_ring_and_response(tmp_path, metrics):
     serve_events = [e for e in ring if e["kind"] == "serve"]
     assert any("req-abc-123" in e.get("trace_ids", [])
                for e in serve_events)
+
+
+# ------------------------------------------------------------- feedback
+def _req(port, method, path, body=None, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request(method, path,
+                 body=None if body is None else json.dumps(body),
+                 headers=headers or {})
+    r = conn.getresponse()
+    out = json.loads(r.read().decode() or "{}")
+    trace = r.getheader("X-Trace-Id")
+    conn.close()
+    return r.status, out, trace
+
+
+def test_feedback_endpoint_spools_and_counts_accepted(tmp_path, metrics):
+    from deeplearning4j_tpu.serve import FeedbackLog
+    from deeplearning4j_tpu.serve import feedback as fb
+    net = _net(81)
+    p = str(tmp_path / "m.zip")
+    net.save(p)
+    registry = ModelRegistry(max_batch=4, max_latency_ms=2)
+    registry.deploy("m", p)
+    log = FeedbackLog(str(tmp_path / "spool"))
+    with ModelServer(registry, feedback=log) as srv:
+        x = _data(6, 4)
+        y = np.eye(N_OUT, dtype=np.float32)[np.arange(6) % N_OUT]
+        status, body, trace = _req(
+            srv.port, "POST", "/v1/models/m:feedback",
+            {"instances": x.tolist(), "labels": y.tolist(),
+             "weights": 2.0},
+            headers={"X-Trace-Id": "fb-1"})
+        assert status == 200
+        assert body == {"accepted": 6, "rejected": 0}
+        assert trace == "fb-1"
+    log.flush()
+    log.close()
+    registry.close()
+    records = fb.read_records(str(tmp_path / "spool"))
+    assert len(records) == 6
+    assert records[0][1]["trace_id"] == "fb-1"
+    assert records[0][1]["model"] == "m"
+    assert records[0][1]["w"] == 2.0
+    np.testing.assert_allclose(records[3][1]["x"], x[3], atol=1e-6)
+    assert metrics.counter(
+        "tpudl_serve_feedback_accepted_total").value == 6
+    assert metrics.counter(
+        "tpudl_serve_feedback_rejected_total").value == 0
+
+
+def test_feedback_rejections_counted_and_echo_trace_id(tmp_path, metrics):
+    """Every refusal shape counts into the rejected counter and echoes
+    X-Trace-Id — spool loss is visible, never silent."""
+    from deeplearning4j_tpu.serve import FeedbackLog
+    net = _net(82)
+    p = str(tmp_path / "m.zip")
+    net.save(p)
+    registry = ModelRegistry(max_batch=4, max_latency_ms=2)
+    registry.deploy("m", p)
+    log = FeedbackLog(str(tmp_path / "spool"))
+    rejected = metrics.counter("tpudl_serve_feedback_rejected_total")
+    with ModelServer(registry, feedback=log) as srv:
+        x = _data(4, 5).tolist()
+        y = np.eye(N_OUT, dtype=np.float32)[:3].tolist()
+        # malformed body (no labels)
+        status, body, trace = _req(srv.port, "POST",
+                                   "/v1/models/m:feedback",
+                                   {"instances": x},
+                                   headers={"X-Trace-Id": "fb-bad-1"})
+        assert status == 400 and trace == "fb-bad-1"
+        assert rejected.value == 1
+        # mismatched lengths: every offered row counts as refused
+        status, body, trace = _req(srv.port, "POST",
+                                   "/v1/models/m:feedback",
+                                   {"instances": x, "labels": y},
+                                   headers={"X-Trace-Id": "fb-bad-2"})
+        assert status == 400 and trace == "fb-bad-2"
+        assert rejected.value == 5
+        # unknown model
+        status, body, trace = _req(srv.port, "POST",
+                                   "/v1/models/ghost:feedback",
+                                   {"instances": x[:2], "labels": y[:2]},
+                                   headers={"X-Trace-Id": "fb-bad-3"})
+        assert status == 404 and trace == "fb-bad-3"
+        assert rejected.value == 7
+    log.close()
+    # no spool configured → 503, rows counted
+    with ModelServer(registry) as srv:
+        status, body, trace = _req(srv.port, "POST",
+                                   "/v1/models/m:feedback",
+                                   {"instances": x[:2], "labels": y[:2]},
+                                   headers={"X-Trace-Id": "fb-bad-4"})
+        assert status == 503 and trace == "fb-bad-4"
+        assert "spool" in body["error"]
+        assert rejected.value == 9
+    registry.close()
+    assert metrics.counter(
+        "tpudl_serve_feedback_accepted_total").value == 0
+
+
+def test_labeled_predict_tap_spools_after_answering(tmp_path, metrics):
+    from deeplearning4j_tpu.serve import FeedbackLog
+    from deeplearning4j_tpu.serve import feedback as fb
+    net = _net(83)
+    p = str(tmp_path / "m.zip")
+    net.save(p)
+    registry = ModelRegistry(max_batch=4, max_latency_ms=2)
+    registry.deploy("m", p)
+    log = FeedbackLog(str(tmp_path / "spool"))
+    with ModelServer(registry, feedback=log) as srv:
+        x = _data(3, 6)
+        y = np.eye(N_OUT, dtype=np.float32)[:3]
+        status, body, _ = _req(srv.port, "POST", "/v1/models/m:predict",
+                               {"instances": x.tolist(),
+                                "labels": y.tolist()},
+                               headers={"X-Trace-Id": "tap-1"})
+        assert status == 200 and len(body["predictions"]) == 3
+        # an unlabeled predict is NOT tapped
+        status, body, _ = _req(srv.port, "POST", "/v1/models/m:predict",
+                               {"instances": x.tolist()})
+        assert status == 200
+    log.flush()
+    log.close()
+    registry.close()
+    records = fb.read_records(str(tmp_path / "spool"))
+    assert len(records) == 3
+    assert records[0][1]["trace_id"] == "tap-1"
+    assert metrics.counter(
+        "tpudl_serve_feedback_accepted_total").value == 3
+
+
+def test_unknown_get_route_echoes_trace_id(tmp_path, metrics):
+    net = _net(84)
+    p = str(tmp_path / "m.zip")
+    net.save(p)
+    registry = ModelRegistry(max_batch=4, max_latency_ms=2)
+    registry.deploy("m", p)
+    with ModelServer(registry) as srv:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=30)
+        conn.request("GET", "/nope", headers={"X-Trace-Id": "get-404"})
+        r = conn.getresponse()
+        r.read()
+        assert r.status == 404
+        assert r.getheader("X-Trace-Id") == "get-404"
+        conn.request("GET", "/v1/models/ghost",
+                     headers={"X-Trace-Id": "get-405"})
+        r = conn.getresponse()
+        r.read()
+        assert r.status == 404
+        assert r.getheader("X-Trace-Id") == "get-405"
+        conn.close()
+    registry.close()
+
+
+def test_feedback_bad_weight_rows_rejected_not_crashed(tmp_path, metrics):
+    """A non-numeric weights entry must cost a counted per-row
+    rejection and a 200 — never an aborted connection."""
+    from deeplearning4j_tpu.serve import FeedbackLog
+    net = _net(85)
+    p = str(tmp_path / "m.zip")
+    net.save(p)
+    registry = ModelRegistry(max_batch=4, max_latency_ms=2)
+    registry.deploy("m", p)
+    log = FeedbackLog(str(tmp_path / "spool"))
+    with ModelServer(registry, feedback=log) as srv:
+        x = _data(3, 7).tolist()
+        y = np.eye(N_OUT, dtype=np.float32)[:3].tolist()
+        status, body, _ = _req(srv.port, "POST", "/v1/models/m:feedback",
+                               {"instances": x, "labels": y,
+                                "weights": [1.0, "x", 2.0]})
+        assert status == 200
+        assert body == {"accepted": 2, "rejected": 1}
+    log.close()
+    registry.close()
+    assert metrics.counter(
+        "tpudl_serve_feedback_accepted_total").value == 2
+    assert metrics.counter(
+        "tpudl_serve_feedback_rejected_total").value == 1
