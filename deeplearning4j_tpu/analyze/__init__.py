@@ -26,14 +26,20 @@ can gate.  Rule catalog: ``docs/static_analysis.md``.
 """
 
 from deeplearning4j_tpu.analyze.diagnostics import (
-    Diagnostic, Report, RULES, RuleInfo, ERROR, WARNING, INFO)
+    Diagnostic, Report, RULES, RuleInfo, ERROR, WARNING, INFO, rule_family)
 from deeplearning4j_tpu.analyze.model_checks import analyze_model, load_model_conf
 from deeplearning4j_tpu.analyze.sharding import check_sharding
 from deeplearning4j_tpu.analyze.lint import (
     lint_paths, lint_package, check_metric_names, check_op_catalog)
+from deeplearning4j_tpu.analyze.concurrency import (
+    analyze_concurrency_paths, analyze_concurrency_package,
+    register_concurrency_rule)
 
 __all__ = [
     "Diagnostic", "Report", "RULES", "RuleInfo", "ERROR", "WARNING", "INFO",
+    "rule_family",
     "analyze_model", "load_model_conf", "check_sharding",
     "lint_paths", "lint_package", "check_metric_names", "check_op_catalog",
+    "analyze_concurrency_paths", "analyze_concurrency_package",
+    "register_concurrency_rule",
 ]

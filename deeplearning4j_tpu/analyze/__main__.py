@@ -8,10 +8,18 @@ report and one exit code):
 - ``--self``: AST-lint the installed ``deeplearning4j_tpu`` tree plus the
   metric-name and op-catalog rules (what CI gates).
 - ``--lint <path> [...]``: AST-lint arbitrary files/directories.
+- ``--concurrency [<path> ...]``: static race/deadlock analysis
+  (TPU4xx) over the given paths — with no paths (or with ``--self``)
+  over the ``deeplearning4j_tpu`` tree itself (also CI-gated).
+
+Combined runs share one parsed AST per file (``analyze.source`` cache),
+so ``--self --lint --concurrency`` parses each module once.
 
 Exit code 0 = no error-severity diagnostics; 1 = errors found;
 2 = usage/load failure.  ``--format json`` emits one machine-readable
-document for tooling.
+document for tooling: every family reports the same finding-object
+schema (rule/slug/family/severity/path/message/hint), with
+pragma-suppressed findings carried separately under ``"suppressed"``.
 """
 
 from __future__ import annotations
@@ -23,6 +31,8 @@ from deeplearning4j_tpu.analyze.diagnostics import Report
 from deeplearning4j_tpu.analyze.model_checks import (
     analyze_model, load_model_conf, parse_byte_size)
 from deeplearning4j_tpu.analyze.lint import lint_paths, lint_package
+from deeplearning4j_tpu.analyze.concurrency import (
+    analyze_concurrency_package, analyze_concurrency_paths)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -38,6 +48,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(AST + metric-name + op-catalog rules)")
     p.add_argument("--lint", nargs="+", metavar="PATH",
                    help="AST-lint the given files/directories")
+    p.add_argument("--concurrency", nargs="*", metavar="PATH", default=None,
+                   help="static race/deadlock analysis (TPU4xx) over the "
+                        "given files/directories; with no paths, over the "
+                        "deeplearning4j_tpu tree itself")
     p.add_argument("--hbm-budget", metavar="SIZE",
                    help="fail if the estimated training footprint exceeds "
                         "this (e.g. 16GiB)")
@@ -56,10 +70,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    if not (args.model or args.self_check or args.lint):
+    if not (args.model or args.self_check or args.lint
+            or args.concurrency is not None):
         build_parser().print_usage(sys.stderr)
-        print("error: nothing to do — pass --model, --self and/or --lint",
-              file=sys.stderr)
+        print("error: nothing to do — pass --model, --self, --lint "
+              "and/or --concurrency", file=sys.stderr)
         return 2
 
     try:
@@ -85,6 +100,10 @@ def main(argv=None) -> int:
         report.extend(lint_package())
     if args.lint:
         report.extend(lint_paths(args.lint))
+    if args.concurrency is not None:
+        report.extend(analyze_concurrency_paths(args.concurrency)
+                      if args.concurrency
+                      else analyze_concurrency_package())
 
     if args.format == "json":
         print(report.to_json())

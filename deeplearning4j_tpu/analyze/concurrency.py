@@ -1,0 +1,1156 @@
+"""``tpudl.analyze.concurrency`` — static race & deadlock detection.
+
+The framework is a genuinely concurrent system: serve engine worker,
+DeviceFeeder producer, online loop, checkpoint save thread, remote
+stats router, flight-recorder watchdog, HTTP servers, signal handlers.
+The last several PRs each shipped review-pass fixes for the same bug
+classes — non-reentrant locks self-deadlocking under signal handlers,
+stranded Futures, undrained children, torn indexes under racing saves.
+This pass turns that review checklist into rules with stable IDs, run
+over the whole tree by ``python -m deeplearning4j_tpu.analyze
+--concurrency [--self]`` and gated in tier-1 like ``--self`` lint.
+
+Model
+-----
+
+Per module we discover **thread entry points** — ``threading.Thread``
+targets (including nested closures), ``Thread`` subclass ``run``
+methods, ``BaseHTTPRequestHandler`` ``do_*`` hooks, and
+signal/excepthook/atexit handlers — plus one ``caller`` pseudo-entry
+per class (its public API, which user threads drive).  For each entry
+we compute the transitive closure over intra-module calls, carrying the
+set of locks held at each point (``with self._lock:`` spans and
+explicit ``acquire``/``release``), and record which ``self.*``
+attributes each entry reads and writes under which locks.
+
+Rules (pluggable via :func:`register_concurrency_rule`):
+
+- **TPU401** lock-order inversion: the lock-acquisition graph (edge
+  A→B = B acquired while A held, through calls) has a cycle — two
+  threads interleaving those paths deadlock.  Re-acquiring a
+  non-reentrant ``threading.Lock`` already held on the same path is the
+  one-lock cycle.
+- **TPU402** unlocked shared write: a ``self.*`` attribute written from
+  ≥2 entry points with no lock common to all write sites (writes in
+  ``__init__`` are construction-time and exempt; attributes holding
+  thread-safe objects — locks, events, queues — are exempt).
+- **TPU403** non-reentrant lock in an async handler: a
+  ``threading.Lock`` acquired on a path reachable from a
+  signal/excepthook/atexit handler — the handler can interrupt the
+  owner mid-critical-section and self-deadlock (the PR 6 SIGTERM-dump
+  incident).
+- **TPU404** blocking call under a lock: an indefinite ``queue``
+  get/put, thread/process ``join``/``wait``, ``sleep`` or network call
+  while holding a lock starves every other acquirer (bounded waits with
+  an explicit ``timeout=`` are exempt, as is ``Condition.wait`` on the
+  condition's own lock, which releases it).
+- **TPU405** unjoined thread: a class starts a thread but no
+  ``close``/``shutdown``/``stop``-family method joins (or shuts down)
+  anything — the PR 3/PR 8 thread-hygiene class (threads started and
+  joined within one method, and module-level process-lifetime daemons,
+  are exempt).
+- **TPU406** future left unresolved: a worker loop resolves Futures via
+  ``set_result`` but the function has no ``set_exception`` path — one
+  exception between dequeue and resolution strands every waiter (the
+  PR 5/6 stranded-Future class).
+
+Suppressions: ``# tpudl: ok(TPU4xx) — reason`` (see
+:mod:`deeplearning4j_tpu.analyze.source`); every suppression must carry
+a reason or it is itself a TPU400 finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Callable, Iterable, Optional
+
+from deeplearning4j_tpu.analyze import source as source_cache
+from deeplearning4j_tpu.analyze.diagnostics import Diagnostic, Report
+
+# ------------------------------------------------------------ classification
+_NONREENTRANT_LOCK_CTORS = {"Lock"}
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+_EVENT_CTORS = {"Event"}
+_QUEUE_CTORS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+                "JoinableQueue"}
+_THREAD_CTORS = {"Thread", "Timer"}
+_THREADSAFE_CTORS = (_LOCK_CTORS | _EVENT_CTORS | _QUEUE_CTORS
+                     | {"Barrier", "deque", "local"})
+_LOCK_NAME_TOKENS = {"lock", "mutex"}
+_QUEUE_NAME_TOKENS = {"queue", "q", "inq", "outq", "jobs"}
+_THREADISH_NAME_TOKENS = {"thread", "threads", "worker", "workers", "proc",
+                          "process", "child", "children", "sender",
+                          "receiver", "writer", "watchdog"}
+_EVENTISH_NAME_TOKENS = {"event", "cond", "condition", "wake", "drained",
+                         "stop", "stopped", "closed", "done", "ready",
+                         "barrier"}
+_FUTURE_NAME_TOKENS = {"fut", "future", "futures"}
+_MUTATOR_ATTRS = {"append", "appendleft", "extend", "extendleft", "add",
+                  "update", "insert", "remove", "discard", "pop", "popleft",
+                  "popitem", "clear", "setdefault"}
+_CLEANUP_NAMES = {"close", "shutdown", "stop", "join", "terminate",
+                  "__exit__", "__del__", "abort"}
+_HANDLER_BASE_TOKENS = ("HTTPRequestHandler",)
+
+
+def _name_tokens(name: str) -> set[str]:
+    return set(name.lower().strip("_").split("_"))
+
+
+def _call_name(func: ast.expr) -> str:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def _ctor_name(value: ast.expr) -> Optional[str]:
+    """``threading.Lock()`` / ``Queue()`` / ``deque()`` → the ctor's
+    bare name; None for anything else."""
+    if not isinstance(value, ast.Call):
+        return None
+    return _call_name(value.func) or None
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _has_real_timeout(call: ast.Call) -> bool:
+    """An explicit, non-None timeout bounds the wait."""
+    value = _kw(call, "timeout") or _kw(call, "timeout_s")
+    if value is None:
+        return False
+    return not (isinstance(value, ast.Constant) and value.value is None)
+
+
+def _bounded_positional(call: ast.Call) -> bool:
+    """``.join(t)`` / ``.wait(t)`` — the first positional IS the
+    timeout for Thread.join/Event.wait/Condition.wait."""
+    if not call.args:
+        return False
+    arg = call.args[0]
+    return not (isinstance(arg, ast.Constant) and arg.value is None)
+
+
+# ------------------------------------------------------------------- facts
+class Site:
+    """One interesting point in a unit's body."""
+
+    __slots__ = ("what", "lineno", "held")
+
+    def __init__(self, what: str, lineno: int, held: frozenset):
+        self.what = what          # attr name / lock id / description
+        self.lineno = lineno
+        self.held = held          # lock ids held at this point (local)
+
+
+class UnitFacts:
+    """Per-callable facts: a method, module function, or nested def."""
+
+    def __init__(self, key: tuple[str, str], node: ast.AST):
+        self.key = key            # (class name or "", qualified name)
+        self.node = node
+        self.writes: list[Site] = []     # self.<attr> stores/mutations
+        self.reads: list[Site] = []      # self.<attr> loads
+        self.acquires: list[Site] = []   # lock id acquired (with/acquire)
+        self.blocking: list[Site] = []   # potentially-indefinite waits
+        self.calls: list[Site] = []      # resolvable intra-module calls
+        self.thread_starts: list[tuple[Optional[tuple], int]] = []
+        self.joins: list[int] = []       # .join()/.shutdown() linenos
+        self.set_results_in_loop: list[int] = []
+        self.has_set_exception = False
+
+    @property
+    def name(self) -> str:
+        cls, fn = self.key
+        return f"{cls}.{fn}" if cls else fn
+
+
+class ClassModel:
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.name = node.name
+        self.bases: list[str] = []
+        for base in node.bases:
+            self.bases.append(base.attr if isinstance(base, ast.Attribute)
+                              else getattr(base, "id", ""))
+        self.methods: dict[str, ast.AST] = {}
+        self.attr_ctors: dict[str, str] = {}      # self.X = Ctor()
+        self.attr_thread_targets: dict[str, Optional[tuple]] = {}
+        self.lock_attrs: set[str] = set()
+
+    def is_thread_subclass(self) -> bool:
+        return any(b in _THREAD_CTORS for b in self.bases)
+
+    def is_http_handler(self) -> bool:
+        return any(any(tok in b for tok in _HANDLER_BASE_TOKENS)
+                   for b in self.bases)
+
+
+class EntryPoint:
+    """A root from which a distinct thread of control enters the code."""
+
+    def __init__(self, kind: str, label: str, roots: list[tuple[str, str]],
+                 lineno: int, cls: Optional[str] = None):
+        self.kind = kind          # thread | request | signal | atexit |
+                                  # excepthook | caller
+        self.label = label        # e.g. "thread:_run", "caller API"
+        self.roots = roots        # unit keys this entry starts at
+        self.lineno = lineno
+        self.cls = cls            # owning class name for class entries
+
+    def __repr__(self) -> str:
+        return f"<EntryPoint {self.label} roots={self.roots}>"
+
+
+class ConcurrencyModel:
+    """Everything the rules need, computed once per module."""
+
+    def __init__(self, path: str, tree: ast.Module):
+        self.path = path
+        self.tree = tree
+        self.classes: dict[str, ClassModel] = {}
+        self.module_locks: dict[str, str] = {}     # NAME → ctor
+        self.units: dict[tuple[str, str], UnitFacts] = {}
+        self.entries: list[EntryPoint] = []
+        # lock graph: (held, acquired) → list of (unit name, lineno)
+        self.lock_edges: dict[tuple[str, str], list[tuple[str, int]]] = {}
+        # TPU404 candidates: (desc, unit name, lineno, held ids, root)
+        self.blocking_under_lock: list[tuple] = []
+        _build(self)
+
+    def anchor(self, lineno) -> str:
+        return f"{self.path}:{lineno}"
+
+    def unit(self, key: tuple[str, str]) -> Optional[UnitFacts]:
+        return self.units.get(key)
+
+    # -- entry-point attribute footprints (transitive, lock-aware) -----
+    def entry_writes(self, entry: EntryPoint) -> dict[str, list[tuple]]:
+        """attr → [(unit name, lineno, effective held-lock ids)] over
+        the entry's whole call closure (``__init__`` excluded — it runs
+        before any thread exists)."""
+        out: dict[str, list[tuple]] = {}
+        for unit, ctx in self._closure(entry):
+            # __init__ itself is construction-time (happens-before every
+            # thread start) — but a worker NESTED in __init__ and handed
+            # to Thread(target=...) runs after, so only the exact unit
+            # is exempt
+            if unit.key[1] == "__init__":
+                continue
+            for site in unit.writes:
+                out.setdefault(site.what, []).append(
+                    (unit.name, site.lineno, site.held | ctx))
+        return out
+
+    def entry_reads(self, entry: EntryPoint) -> dict[str, list[tuple]]:
+        out: dict[str, list[tuple]] = {}
+        for unit, ctx in self._closure(entry):
+            for site in unit.reads:
+                out.setdefault(site.what, []).append(
+                    (unit.name, site.lineno, site.held | ctx))
+        return out
+
+    def entry_acquires(self, entry: EntryPoint) -> list[tuple]:
+        """[(lock id, unit name, lineno)] over the entry's closure."""
+        out = []
+        for unit, ctx in self._closure(entry):
+            for site in unit.acquires:
+                out.append((site.what, unit.name, site.lineno))
+        return out
+
+    def _closure(self, entry: EntryPoint) -> list[tuple[UnitFacts,
+                                                        frozenset]]:
+        """(unit, held-lock context) pairs reachable from the entry's
+        roots via resolvable intra-module calls."""
+        seen: set[tuple] = set()
+        stack: list[tuple[tuple, frozenset]] = [
+            (root, frozenset()) for root in entry.roots]
+        out = []
+        while stack:
+            key, ctx = stack.pop()
+            unit = self.units.get(key)
+            if unit is None or (key, ctx) in seen:
+                continue
+            seen.add((key, ctx))
+            out.append((unit, ctx))
+            for call in unit.calls:
+                callee = self._resolve_call(unit, call.what)
+                if callee is not None:
+                    stack.append((callee, ctx | call.held))
+        return out
+
+    def _resolve_call(self, unit: UnitFacts,
+                      callee: str) -> Optional[tuple[str, str]]:
+        """'self.m' → same-class method; bare name → nested sibling,
+        then module function."""
+        cls, fname = unit.key
+        if callee.startswith("self."):
+            key = (cls, callee[5:])
+            return key if key in self.units else None
+        nested = (cls, f"{fname}.{callee}")
+        if nested in self.units:
+            return nested
+        key = ("", callee)
+        return key if key in self.units else None
+
+
+# ------------------------------------------------------------ model builder
+class _UnitScanner:
+    """Walk one callable's statements carrying the held-lock set."""
+
+    def __init__(self, model: ConcurrencyModel, facts: UnitFacts,
+                 cls: Optional[ClassModel]):
+        self.model = model
+        self.facts = facts
+        self.cls = cls
+        self.local_ctors: dict[str, str] = {}            # name → ctor
+        self.local_thread_targets: dict[str, Optional[tuple]] = {}
+        self.threadish_locals: set[str] = set()          # loop vars etc.
+
+    # -- lock identity -------------------------------------------------
+    def lock_id(self, expr: ast.expr) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                and self.cls is not None:
+            attr = expr.attr
+            if attr in self.cls.lock_attrs \
+                    or _name_tokens(attr) & _LOCK_NAME_TOKENS:
+                return f"{self.cls.name}.{attr}"
+            return None
+        if isinstance(expr, ast.Name):
+            name = expr.id
+            if name in self.model.module_locks \
+                    or self.local_ctors.get(name) in _LOCK_CTORS \
+                    or _name_tokens(name) & _LOCK_NAME_TOKENS:
+                return name
+            return None
+        return None
+
+    # -- receiver classification ---------------------------------------
+    def _receiver_ctor(self, recv: ast.expr) -> Optional[str]:
+        if isinstance(recv, ast.Attribute) and \
+                isinstance(recv.value, ast.Name) and recv.value.id == "self" \
+                and self.cls is not None:
+            return self.cls.attr_ctors.get(recv.attr)
+        if isinstance(recv, ast.Name):
+            return self.local_ctors.get(recv.id)
+        return None
+
+    def _receiver_tokens(self, recv: ast.expr) -> set[str]:
+        if isinstance(recv, ast.Attribute):
+            return _name_tokens(recv.attr)
+        if isinstance(recv, ast.Name):
+            return _name_tokens(recv.id)
+        return set()
+
+    def _is_threadish(self, recv: ast.expr) -> bool:
+        if self._receiver_ctor(recv) in (_THREAD_CTORS | _QUEUE_CTORS
+                                         | {"Popen"}):
+            return True
+        if isinstance(recv, ast.Name) and recv.id in self.threadish_locals:
+            return True
+        return bool(self._receiver_tokens(recv) & _THREADISH_NAME_TOKENS)
+
+    # -- blocking classification ---------------------------------------
+    def _blocking_desc(self, call: ast.Call,
+                       held: set[str]) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            if func.id == "sleep":
+                return "sleep()"
+            if func.id == "urlopen":
+                return "urlopen()"
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        attr, recv = func.attr, func.value
+        if attr == "sleep" and isinstance(recv, ast.Name) \
+                and recv.id in {"time", "_time"}:
+            return "time.sleep()"
+        if attr == "urlopen":
+            return "urlopen()"
+        if attr in {"get", "put"}:
+            ctor = self._receiver_ctor(recv)
+            queueish = ctor in _QUEUE_CTORS or \
+                (ctor is None
+                 and self._receiver_tokens(recv) & _QUEUE_NAME_TOKENS)
+            if not queueish or _has_real_timeout(call):
+                return None
+            block = _kw(call, "block")
+            if isinstance(block, ast.Constant) and block.value is False:
+                return None
+            return f"queue .{attr}()"
+        if attr == "join":
+            if not self._is_threadish(recv) or _has_real_timeout(call) \
+                    or _bounded_positional(call):
+                return None
+            return ".join()"
+        if attr == "wait":
+            ctor = self._receiver_ctor(recv)
+            waitish = (ctor in (_EVENT_CTORS | {"Condition", "Popen"})
+                       or self._receiver_tokens(recv)
+                       & (_EVENTISH_NAME_TOKENS | _THREADISH_NAME_TOKENS))
+            if not waitish or _has_real_timeout(call) \
+                    or _bounded_positional(call):
+                return None
+            # Condition.wait on the condition's OWN lock releases it
+            lock = self.lock_id(recv)
+            if lock is not None and held == {lock}:
+                return None
+            return ".wait()"
+        if attr in {"communicate", "result"}:
+            if attr == "result":
+                futureish = (self._receiver_ctor(recv) == "Future"
+                             or self._receiver_tokens(recv)
+                             & _FUTURE_NAME_TOKENS)
+                if not futureish:
+                    return None
+            if _has_real_timeout(call):
+                return None
+            return f".{attr}()"
+        if attr in {"recv", "accept", "connect", "sendall"}:
+            tokens = self._receiver_tokens(recv)
+            if tokens & {"sock", "socket", "conn", "connection"}:
+                return f"socket .{attr}()"
+            return None
+        if attr in {"run", "check_output", "check_call", "call"} \
+                and isinstance(recv, ast.Name) and recv.id == "subprocess" \
+                and not _has_real_timeout(call):
+            return f"subprocess.{attr}()"
+        return None
+
+    # -- thread-target resolution ---------------------------------------
+    def _thread_target(self, call: ast.Call) -> Optional[tuple]:
+        """Unit key the Thread will run, when statically resolvable."""
+        target = _kw(call, "target")
+        if target is None:
+            return None
+        if isinstance(target, ast.Attribute) \
+                and isinstance(target.value, ast.Name) \
+                and target.value.id == "self" and self.cls is not None:
+            return (self.cls.name, target.attr)
+        if isinstance(target, ast.Name):
+            cls, fname = self.facts.key
+            nested = (cls, f"{fname}.{target.id}")
+            if nested in self.model.units:
+                return nested
+            return ("", target.id)
+        return None
+
+    # -- statement walk --------------------------------------------------
+    def scan(self) -> None:
+        body = getattr(self.facts.node, "body", [])
+        self._scan_stmts(body, set(), in_loop=False)
+
+    def _scan_stmts(self, stmts: list, held: set[str],
+                    in_loop: bool) -> None:
+        for stmt in stmts:
+            self._scan_stmt(stmt, held, in_loop)
+
+    def _scan_stmt(self, stmt: ast.stmt, held: set[str],
+                   in_loop: bool) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return   # nested defs are separate units, pre-registered
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            acquired = []
+            for item in stmt.items:
+                self._scan_expr(item.context_expr, held, in_loop)
+                lock = self.lock_id(item.context_expr)
+                if lock is not None:
+                    self.facts.acquires.append(
+                        Site(lock, stmt.lineno, frozenset(held)))
+                    acquired.append(lock)
+            inner = set(held) | set(acquired)
+            self._scan_stmts(stmt.body, inner, in_loop)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = getattr(stmt, "value", None)
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            if value is not None:
+                self._scan_expr(value, held, in_loop)
+                self._track_assignment(targets, value)
+            for target in targets:
+                self._scan_target(target, stmt.lineno, held)
+            return
+        if isinstance(stmt, ast.Delete):
+            for target in stmt.targets:
+                self._scan_target(target, stmt.lineno, held)
+            return
+        if isinstance(stmt, ast.For):
+            self._scan_expr(stmt.iter, held, in_loop)
+            self._track_loop_var(stmt)
+            self._scan_stmts(stmt.body, set(held), in_loop=True)
+            self._scan_stmts(stmt.orelse, set(held), in_loop)
+            return
+        if isinstance(stmt, ast.While):
+            self._scan_expr(stmt.test, held, in_loop)
+            self._scan_stmts(stmt.body, set(held), in_loop=True)
+            self._scan_stmts(stmt.orelse, set(held), in_loop)
+            return
+        if isinstance(stmt, ast.If):
+            self._scan_expr(stmt.test, held, in_loop)
+            self._scan_stmts(stmt.body, set(held), in_loop)
+            self._scan_stmts(stmt.orelse, set(held), in_loop)
+            return
+        if isinstance(stmt, ast.Try):
+            self._scan_stmts(stmt.body, held, in_loop)
+            for handler in stmt.handlers:
+                self._scan_stmts(handler.body, set(held), in_loop)
+            self._scan_stmts(stmt.orelse, set(held), in_loop)
+            self._scan_stmts(stmt.finalbody, held, in_loop)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._scan_expr(stmt.value, held, in_loop, stmt_level=True)
+            return
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            self._scan_expr(stmt.value, held, in_loop)
+            return
+        if isinstance(stmt, (ast.Raise, ast.Assert)):
+            for sub in ast.iter_child_nodes(stmt):
+                if isinstance(sub, ast.expr):
+                    self._scan_expr(sub, held, in_loop)
+            return
+        for sub in ast.iter_child_nodes(stmt):
+            if isinstance(sub, ast.expr):
+                self._scan_expr(sub, held, in_loop)
+            elif isinstance(sub, ast.stmt):
+                self._scan_stmt(sub, held, in_loop)
+
+    def _track_assignment(self, targets: list, value: ast.expr) -> None:
+        ctor = _ctor_name(value)
+        if ctor is None:
+            return
+        thread_target = (self._thread_target(value)
+                         if isinstance(value, ast.Call)
+                         and ctor in _THREAD_CTORS else None)
+        for target in targets:
+            if isinstance(target, ast.Name):
+                self.local_ctors[target.id] = ctor
+                if ctor in _THREAD_CTORS:
+                    self.local_thread_targets[target.id] = thread_target
+            elif isinstance(target, ast.Attribute) \
+                    and isinstance(target.value, ast.Name) \
+                    and target.value.id == "self" and self.cls is not None:
+                self.cls.attr_ctors[target.attr] = ctor
+                if ctor in _LOCK_CTORS:
+                    self.cls.lock_attrs.add(target.attr)
+                if ctor in _THREAD_CTORS:
+                    self.cls.attr_thread_targets[target.attr] = thread_target
+
+    def _track_loop_var(self, stmt: ast.For) -> None:
+        """``for t in self._threads:`` marks ``t`` thread-like."""
+        iter_tokens = set()
+        for node in ast.walk(stmt.iter):
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                iter_tokens |= self._receiver_tokens(node)
+        if iter_tokens & _THREADISH_NAME_TOKENS:
+            for node in ast.walk(stmt.target):
+                if isinstance(node, ast.Name):
+                    self.threadish_locals.add(node.id)
+
+    def _scan_target(self, target: ast.expr, lineno: int,
+                     held: set[str]) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._scan_target(elt, lineno, held)
+            return
+        if isinstance(target, ast.Subscript):
+            target = target.value   # self.X[k] = v writes self.X
+        if isinstance(target, ast.Attribute) \
+                and isinstance(target.value, ast.Name) \
+                and target.value.id == "self":
+            self.facts.writes.append(
+                Site(target.attr, lineno, frozenset(held)))
+
+    def _scan_expr(self, expr: ast.expr, held: set[str], in_loop: bool,
+                   stmt_level: bool = False) -> None:
+        # shallow walk: a lambda/nested-def body runs in its own context
+        stack: list[ast.AST] = [expr]
+        nodes: list[ast.AST] = []
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                continue
+            nodes.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        for node in nodes:
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self" \
+                    and isinstance(node.ctx, ast.Load):
+                self.facts.reads.append(
+                    Site(node.attr, node.lineno, frozenset(held)))
+            if not isinstance(node, ast.Call):
+                continue
+            self._scan_call(node, held, in_loop,
+                            stmt_level=(stmt_level and node is expr))
+
+    def _scan_call(self, call: ast.Call, held: set[str], in_loop: bool,
+                   stmt_level: bool) -> None:
+        func = call.func
+        attr = func.attr if isinstance(func, ast.Attribute) else None
+
+        # explicit acquire/release as statements extend the held span
+        if attr in {"acquire", "release"} and isinstance(func,
+                                                        ast.Attribute):
+            lock = self.lock_id(func.value)
+            if lock is not None:
+                if attr == "acquire":
+                    self.facts.acquires.append(
+                        Site(lock, call.lineno, frozenset(held)))
+                    if stmt_level:
+                        held.add(lock)
+                elif stmt_level:
+                    held.discard(lock)
+                return
+
+        # mutations of self attributes through methods
+        if attr in _MUTATOR_ATTRS and isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Attribute) \
+                and isinstance(func.value.value, ast.Name) \
+                and func.value.value.id == "self":
+            self.facts.writes.append(
+                Site(func.value.attr, call.lineno, frozenset(held)))
+
+        # thread starts
+        if attr == "start" and isinstance(func, ast.Attribute):
+            recv = func.value
+            target = None
+            started = False
+            if isinstance(recv, ast.Call) \
+                    and _ctor_name(recv) in _THREAD_CTORS:
+                started = True     # Thread(...).start() inline
+                target = self._thread_target(recv)
+            elif isinstance(recv, ast.Name) \
+                    and recv.id in self.local_thread_targets:
+                started = True
+                target = self.local_thread_targets[recv.id]
+            elif isinstance(recv, ast.Attribute) \
+                    and isinstance(recv.value, ast.Name) \
+                    and recv.value.id == "self" and self.cls is not None \
+                    and recv.attr in self.cls.attr_thread_targets:
+                started = True
+                target = self.cls.attr_thread_targets[recv.attr]
+            if started:
+                self.facts.thread_starts.append((target, call.lineno))
+
+        # joins/shutdowns (TPU405 evidence; a bounded join still counts
+        # as cleanup — the class TRIED).  A join only counts when the
+        # receiver is thread/queue/process-shaped: os.path.join or
+        # ", ".join must never read as thread hygiene.
+        if isinstance(func, ast.Attribute):
+            if attr == "join" and self._is_threadish(func.value):
+                self.facts.joins.append(call.lineno)
+            elif attr in {"shutdown", "server_close"}:
+                self.facts.joins.append(call.lineno)
+
+        # future resolution (TPU406)
+        if attr == "set_result" and in_loop:
+            self.facts.set_results_in_loop.append(call.lineno)
+        if attr == "set_exception":
+            self.facts.has_set_exception = True
+
+        # blocking classification (TPU404 raw material)
+        desc = self._blocking_desc(call, held)
+        if desc is not None:
+            self.facts.blocking.append(
+                Site(desc, call.lineno, frozenset(held)))
+
+        # resolvable intra-module calls
+        if isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id == "self":
+            self.facts.calls.append(
+                Site(f"self.{func.attr}", call.lineno, frozenset(held)))
+        elif isinstance(func, ast.Name):
+            self.facts.calls.append(
+                Site(func.id, call.lineno, frozenset(held)))
+
+
+def _register_units(model: ConcurrencyModel, node: ast.AST,
+                    cls_name: str, prefix: str) -> None:
+    """Register ``node`` and its nested defs as units."""
+    qual = f"{prefix}.{node.name}" if prefix else node.name
+    key = (cls_name, qual)
+    model.units[key] = UnitFacts(key, node)
+    for stmt in ast.walk(node):
+        if stmt is node:
+            continue
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # only one nesting level of naming: deeper defs keep the
+            # immediate parent's prefix, which is enough to resolve the
+            # nested-thread-target idiom
+            parent_key = (cls_name, f"{qual}.{stmt.name}")
+            if parent_key not in model.units:
+                model.units[parent_key] = UnitFacts(parent_key, stmt)
+
+
+def _build(model: ConcurrencyModel) -> None:
+    # pass 1: classes, module locks, unit registration
+    module_fn_nodes: list[ast.AST] = []
+    for stmt in model.tree.body:
+        if isinstance(stmt, ast.ClassDef):
+            cls = ClassModel(stmt)
+            model.classes[cls.name] = cls
+            for sub in stmt.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    cls.methods[sub.name] = sub
+                    _register_units(model, sub, cls.name, "")
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            module_fn_nodes.append(stmt)
+            _register_units(model, stmt, "", "")
+        elif isinstance(stmt, ast.Assign):
+            ctor = _ctor_name(stmt.value)
+            if ctor in _LOCK_CTORS:
+                for target in stmt.targets:
+                    if isinstance(target, ast.Name):
+                        model.module_locks[target.id] = ctor
+
+    # pass 2: pre-scan assignments so attr ctors (locks, threads,
+    # queues) are known before any method body is interpreted — a lock
+    # created in __init__ must be recognized in methods defined earlier
+    for cls in model.classes.values():
+        for method in cls.methods.values():
+            for node in ast.walk(method):
+                if isinstance(node, ast.Assign):
+                    ctor = _ctor_name(node.value)
+                    if ctor is None:
+                        continue
+                    for target in node.targets:
+                        if isinstance(target, ast.Attribute) \
+                                and isinstance(target.value, ast.Name) \
+                                and target.value.id == "self":
+                            cls.attr_ctors.setdefault(target.attr, ctor)
+                            if ctor in _LOCK_CTORS:
+                                cls.lock_attrs.add(target.attr)
+
+    # pass 3: scan every unit's body
+    for key, facts in model.units.items():
+        cls = model.classes.get(key[0]) if key[0] else None
+        _UnitScanner(model, facts, cls).scan()
+
+    _discover_entries(model)
+    _build_lock_graph(model)
+
+
+def _discover_entries(model: ConcurrencyModel) -> None:
+    entries = model.entries
+    thread_roots: set[tuple] = set()
+
+    # Thread targets recorded by scanners
+    for facts in model.units.values():
+        for target, lineno in facts.thread_starts:
+            if target is not None and target in model.units:
+                if target not in thread_roots:
+                    thread_roots.add(target)
+                    cls = target[0] or None
+                    entries.append(EntryPoint(
+                        "thread", f"thread:{model.units[target].name}",
+                        [target], lineno, cls=cls))
+
+    for cls in model.classes.values():
+        # Thread subclasses: run() is the entry
+        if cls.is_thread_subclass() and "run" in cls.methods:
+            key = (cls.name, "run")
+            if key not in thread_roots:
+                thread_roots.add(key)
+                entries.append(EntryPoint(
+                    "thread", f"thread:{cls.name}.run", [key],
+                    cls.methods["run"].lineno, cls=cls.name))
+        # HTTP request handlers: each do_* runs on a request thread
+        do_methods = [m for m in cls.methods if m.startswith("do_")]
+        if do_methods and (cls.is_http_handler()
+                           or "Handler" in cls.name):
+            for m in do_methods:
+                key = (cls.name, m)
+                thread_roots.add(key)
+                entries.append(EntryPoint(
+                    "request", f"request:{cls.name}.{m}", [key],
+                    cls.methods[m].lineno, cls=cls.name))
+
+    # signal/atexit/excepthook handlers
+    handler_seen: set[tuple] = set()
+    for facts in model.units.values():
+        for node in ast.walk(facts.node):
+            kind, handler = _handler_registration(node)
+            if kind is None:
+                continue
+            key = _handler_key(model, facts, handler)
+            if key is not None and key in model.units \
+                    and (kind, key) not in handler_seen:
+                handler_seen.add((kind, key))
+                thread_roots.add(key)
+                entries.append(EntryPoint(
+                    kind, f"{kind}:{model.units[key].name}", [key],
+                    getattr(node, "lineno", 0), cls=key[0] or None))
+
+    # one "caller" pseudo-entry per class: the public API user threads
+    # drive (construction excluded — it happens-before every thread)
+    for cls in model.classes.values():
+        roots = []
+        for name, node in cls.methods.items():
+            key = (cls.name, name)
+            if key in thread_roots or name == "__init__":
+                continue
+            if name.startswith("_") and name not in {"__enter__",
+                                                     "__exit__",
+                                                     "__call__"}:
+                continue
+            roots.append(key)
+        if roots:
+            entries.append(EntryPoint(
+                "caller", "caller API", sorted(roots), cls.node.lineno,
+                cls=cls.name))
+
+
+def _handler_registration(node: ast.AST):
+    """(kind, handler expr) for signal.signal/atexit.register calls and
+    sys.excepthook/threading.excepthook assignments."""
+    if isinstance(node, ast.Call):
+        name = _call_name(node.func)
+        recv = (node.func.value if isinstance(node.func, ast.Attribute)
+                else None)
+        recv_id = recv.id if isinstance(recv, ast.Name) else None
+        if name == "signal" and recv_id == "signal" and len(node.args) >= 2:
+            return "signal", node.args[1]
+        if name == "register" and recv_id == "atexit" and node.args:
+            return "atexit", node.args[0]
+    if isinstance(node, ast.Assign) and len(node.targets) == 1:
+        target = node.targets[0]
+        if isinstance(target, ast.Attribute) and target.attr == "excepthook" \
+                and isinstance(target.value, ast.Name) \
+                and target.value.id in {"sys", "threading"}:
+            return "excepthook", node.value
+    return None, None
+
+
+def _handler_key(model: ConcurrencyModel, facts: UnitFacts,
+                 handler: ast.AST) -> Optional[tuple]:
+    if isinstance(handler, ast.Attribute) \
+            and isinstance(handler.value, ast.Name) \
+            and handler.value.id == "self":
+        return (facts.key[0], handler.attr)
+    if isinstance(handler, ast.Name):
+        cls, fname = facts.key
+        nested = (cls, f"{fname}.{handler.id}")
+        if nested in model.units:
+            return nested
+        return ("", handler.id)
+    return None
+
+
+def _build_lock_graph(model: ConcurrencyModel) -> None:
+    """Lock-order edges and blocking-under-lock sites over EVERY unit's
+    closure (not just discovered entries — a lock path is dangerous no
+    matter which thread walks it)."""
+    visited: set[tuple] = set()
+
+    def visit(key: tuple, ctx: frozenset, root: str) -> None:
+        unit = model.units.get(key)
+        if unit is None or (key, ctx) in visited:
+            return
+        visited.add((key, ctx))
+        for site in unit.acquires:
+            effective = site.held | ctx
+            for held_lock in effective:
+                # held == acquired is a self-edge: a problem only for a
+                # non-reentrant Lock (TPU401 handles the distinction)
+                model.lock_edges.setdefault(
+                    (held_lock, site.what), []).append(
+                    (unit.name, site.lineno))
+        for site in unit.blocking:
+            effective = site.held | ctx
+            if effective:
+                model.blocking_under_lock.append(
+                    (site.what, unit.name, site.lineno,
+                     frozenset(effective), root))
+        for call in unit.calls:
+            callee = model._resolve_call(unit, call.what)
+            if callee is not None:
+                visit(callee, ctx | call.held, root)
+
+    for key in list(model.units):
+        visit(key, frozenset(), model.units[key].name)
+
+
+def build_model(path: str, tree: Optional[ast.Module] = None
+                ) -> ConcurrencyModel:
+    """Public hook (tests, downstream tooling): the per-module model."""
+    if tree is None:
+        tree = source_cache.load_source(path).tree
+    return ConcurrencyModel(path, tree)
+
+
+# ------------------------------------------------------------ rule registry
+CONCURRENCY_RULES: dict[str, Callable[[ConcurrencyModel],
+                                      list[Diagnostic]]] = {}
+
+
+def register_concurrency_rule(rule_id: str):
+    """Add a concurrency rule: ``fn(model) -> list[Diagnostic]``.
+    Third-party rules register the same way the builtin ones do
+    (mirrors ``lint.register_lint_rule``)."""
+    def deco(fn):
+        CONCURRENCY_RULES[rule_id] = fn
+        return fn
+    return deco
+
+
+def _ctor_of(model: ConcurrencyModel, lock_id: str) -> Optional[str]:
+    if "." in lock_id:
+        cls_name, attr = lock_id.split(".", 1)
+        cls = model.classes.get(cls_name)
+        return cls.attr_ctors.get(attr) if cls else None
+    return model.module_locks.get(lock_id)
+
+
+@register_concurrency_rule("TPU401")
+def _rule_lock_order_inversion(model: ConcurrencyModel) -> list[Diagnostic]:
+    out = []
+    graph: dict[str, set[str]] = {}
+    for (a, b), witnesses in model.lock_edges.items():
+        if a == b:
+            # one-lock cycle: re-acquiring a non-reentrant Lock on the
+            # same path self-deadlocks unconditionally
+            if _ctor_of(model, a) in _NONREENTRANT_LOCK_CTORS:
+                unit, lineno = witnesses[0]
+                out.append(Diagnostic(
+                    "TPU401",
+                    f"'{unit}' acquires non-reentrant lock {a} while "
+                    f"already holding it — threading.Lock self-deadlocks "
+                    f"on re-entry",
+                    path=model.anchor(lineno)))
+            continue
+        graph.setdefault(a, set()).add(b)
+
+    # cycle detection with path reconstruction, deduped by node set
+    reported: set[frozenset] = set()
+
+    def dfs(node: str, path: list[str], on_path: set[str],
+            done: set[str]) -> None:
+        on_path.add(node)
+        path.append(node)
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in on_path:
+                cycle = path[path.index(nxt):]
+                cycle_key = frozenset(cycle)
+                if cycle_key not in reported:
+                    reported.add(cycle_key)
+                    out.append(_cycle_diagnostic(model, cycle))
+            elif nxt not in done:
+                dfs(nxt, path, on_path, done)
+        on_path.discard(node)
+        path.pop()
+        done.add(node)
+
+    done: set[str] = set()
+    for node in sorted(graph):
+        if node not in done:
+            dfs(node, [], set(), done)
+    return out
+
+
+def _cycle_diagnostic(model: ConcurrencyModel,
+                      cycle: list[str]) -> Diagnostic:
+    legs = []
+    first_line = None
+    for i, a in enumerate(cycle):
+        b = cycle[(i + 1) % len(cycle)]
+        witnesses = model.lock_edges.get((a, b), [])
+        if witnesses:
+            unit, lineno = witnesses[0]
+            if first_line is None:
+                first_line = lineno
+            legs.append(f"'{unit}' acquires {b} while holding {a} "
+                        f"(line {lineno})")
+    locks = " -> ".join(cycle + [cycle[0]])
+    return Diagnostic(
+        "TPU401",
+        f"lock-order inversion {locks}: " + "; ".join(legs)
+        + " — threads interleaving these paths deadlock",
+        path=model.anchor(first_line if first_line is not None else
+                          getattr(model.tree, 'lineno', 1)))
+
+
+@register_concurrency_rule("TPU402")
+def _rule_unlocked_shared_write(model: ConcurrencyModel) -> list[Diagnostic]:
+    out = []
+    for cls in model.classes.values():
+        cls_entries = [e for e in model.entries if e.cls == cls.name]
+        if len(cls_entries) < 2:
+            continue
+        # attr → entry label → write sites
+        writers: dict[str, dict[str, list[tuple]]] = {}
+        for entry in cls_entries:
+            for attr, sites in model.entry_writes(entry).items():
+                writers.setdefault(attr, {}).setdefault(
+                    entry.label, []).extend(sites)
+        for attr in sorted(writers):
+            if cls.attr_ctors.get(attr) in _THREADSAFE_CTORS:
+                continue
+            by_entry = writers[attr]
+            if len(by_entry) < 2:
+                continue
+            all_sites = [s for sites in by_entry.values() for s in sites]
+            common = frozenset.intersection(
+                *[frozenset(held) for _, _, held in all_sites])
+            if common:
+                continue
+            parts = []
+            for label in sorted(by_entry):
+                unit, lineno, held = by_entry[label][0]
+                held_txt = (f" under {sorted(held)}" if held
+                            else " with no lock")
+                parts.append(f"{label} ('{unit}' line {lineno}{held_txt})")
+            anchor_line = min(lineno for _, lineno, _ in all_sites)
+            out.append(Diagnostic(
+                "TPU402",
+                f"self.{attr} of {cls.name} is written from "
+                f"{len(by_entry)} entry points with no common lock: "
+                + "; ".join(parts),
+                path=model.anchor(anchor_line)))
+    return out
+
+
+@register_concurrency_rule("TPU403")
+def _rule_nonreentrant_lock_in_handler(model: ConcurrencyModel
+                                       ) -> list[Diagnostic]:
+    out = []
+    seen: set[tuple] = set()
+    for entry in model.entries:
+        if entry.kind not in {"signal", "excepthook", "atexit"}:
+            continue
+        for lock, unit, lineno in model.entry_acquires(entry):
+            if _ctor_of(model, lock) not in _NONREENTRANT_LOCK_CTORS:
+                continue
+            key = (entry.label, lock, lineno)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(Diagnostic(
+                "TPU403",
+                f"non-reentrant threading.Lock {lock} is acquired in "
+                f"'{unit}', reachable from {entry.label} — the handler "
+                f"can fire while the interrupted thread holds the lock "
+                f"and self-deadlock; use threading.RLock on "
+                f"handler-reachable paths",
+                path=model.anchor(lineno)))
+    return out
+
+
+@register_concurrency_rule("TPU404")
+def _rule_blocking_call_under_lock(model: ConcurrencyModel
+                                   ) -> list[Diagnostic]:
+    out = []
+    seen: set[tuple] = set()
+    for desc, unit, lineno, held, root in model.blocking_under_lock:
+        key = (desc, lineno)
+        if key in seen:
+            continue
+        seen.add(key)
+        via = f" (on a path from '{root}')" if root != unit else ""
+        out.append(Diagnostic(
+            "TPU404",
+            f"{desc} in '{unit}' can block indefinitely while holding "
+            f"{sorted(held)}{via} — every other acquirer stalls behind "
+            f"it; release the lock first or bound the wait with a "
+            f"timeout",
+            path=model.anchor(lineno)))
+    return out
+
+
+@register_concurrency_rule("TPU405")
+def _rule_unjoined_thread(model: ConcurrencyModel) -> list[Diagnostic]:
+    out = []
+    for cls in model.classes.values():
+        starts = []
+        for name in cls.methods:
+            facts = model.units.get((cls.name, name))
+            if facts is None:
+                continue
+            for target, lineno in facts.thread_starts:
+                # a thread started AND joined within the same method is
+                # scoped (fork/join) — not a lifecycle leak
+                if facts.joins:
+                    continue
+                starts.append((name, lineno))
+        if not starts:
+            continue
+        cleanup_roots = [(cls.name, m) for m in cls.methods
+                         if m in _CLEANUP_NAMES]
+        cleans_up = False
+        if cleanup_roots:
+            entry = EntryPoint("cleanup", "cleanup", cleanup_roots, 0,
+                               cls=cls.name)
+            for unit, _ctx in model._closure(entry):
+                if unit.joins:
+                    cleans_up = True
+                    break
+        if cleans_up:
+            continue
+        for method, lineno in starts:
+            out.append(Diagnostic(
+                "TPU405",
+                f"{cls.name}.{method} starts a thread but no "
+                f"close()/shutdown()/stop() method of {cls.name} joins "
+                f"or shuts anything down — the thread outlives the "
+                f"object and teardown can't drain it",
+                path=model.anchor(lineno)))
+    return out
+
+
+@register_concurrency_rule("TPU406")
+def _rule_future_left_unresolved(model: ConcurrencyModel
+                                 ) -> list[Diagnostic]:
+    out = []
+    for facts in model.units.values():
+        if not facts.set_results_in_loop or facts.has_set_exception:
+            continue
+        out.append(Diagnostic(
+            "TPU406",
+            f"worker loop in '{facts.name}' resolves Futures with "
+            f"set_result but the function has no set_exception path — "
+            f"an exception mid-iteration strands every waiter on an "
+            f"unresolved Future",
+            path=model.anchor(facts.set_results_in_loop[0])))
+    return out
+
+
+# ----------------------------------------------------------------- drivers
+def analyze_concurrency_paths(paths: Iterable[str],
+                              rules: Optional[dict] = None) -> Report:
+    """Run the concurrency rules over files/directories, honoring
+    suppression pragmas.  ``rules`` defaults to every registered rule."""
+    def count_entries(report: Report, model: ConcurrencyModel) -> None:
+        report.context["entry_points"] = (
+            report.context.get("entry_points", 0)
+            + sum(1 for e in model.entries if e.kind != "caller"))
+
+    report = source_cache.run_ast_family(
+        paths, rules if rules is not None else CONCURRENCY_RULES,
+        build=ConcurrencyModel, facts_family="concurrency",
+        count_key="files_analyzed", on_model=count_entries,
+        missing_message="path does not exist — nothing was analyzed",
+        missing_hint="Fix the --concurrency path (a typo here must "
+                     "not read as a clean gate).")
+    report.context.setdefault("entry_points", 0)
+    return report
+
+
+def analyze_concurrency_package(package_dir: Optional[str] = None) -> Report:
+    """The ``--concurrency --self`` check: concurrency rules over the
+    framework tree."""
+    if package_dir is None:
+        import deeplearning4j_tpu
+        package_dir = os.path.dirname(os.path.abspath(
+            deeplearning4j_tpu.__file__))
+    return analyze_concurrency_paths([package_dir])

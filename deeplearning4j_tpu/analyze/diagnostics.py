@@ -248,9 +248,114 @@ _RULE_LIST = [
         "Route the deploy through online.gate.GatedDeployer."
         "deploy_if_better (or EvalGate + your own decision record); "
         "only gate.py itself may touch ModelRegistry.deploy."),
+    # ---- concurrency (AST, whole-repo thread model) -------------------
+    RuleInfo(
+        "TPU400", "bad-suppression", ERROR,
+        "Suppression pragma without a reason, or naming an unknown/"
+        "non-AST rule",
+        "A bare '# tpudl: ok(TPU4xx)' silences a finding with no record "
+        "of WHY it is safe — the next reader (or the next refactor) "
+        "has nothing to re-check the justification against.  "
+        "Suppressions are themselves findings until the reason is "
+        "written down.",
+        "Write '# tpudl: ok(TPU4xx) — <why this is safe here>'; only "
+        "TPU3xx/TPU4xx findings (which anchor to a source line) can be "
+        "suppressed."),
+    RuleInfo(
+        "TPU401", "lock-order-inversion", ERROR,
+        "The lock-acquisition graph has a cycle (lock B taken while "
+        "holding A on one path, A while holding B on another), or a "
+        "non-reentrant Lock is re-acquired on a path that already "
+        "holds it",
+        "Two threads interleaving inverted lock orders deadlock the "
+        "process with no exception and no progress — on a gang, one "
+        "wedged worker stalls every peer until the watchdog fires "
+        "(rc=87) and MTTR is paid.  The one-lock variant (threading."
+        "Lock re-entered on the same path) deadlocks unconditionally — "
+        "the class of bug PR 6 fixed by hand in the flight recorder's "
+        "signal path.",
+        "Acquire locks in one global order (document it on the class), "
+        "or collapse the critical sections onto a single lock; for "
+        "re-entry, use threading.RLock."),
+    RuleInfo(
+        "TPU402", "unlocked-shared-write", ERROR,
+        "A self.<attr> is written from two or more thread entry points "
+        "with no lock common to all write sites",
+        "Torn updates and lost writes: the exact class of the PR 8 "
+        "checkpoint-index race (save_now racing a background save "
+        "corrupted keep-last-K) — found then by review, now by rule.  "
+        "Writes in __init__ are exempt (construction happens-before "
+        "thread start); attributes holding locks/events/queues are "
+        "exempt (they are the synchronization).",
+        "Guard every write site with one shared lock, or confine the "
+        "attribute to a single thread and communicate through a "
+        "queue/event."),
+    RuleInfo(
+        "TPU403", "nonreentrant-lock-in-handler", ERROR,
+        "A non-reentrant threading.Lock is acquired on a path reachable "
+        "from a signal/excepthook/atexit handler",
+        "The handler interrupts an arbitrary thread — including the "
+        "one currently HOLDING that lock mid-critical-section; the "
+        "handler then blocks on a lock its own thread owns and the "
+        "process self-deadlocks.  PR 6's SIGTERM dump landing while "
+        "the main thread held the flight-recorder ring lock was "
+        "exactly this; the fix (RLock on every handler-reachable "
+        "path) is now the rule.",
+        "Use threading.RLock for any lock a signal/excepthook/atexit "
+        "path can reach, or make the handler enqueue work for a "
+        "normal thread instead of doing it inline."),
+    RuleInfo(
+        "TPU404", "blocking-call-under-lock", ERROR,
+        "A potentially-indefinite blocking call (queue get/put, "
+        "thread/process join/wait, sleep, network) while holding a "
+        "lock",
+        "Every other thread needing that lock stalls behind a wait "
+        "that may never return — the shape of PR 8's undrained-pipe "
+        "wedge (children blocked on a full pipe nobody was reading "
+        "while the supervisor polled).  Waits with an explicit "
+        "timeout are exempt (bounded); Condition.wait on the "
+        "condition's own lock is exempt (wait releases it).",
+        "Move the blocking call outside the critical section (copy "
+        "what you need under the lock, then release), or bound it "
+        "with a timeout."),
+    RuleInfo(
+        "TPU405", "unjoined-thread", ERROR,
+        "A class starts a thread but no close()/shutdown()/stop()-"
+        "family method joins or shuts anything down",
+        "The thread outlives the object: tests leak threads between "
+        "cases, interpreter shutdown races daemon threads against "
+        "module teardown (the PR 7 gang-child C++ abort was a "
+        "background thread racing interpreter exit), and nothing can "
+        "ever drain in-flight work deterministically.  Threads started "
+        "and joined within one method (fork/join) are exempt, as are "
+        "module-level process-lifetime daemons.",
+        "Add a close()/shutdown() that signals the loop to stop "
+        "(event/sentinel) and joins the thread; wire it into "
+        "__exit__ so `with` scoping works."),
+    RuleInfo(
+        "TPU406", "future-left-unresolved", ERROR,
+        "A worker loop resolves Futures with set_result but has no "
+        "set_exception path",
+        "One exception between dequeue and set_result strands every "
+        "waiter forever — the PR 5 ParallelInference bug (a dead "
+        "worker stranded all later callers) and the PR 6 serve-"
+        "telemetry hardening (observability failures must not strand "
+        "Futures) were both this shape.",
+        "Wrap the per-item work in try/except and resolve EVERY "
+        "future on both paths (set_result on success, set_exception "
+        "on failure) — see serve/engine.py's _dispatch for the "
+        "pattern."),
 ]
 
 RULES: dict[str, RuleInfo] = {r.id: r for r in _RULE_LIST}
+
+_FAMILY_BY_PREFIX = {"TPU1": "model", "TPU2": "sharding",
+                     "TPU3": "lint", "TPU4": "concurrency"}
+
+
+def rule_family(rule_id: str) -> str:
+    """Stable family name for a rule ID (by hundred-block)."""
+    return _FAMILY_BY_PREFIX.get(rule_id[:4], "unknown")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -279,7 +384,14 @@ class Diagnostic:
         return f"{self.rule} [{sev}] {anchor}{self.message}"
 
     def to_dict(self) -> dict:
-        return {"rule": self.rule, "severity": self.effective_severity(),
+        """One finding-object schema shared by every family (model/
+        sharding/lint/concurrency) so CI can diff findings between
+        commits without per-family parsers."""
+        info = RULES.get(self.rule)
+        return {"rule": self.rule,
+                "slug": info.slug if info else None,
+                "family": rule_family(self.rule),
+                "severity": self.effective_severity(),
                 "path": self.path, "message": self.message,
                 "hint": self.effective_hint()}
 
@@ -290,6 +402,10 @@ class Report:
     def __init__(self, diagnostics: Optional[list[Diagnostic]] = None,
                  context: Optional[dict] = None):
         self.diagnostics: list[Diagnostic] = list(diagnostics or [])
+        # findings silenced by a suppression pragma — kept, not
+        # dropped: text output counts them, JSON carries them in full
+        # so CI can diff suppressions between commits
+        self.suppressed: list[Diagnostic] = []
         # free-form facts worth printing even when clean (param counts,
         # footprint estimate, files linted …)
         self.context: dict = dict(context or {})
@@ -299,7 +415,19 @@ class Report:
         self.diagnostics.append(Diagnostic(rule, message, path, severity, hint))
 
     def extend(self, other: "Report") -> "Report":
-        self.diagnostics.extend(other.diagnostics)
+        # exact duplicates merge away: combined CLI modes (--self --lint
+        # --concurrency) may both report the per-file findings a shared
+        # scan produces (TPU300 parse failures, TPU400 pragma problems)
+        seen = set(self.diagnostics)
+        for d in other.diagnostics:
+            if d not in seen:
+                seen.add(d)
+                self.diagnostics.append(d)
+        seen_sup = set(self.suppressed)
+        for d in other.suppressed:
+            if d not in seen_sup:
+                seen_sup.add(d)
+                self.suppressed.append(d)
         for key, value in other.context.items():
             # combined CLI modes (--self --lint …) must not clobber each
             # other's tallies — counts accumulate, other facts overwrite
@@ -339,13 +467,17 @@ class Report:
         n_err = len(self.errors())
         n_warn = sum(1 for d in self.diagnostics
                      if d.effective_severity() == WARNING)
-        lines.append(f"{n_err} error(s), {n_warn} warning(s)")
+        tail = f"{n_err} error(s), {n_warn} warning(s)"
+        if self.suppressed:
+            tail += f", {len(self.suppressed)} suppressed by pragma"
+        lines.append(tail)
         return "\n".join(lines)
 
     def to_json(self) -> str:
         return json.dumps({
             "context": self.context,
             "diagnostics": [d.to_dict() for d in self.sorted()],
+            "suppressed": [d.to_dict() for d in self.suppressed],
             "errors": len(self.errors()),
             "exit_code": self.exit_code(),
         }, indent=2, default=str)

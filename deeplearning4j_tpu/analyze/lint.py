@@ -1017,17 +1017,28 @@ def iter_python_files(paths: Iterable[str]) -> tuple[list[str], list[str]]:
     """(python files to lint, unusable input paths).  Explicitly-named
     files are linted regardless of extension; directories contribute
     their ``*.py`` trees; missing paths are returned, never dropped — a
-    typo'd CI target must not read as a clean lint."""
+    typo'd CI target must not read as a clean lint.  Overlapping inputs
+    (``--lint pkg pkg/sub``) contribute each file once, first spelling
+    wins — double-reported findings would read as double the errors."""
     files, missing = [], []
+    seen: set[str] = set()
+
+    def add(path: str) -> None:
+        key = os.path.abspath(path)
+        if key not in seen:
+            seen.add(key)
+            files.append(path)
+
     for path in paths:
         if os.path.isdir(path):
             for root, dirs, names in os.walk(path):
                 dirs[:] = [d for d in dirs
                            if d not in {"__pycache__", ".git"}]
-                files.extend(os.path.join(root, n) for n in sorted(names)
-                             if n.endswith(".py"))
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        add(os.path.join(root, n))
         elif os.path.isfile(path):
-            files.append(path)
+            add(path)
         else:
             missing.append(path)
     return files, missing
@@ -1036,32 +1047,18 @@ def iter_python_files(paths: Iterable[str]) -> tuple[list[str], list[str]]:
 def lint_paths(paths: Iterable[str],
                rules: Optional[dict] = None) -> Report:
     """Run the AST rules over files/directories.  ``rules`` defaults to
-    every registered rule."""
-    rules = rules if rules is not None else LINT_RULES
-    report = Report()
-    files, missing = iter_python_files(
-        paths if not isinstance(paths, str) else [paths])
-    report.context["files_linted"] = len(files)
-    for path in missing:
-        report.add("TPU300", "path does not exist — nothing was linted",
-                   path=path,
-                   hint="Fix the --lint path (a typo here must not read "
-                        "as a clean gate).")
-    for path in files:
-        try:
-            with open(path, encoding="utf-8") as f:
-                tree = ast.parse(f.read(), filename=path)
-        except SyntaxError as e:
-            report.add("TPU300", f"does not parse: {e.msg}",
-                       path=f"{path}:{e.lineno}")
-            continue
-        except (OSError, ValueError) as e:
-            report.add("TPU300", f"unreadable: {e}", path=path)
-            continue
-        mod = ModuleInfo(path, tree)
-        for rule_fn in rules.values():
-            report.diagnostics.extend(rule_fn(mod))
-    return report
+    every registered rule.  Parsed ASTs come from the shared
+    ``analyze.source`` cache (one parse per file across rule families),
+    and ``# tpudl: ok(...)`` suppression pragmas are honored — see
+    :mod:`deeplearning4j_tpu.analyze.source` (which also owns the
+    shared per-file driver)."""
+    from deeplearning4j_tpu.analyze import source as source_cache
+    return source_cache.run_ast_family(
+        paths, rules if rules is not None else LINT_RULES,
+        build=ModuleInfo, facts_family="lint", count_key="files_linted",
+        missing_message="path does not exist — nothing was linted",
+        missing_hint="Fix the --lint path (a typo here must not read "
+                     "as a clean gate).")
 
 
 def check_metric_names(registry=None) -> Report:

@@ -1,0 +1,271 @@
+"""Shared parsed-source cache + suppression pragmas for ``tpudl.analyze``.
+
+Every rule family (lint TPU3xx, concurrency TPU4xx) analyzes the same
+tree; parsing each module once per family shows up in tier-1 wall time.
+:func:`load_source` is the single door to a file's AST: one
+``ast.parse`` per (path, mtime, size), shared across families within a
+process.  The :class:`SourceFile` also carries the file's suppression
+pragmas and a ``facts`` dict where each family memoizes its derived
+per-module model (lint's ``ModuleInfo``, concurrency's class model).
+
+Suppression pragma
+------------------
+
+::
+
+    # tpudl: ok(TPU402) — writes race only during shutdown, see close()
+    # tpudl: ok(TPU404,TPU311) — bounded wait, coordinator is local
+
+A pragma suppresses matching AST-family findings (``TPU3xx``/``TPU4xx``)
+anchored at its own line, or — when the pragma sits on a line of its own
+— at the line directly below.  The reason text after the dash is
+MANDATORY: a bare ``# tpudl: ok(TPU402)`` still suppresses, but is
+itself a ``TPU400`` error, so the gate stays red until someone writes
+down *why* the finding is fine.  Unknown rule IDs, rules outside the
+AST families (pragmas cannot excuse a model/graph error), and ``TPU400``
+itself (a pragma problem is fixed by fixing the pragma, never by
+suppressing the complaint) are ``TPU400`` too.  Suppressed findings stay visible: text output counts them, JSON
+carries them in full under ``"suppressed"`` so CI can diff suppressions
+between commits like any other finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import threading
+import tokenize
+from typing import Any, Optional
+
+from deeplearning4j_tpu.analyze.diagnostics import Diagnostic, RULES
+
+PRAGMA_RE = re.compile(r"tpudl:\s*ok\s*\(([^)]*)\)\s*(.*)$")
+_RULE_ID_RE = re.compile(r"^TPU\d{3}$")
+# families a pragma may suppress: the AST rules, which anchor findings
+# to file:line.  Model/graph/sharding findings anchor to layer paths —
+# a line pragma has nothing to attach to there.
+_SUPPRESSIBLE_PREFIXES = ("TPU3", "TPU4")
+
+
+@dataclasses.dataclass(frozen=True)
+class Pragma:
+    lineno: int               # line the comment sits on
+    rules: tuple[str, ...]    # rule IDs inside ok(...)
+    reason: str               # "" when missing — a TPU400 finding
+    standalone: bool          # comment-only line → applies to lineno+1
+    raw: str
+
+
+def _scan_pragmas(text: str) -> list[Pragma]:
+    """Pragmas from COMMENT tokens only — a pragma example inside a
+    docstring or test-fixture string must not suppress anything."""
+    out: list[Pragma] = []
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(text).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = PRAGMA_RE.search(tok.string)
+            if not m:
+                continue
+            rules = tuple(r.strip() for r in m.group(1).split(",")
+                          if r.strip())
+            reason = m.group(2).strip().lstrip("-—–:, \t").strip()
+            standalone = tok.line[:tok.start[1]].strip() == ""
+            out.append(Pragma(tok.start[0], rules, reason, standalone,
+                              tok.string.strip()))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass   # an unparseable file is TPU300 territory, not ours
+    return out
+
+
+class SourceFile:
+    """One parsed module: text + AST + pragmas + per-family fact memo."""
+
+    def __init__(self, path: str, text: str, tree: ast.Module):
+        self.path = path
+        self.text = text
+        self.tree = tree
+        self.pragmas: list[Pragma] = _scan_pragmas(text)
+        # rule families stash derived models here (keyed by family name)
+        # so combined runs build each model once per file
+        self.facts: dict[str, Any] = {}
+        # line → rule IDs suppressed there (valid AND bare pragmas both
+        # suppress; bare ones additionally raise TPU400)
+        self._suppress_at: dict[int, set[str]] = {}
+        for pragma in self.pragmas:
+            target = pragma.lineno + 1 if pragma.standalone else pragma.lineno
+            # TPU400 itself is never suppressible: a pragma problem is
+            # fixed by fixing the pragma, not by stacking another one
+            ok_rules = {r for r in pragma.rules
+                        if r in RULES and r != "TPU400"
+                        and r.startswith(_SUPPRESSIBLE_PREFIXES)}
+            self._suppress_at.setdefault(target, set()).update(ok_rules)
+
+    def suppresses(self, rule: str, lineno: int) -> bool:
+        return rule in self._suppress_at.get(lineno, ())
+
+
+# ------------------------------------------------------------------ cache
+_CACHE: dict[str, tuple[tuple, SourceFile]] = {}
+_CACHE_LOCK = threading.Lock()
+CACHE_STATS = {"parses": 0, "hits": 0}
+
+
+def _stat_key(path: str) -> tuple:
+    st = os.stat(path)
+    return (st.st_mtime_ns, st.st_size)
+
+
+def load_source(path: str) -> SourceFile:
+    """Parse ``path`` once per content version; raises ``OSError`` /
+    ``SyntaxError`` like ``open``+``ast.parse`` would."""
+    path = os.path.abspath(path)
+    key = _stat_key(path)
+    with _CACHE_LOCK:
+        hit = _CACHE.get(path)
+        if hit is not None and hit[0] == key:
+            CACHE_STATS["hits"] += 1
+            return hit[1]
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    tree = ast.parse(text, filename=path)
+    sf = SourceFile(path, text, tree)
+    with _CACHE_LOCK:
+        CACHE_STATS["parses"] += 1
+        _CACHE[path] = (key, sf)
+    return sf
+
+
+def cache_stats() -> dict:
+    with _CACHE_LOCK:
+        return dict(CACHE_STATS)
+
+
+def clear_cache() -> None:
+    with _CACHE_LOCK:
+        _CACHE.clear()
+        CACHE_STATS["parses"] = CACHE_STATS["hits"] = 0
+
+
+# ------------------------------------------------------- pragma application
+def _anchor_line(diag: Diagnostic, path: str) -> Optional[int]:
+    """The line number of a ``file:line`` anchored diagnostic for
+    ``path`` (None when the anchor is elsewhere or not line-shaped)."""
+    if not diag.path:
+        return None
+    anchor_path, _, line = diag.path.rpartition(":")
+    if os.path.abspath(anchor_path) != os.path.abspath(path):
+        return None
+    try:
+        return int(line)
+    except ValueError:
+        return None
+
+
+def apply_suppressions(diags: list[Diagnostic],
+                       sf: SourceFile) -> tuple[list[Diagnostic],
+                                                list[Diagnostic]]:
+    """(kept, suppressed) after honoring the file's pragmas."""
+    if not sf._suppress_at:
+        return list(diags), []
+    kept, suppressed = [], []
+    for d in diags:
+        line = _anchor_line(d, sf.path)
+        if line is not None and sf.suppresses(d.rule, line):
+            suppressed.append(d)
+        else:
+            kept.append(d)
+    return kept, suppressed
+
+
+def run_ast_family(paths, rules: dict, *, build, facts_family: str,
+                   count_key: str, missing_message: str,
+                   missing_hint: str, on_model=None) -> "Report":
+    """The per-file driver every AST rule family shares: resolve paths,
+    load each file once through the cache, memoize the family's derived
+    model on the :class:`SourceFile` (keyed by path spelling so anchors
+    keep the caller-given form), run the rules, honor suppression
+    pragmas, and report pragma problems.  ``build(path, tree)`` makes
+    the family's per-module model; ``on_model(report, model)`` (optional)
+    lets a family accumulate extra context."""
+    from deeplearning4j_tpu.analyze.diagnostics import Report
+    from deeplearning4j_tpu.analyze.lint import iter_python_files
+    report = Report()
+    files, missing = iter_python_files(
+        paths if not isinstance(paths, str) else [paths])
+    report.context[count_key] = len(files)
+    for path in missing:
+        report.add("TPU300", missing_message, path=path, hint=missing_hint)
+    for path in files:
+        try:
+            sf = load_source(path)
+        except SyntaxError as e:
+            report.add("TPU300", f"does not parse: {e.msg}",
+                       path=f"{path}:{e.lineno}")
+            continue
+        except (OSError, ValueError) as e:
+            report.add("TPU300", f"unreadable: {e}", path=path)
+            continue
+        model = sf.facts.get((facts_family, path))
+        if model is None:
+            model = build(path, sf.tree)
+            sf.facts[(facts_family, path)] = model
+        if on_model is not None:
+            on_model(report, model)
+        diags = []
+        for rule_fn in rules.values():
+            diags.extend(rule_fn(model))
+        kept, suppressed = apply_suppressions(diags, sf)
+        report.diagnostics.extend(kept)
+        report.suppressed.extend(suppressed)
+        report.diagnostics.extend(
+            pragma_diagnostics(sf, display_path=path))
+    return report
+
+
+def pragma_diagnostics(sf: SourceFile,
+                       display_path: Optional[str] = None
+                       ) -> list[Diagnostic]:
+    """TPU400 findings for the file's pragmas: missing reason, unknown
+    rule IDs, rules outside the suppressible AST families.
+    ``display_path`` anchors findings to the caller-given path spelling
+    (defaults to the cache's absolute path)."""
+    out = []
+    for pragma in sf.pragmas:
+        anchor = f"{display_path or sf.path}:{pragma.lineno}"
+        if not pragma.rules:
+            out.append(Diagnostic(
+                "TPU400", "suppression pragma names no rule IDs",
+                path=anchor))
+            continue
+        for rule in pragma.rules:
+            if not _RULE_ID_RE.match(rule) or rule not in RULES:
+                out.append(Diagnostic(
+                    "TPU400",
+                    f"suppression pragma names unknown rule {rule!r}",
+                    path=anchor))
+            elif rule == "TPU400":
+                out.append(Diagnostic(
+                    "TPU400",
+                    "suppression pragma names TPU400 — pragma problems "
+                    "cannot be suppressed; fix the pragma it points at",
+                    path=anchor))
+            elif not rule.startswith(_SUPPRESSIBLE_PREFIXES):
+                out.append(Diagnostic(
+                    "TPU400",
+                    f"suppression pragma names {rule}, which is not an "
+                    f"AST-family rule — only TPU3xx/TPU4xx findings "
+                    f"anchor to a source line a pragma can excuse",
+                    path=anchor))
+        if not pragma.reason:
+            out.append(Diagnostic(
+                "TPU400",
+                f"bare suppression {pragma.raw!r} — the reason text "
+                f"after the dash is mandatory (what makes this finding "
+                f"safe here?)",
+                path=anchor))
+    return out

@@ -286,6 +286,11 @@ class DeviceFeeder:
 
         thread = threading.Thread(target=producer, daemon=True,
                                   name="tpudl-device-feeder")
+        # per-epoch thread owned by feed() itself — no class-level close()
+        # exists on purpose: the generator's finally stops and drains it,
+        # and a join would block the abandoning consumer on in-flight
+        # staging (see _drain's docstring)
+        # tpudl: ok(TPU405) — feed()'s own finally stops+drains the producer
         thread.start()
         reg = get_registry()
         wait_hist = reg.histogram("tpudl_data_etl_wait_seconds")

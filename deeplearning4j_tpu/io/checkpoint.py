@@ -179,7 +179,10 @@ class CheckpointListener(TrainingListener):
     # ----------------------------------------------------------- lookups
     def last_checkpoint(self) -> Optional[str]:
         self.flush()
-        return self._saved[-1] if self._saved else None
+        # save_now (health monitor, supervisor) may commit from another
+        # thread even after flush — read the index under its lock
+        with self._index_lock:
+            return self._saved[-1] if self._saved else None
 
     @staticmethod
     def last_checkpoint_in(directory: str,

@@ -211,9 +211,12 @@ class RemoteStatsRouter:
         except Exception:
             # the coordinator is down/stalled: count the loss and move
             # on — re-queueing would just re-lose them and starve newer
-            # records out of the bounded buffer
+            # records out of the bounded buffer.  _dropped is also
+            # incremented by put() on caller threads (overflow), so the
+            # += must happen under the same lock or increments tear.
             self._failures += 1
-            self._dropped += len(batch)
+            with self._lock:
+                self._dropped += len(batch)
             reg.counter("tpudl_cluster_push_failures_total").inc()
             reg.counter("tpudl_cluster_records_dropped_total").inc(len(batch))
         return len(batch)

@@ -116,6 +116,10 @@ class OnlineTrainer:
         os.makedirs(self._lineage_dir(), exist_ok=True)
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # written by start() (caller thread) AND _finish_round (loop
+        # thread): a stop()-then-start() overlapping a timed-out join
+        # leaves the OLD loop thread racing the new start's write
+        self._round_t_lock = threading.Lock()
         self._last_round_t = 0.0
         self.failed: Optional[str] = None     # set when the budget burns
 
@@ -271,7 +275,8 @@ class OnlineTrainer:
 
     def _finish_round(self, r: int, source: FeedbackSource, reg) -> None:
         self._advance_round(r)
-        self._last_round_t = time.monotonic()
+        with self._round_t_lock:
+            self._last_round_t = time.monotonic()
         reg.gauge("tpudl_online_spool_depth").set(source.pending())
         reg.gauge("tpudl_online_staleness_seconds").set(source.staleness_s())
         flight_recorder.progress("online.loop", round=r, done=True)
@@ -285,8 +290,10 @@ class OnlineTrainer:
         pending = self._source().pending()   # one spool read per poll
         if pending >= cfg.min_records:
             return True
-        if self._last_round_t and cfg.interval_s > 0 \
-                and time.monotonic() - self._last_round_t >= cfg.interval_s:
+        with self._round_t_lock:
+            last_round_t = self._last_round_t
+        if last_round_t and cfg.interval_s > 0 \
+                and time.monotonic() - last_round_t >= cfg.interval_s:
             return pending > 0
         return False
 
@@ -325,7 +332,8 @@ class OnlineTrainer:
         if self._thread is not None and self._thread.is_alive():
             return self
         self._stop.clear()
-        self._last_round_t = time.monotonic()
+        with self._round_t_lock:
+            self._last_round_t = time.monotonic()
         self._thread = threading.Thread(target=self._run_loop, daemon=True,
                                         name=f"tpudl-online-{self.name}")
         self._thread.start()

@@ -213,6 +213,9 @@ class AsyncCheckpointer:
 
     def __init__(self, name: str = "tpudl-checkpointer"):
         self._q: queue.Queue = queue.Queue()
+        # appended by the save thread, popped by caller threads — the
+        # lock keeps a failure landing mid-pop from tearing the handoff
+        self._error_lock = threading.Lock()
         self._error: list[BaseException] = []
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=name)
@@ -226,14 +229,17 @@ class AsyncCheckpointer:
                     return
                 job()
             except BaseException as e:   # re-raised on the caller thread
-                self._error.append(e)
+                with self._error_lock:
+                    self._error.append(e)
             finally:
                 self._q.task_done()
 
     def _raise_pending(self) -> None:
-        if self._error:
+        with self._error_lock:
+            error = self._error.pop(0) if self._error else None
+        if error is not None:
             raise RuntimeError(
-                "background checkpoint save failed") from self._error.pop(0)
+                "background checkpoint save failed") from error
 
     def submit(self, job: Callable[[], Any]) -> None:
         self._raise_pending()

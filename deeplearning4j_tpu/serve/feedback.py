@@ -273,7 +273,10 @@ class FeedbackLog:
                                 "tpudl_online_spool_dropped_total").inc()
                             raise
                         seg_count += 1
-                        self._next_index += 1
+                        # written() reads this from caller threads —
+                        # publish the new position under the lock
+                        with self._lock:
+                            self._next_index += 1
                         reg.counter(
                             "tpudl_online_spool_records_total").inc()
                         if seg_count >= self.max_records_per_segment:
@@ -339,7 +342,8 @@ class FeedbackLog:
 
     def written(self) -> int:
         """Records durably appended so far (global write position)."""
-        return self._next_index
+        with self._lock:
+            return self._next_index
 
     def close(self, timeout_s: float = 10.0) -> None:
         self._closed.set()

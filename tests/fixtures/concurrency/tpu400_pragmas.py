@@ -1,0 +1,34 @@
+"""TPU400 fixture: suppression pragmas that are themselves findings —
+bare (no reason), unknown rule ID, non-AST-family rule.  The bare
+pragma still suppresses its TPU402 finding; the TPU400 errors keep the
+gate red until reasons are written."""
+
+import threading
+
+
+class Racy:
+    def __init__(self):
+        self._n = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            # tpudl: ok(TPU402)
+            self._n += 1
+
+    def reset(self):
+        self._n = 0
+
+    def close(self):
+        self._thread.join(1.0)
+
+
+def helper():
+    # tpudl: ok(TPU999) — no such rule exists
+    pass
+
+
+def other():
+    # tpudl: ok(TPU105) — model-family rules have no source line to excuse
+    pass

@@ -1,0 +1,26 @@
+"""TPU401 negative: two locks, always acquired in the same order."""
+
+import threading
+
+
+class Ordered:
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        self._items = []
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        while True:
+            with self._lock_a:
+                with self._lock_b:
+                    break
+
+    def poke(self):
+        with self._lock_a:
+            with self._lock_b:
+                return len(self._items)
+
+    def close(self):
+        self._thread.join(1.0)

@@ -1,0 +1,54 @@
+"""TPU401 positive: a deliberate two-lock order inversion.
+
+``Inverted._worker`` (the thread) takes ``_lock_a`` then ``_lock_b``;
+``Inverted.poke`` (a caller) takes ``_lock_b`` then ``_lock_a``.  Two
+threads interleaving those paths deadlock.  ``IndirectInversion`` hides
+one leg behind a method call — the acquisition graph must follow calls.
+"""
+
+import threading
+
+
+class Inverted:
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        self._items = []
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        while True:
+            with self._lock_a:
+                with self._lock_b:
+                    break
+
+    def poke(self):
+        with self._lock_b:
+            with self._lock_a:
+                return len(self._items)
+
+    def close(self):
+        self._thread.join(1.0)
+
+
+class IndirectInversion:
+    """front→back on one path, back→front on the other — the second
+    acquisition happens inside a callee."""
+
+    def __init__(self):
+        self._front = threading.Lock()
+        self._back = threading.Lock()
+
+    def publish(self):
+        with self._front:
+            self._commit()
+
+    def _commit(self):
+        with self._back:
+            pass
+
+    def refresh(self):
+        with self._back:
+            with self._front:
+                pass

@@ -1,0 +1,18 @@
+"""TPU401 pragma-suppressed: one-lock re-entry (self-deadlock shape),
+vouched for by a reasoned suppression."""
+
+import threading
+
+
+class Reentry:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self):
+        with self._lock:
+            self.inner()
+
+    def inner(self):
+        # tpudl: ok(TPU401) — fixture: demonstrates a reasoned suppression
+        with self._lock:
+            pass

@@ -1,0 +1,48 @@
+"""TPU402 negatives: every shared write happens under one common lock;
+thread-safe attributes (events/queues) and single-writer attributes
+don't flag either."""
+
+import queue
+import threading
+
+
+class Guarded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self._count += 1
+
+    def reset(self):
+        with self._lock:
+            self._count = 0
+
+    def close(self):
+        self._thread.join(1.0)
+
+
+class SingleWriter:
+    """The thread owns ``_progress``; callers only read it."""
+
+    def __init__(self):
+        self._progress = 0
+        self._inbox = queue.Queue()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            self._progress += 1
+
+    def progress(self):
+        return self._progress
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(1.0)

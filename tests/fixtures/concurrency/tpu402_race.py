@@ -1,0 +1,21 @@
+"""TPU402 positive: ``_count`` is written by the worker thread AND the
+caller API with no lock anywhere."""
+
+import threading
+
+
+class Racy:
+    def __init__(self):
+        self._count = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            self._count += 1
+
+    def reset(self):
+        self._count = 0
+
+    def close(self):
+        self._thread.join(1.0)

@@ -1,0 +1,21 @@
+"""TPU402 pragma-suppressed: same race as tpu402_race.py, vouched for."""
+
+import threading
+
+
+class RacyButFine:
+    def __init__(self):
+        self._count = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            # tpudl: ok(TPU402) — fixture: approximate counter, torn increments acceptable
+            self._count += 1
+
+    def reset(self):
+        self._count = 0
+
+    def close(self):
+        self._thread.join(1.0)

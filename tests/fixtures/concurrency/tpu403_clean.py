@@ -1,0 +1,17 @@
+"""TPU403 negative: the handler-reachable lock is an RLock — re-entry
+from an interrupting handler cannot self-deadlock."""
+
+import signal
+import threading
+
+_LOCK = threading.RLock()
+_EVENTS = []
+
+
+def _on_term(signum, frame):
+    with _LOCK:
+        _EVENTS.append(("sigterm", signum))
+
+
+def install():
+    signal.signal(signal.SIGTERM, _on_term)

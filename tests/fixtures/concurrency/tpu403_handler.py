@@ -1,0 +1,22 @@
+"""TPU403 positive: a signal handler path acquires a non-reentrant
+``threading.Lock`` — the handler can interrupt the lock's owner and
+self-deadlock."""
+
+import signal
+import threading
+
+_LOCK = threading.Lock()
+_EVENTS = []
+
+
+def _record(what):
+    with _LOCK:
+        _EVENTS.append(what)
+
+
+def _on_term(signum, frame):
+    _record(("sigterm", signum))
+
+
+def install():
+    signal.signal(signal.SIGTERM, _on_term)

@@ -1,0 +1,29 @@
+"""TPU404 positives: indefinite blocking calls while holding a lock —
+one direct (queue.get under the lock), one through a call (join inside
+a method invoked with the lock held)."""
+
+import queue
+import threading
+
+
+class Wedge:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = queue.Queue()
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        while True:
+            break
+
+    def drain(self):
+        with self._lock:
+            return self._queue.get()   # blocks every other acquirer
+
+    def stop(self):
+        with self._lock:
+            self._finish()
+
+    def _finish(self):
+        self._worker.join()            # lock held by the caller

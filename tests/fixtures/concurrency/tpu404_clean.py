@@ -1,0 +1,33 @@
+"""TPU404 negatives: bounded waits under a lock are fine, indefinite
+waits OUTSIDE the lock are fine, and Condition.wait on the condition's
+own lock releases it."""
+
+import queue
+import threading
+
+
+class Bounded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._queue = queue.Queue()
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        while True:
+            break
+
+    def drain(self):
+        with self._lock:
+            return self._queue.get(timeout=0.5)   # bounded
+
+    def take(self):
+        return self._queue.get()                  # no lock held
+
+    def park(self):
+        with self._cond:
+            self._cond.wait()                     # releases _cond itself
+
+    def stop(self):
+        self._worker.join()

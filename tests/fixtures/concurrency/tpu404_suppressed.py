@@ -1,0 +1,15 @@
+"""TPU404 pragma-suppressed: a blocking get under the lock, vouched."""
+
+import queue
+import threading
+
+
+class WedgeButFine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = queue.Queue()
+
+    def drain(self):
+        with self._lock:
+            # tpudl: ok(TPU404) — fixture: single-threaded test harness, no second acquirer
+            return self._queue.get()

@@ -1,0 +1,44 @@
+"""TPU405 negatives: a proper close() that signals and joins; a
+fork/join thread scoped to one method; cleanup that joins via a helper
+call."""
+
+import threading
+
+
+class Tidy:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            break
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(5.0)
+
+
+class Scoped:
+    def compute(self, fn):
+        out = []
+        thread = threading.Thread(target=lambda: out.append(fn()))
+        thread.start()
+        thread.join()
+        return out
+
+
+class Delegating:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        return
+
+    def _teardown(self):
+        self._thread.join(5.0)
+
+    def shutdown(self):
+        self._teardown()

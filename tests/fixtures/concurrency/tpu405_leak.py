@@ -1,0 +1,16 @@
+"""TPU405 positive: a class starts a long-lived thread and has no
+close()/shutdown()/stop() that joins anything — the thread outlives
+the object."""
+
+import threading
+
+
+class Leaky:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            break

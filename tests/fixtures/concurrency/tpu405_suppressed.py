@@ -1,0 +1,13 @@
+"""TPU405 pragma-suppressed: a deliberate process-lifetime thread."""
+
+import threading
+
+
+class Daemonic:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        # tpudl: ok(TPU405) — fixture: process-lifetime daemon by design
+        self._thread.start()
+
+    def _run(self):
+        return

@@ -1,0 +1,22 @@
+"""TPU406 negative: every future resolves on both paths."""
+
+import queue
+import threading
+
+
+class Resolved:
+    def __init__(self):
+        self._jobs = queue.Queue()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            fut, fn = self._jobs.get()
+            try:
+                fut.set_result(fn())
+            except BaseException as e:
+                fut.set_exception(e)
+
+    def close(self):
+        self._thread.join(1.0)

@@ -1,0 +1,20 @@
+"""TPU406 positive: a worker loop resolves Futures with set_result but
+has no set_exception path — one exception strands every waiter."""
+
+import queue
+import threading
+
+
+class Unresolved:
+    def __init__(self):
+        self._jobs = queue.Queue()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            fut, fn = self._jobs.get()
+            fut.set_result(fn())       # fn() raising strands fut forever
+
+    def close(self):
+        self._thread.join(1.0)

@@ -1,0 +1,20 @@
+"""TPU406 pragma-suppressed."""
+
+import queue
+import threading
+
+
+class UnresolvedButFine:
+    def __init__(self):
+        self._jobs = queue.Queue()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            fut, fn = self._jobs.get()
+            # tpudl: ok(TPU406) — fixture: fn is a pre-validated pure lambda
+            fut.set_result(fn())
+
+    def close(self):
+        self._thread.join(1.0)

@@ -1,0 +1,361 @@
+"""Tier-1 gate + seed tests for the TPU4xx concurrency analyzer.
+
+Mirrors ``test_analyze_self.py``'s contract for the new family: the
+framework tree must be free of unsuppressed concurrency findings, every
+suppression must carry a written reason (a bare pragma is a TPU400
+error and fails this gate), and each rule has positive / negative /
+pragma-suppressed seed fixtures under ``tests/fixtures/concurrency/``.
+"""
+
+import json
+import os
+
+import pytest
+
+import deeplearning4j_tpu
+from deeplearning4j_tpu.analyze import source as source_cache
+from deeplearning4j_tpu.analyze.__main__ import main as analyze_main
+from deeplearning4j_tpu.analyze.concurrency import (
+    CONCURRENCY_RULES, analyze_concurrency_package,
+    analyze_concurrency_paths, build_model, register_concurrency_rule)
+from deeplearning4j_tpu.analyze.diagnostics import Diagnostic, rule_family
+from deeplearning4j_tpu.analyze.lint import lint_paths
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "concurrency")
+PACKAGE_DIR = os.path.dirname(os.path.abspath(deeplearning4j_tpu.__file__))
+
+
+def fixture(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def run_on(name: str):
+    return analyze_concurrency_paths([fixture(name)])
+
+
+# ------------------------------------------------------------ tier-1 gates
+def test_framework_tree_is_concurrency_clean():
+    """The whole-package self-analysis: zero unsuppressed TPU4xx
+    findings, and zero TPU400 (so every suppression carries a reason)."""
+    report = analyze_concurrency_package()
+    errors = report.errors()
+    assert errors == [], "concurrency findings in the tree:\n" + "\n".join(
+        d.render() for d in errors)
+    assert report.context["files_analyzed"] > 100
+    # the framework genuinely spawns threads — entry-point discovery
+    # finding none would mean the model silently went blind
+    assert report.context["entry_points"] >= 10
+
+
+def test_self_cli_with_concurrency_exits_zero():
+    assert analyze_main(["--concurrency", "--self"]) == 0
+
+
+def test_suppressions_in_tree_are_reasoned():
+    """Anything the tree suppresses is still visible in the report, and
+    none of it is reason-less (that would be a TPU400 error)."""
+    report = analyze_concurrency_package()
+    assert not [d for d in report.diagnostics if d.rule == "TPU400"]
+    for d in report.suppressed:
+        assert d.rule.startswith("TPU4")
+
+
+# ------------------------------------------------------- TPU401 acceptance
+def test_tpu401_inversion_cycle_names_both_locks_and_paths():
+    report = run_on("tpu401_inversion.py")
+    findings = report.by_rule("TPU401")
+    assert len(findings) == 2, "\n".join(d.render() for d in findings)
+    direct = next(d for d in findings if "Inverted._lock_a" in d.message)
+    # the cycle names BOTH locks and BOTH code paths, with lines
+    assert "Inverted._lock_b" in direct.message
+    assert "Inverted._worker" in direct.message
+    assert "Inverted.poke" in direct.message
+    assert "line" in direct.message
+    # the indirect cycle required following a call edge
+    indirect = next(d for d in findings if "IndirectInversion" in d.message)
+    assert "IndirectInversion._commit" in indirect.message
+    assert "IndirectInversion.refresh" in indirect.message
+
+
+def test_tpu401_consistent_order_is_clean():
+    assert run_on("tpu401_clean.py").errors() == []
+
+
+def test_tpu401_reentry_suppressed_with_reason():
+    report = run_on("tpu401_suppressed.py")
+    assert report.errors() == []
+    assert [d.rule for d in report.suppressed] == ["TPU401"]
+
+
+# ------------------------------------------------ per-rule seed fixtures
+@pytest.mark.parametrize("rule,pos,neg,sup", [
+    ("TPU402", "tpu402_race.py", "tpu402_clean.py", "tpu402_suppressed.py"),
+    ("TPU403", "tpu403_handler.py", "tpu403_clean.py",
+     "tpu403_suppressed.py"),
+    ("TPU404", "tpu404_blocking.py", "tpu404_clean.py",
+     "tpu404_suppressed.py"),
+    ("TPU405", "tpu405_leak.py", "tpu405_clean.py", "tpu405_suppressed.py"),
+    ("TPU406", "tpu406_futures.py", "tpu406_clean.py",
+     "tpu406_suppressed.py"),
+])
+def test_rule_seed_fixtures(rule, pos, neg, sup):
+    positive = run_on(pos)
+    assert {d.rule for d in positive.errors()} == {rule}, "\n".join(
+        d.render() for d in positive.diagnostics)
+    negative = run_on(neg)
+    assert negative.errors() == [], "\n".join(
+        d.render() for d in negative.errors())
+    suppressed = run_on(sup)
+    assert suppressed.errors() == []
+    assert [d.rule for d in suppressed.suppressed] == [rule]
+
+
+def test_tpu402_message_names_both_entry_points():
+    report = run_on("tpu402_race.py")
+    (finding,) = report.by_rule("TPU402")
+    assert "thread:Racy._run" in finding.message
+    assert "caller API" in finding.message
+    assert "_count" in finding.message
+
+
+def test_tpu404_direct_and_through_a_call():
+    report = run_on("tpu404_blocking.py")
+    findings = report.by_rule("TPU404")
+    assert len(findings) == 2
+    assert any("queue .get()" in d.message for d in findings)
+    # the join is flagged in _finish but the lock came from stop()
+    join = next(d for d in findings if ".join()" in d.message)
+    assert "Wedge._finish" in join.message
+    assert "Wedge._lock" in join.message
+
+
+# ------------------------------------------------------------ pragmas
+def test_tpu400_bad_pragma_shapes():
+    report = run_on("tpu400_pragmas.py")
+    messages = [d.message for d in report.by_rule("TPU400")]
+    assert len(messages) == 3
+    assert any("bare suppression" in m for m in messages)
+    assert any("TPU999" in m for m in messages)
+    assert any("TPU105" in m for m in messages)
+    # the bare pragma STILL suppresses — the TPU400 is what keeps the
+    # gate red, not a duplicate of the silenced finding
+    assert [d.rule for d in report.suppressed] == ["TPU402"]
+    assert not report.by_rule("TPU402")
+
+
+def test_pragma_cannot_suppress_tpu400(tmp_path):
+    """Naming TPU400 in a pragma is itself a TPU400 — a pragma problem
+    is fixed by fixing the pragma, never by stacking another one."""
+    path = tmp_path / "meta.py"
+    path.write_text(
+        "def helper():\n"
+        "    # tpudl: ok(TPU400) — trying to silence the pragma police\n"
+        "    pass\n")
+    report = analyze_concurrency_paths([str(path)])
+    (finding,) = report.errors()
+    assert finding.rule == "TPU400"
+    assert "cannot be suppressed" in finding.message
+    assert report.suppressed == []
+
+
+def test_overlapping_paths_analyze_each_file_once(tmp_path):
+    """`--concurrency pkg pkg/sub` must not double findings or counts."""
+    (tmp_path / "m.py").write_text(
+        "import sys\n\n\n"
+        "def helper():\n"
+        "    sys.exit(1)\n")
+    report = lint_paths([str(tmp_path / "m.py"), str(tmp_path)])
+    assert report.context["files_linted"] == 1
+    assert len(report.errors()) == 1
+
+
+def test_pragma_honored_by_lint_family_too(tmp_path):
+    """One pragma grammar across families: a TPU3xx lint finding is
+    suppressible the same way (and a reason is still mandatory)."""
+    good = tmp_path / "good.py"
+    good.write_text(
+        "import sys\n\n\n"
+        "def helper():\n"
+        "    # tpudl: ok(TPU312) — test fixture: suppression plumbing\n"
+        "    sys.exit(1)\n")
+    report = lint_paths([str(good)])
+    assert report.errors() == []
+    assert [d.rule for d in report.suppressed] == ["TPU312"]
+
+    bare = tmp_path / "bare.py"
+    bare.write_text(
+        "import sys\n\n\n"
+        "def helper():\n"
+        "    # tpudl: ok(TPU312)\n"
+        "    sys.exit(1)\n")
+    report = lint_paths([str(bare)])
+    assert [d.rule for d in report.errors()] == ["TPU400"]
+
+
+def test_pragma_in_string_literal_does_not_suppress(tmp_path):
+    """Only COMMENT tokens carry pragmas — a docstring mentioning the
+    grammar must not silence anything."""
+    path = tmp_path / "strung.py"
+    path.write_text(
+        '"""Docs: write `# tpudl: ok(TPU402) — why` above the line."""\n'
+        "import threading\n\n\n"
+        "class Racy:\n"
+        "    def __init__(self):\n"
+        "        self._n = 0\n"
+        "        self._t = threading.Thread(target=self._run)\n"
+        "        self._t.start()\n\n"
+        "    def _run(self):\n"
+        "        self._n += 1\n\n"
+        "    def reset(self):\n"
+        "        self._n = 0\n\n"
+        "    def close(self):\n"
+        "        self._t.join(1.0)\n")
+    report = analyze_concurrency_paths([str(path)])
+    assert [d.rule for d in report.errors()] == ["TPU402"]
+    assert report.suppressed == []
+
+
+# ------------------------------------------------------- shared AST cache
+def test_families_share_one_parse_per_file():
+    """--self --lint --concurrency must parse each module once: the
+    second family over the same tree is all cache hits."""
+    source_cache.clear_cache()
+    lint_report = lint_paths([FIXTURES])
+    parses_after_lint = source_cache.cache_stats()["parses"]
+    assert parses_after_lint == lint_report.context["files_linted"]
+    conc_report = analyze_concurrency_paths([FIXTURES])
+    stats = source_cache.cache_stats()
+    assert stats["parses"] == parses_after_lint, \
+        "concurrency pass re-parsed files the lint pass already parsed"
+    assert stats["hits"] >= conc_report.context["files_analyzed"]
+
+
+# ------------------------------------------------------------ JSON output
+def test_json_finding_schema_shared_across_families(capsys):
+    rc = analyze_main(["--concurrency", fixture("tpu402_race.py"),
+                       "--lint", fixture("tpu402_race.py"),
+                       "--format", "json"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["exit_code"] == 1
+    assert "suppressed" in doc
+    (finding,) = doc["diagnostics"]
+    assert set(finding) == {"rule", "slug", "family", "severity", "path",
+                            "message", "hint"}
+    assert finding["rule"] == "TPU402"
+    assert finding["family"] == "concurrency"
+    assert finding["slug"] == "unlocked-shared-write"
+
+
+def test_json_carries_suppressed_findings(capsys):
+    rc = analyze_main(["--concurrency", fixture("tpu402_suppressed.py"),
+                       "--format", "json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["diagnostics"] == []
+    (sup,) = doc["suppressed"]
+    assert sup["rule"] == "TPU402"
+    assert sup["family"] == "concurrency"
+
+
+def test_rule_family_mapping():
+    assert rule_family("TPU101") == "model"
+    assert rule_family("TPU201") == "sharding"
+    assert rule_family("TPU301") == "lint"
+    assert rule_family("TPU402") == "concurrency"
+
+
+# --------------------------------------------------------- extensibility
+def test_register_concurrency_rule_pluggable():
+    @register_concurrency_rule("TPU499")
+    def _count_classes(model):
+        return [Diagnostic("TPU499", f"classes={len(model.classes)}",
+                           path=model.path)]
+    try:
+        report = analyze_concurrency_paths(
+            [fixture("tpu402_race.py")],
+            rules={"TPU499": CONCURRENCY_RULES["TPU499"]})
+        (finding,) = report.diagnostics
+        assert finding.rule == "TPU499"
+        assert finding.message == "classes=1"
+    finally:
+        CONCURRENCY_RULES.pop("TPU499")
+
+
+def test_tpu405_os_path_join_is_not_cleanup(tmp_path):
+    """Only thread/queue/process-shaped receivers count as joins —
+    os.path.join in a close() must not exempt a leaked thread."""
+    path = tmp_path / "pathjoin.py"
+    path.write_text(
+        "import os\n"
+        "import threading\n\n\n"
+        "class Leaky:\n"
+        "    def __init__(self):\n"
+        "        self._thread = threading.Thread(target=self._run)\n"
+        "        self._thread.start()\n\n"
+        "    def _run(self):\n"
+        "        return\n\n"
+        "    def close(self):\n"
+        "        return os.path.join('/tmp', 'x')\n")
+    report = analyze_concurrency_paths([str(path)])
+    assert [d.rule for d in report.errors()] == ["TPU405"]
+
+
+def test_tpu402_sees_workers_nested_in_init(tmp_path):
+    """A worker closure defined inside __init__ runs AFTER the thread
+    starts — only __init__ itself is construction-time-exempt."""
+    path = tmp_path / "nested.py"
+    path.write_text(
+        "import threading\n\n\n"
+        "class Racy:\n"
+        "    def __init__(self):\n"
+        "        self._n = 0\n\n"
+        "        def worker():\n"
+        "            self._n += 1\n\n"
+        "        self._thread = threading.Thread(target=worker)\n"
+        "        self._thread.start()\n\n"
+        "    def reset(self):\n"
+        "        self._n = 0\n\n"
+        "    def close(self):\n"
+        "        self._thread.join(1.0)\n")
+    report = analyze_concurrency_paths([str(path)])
+    assert [d.rule for d in report.errors()] == ["TPU402"]
+
+
+def test_anchors_keep_caller_given_paths(tmp_path, monkeypatch):
+    """Findings anchor to the path AS GIVEN (relative stays relative) so
+    JSON diffs don't turn machine-specific — suppression matching still
+    works because it abspath-normalizes both sides."""
+    pkg = tmp_path / "relcheck"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(
+        "import sys\n\n\n"
+        "def helper():\n"
+        "    sys.exit(1)\n")
+    monkeypatch.chdir(tmp_path)
+    report = lint_paths(["relcheck"])
+    (finding,) = report.errors()
+    assert finding.path.startswith("relcheck/"), finding.path
+    conc = analyze_concurrency_paths(["relcheck"])
+    assert conc.context["files_analyzed"] == 1
+
+
+def test_build_model_exposes_entries_and_lock_graph():
+    model = build_model(fixture("tpu401_inversion.py"))
+    labels = {e.label for e in model.entries}
+    assert "thread:Inverted._worker" in labels
+    assert "caller API" in labels
+    assert ("Inverted._lock_a", "Inverted._lock_b") in model.lock_edges
+    assert ("Inverted._lock_b", "Inverted._lock_a") in model.lock_edges
+
+
+def test_combined_cli_merges_and_dedups(capsys):
+    """--self --lint --concurrency over one file: TPU400 pragma findings
+    come from the shared scan and must not double-report."""
+    rc = analyze_main(["--concurrency", fixture("tpu400_pragmas.py"),
+                       "--lint", fixture("tpu400_pragmas.py"),
+                       "--format", "json"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    tpu400 = [d for d in doc["diagnostics"] if d["rule"] == "TPU400"]
+    assert len(tpu400) == 3        # bare + unknown + non-AST, once each

@@ -49,3 +49,11 @@ def test_rule_catalog_documented():
         assert rule_id in doc, f"{rule_id} missing from docs/static_analysis.md"
     # the generated table is embedded verbatim, so docs can't drift
     assert rule_catalog_markdown() in doc
+    # the concurrency family has its own rationale section, one
+    # "**TPU4xx slug.**" block per rule (TPU400 lives in the pragma
+    # paragraph and the table)
+    assert "## Concurrency" in doc
+    for rule_id, info in RULES.items():
+        if rule_id.startswith("TPU4") and rule_id != "TPU400":
+            assert f"**{rule_id} {info.slug}.**" in doc, \
+                f"{rule_id} rationale missing from the Concurrency section"
