@@ -4,6 +4,14 @@
 Compares the Pallas blockwise kernel against the materializing jnp
 reference at growing sequence lengths; prints one JSON line per config.
 Numbers recorded in bench/PROFILE.md.
+
+Since flash became the standard-path default (``use_flash=None`` auto-
+enables at seq >= 1024), each row also records the promotion contract:
+``auto_default`` confirms the default routing picks the kernel at that
+sequence length, and ``meets_floor`` asserts the measured speedup holds
+the 1.29x the promotion was justified by (bench/PROFILE.md, round 4) —
+a row with ``meets_floor: false`` is a regression of the default path,
+not just a slower kernel.
 """
 
 import json
@@ -13,11 +21,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.ops.attention import _auto_flash, FLASH_AUTO_SEQ_LEN
 from deeplearning4j_tpu.ops.pallas import flash_attention
 from deeplearning4j_tpu.parallel.context_parallel import reference_attention
 
 
 STEPS = 20
+SPEEDUP_FLOOR = 1.29   # the measured win the default promotion rests on
 
 
 def _chained(attn_fn):
@@ -59,10 +69,16 @@ def main():
             ref_ms = bench(r, (q, k, v))
         except Exception:        # [T,T] materialization OOMs at long seq
             ref_ms = None
+        speedup = None if ref_ms is None else round(ref_ms / flash_ms, 2)
         print(json.dumps({
             "metric": "flash_attention_ms", "seq_len": t, "value": round(flash_ms, 2),
             "unit": "ms", "reference_ms": None if ref_ms is None else round(ref_ms, 2),
-            "speedup": None if ref_ms is None else round(ref_ms / flash_ms, 2)}))
+            "speedup": speedup,
+            # the promoted-default contract: this seq routes to flash by
+            # default, and the speedup that justified the promotion holds
+            "auto_default": bool(_auto_flash(q, k)) and t >= FLASH_AUTO_SEQ_LEN,
+            "speedup_floor": SPEEDUP_FLOOR,
+            "meets_floor": None if speedup is None else speedup >= SPEEDUP_FLOOR}))
 
 
 if __name__ == "__main__":

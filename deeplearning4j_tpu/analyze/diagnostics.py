@@ -248,6 +248,23 @@ _RULE_LIST = [
         "Route the deploy through online.gate.GatedDeployer."
         "deploy_if_better (or EvalGate + your own decision record); "
         "only gate.py itself may touch ModelRegistry.deploy."),
+    RuleInfo(
+        "TPU314", "upcast-in-serving-path", ERROR,
+        "dtype upcast (.astype(float32/float64)) or a per-request "
+        "dequantize call inside a serving/request-path function",
+        "The serving hot path is HBM-bound: a float32/float64 astype on "
+        "an activation or weight tensor inside a per-request function "
+        "doubles (or quadruples) the bytes every request streams, and a "
+        "dequantize call there rebuilds the full-precision weights per "
+        "request — silently undoing the entire int8 quantization win "
+        "(the dequant belongs fused inside the kernel, or once at "
+        "deploy time).  Loss/score math may upcast; request functions "
+        "may not.",
+        "Keep request-path tensors in the policy compute dtype; fuse "
+        "dequantization into the matmul (ops.pallas.quant_matmul) or "
+        "do it once at deploy; if the upcast is genuinely required "
+        "(e.g. host-side JSON decode), suppress with a reasoned "
+        "'# tpudl: ok(TPU314) — <why>'."),
     # ---- concurrency (AST, whole-repo thread model) -------------------
     RuleInfo(
         "TPU400", "bad-suppression", ERROR,

@@ -19,7 +19,9 @@ function — telemetry goes through the buffered RemoteStatsRouter),
 TPU312 (os._exit/sys.exit outside the watchdog/supervisor — a stray
 exit defeats supervision and drops the black box), TPU313
 (ModelRegistry.deploy called directly from online-loop code — a
-candidate reaches serving only through the eval gate).
+candidate reaches serving only through the eval gate), TPU314 (dtype
+upcast or per-request dequantize inside serving-path functions — the
+quantized serve win undone on the request path).
 Registry-backed rules that ride along in ``lint_package``/``--self``:
 TPU305 (metric names — the former ``obs.check`` lint) and TPU306
 (op-spec catalog integrity).
@@ -1000,6 +1002,78 @@ def _rule_deploy_outside_gate(mod: ModuleInfo) -> list[Diagnostic]:
                     f"online-loop '{fn.name}' — candidates reach serving "
                     f"only through the eval gate "
                     f"(online.gate.GatedDeployer.deploy_if_better)",
+                    path=mod.anchor(node)))
+    return out
+
+
+# TPU314: upcasts that double/quadruple request-path HBM traffic.
+# bf16/int8/f16 casts narrow and are fine; float32/float64 widen.
+_WIDE_DTYPE_NAMES = {"float32", "float64", "double"}
+# per-request dequantization: rebuilding full-precision weights on the
+# request path undoes the quantized serve win (nn.quantize docstring)
+_DEQUANT_CALL_NAMES = {"dequantize", "dequantize_weight", "dequantize_net",
+                       "dequantize_params"}
+
+
+def _is_wide_dtype_arg(node: ast.AST) -> bool:
+    """``jnp.float32`` / ``np.float64`` / ``"float32"`` — a widening
+    dtype expression in an astype argument."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, str) and node.value in _WIDE_DTYPE_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr in _WIDE_DTYPE_NAMES
+    if isinstance(node, ast.Name):
+        return node.id in _WIDE_DTYPE_NAMES
+    return False
+
+
+@register_lint_rule("TPU314")
+def _rule_upcast_in_serving_path(mod: ModuleInfo) -> list[Diagnostic]:
+    """Dtype upcast or per-request dequantize inside serving-token
+    functions: ``x.astype(jnp.float32)`` on the request path doubles the
+    bytes every request streams from HBM (quadruples from int8), and a
+    ``dequantize*`` call there rebuilds full-precision weights per
+    request — the quantized serve path's whole arithmetic-intensity win
+    undone where nobody is looking.  Builder-token functions (the
+    one-time factories) are exempt, exactly like TPU309."""
+    out = []
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        tokens = set(fn.name.lower().strip("_").split("_"))
+        if fn.name not in _HTTP_HANDLER_NAMES:
+            if not tokens & _SERVING_TOKENS or tokens & _BUILDER_TOKENS:
+                continue
+        for node in _walk_shallow(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            astype_arg = None
+            if isinstance(f, ast.Attribute) and f.attr == "astype":
+                if node.args:
+                    astype_arg = node.args[0]
+                else:   # keyword form: x.astype(dtype=jnp.float32)
+                    astype_arg = next((kw.value for kw in node.keywords
+                                       if kw.arg == "dtype"), None)
+            if astype_arg is not None and _is_wide_dtype_arg(astype_arg):
+                out.append(Diagnostic(
+                    "TPU314",
+                    f"float32/float64 astype inside request-path "
+                    f"'{fn.name}' widens every request's HBM traffic — "
+                    f"keep serving tensors in the policy compute dtype "
+                    f"(loss/score math may upcast; request paths may "
+                    f"not)",
+                    path=mod.anchor(node)))
+            elif (isinstance(f, ast.Name) and f.id in _DEQUANT_CALL_NAMES) \
+                    or (isinstance(f, ast.Attribute)
+                        and f.attr in _DEQUANT_CALL_NAMES):
+                out.append(Diagnostic(
+                    "TPU314",
+                    f"per-request dequantize inside request-path "
+                    f"'{fn.name}' rebuilds full-precision weights every "
+                    f"request — fuse the dequant into the matmul "
+                    f"(ops.pallas.quant_matmul) or dequantize once at "
+                    f"deploy time",
                     path=mod.anchor(node)))
     return out
 

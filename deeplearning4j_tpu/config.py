@@ -64,6 +64,14 @@ class Config:
     - ``shape_bucketing``: pad ragged tail batches up to a static bucket
       shape with mask-extension (zero loss / zero gradient padding) so
       an epoch compiles the train step once — see docs/data_pipeline.md.
+    - ``fused_conv``: lower the conv zoo's bottleneck blocks onto the
+      Pallas fused conv+BN kernels (``nn.layers.fused.FusedBottleneck``
+      / ``ops.pallas.conv_bn.matmul_bn_act``) by default — the
+      cuDNN-platform-engine analog, numerically pinned to the unfused
+      graph by the oracle-equivalence tests.  On by default
+      (``DL4J_TPU_FUSED_CONV=0`` reverts to the unfused per-layer
+      graph); an explicit ``fused=`` argument to a zoo factory always
+      wins.
     - ``compile_cache_dir``: when set, enables jax's persistent
       compilation cache there (XLA programs survive process restarts).
     - ``tracing``: enable span-based tracing (``obs.tracing``); spans add
@@ -91,6 +99,7 @@ class Config:
     prefetch_size: int = 2
     device_feed: bool = True
     shape_bucketing: bool = True
+    fused_conv: bool = True
     compile_cache_dir: str = ""
     profiling: bool = False
     tracing: bool = False

@@ -195,14 +195,23 @@ def _bottleneck(gb, name, in_name, filters, stride, project):
 
 def resnet50(seed: int = 123, num_classes: int = 1000, height: int = 224,
              width: int = 224, channels: int = 3, updater=None,
-             fused: bool = False) -> ComputationGraph:
+             fused: bool | None = None) -> ComputationGraph:
     """ResNet50.java parity: [3, 4, 6, 3] bottleneck stages — the BASELINE
     headline model.  NHWC + channels-last BN; stride-2 downsampling in the
     first block of stages 3-5 (v1).
 
-    ``fused=True`` swaps each bottleneck for the single
+    ``fused`` picks the bottleneck lowering: ``True`` builds each block
+    as the single
     :class:`~deeplearning4j_tpu.nn.layers.fused.FusedBottleneck` layer
-    (Pallas conv+BN kernels — the cuDNN-platform-engine analog)."""
+    (Pallas conv+BN kernels — the cuDNN-platform-engine analog),
+    ``False`` the unfused ConvolutionLayer+BatchNormalization graph.
+    ``None`` (default) follows ``config.fused_conv`` — ON by default,
+    since the fused lowering is numerically pinned to the unfused graph
+    (``remap_bottleneck_params`` + the oracle-equivalence tests) and is
+    the conv zoo's arithmetic-intensity lever (ROADMAP item 1)."""
+    if fused is None:
+        from deeplearning4j_tpu.config import get_config
+        fused = bool(get_config().fused_conv)
     gb = (NeuralNetConfiguration.builder()
           .seed(seed)
           .updater(updater or Nesterovs(1e-1, 0.9))

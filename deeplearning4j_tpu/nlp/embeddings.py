@@ -570,8 +570,9 @@ class ParagraphVectors(Word2Vec):
         if len(ids) < 2:
             return np.zeros(self.vector_size, np.float32)
         rng = np.random.default_rng(self.seed + 17)
-        dvec = ((rng.random((1, self.vector_size)) - 0.5)
-                / self.vector_size).astype(np.float32)
+        dvec = (rng.random((1, self.vector_size)) - 0.5) / self.vector_size
+        # tpudl: ok(TPU314) — host numpy init of ONE [1,D] doc vector: f64 rng narrowed DOWN to f32, no HBM tensor widened
+        dvec = dvec.astype(np.float32)
         docs = [np.array(ids, np.int32)]
         old_epochs = self.epochs
         self.epochs = epochs

@@ -35,8 +35,10 @@ class SelfAttentionLayer(Layer):
     # MultiHeadAttention import parity)
     has_bias: bool = False
     # long-sequence path: route the inner product through the Pallas
-    # flash kernel (forward + backward, no [T,T] materialization)
-    use_flash: bool = False
+    # flash kernel (forward + backward, no [T,T] materialization).
+    # None = auto (the promoted default): flash for seq >= 1024,
+    # einsum below; an explicit False always wins
+    use_flash: Optional[bool] = None
     flash_block: int = 0      # 0 = tuned default (1024×1024 blocks)
 
     def get_output_type(self, input_type: InputType) -> InputType:

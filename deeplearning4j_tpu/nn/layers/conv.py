@@ -82,6 +82,16 @@ class ConvolutionLayer(Layer):
             params["b"] = self._init_bias((self.n_out,))
         return params
 
+    def _weight(self, params):
+        """Conv kernel in its stored form, or widened from int8 + scale
+        for a quantized net (nn.quantize): the HBM read is one byte per
+        weight; the widen happens on-chip on the way into the conv."""
+        if "W_q" in params:
+            from deeplearning4j_tpu.nn.quantize import dequantize_weight
+            return dequantize_weight(params["W_q"], params["W_scale"],
+                                     dtype_policy().compute_dtype)
+        return params["W"]
+
     def _conv(self, x, w, stride, padding, dilation, groups=1):
         """Returns the conv result in COMPUTE dtype — the output-dtype cast
         happens once at the end of apply(), after bias+activation, so a
@@ -114,7 +124,8 @@ class ConvolutionLayer(Layer):
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         _, stride, pad, dilation = self._dims()
         x = self._maybe_dropout(x, train, rng)
-        y = self._conv(x, params["W"], stride, self._padding_arg(pad), dilation)
+        y = self._conv(x, self._weight(params), stride,
+                       self._padding_arg(pad), dilation)
         return self._finish(y, params), state
 
 
@@ -173,7 +184,7 @@ class Convolution1DLayer(ConvolutionLayer):
             padding = [(p, p)]
         policy = dtype_policy()
         y = lax.conv_general_dilated(
-            x.astype(policy.compute_dtype), params["W"].astype(policy.compute_dtype),
+            x.astype(policy.compute_dtype), self._weight(params).astype(policy.compute_dtype),
             window_strides=(s,), padding=padding, rhs_dilation=(d,),
             dimension_numbers=("NWC", "WIO", "NWC"),
         )
@@ -218,7 +229,7 @@ class Convolution3DLayer(ConvolutionLayer):
         padding = "SAME" if self.convolution_mode == "same" else [(pp, pp) for pp in p]
         policy = dtype_policy()
         y = lax.conv_general_dilated(
-            x.astype(policy.compute_dtype), params["W"].astype(policy.compute_dtype),
+            x.astype(policy.compute_dtype), self._weight(params).astype(policy.compute_dtype),
             window_strides=s, padding=padding, rhs_dilation=d,
             dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
         )
@@ -250,7 +261,7 @@ class Deconvolution2D(ConvolutionLayer):
             padding = [((kh - 1) * dilation[0] - ph, (kh - 1) * dilation[0] - ph),
                        ((kw - 1) * dilation[1] - pw, (kw - 1) * dilation[1] - pw)]
         y = lax.conv_transpose(
-            x.astype(policy.compute_dtype), params["W"].astype(policy.compute_dtype),
+            x.astype(policy.compute_dtype), self._weight(params).astype(policy.compute_dtype),
             strides=stride, padding=padding, rhs_dilation=dilation,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
@@ -282,8 +293,8 @@ class DepthwiseConvolution2D(ConvolutionLayer):
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         _, stride, pad, dilation = self._dims()
         x = self._maybe_dropout(x, train, rng)
-        y = self._conv(x, params["W"], stride, self._padding_arg(pad), dilation,
-                       groups=x.shape[-1])
+        y = self._conv(x, self._weight(params), stride,
+                       self._padding_arg(pad), dilation, groups=x.shape[-1])
         return self._finish(y, params), state
 
 

@@ -51,11 +51,25 @@ class DenseLayer(Layer):
     def pre_output(self, params, state, x, *, train=False, rng=None):
         policy = dtype_policy()
         x = self._maybe_dropout(x, train, rng)
-        if x.ndim > 2 and x.shape[-1] == params["W"].shape[0]:
+        quantized = "W_q" in params   # nn.quantize: per-channel int8 weights
+        n_in = (params["W_q"] if quantized else params["W"]).shape[0]
+        if x.ndim > 2 and x.shape[-1] == n_in:
             pass  # [B,T,C] time-distributed path: contract the last axis
         elif x.ndim > 2:
             x = x.reshape(x.shape[0], -1)  # CNN→FF flatten
-        y = jnp.dot(x.astype(policy.compute_dtype), params["W"].astype(policy.compute_dtype))
+        if quantized:
+            # int8 weights stream 1 byte/param from HBM; the dequant is
+            # fused into the matmul (Pallas kernel on TPU, jnp oracle
+            # elsewhere) — activations stay in the compute dtype
+            from deeplearning4j_tpu.ops.pallas.quant_matmul import int8_matmul
+            xc = x.astype(policy.compute_dtype)
+            lead = xc.shape[:-1]
+            y = int8_matmul(xc.reshape(-1, xc.shape[-1]),
+                            params["W_q"], params["W_scale"])
+            y = y.reshape(lead + (y.shape[-1],))
+        else:
+            y = jnp.dot(x.astype(policy.compute_dtype),
+                        params["W"].astype(policy.compute_dtype))
         if self.has_bias:
             y = y + params["b"].astype(y.dtype)
         return y.astype(policy.output_dtype)
@@ -166,11 +180,23 @@ class EmbeddingLayer(Layer):
             params["b"] = self._init_bias((self.n_out,))
         return params
 
+    def _lookup(self, params, idx):
+        """Gather rows; a quantized table gathers int8 rows (1 byte per
+        element off HBM) and applies the per-channel scale after.  The
+        result lands in the policy COMPUTE dtype — an f32 result under a
+        bf16 policy would widen every [B,T,D] activation downstream,
+        exactly the upcast the quantized path exists to avoid."""
+        if "W_q" in params:
+            y = (jnp.take(params["W_q"], idx, axis=0).astype(jnp.float32)
+                 * params["W_scale"])
+            return y.astype(dtype_policy().compute_dtype)
+        return jnp.take(params["W"], idx, axis=0)
+
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         idx = x.astype(jnp.int32)
         if idx.ndim == 2 and idx.shape[-1] == 1:
             idx = idx[..., 0]
-        y = jnp.take(params["W"], idx, axis=0)
+        y = self._lookup(params, idx)
         if self.has_bias:
             y = y + params["b"]
         return activations.get(self.activation or "identity")(y), state
@@ -189,7 +215,7 @@ class EmbeddingSequenceLayer(EmbeddingLayer):
         idx = x.astype(jnp.int32)
         if idx.ndim == 3 and idx.shape[-1] == 1:
             idx = idx[..., 0]
-        y = jnp.take(params["W"], idx, axis=0)  # [B, T, nOut]
+        y = self._lookup(params, idx)  # [B, T, nOut]
         if self.has_bias:
             y = y + params["b"]
         return activations.get(self.activation or "identity")(y), state

@@ -1,0 +1,177 @@
+"""Post-training quantization — ``tpudl.nn.quantize``.
+
+Converts a trained net's dense / embedding / conv weights to
+**per-output-channel int8** (symmetric, scale = amax/127 per channel)
+while activations stay in the policy compute dtype (bf16 on TPU).  The
+layer zoo lowers the quantized matmuls onto the fused int8xbf16
+dequant-matmul kernel (:mod:`deeplearning4j_tpu.ops.pallas.quant_matmul`)
+on TPU; embeddings gather int8 rows and scale after the gather; conv
+kernels widen on read.  Weight HBM traffic drops 4x vs f32 (2x vs
+bf16) — the arithmetic-intensity lever of ROADMAP item 1.
+
+The quantized net is the SAME ``MultiLayerNetwork`` class with the same
+configuration (param dicts carry ``W_q``/``W_scale`` instead of ``W``),
+so it shares the step-cached serving forward and the engine's bucket
+machinery with its full-precision sibling: the jit boundary sees a
+different param pytree structure and holds a *separate* compiled
+program per bucket for each precision — hot-swapping between warmed
+bf16 and int8 variants of one architecture recompiles nothing.
+
+**Calibration** (:func:`calibrate`) runs a holdout iterator through the
+full-precision and quantized forwards and records the observed output
+deviation; the resulting :class:`QuantizationReport` carries the
+**calibrated tolerance band** the parity tests and the serve path hold
+the quantized model to.  Accuracy is gated, not assumed: deploys of a
+quantized variant go through ``online.gate.GatedDeployer``, which
+scores the quantized candidate against the full-precision incumbent on
+holdout and refuses a quantization that costs accuracy
+(docs/serving.md, "Quantized serving").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+# layers whose "W" participates in quantization (per-output-channel
+# scale over the LAST weight axis works for [K,N] dense, [V,D]
+# embedding, and HWIO / WIO conv kernels alike)
+_QUANT_EPS = 1e-12
+
+
+@dataclasses.dataclass
+class QuantizationReport:
+    """What one :func:`quantize_net` pass did — serialized into bench
+    records, flight-ring events and the ``tpudl_serve_quantized_*``
+    gauges at deploy time."""
+
+    layers_quantized: int
+    fp_weight_bytes: int           # bytes the quantized tensors occupied
+    quantized_weight_bytes: int    # int8 payload + f32 scales
+    max_abs_err: Optional[float] = None    # calibration: max |q - fp|
+    mean_abs_err: Optional[float] = None
+    tolerance_band: Optional[float] = None  # calibrated parity band
+    calibration_batches: int = 0
+
+    @property
+    def compression_ratio(self) -> float:
+        return self.fp_weight_bytes / max(self.quantized_weight_bytes, 1)
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["compression_ratio"] = round(self.compression_ratio, 3)
+        return d
+
+
+def quantize_weight(w) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-output-channel int8 quantization of a weight whose
+    LAST axis is the output-channel axis.  Returns ``(w_q int8,
+    scale f32[n_out])`` with ``w ≈ w_q * scale``."""
+    w32 = jnp.asarray(w, jnp.float32)
+    reduce_axes = tuple(range(w32.ndim - 1))
+    amax = jnp.max(jnp.abs(w32), axis=reduce_axes)
+    scale = jnp.maximum(amax, _QUANT_EPS) / 127.0
+    w_q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return w_q, scale
+
+
+def dequantize_weight(w_q, scale, dtype=jnp.float32) -> jnp.ndarray:
+    """``w_q * scale`` widened to ``dtype`` — the oracle inverse (and
+    the conv path's widen-on-read; per-request dequantization on a
+    serving path is what lint rule TPU314 exists to catch)."""
+    return (w_q.astype(jnp.float32)
+            * scale.astype(jnp.float32)).astype(dtype)
+
+
+def _quantizable(layer) -> bool:
+    from deeplearning4j_tpu.nn.layers.conv import ConvolutionLayer
+    from deeplearning4j_tpu.nn.layers.core import (DenseLayer,
+                                                   EmbeddingLayer)
+    return isinstance(layer, (DenseLayer, EmbeddingLayer,
+                              ConvolutionLayer))
+
+
+def quantize_net(net, calibration=None, safety_factor: float = 2.0):
+    """Post-training-quantize a ``MultiLayerNetwork``: per-channel int8
+    weights for every dense/embedding/conv layer, biases and norm
+    params untouched, activations left on the policy compute dtype.
+
+    Returns a NEW net (deep copy; the input net keeps serving) with
+    ``net.quantized_ == "int8"`` and ``net.quantization_`` holding the
+    :class:`QuantizationReport`.  ``calibration`` — an optional
+    DataSetIterator (or iterable of feature arrays): each batch runs
+    through both forwards and the observed max output deviation becomes
+    the report's calibrated ``tolerance_band``
+    (``safety_factor * max_abs_err``).
+    """
+    layers = getattr(net, "layers", None)
+    params = getattr(net, "params_", None)
+    if layers is None or not isinstance(params, list):
+        raise TypeError(
+            f"quantize_net supports MultiLayerNetwork-family nets "
+            f"(per-layer param list); got {type(net).__name__}")
+    qnet = net.clone()
+    n_quantized = 0
+    fp_bytes = 0
+    q_bytes = 0
+    for i, layer in enumerate(qnet.layers):
+        layer_params = qnet.params_[i]
+        w = layer_params.get("W") if isinstance(layer_params, dict) else None
+        if w is None or not _quantizable(layer) or w.ndim < 2:
+            continue
+        w_q, scale = quantize_weight(w)
+        new_params = {k: v for k, v in layer_params.items() if k != "W"}
+        new_params["W_q"] = w_q
+        new_params["W_scale"] = scale
+        qnet.params_[i] = new_params
+        n_quantized += 1
+        fp_bytes += int(np.prod(w.shape)) * jnp.dtype(w.dtype).itemsize
+        q_bytes += int(np.prod(w.shape)) + 4 * int(scale.shape[0])
+    report = QuantizationReport(n_quantized, fp_bytes, q_bytes)
+    if calibration is not None and n_quantized:
+        _calibrate(net, qnet, calibration, report, safety_factor)
+    qnet.quantized_ = "int8"
+    qnet.quantization_ = report
+    return qnet
+
+
+def _features(batch):
+    return batch.features if hasattr(batch, "features") else batch
+
+
+def _calibrate(net, qnet, calibration, report: QuantizationReport,
+               safety_factor: float) -> None:
+    """Holdout pass: measure the quantized forward's deviation from the
+    full-precision forward — the calibrated band parity tests (and the
+    serve runbook) hold the quantized model to."""
+    if hasattr(calibration, "reset"):
+        calibration.reset()
+    max_err = 0.0
+    sum_err = 0.0
+    count = 0
+    batches = 0
+    for batch in calibration:
+        x = _features(batch)
+        fp = np.asarray(net.output(x), np.float32)
+        q = np.asarray(qnet.output(x), np.float32)
+        err = np.abs(q - fp)
+        max_err = max(max_err, float(err.max(initial=0.0)))
+        sum_err += float(err.sum())
+        count += err.size
+        batches += 1
+    if batches:
+        report.max_abs_err = max_err
+        report.mean_abs_err = sum_err / max(count, 1)
+        report.tolerance_band = float(safety_factor * max_err)
+        report.calibration_batches = batches
+
+
+def calibrate(net, holdout, safety_factor: float = 2.0) -> QuantizationReport:
+    """Standalone calibration: quantize a copy of ``net`` and measure
+    its deviation band over ``holdout`` without deploying anything —
+    the dry-run a serving operator does before flipping precision."""
+    return quantize_net(net, calibration=holdout,
+                        safety_factor=safety_factor).quantization_

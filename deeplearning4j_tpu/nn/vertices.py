@@ -200,7 +200,9 @@ class AttentionVertex(GraphVertex):
 
     n_heads: int = 1
     causal: bool = False
-    use_flash: bool = False     # Pallas blockwise kernel (long sequences)
+    # None = auto: Pallas blockwise kernel at seq >= 1024 (the promoted
+    # default); explicit False keeps the einsum chain
+    use_flash: Optional[bool] = None
     flash_block: int = 0      # 0 = tuned default (1024×1024 blocks)
 
     def apply(self, inputs):

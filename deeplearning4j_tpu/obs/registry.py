@@ -570,6 +570,20 @@ def install_standard_metrics(registry: Optional[MetricsRegistry] = None) -> dict
                   "Feedback rows refused by the HTTP front-end (bad "
                   "payload, unknown model, no spool configured) — spool "
                   "loss made visible"),
+        r.counter("tpudl_serve_quantized_batches_total",
+                  "Micro-batches dispatched by int8-quantized inference "
+                  "engines (nn.quantize serve variants)"),
+        r.gauge("tpudl_serve_quantized_weight_bytes",
+                "Weight bytes (int8 payload + f32 scales) of the most "
+                "recently deployed quantized model"),
+        r.gauge("tpudl_serve_quantized_compression_ratio",
+                "Full-precision weight bytes over quantized weight "
+                "bytes for the most recent quantized deploy (~4x from "
+                "f32, ~2x from bf16)"),
+        r.gauge("tpudl_serve_quantized_max_abs_err",
+                "Calibrated max abs output deviation of the quantized "
+                "forward vs full precision (quantize calibration pass "
+                "over the holdout iterator)"),
         r.counter("tpudl_online_candidates_total",
                   "Fine-tune candidates the online loop produced "
                   "(gated + aborted)"),

@@ -140,18 +140,38 @@ class GatedDeployer:
         # re-load and re-evaluate the same incumbent every round
         self._incumbent_scores: dict[str, tuple[int, float]] = {}
 
+    @staticmethod
+    def _as_served(net, precision: Optional[str], calibration=None):
+        """Quantize ``net`` exactly the way ``registry.deploy`` will, so
+        the gate scores what would actually serve — scoring the
+        full-precision candidate and then deploying an int8 variant
+        would let quantization error sneak past the gate."""
+        if precision != "int8":
+            return net
+        from deeplearning4j_tpu.nn import quantize
+        return quantize.quantize_net(net, calibration=calibration)
+
     def _incumbent_score(self, entry) -> float:
         from deeplearning4j_tpu.io.model_serializer import restore_model
         cached = self._incumbent_scores.get(entry.name)
         if cached is not None and cached[0] == entry.version:
             return cached[1]
         incumbent = restore_model(entry.path, load_updater=False)
+        incumbent = self._as_served(incumbent,
+                                    getattr(entry, "precision", None))
         score = self.gate.score(incumbent)
         self._incumbent_scores[entry.name] = (entry.version, score)
         return score
 
     def deploy_if_better(self, name: str, candidate_path: str,
-                         **engine_kw) -> GateDecision:
+                         precision: Optional[str] = None,
+                         calibration=None, **engine_kw) -> GateDecision:
+        """Verify → score → compare → hot-swap.  ``precision="int8"``
+        gates a QUANTIZED candidate: the candidate is quantized before
+        scoring (the same transform the deploy applies), so the
+        non-regression decision covers the quantization error too — a
+        quantization that costs accuracy vs the serving incumbent is
+        refused here and the incumbent keeps serving."""
         from deeplearning4j_tpu.io.model_serializer import restore_model
         from deeplearning4j_tpu.resilience.checkpoint import \
             CheckpointCorruptError
@@ -167,6 +187,8 @@ class GatedDeployer:
             # verified load — a torn/bit-rotted candidate is refused
             # HERE, before scoring, long before any pointer flips
             candidate = restore_model(candidate_path, load_updater=False)
+            candidate = self._as_served(candidate, precision,
+                                        calibration=calibration)
             candidate_score = self.gate.score(candidate)
             if entry is not None:
                 incumbent_score = self._incumbent_score(entry)
@@ -191,7 +213,10 @@ class GatedDeployer:
                       f"{self.gate.min_delta:g})",
                 candidate_score, incumbent_score, t0)
         try:
-            entry = self.registry.deploy(name, candidate_path, **engine_kw)
+            entry = self.registry.deploy(name, candidate_path,
+                                         precision=precision,
+                                         calibration=calibration,
+                                         **engine_kw)
         except Exception as e:
             # deploy re-verifies the zip; a failure here never touched
             # the serving pointer — the incumbent keeps serving

@@ -18,6 +18,19 @@ import jax.numpy as jnp
 
 NEG_INF = -1e9
 
+# the promoted default (ROADMAP item 1): sequences at/above this length
+# route through the Pallas flash kernel automatically — the measured
+# crossover on v5e is ~1k (1.29x over einsum at seq 4096,
+# bench/PROFILE.md), below it the einsum chain wins on launch overhead
+FLASH_AUTO_SEQ_LEN = 1024
+
+
+def _auto_flash(q, k) -> bool:
+    """Default flash routing for ``use_flash=None``: long sequences in a
+    kernel-supported dtype.  Explicit True/False always wins."""
+    return (max(q.shape[1], k.shape[1]) >= FLASH_AUTO_SEQ_LEN
+            and q.dtype in (jnp.float32, jnp.bfloat16))
+
 
 def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                           mask: Optional[jnp.ndarray] = None,
@@ -39,7 +52,7 @@ def multi_head_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                          mask: Optional[jnp.ndarray] = None,
                          kv_mask: Optional[jnp.ndarray] = None,
                          causal: bool = False,
-                         use_flash: bool = False,
+                         use_flash: Optional[bool] = None,
                          flash_block: int = 0) -> jnp.ndarray:
     """Multi-head attention on pre-projected q/k/v of shape [B,T,H*Dh].
 
@@ -47,9 +60,14 @@ def multi_head_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     outputs, matching DL4J's masked-attention semantics); ``kv_mask`` masks
     keys only (cross-attention).  ``causal`` adds the autoregressive mask.
     ``use_flash`` routes through the Pallas blockwise kernel (no [T,T]
-    materialization, differentiable) — the long-sequence path.
+    materialization, differentiable) — ``None`` (the default) auto-enables
+    it for seq_len >= ``FLASH_AUTO_SEQ_LEN`` (1024), where the kernel is
+    the measured winner; an explicit ``False`` always keeps the einsum
+    chain.
     """
     b, tq, d = q.shape
+    if use_flash is None:
+        use_flash = _auto_flash(q, k)
     if use_flash:
         from deeplearning4j_tpu.ops.pallas import flash_attention
         key_mask = mask if mask is not None else kv_mask

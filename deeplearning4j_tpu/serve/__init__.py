@@ -12,6 +12,11 @@ of users"), composing the earlier PRs' substrate into one path:
 - :class:`ModelRegistry` — versioned deploy/hot-swap/rollback, loading
   models only through the PR-4 verified checkpoint path (a corrupt zip
   is refused before anything flips; the current version keeps serving).
+  ``deploy(..., precision="int8")`` serves a post-training-quantized
+  variant (``nn.quantize``: per-channel int8 weights, bf16 activations,
+  fused dequant-matmul kernel) that shares the compiled-forward cache
+  and bucket set with its full-precision sibling — see
+  docs/serving.md "Quantized serving".
 - :class:`ModelServer` — stdlib HTTP JSON endpoint
   (``POST /v1/models/<name>:predict``, ``POST .../<name>:feedback``,
   ``GET /v1/models``, ``GET /healthz`` readiness, ``GET /metrics``).

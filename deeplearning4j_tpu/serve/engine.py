@@ -146,6 +146,13 @@ class InferenceEngine:
         self._queue: queue.Queue = queue.Queue(maxsize=self.queue_limit)
         self._closed = threading.Event()
         self._fwd = None
+        # quantized variant (nn.quantize): same class + config as its
+        # full-precision sibling, so it SHARES the step-cached forward —
+        # the int8 param pytree just holds its own compiled program per
+        # bucket under the same jit boundary (zero-recompile swaps both
+        # ways once each precision is warm).  Cost-model entries and the
+        # tpudl_serve_quantized_* series key off this flag.
+        self.precision: str = getattr(model, "quantized_", None) or "fp"
         if _pure_forward_net(model):
             sig = step_cache.net_signature(model)
             key = sig + ("serve_forward",) if sig is not None else None
@@ -305,9 +312,15 @@ class InferenceEngine:
             analyze_args = None
             # per-bucket cost entries: one forward fn holds one compiled
             # program PER bucket, and bucket-B's wall time must be
-            # attributed bucket-B's FLOPs, not the first-analyzed one's
+            # attributed bucket-B's FLOPs, not the first-analyzed one's.
+            # A quantized engine shares the forward fn with its
+            # full-precision sibling, so the precision joins the
+            # signature — int8's (fewer) weight bytes must not launder
+            # into the bf16 program's roofline numbers or vice versa.
+            cost_sig = (bucket, self.precision) if self.precision != "fp" \
+                else bucket
             if self._fwd is not None \
-                    and costmodel.should_analyze(self._fwd, sig=bucket):
+                    and costmodel.should_analyze(self._fwd, sig=cost_sig):
                 analyze_args = costmodel.abstractify(
                     (self.model.params_, self.model.state_, features, mask))
             with tracing.span("serve", model=self.name, rows=rows,
@@ -343,15 +356,18 @@ class InferenceEngine:
             if retraced > 0:
                 reg.counter("tpudl_serve_recompiles_total").inc(retraced)
             if analyze_args is not None:
+                kind = (costmodel.program_kind(self._fwd)
+                        or f"serve:{type(self.model).__name__}")
+                if self.precision != "fp":
+                    kind = f"{kind}:{self.precision}"
                 costmodel.schedule_analysis(
-                    self._fwd, analyze_args,
-                    kind=(costmodel.program_kind(self._fwd)
-                          or f"serve:{type(self.model).__name__}"),
-                    sig=bucket)
+                    self._fwd, analyze_args, kind=kind, sig=cost_sig)
             if retraced == 0:
                 # steady-state micro-batch: serving self-reports MFU/HBM
                 # utilization of its compiled forward too
-                costmodel.observe_step(self._fwd, device_s, sig=bucket)
+                costmodel.observe_step(self._fwd, device_s, sig=cost_sig)
+            if self.precision != "fp":
+                reg.counter("tpudl_serve_quantized_batches_total").inc()
             flight_recorder.progress("serve.dispatch")
             flight_recorder.record(
                 "serve", model=self.name, rows=rows, requests=len(live),

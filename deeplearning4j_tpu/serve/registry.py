@@ -43,10 +43,41 @@ SERVING = "serving"
 RETIRED = "retired"
 
 
+def _apply_precision(net, precision: Optional[str], calibration):
+    """Resolve a deploy's precision request.  ``None``/``"bf16"``/
+    ``"f32"`` serve the net exactly as loaded; ``"int8"`` post-training-
+    quantizes it (``nn.quantize``) and stamps the
+    ``tpudl_serve_quantized_*`` gauges from the quantization report."""
+    if precision in (None, "bf16", "f32", "fp32", "float32"):
+        return net, (precision or "bf16")
+    if precision != "int8":
+        raise ValueError(f"unknown deploy precision {precision!r}; "
+                         f"expected 'int8', 'bf16' or 'f32'")
+    from deeplearning4j_tpu.nn import quantize
+    from deeplearning4j_tpu.obs import flight_recorder
+    qnet = quantize.quantize_net(net, calibration=calibration)
+    report = qnet.quantization_
+    reg = get_registry()
+    reg.gauge("tpudl_serve_quantized_weight_bytes").set(
+        report.quantized_weight_bytes)
+    reg.gauge("tpudl_serve_quantized_compression_ratio").set(
+        report.compression_ratio)
+    # always write the gauge: an uncalibrated deploy (rollbacks forward
+    # precision but never calibration) must read as unknown (NaN), not
+    # as the PREVIOUS model's deviation band
+    reg.gauge("tpudl_serve_quantized_max_abs_err").set(
+        report.max_abs_err if report.max_abs_err is not None
+        else float("nan"))
+    flight_recorder.record("serve_quantize", **report.to_dict())
+    return qnet, "int8"
+
+
 @dataclasses.dataclass
 class ModelVersion:
     """One deployed (name, version): the loaded net rides inside the
-    engine; retired versions keep only their zip path for rollback."""
+    engine; retired versions keep only their zip path (and precision —
+    a rollback must restore the variant that actually served, not
+    silently change precision)."""
 
     name: str
     version: int
@@ -54,11 +85,13 @@ class ModelVersion:
     status: str
     deployed_at: float
     engine: Optional[InferenceEngine] = None
+    precision: str = "bf16"
 
     def to_dict(self) -> dict:
         return {"name": self.name, "version": self.version,
                 "path": self.path, "status": self.status,
-                "deployed_at": self.deployed_at}
+                "deployed_at": self.deployed_at,
+                "precision": self.precision}
 
 
 class ModelRegistry:
@@ -93,15 +126,30 @@ class ModelRegistry:
             return self._swaps_in_flight == 0
 
     # --------------------------------------------------------- deploy
-    def deploy(self, name: str, path: str, **engine_kw) -> ModelVersion:
+    def deploy(self, name: str, path: str, precision: Optional[str] = None,
+               calibration=None, **engine_kw) -> ModelVersion:
         """Load ``path`` through the verified serializer and make it the
         current version of ``name``.  Raises ``CheckpointCorruptError``
         (corrupt zip) or the serializer's errors WITHOUT touching the
-        currently-serving version."""
+        currently-serving version.
+
+        ``precision="int8"`` post-training-quantizes the verified load
+        (``nn.quantize``: per-channel int8 weights, activations stay on
+        the policy compute dtype) before the engine is built — the
+        quantized variant shares the step-cached forward and bucket set
+        with its full-precision sibling, so swapping precisions on one
+        architecture recompiles nothing once both are warm.
+        ``calibration`` (optional DataSetIterator) runs the quantize
+        calibration pass and stamps the deviation-band gauges.  NOTE:
+        an accuracy gate is deliberately NOT applied here — route
+        quantized deploys through ``online.gate.GatedDeployer`` so a
+        quantization that costs accuracy is refused, not served.
+        """
         from deeplearning4j_tpu.io.model_serializer import restore_model
         # verified load happens OUTSIDE the swap window: readiness only
         # flips for the engine-build + pointer-flip + drain
         net = restore_model(path, load_updater=False)
+        net, precision = _apply_precision(net, precision, calibration)
         kw = {**self.engine_defaults, **engine_kw}
         with self._swap():
             engine = InferenceEngine(net, name=name, **kw)
@@ -109,7 +157,8 @@ class ModelRegistry:
                 version = self._next_version.get(name, 0) + 1
                 self._next_version[name] = version
                 entry = ModelVersion(name, version, str(path), SERVING,
-                                     time.time(), engine)
+                                     time.time(), engine,
+                                     precision=precision)
                 old = self._current.get(name)
                 self._current[name] = entry
                 self._history.setdefault(name, []).append(entry)
@@ -124,8 +173,8 @@ class ModelRegistry:
         return entry
 
     def rollback(self, name: str) -> ModelVersion:
-        """Redeploy the newest retired version's zip (re-verified) as a
-        new version number."""
+        """Redeploy the newest retired version's zip (re-verified, same
+        precision it served at) as a new version number."""
         with self._lock:
             history = self._history.get(name, [])
             previous = next((mv for mv in reversed(history)
@@ -133,7 +182,8 @@ class ModelRegistry:
         if previous is None:
             raise LookupError(f"model {name!r} has no previous version "
                               f"to roll back to")
-        return self.deploy(name, previous.path)
+        return self.deploy(name, previous.path,
+                           precision=previous.precision)
 
     def undeploy(self, name: str) -> None:
         """Remove ``name`` entirely (drains its engine)."""

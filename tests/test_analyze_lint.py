@@ -708,3 +708,74 @@ def test_deploy_outside_gate_needs_registry_import_and_loop_tokens(tmp_path):
         """)
     assert report.by_rule("TPU313") == []
     assert report.exit_code() == 0
+
+
+# ------------------------------------------------------------ TPU314
+def test_upcast_in_serving_path_flags_astype_and_dequantize(tmp_path):
+    """Seeded defects: a float32 astype and a per-request dequantize in
+    serving-token functions each flag with the rule ID."""
+    report = _lint_source(tmp_path, """
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.quantize import dequantize_weight
+
+        def predict_quantized(params, x):
+            w = params["W_q"].astype(jnp.float32)
+            return x @ (w * params["W_scale"])
+
+        def handle_request(self, params, x):
+            w = dequantize_weight(params["W_q"], params["W_scale"])
+            return x @ w
+        """)
+    hits = report.by_rule("TPU314")
+    assert len(hits) == 2
+    assert any("astype" in h.message for h in hits)
+    assert any("dequantize" in h.message for h in hits)
+    assert report.exit_code() == 1
+
+
+def test_upcast_in_serving_path_flags_http_handlers_and_f64(tmp_path):
+    """do_POST is per-request by contract; float64 widens too, and the
+    keyword form astype(dtype=...) must not escape."""
+    report = _lint_source(tmp_path, """
+        import numpy as np
+
+        class Handler:
+            def do_POST(self):
+                x = self.read_body().astype(np.float64)
+                return self.answer(x)
+
+        def predict(params, x):
+            return x.astype(dtype=np.float32) @ params["W"]
+        """)
+    assert len(report.by_rule("TPU314")) == 2
+
+
+def test_upcast_in_serving_path_exemptions(tmp_path):
+    """Builders (deploy-time dequant), non-serving functions (loss math
+    may upcast), narrowing casts, and reasoned pragmas all stay clean."""
+    report = _lint_source(tmp_path, """
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.quantize import dequantize_weight
+
+        def build_serving_weights(params):
+            # one-time deploy-time dequant: exactly where it belongs
+            return dequantize_weight(params["W_q"], params["W_scale"])
+
+        def compute_score_array(z, labels):
+            z = z.astype(jnp.float32)       # loss math upcasts by design
+            return z - labels
+
+        def predict(params, x):
+            x = x.astype(jnp.bfloat16)      # narrowing is the point
+            return x @ params["W"]
+        """)
+    assert report.by_rule("TPU314") == []
+    assert report.exit_code() == 0
+    report = _lint_source(tmp_path, """
+        import numpy as np
+
+        def predict_rows(x):
+            # tpudl: ok(TPU314) — host-side JSON decode, not an HBM tensor
+            return np.asarray(x).astype(np.float32)
+        """)
+    assert report.by_rule("TPU314") == []

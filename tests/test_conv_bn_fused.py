@@ -156,7 +156,8 @@ class TestFusedBottleneck:
         from deeplearning4j_tpu.models import resnet50
         from deeplearning4j_tpu.models.zoo import remap_bottleneck_params
         rng = np.random.default_rng(3)
-        net_u = resnet50(height=32, width=32, num_classes=10).init()
+        net_u = resnet50(height=32, width=32, num_classes=10,
+                         fused=False).init()
         net_f = resnet50(height=32, width=32, num_classes=10, fused=True).init()
         x = jnp.asarray(rng.normal(size=(2, 32, 32, 3)).astype(np.float32))
         # train one step worth of stats so running mean/var are non-trivial
@@ -178,3 +179,53 @@ class TestFusedBottleneck:
         for k in pu:
             jax.tree.map(np.testing.assert_array_equal,
                          pu[k], net_u.params_[k])
+
+
+class TestFusedConvDefault:
+    """ISSUE 11 satellite: FusedBottleneck is the DEFAULT conv-zoo
+    lowering behind ``config.fused_conv`` (on by default); an explicit
+    ``fused=`` argument always wins.  The numeric pin against the
+    unfused path is ``test_checkpoint_remap_fused_unfused`` above —
+    here the default graph is proven to be the fused one AND to match
+    the unfused oracle on the same weights."""
+
+    def test_default_follows_config_and_explicit_wins(self):
+        from deeplearning4j_tpu.config import set_config
+        from deeplearning4j_tpu.models import resnet50
+
+        def bottleneck_layers(net):
+            return [v.obj for v in net.conf.vertices
+                    if isinstance(v.obj, FusedBottleneck)]
+
+        try:
+            assert bottleneck_layers(
+                resnet50(height=32, width=32, num_classes=4)), \
+                "config.fused_conv=True (default) must build FusedBottleneck"
+            assert not bottleneck_layers(
+                resnet50(height=32, width=32, num_classes=4, fused=False))
+            set_config(fused_conv=False)
+            assert not bottleneck_layers(
+                resnet50(height=32, width=32, num_classes=4))
+            assert bottleneck_layers(
+                resnet50(height=32, width=32, num_classes=4, fused=True))
+        finally:
+            set_config(fused_conv=True)
+
+    def test_default_graph_matches_unfused_oracle(self):
+        """The shipped default (fused) evaluates to the same function as
+        the unfused graph under remapped weights."""
+        from deeplearning4j_tpu.models import resnet50
+        from deeplearning4j_tpu.models.zoo import remap_bottleneck_params
+        rng = np.random.default_rng(7)
+        net_d = resnet50(height=32, width=32, num_classes=4).init()
+        assert any(isinstance(v.obj, FusedBottleneck)
+                   for v in net_d.conf.vertices)
+        net_u = resnet50(height=32, width=32, num_classes=4,
+                         fused=False).init()
+        pu, su = remap_bottleneck_params(net_d.params_, net_d.state_,
+                                         to_fused=False)
+        net_u.params_, net_u.state_ = pu, su
+        x = jnp.asarray(rng.normal(size=(2, 32, 32, 3)).astype(np.float32))
+        np.testing.assert_allclose(np.asarray(net_u.output(x)),
+                                   np.asarray(net_d.output(x)),
+                                   rtol=2e-4, atol=2e-4)

@@ -71,7 +71,10 @@ def test_bench_skip_path_runs_cpu_records_and_exits_zero(monkeypatch,
     monkeypatch.setattr(
         bench, "bench_serving",
         lambda: {"metric": "serving_requests_per_s", "value": 100.0,
-                 "mfu": 0.02, "hbm_util": 0.06, "arith_intensity": 3.7})
+                 "mfu": 0.02, "hbm_util": 0.06, "arith_intensity": 3.7,
+                 "quantized": {"speedup": 1.4, "p99_ratio": 0.8,
+                               "wins": True, "intensity_gain": 1.25,
+                               "arith_intensity_int8": 4.6}})
     monkeypatch.setattr(
         bench, "bench_multichip",
         lambda: {"metric": "multichip_scaling_efficiency", "value": 0.8,
@@ -89,6 +92,12 @@ def test_bench_skip_path_runs_cpu_records_and_exits_zero(monkeypatch,
     assert record["status"] == "skipped"
     assert record["detail"]["feed_overlap"]["speedup"] == 1.4
     assert record["detail"]["serving"]["value"] == 100.0
+    # the ISSUE-11 quantized row (int8 vs bf16 + the cost-model
+    # intensity stamps) rides the tunnel-down record inside the serving
+    # row — a down tunnel still produces the quantized evidence
+    quantized = record["detail"]["serving"]["quantized"]
+    assert quantized["wins"] is True
+    assert quantized["intensity_gain"] == 1.25
     # the multichip scaling row rides the tunnel-down record too —
     # federated telemetry is CPU-measurable, so rc=0 with data, not rc=1
     multichip = record["detail"]["multichip"]

@@ -340,3 +340,49 @@ class TestConv3BnFused:
         for gi, gri in zip(g, gr):
             np.testing.assert_allclose(np.asarray(gi), np.asarray(gri),
                                        rtol=1e-4, atol=1e-5)
+
+
+class TestFlashAutoDefault:
+    """ISSUE 11 satellite: the flash kernel is the standard BERT path —
+    ``use_flash=None`` auto-enables at seq >= 1024 (explicit False
+    still wins), with numeric parity against the einsum path."""
+
+    def test_auto_matches_einsum_at_long_seq(self):
+        from deeplearning4j_tpu.ops.attention import multi_head_attention
+        rng = np.random.default_rng(21)
+        b, t, h, d = 1, 1024, 2, 8
+        q, k, v = (jnp.asarray(rng.normal(size=(b, t, h * d))
+                               .astype(np.float32)) for _ in range(3))
+        auto = multi_head_attention(q, k, v, n_heads=h)          # default
+        einsum = multi_head_attention(q, k, v, n_heads=h, use_flash=False)
+        np.testing.assert_allclose(np.asarray(auto), np.asarray(einsum),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_auto_routing_thresholds(self, monkeypatch):
+        """seq >= 1024 routes to the kernel, shorter stays on einsum,
+        and an explicit False beats the auto promotion."""
+        from deeplearning4j_tpu.ops import pallas as pallas_mod
+        from deeplearning4j_tpu.ops.attention import multi_head_attention
+        calls = []
+        real = pallas_mod.flash_attention
+
+        def spy(*a, **kw):
+            calls.append(kw.get("block_q"))
+            return real(*a, **kw)
+
+        monkeypatch.setattr(pallas_mod, "flash_attention", spy)
+        rng = np.random.default_rng(22)
+        short = jnp.asarray(rng.normal(size=(1, 64, 16)).astype(np.float32))
+        long = jnp.asarray(rng.normal(size=(1, 1024, 16)).astype(np.float32))
+        multi_head_attention(short, short, short, n_heads=2)
+        assert calls == []                       # short seq: einsum path
+        multi_head_attention(long, long, long, n_heads=2)
+        assert len(calls) == 1                   # long seq: promoted
+        multi_head_attention(long, long, long, n_heads=2, use_flash=False)
+        assert len(calls) == 1                   # explicit False wins
+
+    def test_bert_config_default_is_auto(self):
+        from deeplearning4j_tpu.models.bert import BertConfig
+        from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+        assert BertConfig().use_flash is None
+        assert SelfAttentionLayer().use_flash is None

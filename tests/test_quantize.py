@@ -1,0 +1,301 @@
+"""tpudl.nn.quantize + the quantized serve path (ISSUE 11 tentpole).
+
+Acceptance: the fused int8 dequant-matmul kernel matches the jnp oracle
+in the 1e-2 band; a quantized net's predictions match full precision
+within its CALIBRATED tolerance band; ``ModelRegistry.deploy(...,
+precision="int8")`` serves a quantized variant that shares the
+step-cache/bucket machinery; ``GatedDeployer`` demonstrably refuses an
+accuracy-regressing quantization (test-injected) before any flip; and
+hot-swapping between warmed bf16 and int8 variants of one architecture
+under concurrent load drops zero requests and triggers zero
+shared-bucket recompiles.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn import quantize
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.obs.registry import (MetricsRegistry, get_registry,
+                                             set_registry)
+from deeplearning4j_tpu.ops.pallas import (int8_matmul_pallas,
+                                           int8_matmul_reference)
+from deeplearning4j_tpu.serve import InferenceEngine, ModelRegistry
+from deeplearning4j_tpu.train import Sgd
+
+N_IN, N_OUT = 12, 4
+
+
+@pytest.fixture
+def metrics():
+    prev = set_registry(MetricsRegistry())
+    yield get_registry()
+    set_registry(prev)
+
+
+def _net(seed=3):
+    return MultiLayerNetwork(
+        NeuralNetConfiguration.builder()
+        .seed(seed).updater(Sgd(0.1)).weight_init("xavier").list()
+        .layer(DenseLayer(n_out=24, activation="relu"))
+        .layer(OutputLayer(n_out=N_OUT, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(N_IN))
+        .build()).init()
+
+
+def _clustered_data(n=96, seed=0):
+    """Linearly separable 4-class blobs — a net trained on these holds
+    a real accuracy for the gate to defend."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, N_OUT, n)
+    centers = rng.normal(size=(N_OUT, N_IN)) * 4.0
+    x = centers[labels] + rng.normal(size=(n, N_IN)) * 0.3
+    y = np.eye(N_OUT, dtype=np.float32)[labels]
+    return x.astype(np.float32), y
+
+
+# -------------------------------------------------------------- kernel
+class TestInt8MatmulKernel:
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_kernel_matches_oracle_in_band(self, dtype):
+        """Interpreter-mode Pallas kernel vs the pure-jnp oracle: the
+        1e-2 relative band (quantization noise dwarfs kernel rounding)."""
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(37, 64)).astype(np.float32)
+                        ).astype(dtype)
+        w = jnp.asarray(rng.normal(size=(64, 48)).astype(np.float32) * 0.4)
+        w_q, scale = quantize.quantize_weight(w)
+        yk = np.asarray(int8_matmul_pallas(x, w_q, scale, interpret=True),
+                        np.float32)
+        yo = np.asarray(int8_matmul_reference(x, w_q, scale), np.float32)
+        np.testing.assert_allclose(yk, yo, rtol=1e-2, atol=1e-2)
+        # and the whole quantized product stays in the band vs full
+        # precision
+        fp = np.asarray(x.astype(jnp.float32) @ w, np.float32)
+        assert np.max(np.abs(yo - fp)) < 1e-2 * max(1.0, np.abs(fp).max())
+
+    def test_kernel_pads_ragged_m_and_keeps_dtype(self):
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(13, 32)).astype(np.float32)
+                        ).astype(jnp.bfloat16)
+        w_q, scale = quantize.quantize_weight(
+            jnp.asarray(rng.normal(size=(32, 16)).astype(np.float32)))
+        y = int8_matmul_pallas(x, w_q, scale, interpret=True, block_m=8)
+        assert y.shape == (13, 16) and y.dtype == jnp.bfloat16
+
+    def test_quantize_weight_roundtrip_error_bound(self):
+        """Symmetric per-channel int8: reconstruction error <= scale/2
+        per channel (round-to-nearest)."""
+        rng = np.random.default_rng(2)
+        w = jnp.asarray(rng.normal(size=(40, 24)).astype(np.float32))
+        w_q, scale = quantize.quantize_weight(w)
+        assert w_q.dtype == jnp.int8 and scale.shape == (24,)
+        err = np.abs(np.asarray(quantize.dequantize_weight(w_q, scale) - w))
+        assert np.all(err <= np.asarray(scale)[None, :] * 0.5 + 1e-7)
+
+
+# --------------------------------------------------------- quantize_net
+class TestQuantizeNet:
+    def test_predictions_within_calibrated_band(self):
+        net = _net()
+        x, y = _clustered_data(seed=5)
+        it = ArrayDataSetIterator(x, y, 32)
+        qnet = quantize.quantize_net(net, calibration=it)
+        report = qnet.quantization_
+        assert qnet.quantized_ == "int8"
+        assert report.layers_quantized == 2
+        assert report.compression_ratio > 3.0
+        assert report.tolerance_band is not None
+        fp = np.asarray(net.output(x))
+        q = np.asarray(qnet.output(x))
+        assert np.max(np.abs(q - fp)) <= report.tolerance_band
+        # the source net is untouched (it keeps serving while the
+        # quantized candidate is scored)
+        assert "W" in net.params_[0] and "W_q" in qnet.params_[0]
+
+    def test_non_mln_model_rejected(self):
+        with pytest.raises(TypeError, match="MultiLayerNetwork"):
+            quantize.quantize_net(object())
+
+
+# ------------------------------------------------------ serve precision
+class TestQuantizedServe:
+    def test_deploy_precision_int8_serves_and_stamps_gauges(
+            self, tmp_path, metrics):
+        net = _net(7)
+        x, y = _clustered_data(seed=6)
+        p = str(tmp_path / "m.zip")
+        net.save(p)
+        registry = ModelRegistry(max_batch=8, max_latency_ms=2)
+        entry = registry.deploy("m", p, precision="int8",
+                                calibration=ArrayDataSetIterator(x, y, 32))
+        assert entry.precision == "int8"
+        assert entry.to_dict()["precision"] == "int8"
+        assert entry.engine.precision == "int8"
+        out = np.asarray(registry.predict("m", x[:4], timeout_s=30))
+        fp = np.asarray(net.output(x[:4]))
+        assert np.max(np.abs(out - fp)) < 0.05
+        assert metrics.gauge(
+            "tpudl_serve_quantized_weight_bytes").value > 0
+        assert metrics.gauge(
+            "tpudl_serve_quantized_compression_ratio").value > 3.0
+        assert metrics.gauge(
+            "tpudl_serve_quantized_max_abs_err").value >= 0
+        assert metrics.counter(
+            "tpudl_serve_quantized_batches_total").value >= 1
+        registry.close()
+
+    def test_unknown_precision_rejected_before_flip(self, tmp_path, metrics):
+        net = _net(8)
+        p = str(tmp_path / "m.zip")
+        net.save(p)
+        registry = ModelRegistry(max_batch=4, max_latency_ms=2)
+        registry.deploy("m", p)
+        with pytest.raises(ValueError, match="precision"):
+            registry.deploy("m", p, precision="int4")
+        assert registry.get("m").version == 1     # incumbent untouched
+        registry.close()
+
+    def test_rollback_restores_precision(self, tmp_path, metrics):
+        net = _net(9)
+        p = str(tmp_path / "m.zip")
+        net.save(p)
+        registry = ModelRegistry(max_batch=4, max_latency_ms=2)
+        registry.deploy("m", p, precision="int8")
+        registry.deploy("m", p)                   # v2: bf16
+        rolled = registry.rollback("m")           # back to the int8 variant
+        assert rolled.precision == "int8"
+        registry.close()
+
+    def test_hot_swap_bf16_int8_zero_drops_zero_recompiles(
+            self, tmp_path, metrics):
+        """The acceptance flagship: warmed bf16 and int8 variants of ONE
+        architecture swap under concurrent load with zero dropped
+        requests and zero shared-bucket recompiles."""
+        net = _net(11)
+        x, _ = _clustered_data(seed=7)
+        p = str(tmp_path / "m.zip")
+        net.save(p)
+        registry = ModelRegistry(max_batch=4, max_latency_ms=2,
+                                 queue_limit=512, buckets=(4,))
+        registry.deploy("m", p)
+        registry.predict("m", x[:4], timeout_s=30)      # warm bf16 bucket
+        registry.deploy("m", p, precision="int8")
+        registry.predict("m", x[:4], timeout_s=30)      # warm int8 bucket
+        fp = np.asarray(net.output(x))
+        recompiles_warm = metrics.counter(
+            "tpudl_serve_recompiles_total").value
+        programs_warm = registry.get("m").engine.compiled_programs
+
+        errors, results = [], []
+        stop = threading.Event()
+
+        def client(cid):
+            rng = np.random.default_rng(cid)
+            count = 0
+            while not (stop.is_set() and count >= 10):
+                i = int(rng.integers(0, x.shape[0] - 4))
+                try:
+                    out = registry.predict("m", x[i:i + 4], timeout_s=30)
+                    results.append((i, np.asarray(out)))
+                except BaseException as e:  # noqa: BLE001 — test collects
+                    errors.append(e)
+                count += 1
+                if count > 400:
+                    break
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(4)]
+        for t in threads:
+            t.start()
+        # swap precision back and forth mid-traffic
+        registry.deploy("m", p)                         # → bf16
+        time.sleep(0.1)
+        registry.deploy("m", p, precision="int8")       # → int8
+        time.sleep(0.1)
+        registry.deploy("m", p)                         # → bf16
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+
+        assert not errors, errors[:3]
+        assert len(results) >= 40
+        for i, rows in results:
+            # every answer is a valid output of one of the two variants
+            # (int8 sits inside the calibrated band of bf16)
+            assert np.max(np.abs(rows - fp[i:i + 4])) < 0.05, \
+                f"garbled response for rows {i}..{i + 4}"
+        # zero shared-bucket recompiles: both precisions were warm, so
+        # the swaps traced nothing new
+        assert metrics.counter("tpudl_serve_recompiles_total").value \
+            == recompiles_warm
+        assert registry.get("m").engine.compiled_programs == programs_warm
+        registry.close()
+
+
+# ------------------------------------------------------------- the gate
+class TestQuantizedGate:
+    def _trained_net_and_holdout(self, tmp_path):
+        x, y = _clustered_data(n=128, seed=13)
+        net = _net(13)
+        net.fit(ArrayDataSetIterator(x[:96], y[:96], 32), epochs=30)
+        holdout = ArrayDataSetIterator(x[96:], y[96:], 32)
+        acc = net.evaluate(holdout).accuracy()
+        assert acc > 0.9, f"fixture net failed to train (acc={acc})"
+        p = str(tmp_path / "m.zip")
+        net.save(p)
+        return net, holdout, p
+
+    def test_gate_accepts_accuracy_preserving_quantization(
+            self, tmp_path, metrics):
+        from deeplearning4j_tpu.online.gate import EvalGate, GatedDeployer
+        net, holdout, p = self._trained_net_and_holdout(tmp_path)
+        registry = ModelRegistry(max_batch=8, max_latency_ms=2)
+        registry.deploy("m", p)                     # bf16 incumbent
+        deployer = GatedDeployer(registry, EvalGate(holdout, "accuracy"))
+        decision = deployer.deploy_if_better("m", p, precision="int8")
+        assert decision.deploy, decision.reason
+        assert registry.get("m").precision == "int8"
+        assert metrics.counter("tpudl_online_deploys_total").value == 1
+        registry.close()
+
+    def test_gate_refuses_accuracy_regressing_quantization(
+            self, tmp_path, metrics, monkeypatch):
+        """Test-injected regression: a quantization that destroys the
+        weights must be refused BEFORE any flip — the bf16 incumbent
+        keeps serving."""
+        from deeplearning4j_tpu.online.gate import EvalGate, GatedDeployer
+        net, holdout, p = self._trained_net_and_holdout(tmp_path)
+        registry = ModelRegistry(max_batch=8, max_latency_ms=2)
+        registry.deploy("m", p)
+        incumbent_out = np.asarray(
+            registry.predict("m", holdout.features[:4], timeout_s=30))
+
+        def broken_quantize_weight(w):
+            w_q = jnp.zeros(np.asarray(w).shape, jnp.int8)
+            return w_q, jnp.ones((np.asarray(w).shape[-1],), jnp.float32)
+
+        monkeypatch.setattr(quantize, "quantize_weight",
+                            broken_quantize_weight)
+        deployer = GatedDeployer(registry, EvalGate(holdout, "accuracy"))
+        decision = deployer.deploy_if_better("m", p, precision="int8")
+        assert not decision.deploy
+        assert "regression" in decision.reason
+        assert metrics.counter("tpudl_online_refusals_total").value == 1
+        # the flip never happened: same version, same precision, and the
+        # incumbent still answers with its own weights
+        entry = registry.get("m")
+        assert entry.version == 1 and entry.precision == "bf16"
+        np.testing.assert_allclose(
+            np.asarray(registry.predict("m", holdout.features[:4],
+                                        timeout_s=30)),
+            incumbent_out, rtol=1e-5, atol=1e-6)
+        registry.close()
