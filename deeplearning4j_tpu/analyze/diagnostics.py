@@ -265,6 +265,25 @@ _RULE_LIST = [
         "do it once at deploy; if the upcast is genuinely required "
         "(e.g. host-side JSON decode), suppress with a reasoned "
         "'# tpudl: ok(TPU314) — <why>'."),
+    RuleInfo(
+        "TPU315", "live-compile-in-restart-path", ERROR,
+        "jax.jit built (or a .lower().compile() AOT chain run) inside a "
+        "deploy/resume/respawn/rollback-path function instead of "
+        "warming from the compiled-artifact store "
+        "(train/artifact_store.py itself exempt)",
+        "Restarts are routine — the supervisor respawns gangs, the "
+        "online loop hot-swaps continuously — and the artifact store "
+        "exists precisely so those paths deserialize compiled programs "
+        "instead of paying live XLA compilation before first traffic.  "
+        "A jit build or an eager lower().compile() inside a restart-"
+        "path function reintroduces the seconds-to-minutes cold start "
+        "the store eliminated, silently, on exactly the path MTTR is "
+        "measured on.",
+        "Warm from the store (artifact_store.warm_from_zip at "
+        "deploy/resume time; bake at checkpoint/deploy time via "
+        "bake_artifacts/ensure_zip_artifacts) and let train.step_cache "
+        "hand out the warmed step; one-time builders (make_/build_ "
+        "factories) may compile."),
     # ---- concurrency (AST, whole-repo thread model) -------------------
     RuleInfo(
         "TPU400", "bad-suppression", ERROR,

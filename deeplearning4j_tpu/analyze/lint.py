@@ -21,7 +21,10 @@ exit defeats supervision and drops the black box), TPU313
 (ModelRegistry.deploy called directly from online-loop code — a
 candidate reaches serving only through the eval gate), TPU314 (dtype
 upcast or per-request dequantize inside serving-path functions — the
-quantized serve win undone on the request path).
+quantized serve win undone on the request path), TPU315 (jax.jit build
+or eager lower().compile() inside a deploy/resume/respawn-path
+function — restart paths warm from the compiled-artifact store, they
+don't compile).
 Registry-backed rules that ride along in ``lint_package``/``--self``:
 TPU305 (metric names — the former ``obs.check`` lint) and TPU306
 (op-spec catalog integrity).
@@ -1074,6 +1077,69 @@ def _rule_upcast_in_serving_path(mod: ModuleInfo) -> list[Diagnostic]:
                     f"request — fuse the dequant into the matmul "
                     f"(ops.pallas.quant_matmul) or dequantize once at "
                     f"deploy time",
+                    path=mod.anchor(node)))
+    return out
+
+
+# whole-name tokens marking a function as a restart path for TPU315 —
+# the code that brings a model or a trainer back up after a process
+# death, a hot-swap or a rollback, where the artifact store exists so
+# first traffic never waits on XLA
+_RESTART_TOKENS = {"deploy", "redeploy", "resume", "respawn", "restart",
+                   "rollback", "warm"}
+# the store itself must lower+compile — that IS baking
+_ARTIFACT_STORE_EXEMPT_SUFFIX = "train/artifact_store.py"
+
+
+def _is_lower_compile_chain(node: ast.Call) -> bool:
+    """``<x>.lower(...).compile(...)`` — the eager AOT compile idiom
+    (matching bare ``.compile(`` would false-positive on re.compile)."""
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == "compile"
+            and isinstance(f.value, ast.Call)
+            and isinstance(f.value.func, ast.Attribute)
+            and f.value.func.attr == "lower")
+
+
+@register_lint_rule("TPU315")
+def _rule_live_compile_in_restart_path(mod: ModuleInfo) -> list[Diagnostic]:
+    """jax.jit built — or an eager ``.lower().compile()`` AOT chain run —
+    inside a deploy/resume/respawn/rollback-token function: the restart
+    paths are exactly where the compiled-artifact store must be warmed
+    instead of paying live XLA compilation before first traffic.
+    Builder-token factories are exempt (they create the compiled
+    forward once, off the restart path), as is the store module itself
+    (baking IS lower+compile)."""
+    norm = mod.path.replace(os.sep, "/")
+    if norm == _ARTIFACT_STORE_EXEMPT_SUFFIX \
+            or norm.endswith("/" + _ARTIFACT_STORE_EXEMPT_SUFFIX):
+        return []
+    out = []
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        tokens = set(fn.name.lower().strip("_").split("_"))
+        if not tokens & _RESTART_TOKENS or tokens & _BUILDER_TOKENS:
+            continue
+        for node in _walk_shallow(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_jit_build(mod, node):
+                out.append(Diagnostic(
+                    "TPU315",
+                    f"jax.jit built inside restart-path '{fn.name}' — a "
+                    f"deploy/resume/respawn pays live trace+compile "
+                    f"before first traffic instead of warming from the "
+                    f"compiled-artifact store (train/artifact_store)",
+                    path=mod.anchor(node)))
+            elif _is_lower_compile_chain(node):
+                out.append(Diagnostic(
+                    "TPU315",
+                    f".lower().compile() run inside restart-path "
+                    f"'{fn.name}' — an eager AOT compile on the restart "
+                    f"path recreates the cold start the artifact store "
+                    f"removes; bake at checkpoint/deploy time and warm "
+                    f"here instead",
                     path=mod.anchor(node)))
     return out
 

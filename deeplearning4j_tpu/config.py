@@ -74,6 +74,19 @@ class Config:
       wins.
     - ``compile_cache_dir``: when set, enables jax's persistent
       compilation cache there (XLA programs survive process restarts).
+    - ``artifact_store``: honor the compiled-artifact store
+      (``train.artifact_store``): warm-load serialized executables from
+      checkpoint zips at deploy/resume/respawn time and dispatch
+      matching calls to them with zero JIT on the request path.  On by
+      default (loading is cheap and refuses stale artifacts);
+      ``DL4J_TPU_ARTIFACT_STORE=0`` reverts to live compilation
+      everywhere.
+    - ``artifact_bake``: let trainers bake (AOT-compile + serialize)
+      their train/eval programs on a background worker after the first
+      steady-state step, so every checkpoint written afterwards carries
+      warm-start artifacts.  Off by default — baking duplicates each
+      program's XLA compile; production fleets (and the supervisor's
+      gang children) turn it on for millisecond respawns.
     - ``tracing``: enable span-based tracing (``obs.tracing``); spans add
       a device sync per step, so it's off by default.
     - ``trace_dir``: where span jsonl / Chrome-trace / ``jax.profiler``
@@ -101,6 +114,8 @@ class Config:
     shape_bucketing: bool = True
     fused_conv: bool = True
     compile_cache_dir: str = ""
+    artifact_store: bool = True
+    artifact_bake: bool = False
     profiling: bool = False
     tracing: bool = False
     trace_dir: str = "traces"

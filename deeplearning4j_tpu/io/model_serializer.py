@@ -130,6 +130,12 @@ def write_model(net, path: str, save_updater: bool = True,
         buf = _io.BytesIO()
         np.savez(buf, _type=type(normalizer).__name__, **normalizer._state())
         entries["normalizer.npz"] = buf.getvalue()
+    # compiled-artifact store: a net (or snapshot) carrying baked
+    # programs embeds them next to the weights — byte reuse, the
+    # programs don't change across checkpoints — so every restart path
+    # that loads this zip can warm instead of compiling
+    from deeplearning4j_tpu.train import artifact_store
+    entries.update(artifact_store.zip_entries_for(net))
     write_checkpoint_zip(path, entries)
 
 

@@ -470,6 +470,33 @@ def install_standard_metrics(registry: Optional[MetricsRegistry] = None) -> dict
                   "Compiled-step reuses served by train.step_cache"),
         r.counter("tpudl_train_step_cache_misses_total",
                   "Step builds admitted into train.step_cache"),
+        r.counter("tpudl_compile_artifact_hits_total",
+                  "Calls dispatched to an executable warm-loaded from "
+                  "a checkpoint's compiled-artifact store (zero JIT on "
+                  "the request path)"),
+        r.counter("tpudl_compile_artifact_misses_total",
+                  "Calls on a store-warmed program whose signature had "
+                  "no artifact — fell back to live compilation"),
+        r.counter("tpudl_compile_artifact_rejects_total",
+                  "Artifacts refused at warm-load time (format/jax/"
+                  "backend/donation mismatch or undeserializable "
+                  "payload) — stale artifacts recompile, never corrupt"),
+        r.counter("tpudl_compile_artifacts_baked_total",
+                  "Programs AOT-compiled and serialized into a "
+                  "checkpoint's artifact store"),
+        r.counter("tpudl_compile_artifacts_loaded_total",
+                  "Serialized executables deserialized into the "
+                  "process warm pool"),
+        r.histogram("tpudl_compile_bake_seconds",
+                    "Wall time to AOT-lower, compile and serialize one "
+                    "program into the artifact store"),
+        r.histogram("tpudl_compile_warm_load_seconds",
+                    "Wall time to warm-load a checkpoint zip's "
+                    "artifacts (the 'deserialize and go' cold-start "
+                    "cost)"),
+        r.gauge("tpudl_compile_warm_programs",
+                "Programs resident in the artifact warm pool after the "
+                "most recent load"),
         r.histogram("tpudl_data_etl_wait_seconds",
                     "Consumer-side wait for the next ready batch "
                     "(DeviceFeeder / AsyncDataSetIterator queue get)"),

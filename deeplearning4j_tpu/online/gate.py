@@ -165,13 +165,23 @@ class GatedDeployer:
 
     def deploy_if_better(self, name: str, candidate_path: str,
                          precision: Optional[str] = None,
-                         calibration=None, **engine_kw) -> GateDecision:
+                         calibration=None,
+                         prebake_artifacts: bool = False,
+                         **engine_kw) -> GateDecision:
         """Verify → score → compare → hot-swap.  ``precision="int8"``
         gates a QUANTIZED candidate: the candidate is quantized before
         scoring (the same transform the deploy applies), so the
         non-regression decision covers the quantization error too — a
         quantization that costs accuracy vs the serving incumbent is
-        refused here and the incumbent keeps serving."""
+        refused here and the incumbent keeps serving.
+
+        ``prebake_artifacts=True`` (what the online loop passes) bakes
+        the gate-passing candidate's compiled serve programs into its
+        zip BEFORE the pointer flip — the deploy then warms from the
+        store, so the swap window never compiles, and a later process
+        restart onto this zip starts in milliseconds
+        (train/artifact_store).  Refused candidates are never baked —
+        no point compiling a model that will not serve."""
         from deeplearning4j_tpu.io.model_serializer import restore_model
         from deeplearning4j_tpu.resilience.checkpoint import \
             CheckpointCorruptError
@@ -213,9 +223,17 @@ class GatedDeployer:
                       f"{self.gate.min_delta:g})",
                 candidate_score, incumbent_score, t0)
         try:
+            # prebake rides deploy's own bake path: gate-PASSING
+            # candidates get their (bucket, precision) programs baked
+            # into the zip before the engine build and the pointer
+            # flip, so the swap window never JITs — and a bake failure
+            # is recorded and ignored there (costs a live compile,
+            # never the deploy).  Refused candidates above are never
+            # baked: no point compiling a model that will not serve.
             entry = self.registry.deploy(name, candidate_path,
                                          precision=precision,
                                          calibration=calibration,
+                                         bake_artifacts=prebake_artifacts,
                                          **engine_kw)
         except Exception as e:
             # deploy re-verifies the zip; a failure here never touched

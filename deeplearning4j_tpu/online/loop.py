@@ -82,6 +82,12 @@ class OnlineConfig:
     watch_p99_max_s: Optional[float] = None
     max_consecutive_failures: int = 3   # loop supervision budget
     poll_s: float = 0.5                 # trigger-check cadence
+    # pre-bake a gate-passing candidate's compiled programs into its
+    # zip BEFORE the pointer flip (train/artifact_store): the hot-swap
+    # window never compiles, and a restarted server deploying the
+    # promoted zip starts warm.  Costs one AOT compile per bucket per
+    # deployed round, off the serving path.
+    prebake_artifacts: bool = True
 
 
 class OnlineTrainer:
@@ -245,7 +251,8 @@ class OnlineTrainer:
         candidate_path = os.path.join(round_dir, "candidate.zip")
         write_model(net, candidate_path)
         gate_decision: GateDecision = self.deployer.deploy_if_better(
-            self.name, candidate_path, **self.engine_kw)
+            self.name, candidate_path,
+            prebake_artifacts=cfg.prebake_artifacts, **self.engine_kw)
         decision.update({"status": "deployed" if gate_decision.deploy
                          else "refused",
                          "gate": gate_decision.to_dict(),

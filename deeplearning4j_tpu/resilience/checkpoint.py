@@ -184,7 +184,11 @@ class NetSnapshot:
         # resume bookkeeping the trainer stamps on the net (see
         # Trainer.fit): post-step counters + the post-split RNG key
         for attr in ("_completed_iterations", "_completed_epochs",
-                     "_epoch_batches"):
+                     "_epoch_batches",
+                     # baked compiled-program artifacts ride every
+                     # checkpoint once the trainer stashes them (bytes,
+                     # already serialized — no device work)
+                     "_artifact_entries", "_artifact_index"):
             if hasattr(net, attr):
                 setattr(self, attr, getattr(net, attr))
         key = getattr(net, "_rng_key", None)

@@ -144,7 +144,11 @@ class ClusterSupervisor:
     ``DL4J_TPU_RESUME_FROM`` (when a verified checkpoint exists under
     ``checkpoint_dir``) and ``DL4J_TPU_WORKER_GENERATION``; their
     worker id (``w<slot>``) is stable across restarts so the federated
-    series stay comparable.  ``DL4J_TPU_FAULT_PLAN`` is stripped from
+    series stay comparable.  When the resumed checkpoint carries a
+    compiled-artifact store (``artifact_bake=True`` children embed
+    one), ``Trainer.fit(resume_from=...)`` warms the serialized
+    executables before building any step — the respawned gang's first
+    step runs with zero JIT instead of recompiling everything.  ``DL4J_TPU_FAULT_PLAN`` is stripped from
     restarted generations by default (``clear_fault_plan_on_restart``)
     so an injected death drill fires exactly once.
 
@@ -170,7 +174,8 @@ class ClusterSupervisor:
                  backoff: Optional[RetryPolicy] = None,
                  poll_s: float = 0.1,
                  clear_fault_plan_on_restart: bool = True,
-                 mttr_wait_s: float = 60.0):
+                 mttr_wait_s: float = 60.0,
+                 artifact_bake: Optional[bool] = None):
         if degradation not in ("halt", "shrink"):
             raise ValueError(f"degradation must be 'halt' or 'shrink', "
                              f"got {degradation!r}")
@@ -196,6 +201,17 @@ class ClusterSupervisor:
         self.poll_s = float(poll_s)
         self.clear_fault_plan_on_restart = clear_fault_plan_on_restart
         self.mttr_wait_s = float(mttr_wait_s)
+        # compiled-artifact store: ``artifact_bake=True`` makes every
+        # worker AOT-serialize its train/eval programs into its
+        # checkpoints (config.artifact_bake in the child), so a respawn
+        # resumes with zero JIT — MTTR drops from "recompile the world"
+        # to "deserialize and go".  None inherits whatever the
+        # environment already says.
+        if artifact_bake is not None:
+            # explicit argument WINS over a stray extra_env entry —
+            # None is the "inherit the environment" spelling
+            self.extra_env["DL4J_TPU_ARTIFACT_BAKE"] = \
+                "1" if artifact_bake else "0"
 
     # ------------------------------------------------------------- pieces
     def _latest_checkpoint(self) -> Optional[str]:

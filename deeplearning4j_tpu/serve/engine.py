@@ -391,8 +391,20 @@ class InferenceEngine:
     @property
     def compiled_programs(self) -> int:
         """Traced XLA programs behind this engine's forward (0 for
-        fallback models) — the ≤1-per-bucket invariant's measurement."""
+        fallback models) — the ≤1-per-bucket invariant's measurement.
+        A forward warmed from the artifact store dispatches preloaded
+        executables without tracing, so this stays 0 across a warm
+        restart — exactly what the zero-JIT-on-the-request-path tests
+        pin."""
         return step_cache.jit_cache_entries(self._fwd)
+
+    @property
+    def warm_programs(self) -> int:
+        """Distinct call signatures this engine has served from the
+        persistent artifact store (train/artifact_store) instead of
+        compiling live."""
+        served = getattr(self._fwd, "warm_served", None)
+        return len(served) if served is not None else 0
 
     def shutdown(self, drain: bool = True, timeout_s: float = 30.0) -> None:
         """Stop the engine.  ``drain=True`` (default, and what the

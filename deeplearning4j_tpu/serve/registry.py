@@ -43,6 +43,18 @@ SERVING = "serving"
 RETIRED = "retired"
 
 
+def _engine_buckets(kw: dict) -> tuple:
+    """The static bucket set the engine built from ``kw`` will compile —
+    what a deploy-time bake must cover.  Empty when bucketing is off
+    (no static shapes to bake against)."""
+    from deeplearning4j_tpu.serve.engine import _default_buckets
+    if not kw.get("bucketing", True):
+        return ()
+    if kw.get("buckets"):
+        return tuple(sorted(int(b) for b in kw["buckets"]))
+    return _default_buckets(int(kw.get("max_batch", 32)))
+
+
 def _apply_precision(net, precision: Optional[str], calibration):
     """Resolve a deploy's precision request.  ``None``/``"bf16"``/
     ``"f32"`` serve the net exactly as loaded; ``"int8"`` post-training-
@@ -127,11 +139,25 @@ class ModelRegistry:
 
     # --------------------------------------------------------- deploy
     def deploy(self, name: str, path: str, precision: Optional[str] = None,
-               calibration=None, **engine_kw) -> ModelVersion:
+               calibration=None, bake_artifacts: bool = False,
+               **engine_kw) -> ModelVersion:
         """Load ``path`` through the verified serializer and make it the
         current version of ``name``.  Raises ``CheckpointCorruptError``
         (corrupt zip) or the serializer's errors WITHOUT touching the
         currently-serving version.
+
+        Cold starts: when the zip carries a compiled-artifact store
+        (train/artifact_store — baked by a prior deploy, the gated
+        online path, or a trainer with ``config.artifact_bake``), the
+        matching executables are warm-loaded BEFORE the engine is
+        built, so a restarted server answers its first request with
+        zero JIT on the request path; stale or cross-version artifacts
+        are counted rejects that fall back to live compilation.
+        ``bake_artifacts=True`` additionally AOT-compiles and embeds
+        this deploy's (bucket, precision) programs into the zip — the
+        next process to deploy it starts warm.  Baking compiles eagerly
+        (seconds), so it is opt-in here; ``GatedDeployer`` pre-bakes
+        candidates before the pointer flip instead.
 
         ``precision="int8"`` post-training-quantizes the verified load
         (``nn.quantize``: per-channel int8 weights, activations stay on
@@ -151,6 +177,23 @@ class ModelRegistry:
         net = restore_model(path, load_updater=False)
         net, precision = _apply_precision(net, precision, calibration)
         kw = {**self.engine_defaults, **engine_kw}
+        from deeplearning4j_tpu.train import artifact_store
+        if artifact_store.enabled():
+            if bake_artifacts:
+                try:
+                    artifact_store.ensure_zip_artifacts(
+                        path, net=net, buckets=_engine_buckets(kw))
+                except Exception as e:
+                    # baking is an optimization — a deploy must never
+                    # fail (or stall the flip) because AOT serialization
+                    # refused a program
+                    from deeplearning4j_tpu.obs import flight_recorder
+                    flight_recorder.record(
+                        "artifact_bake_failed", model=name,
+                        error=repr(e)[:200])
+            # warm BEFORE the engine builds its forward: the first
+            # request then dispatches a preloaded executable
+            artifact_store.warm_from_zip(path)
         with self._swap():
             engine = InferenceEngine(net, name=name, **kw)
             with self._lock:

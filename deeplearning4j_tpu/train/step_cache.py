@@ -108,8 +108,13 @@ def get_or_build(key: Optional[tuple], builder: Callable[[], Any]) -> Any:
     model (``obs.costmodel``) with its cache-key kind — this is the one
     point every compiled step funnels through, so the per-program cost
     breakdown gets real names (``train:MultiLayerNetwork``, ``eval:...``,
-    ``dcn_grad_encode:...``) for free."""
+    ``dcn_grad_encode:...``) for free.  It is also where the persistent
+    artifact store hooks in: cacheable steps are handed out wrapped in
+    :class:`~deeplearning4j_tpu.train.artifact_store.WarmedJit`, so a
+    process warmed from a checkpoint's serialized executables answers
+    matching calls with zero JIT (see train/artifact_store.py)."""
     from deeplearning4j_tpu.obs import costmodel
+    from deeplearning4j_tpu.train import artifact_store
     if key is None:
         return builder()
     reg = get_registry()
@@ -121,7 +126,7 @@ def get_or_build(key: Optional[tuple], builder: Callable[[], Any]) -> Any:
             return fn
     # build outside the lock: builders only wrap (trace/compile happens
     # at first call), but a slow builder must not serialize other keys
-    fn = builder()
+    fn = artifact_store.maybe_wrap(key, builder())
     with _LOCK:
         existing = _CACHE.get(key)
         if existing is not None:

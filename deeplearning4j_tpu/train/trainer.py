@@ -227,6 +227,12 @@ class Trainer:
         self._tbptt_step = None
         self._stats_step = None
         self._eval_loss_fn = None
+        # artifact-store bookkeeping: the first step's abstract call
+        # signature (what a bake lowers against) and the one-shot
+        # background-bake latch (config.artifact_bake)
+        self._bake_args = None
+        self._tbptt_bake_args = None
+        self._bake_scheduled = False
         self._stats_listeners = [l for l in self.bus.listeners
                                  if getattr(l, "wants_model_stats", False)]
         self._compiled = False   # first step through a jit boundary = compile
@@ -380,6 +386,14 @@ class Trainer:
         sig = (costmodel.shape_sig((batch.features, batch.labels,
                                     fmask, lmask))
                if costmodel.enabled() else None)
+        if self._bake_args is None and get_config().artifact_store:
+            # what a bake will AOT-lower (abstract only — holding real
+            # buffers here would block donation).  Captured whenever
+            # the store is enabled, not just under artifact_bake, so an
+            # explicit bake_artifacts() call after fit always works;
+            # one tree_map on the first step, then the None check
+            # short-circuits.
+            self._bake_args = costmodel.abstractify(args)
         analyze_args = (
             costmodel.abstractify(args)
             if not sampling and costmodel.should_analyze(self._step, sig=sig)
@@ -454,6 +468,12 @@ class Trainer:
         n_segments = 0
         for seg_idx, seg in enumerate(_tbptt_segments(batch, length)):
             seg_rng = jax.random.fold_in(rng, seg_idx)
+            if seg_idx == 0 and self._tbptt_bake_args is None \
+                    and get_config().artifact_store:
+                self._tbptt_bake_args = costmodel.abstractify(
+                    (net.params_, net.state_, net.opt_state, carries,
+                     seg.features, seg.labels, seg.features_mask,
+                     seg.labels_mask, seg_rng))
             if seg_idx == 0 and costmodel.enabled():
                 # one shared segment shape by construction (masked tail
                 # padding), so the first segment's sig covers them all
@@ -549,6 +569,16 @@ class Trainer:
                                    sig=getattr(self, "_last_step_sig", None))
         reg.counter("tpudl_train_steps_total").inc()
         reg.counter("tpudl_train_examples_total").inc(n_examples)
+        if retraced == 0 and not self._bake_scheduled \
+                and get_config().artifact_bake \
+                and (self._bake_args is not None
+                     or self._tbptt_bake_args is not None):
+            # compiles have settled: bake this trainer's programs ONCE
+            # on the background worker, so every checkpoint written
+            # from here on carries warm-restart artifacts
+            self._bake_scheduled = True
+            from deeplearning4j_tpu.train import artifact_store
+            artifact_store.schedule_bake(self.bake_artifacts)
         flight_recorder.record("step", iteration=net.iteration,
                                epoch=net.epoch,
                                duration_ms=round(dt * 1e3, 3),
@@ -573,6 +603,58 @@ class Trainer:
         self.bus.dispatch("iteration_done", net, net.iteration, net.epoch, loss)
         net.iteration += 1
         return loss
+
+    def bake_artifacts(self) -> int:
+        """AOT-compile and serialize this trainer's programs (train or
+        tbptt step + eval loss) into an artifact stash on the net, so
+        every subsequent checkpoint zip embeds them and a restarted
+        process resumes with zero JIT (train/artifact_store).  Needs at
+        least one completed step (the abstract call signature is
+        captured there); uncacheable configs (per-layer updaters,
+        frozen layers) bake nothing, exactly like the step cache.
+        Returns the number of programs baked.  Runs on the background
+        bake worker when ``config.artifact_bake`` is set; callable
+        directly (e.g. right before a deploy-time save)."""
+        from deeplearning4j_tpu.train import artifact_store
+        if self._cache_sig is None:
+            return 0
+        jobs = []
+        if self._tbptt_bake_args is not None and self._tbptt_step is not None:
+            jobs.append((self._tbptt_step, self._tbptt_bake_args,
+                         self._step_key("tbptt"), "tbptt"))
+        if self._bake_args is not None:
+            if self._step is not None:
+                jobs.append((self._step, self._bake_args,
+                             self._step_key("train"), "train"))
+            # eval loss shares the train step's (params, state, batch)
+            # signature minus opt_state and rng
+            a = self._bake_args
+            eval_args = (a[0], a[1], a[3], a[4], a[5], a[6])
+            if self._eval_loss_fn is None:
+                self._eval_loss_fn = step_cache.get_or_build(
+                    self._step_key("eval"),
+                    lambda: make_eval_step(self.net))
+            jobs.append((self._eval_loss_fn, eval_args,
+                         self._step_key("eval"), "eval"))
+        entries: dict = {}
+        index: list = []
+        for fn, abstract_args, key, kind in jobs:
+            if key is None or fn is None:
+                continue
+            inner = getattr(fn, "_fn", fn)   # unwrap WarmedJit
+            try:
+                e, ix = artifact_store.bake_program(
+                    inner, abstract_args, key, kind)
+            except Exception:
+                # baking is an optimization; a program that refuses AOT
+                # serialization must not fail training or checkpoints
+                flight_recorder.record("artifact_bake_failed",
+                                       program=kind)
+                continue
+            entries.update(e)
+            index.append(ix)
+        artifact_store.stash_on_net(self.net, entries, index)
+        return len(index)
 
     def resume_state(self, source, iterator=None) -> dict:
         """Restore full training state from ``source`` (a checkpoint zip
@@ -601,6 +683,16 @@ class Trainer:
         self._ensure_ready()
         state = restore_into(self.net, path, tx=self.tx,
                              verify=not verified)
+        # warm the compiled-artifact pool — a respawned process
+        # (supervisor, online loop) then takes its first step with zero
+        # JIT instead of recompiling the world.  Strictly AFTER the
+        # verified restore above: a corrupt zip must be refused whole
+        # before any of its artifacts can enter the first-wins pool
+        # (the warmed wrappers re-check the pool per call, so warming
+        # after the step was built loses nothing).
+        from deeplearning4j_tpu.train import artifact_store
+        if artifact_store.enabled():
+            artifact_store.warm_from_zip(path)
         policy = state.get("dtype_policy")
         if policy:
             # the compiled step must see the dtypes the run was using
@@ -645,12 +737,15 @@ class Trainer:
         and a mid-epoch checkpoint fast-forwards the iterator, so an
         interrupted fit resumed here reproduces the uninterrupted run's
         per-step losses exactly (tests/test_resilience.py pins 1e-6)."""
-        self._ensure_ready()
         net = self.net
         epochs_to_run = epochs
         if resume_from is not None:
+            # resume first: it verifies + restores state, then warms
+            # the artifact pool, so the first step below dispatches the
+            # checkpoint's deserialized program instead of compiling
             self.resume_state(resume_from, iterator)
             epochs_to_run = max(0, epochs - net.epoch)
+        self._ensure_ready()
         # the post-split key stamped by the previous step/restore; a
         # fresh net derives from its seed (bitwise-deterministic runs)
         key = getattr(net, "_rng_key", None)

@@ -779,3 +779,72 @@ def test_upcast_in_serving_path_exemptions(tmp_path):
             return np.asarray(x).astype(np.float32)
         """)
     assert report.by_rule("TPU314") == []
+
+
+# ------------------------------------------------------------ TPU315
+def test_live_compile_in_restart_path_flags_jit_and_aot_chain(tmp_path):
+    """Seeded defects: a jax.jit build inside a deploy-path function and
+    an eager lower().compile() inside a resume-path function each flag
+    — restart paths warm from the artifact store, they don't compile."""
+    report = _lint_source(tmp_path, """
+        import jax
+
+        def deploy_model(net, zip_path):
+            fwd = jax.jit(lambda p, x: net.forward(p, x))   # live compile
+            return fwd
+
+        def resume_training(step, abstract_args):
+            return step.lower(*abstract_args).compile()     # eager AOT
+        """)
+    hits = report.by_rule("TPU315")
+    assert len(hits) == 2
+    assert any("jax.jit built" in h.message for h in hits)
+    assert any("lower().compile()" in h.message for h in hits)
+    assert report.exit_code() == 1
+
+
+def test_live_compile_in_restart_path_respawn_and_rollback(tmp_path):
+    """The supervisor-shaped tokens flag too; calling an ALREADY-built
+    jitted function on a restart path is fine (that is the warm path)."""
+    report = _lint_source(tmp_path, """
+        from jax import jit
+
+        def respawn_worker(fn):
+            return jit(fn)
+
+        def rollback_version(warmed_step, args):
+            return warmed_step(*args)        # dispatch, not a build
+        """)
+    hits = report.by_rule("TPU315")
+    assert len(hits) == 1
+    assert "respawn_worker" in hits[0].message
+
+
+def test_live_compile_in_restart_path_exemptions(tmp_path):
+    """Builder-token factories compile by design; re.compile must not
+    false-positive; non-restart functions are out of scope; and the
+    store module itself (the baker) is path-exempt."""
+    report = _lint_source(tmp_path, """
+        import re
+        import jax
+
+        def build_deploy_forward(net):
+            return jax.jit(net.forward)      # one-time factory
+
+        def deploy_manifest(pattern, text):
+            return re.compile(pattern).match(text)   # not an AOT chain
+
+        def train_step_builder(fn):
+            return jax.jit(fn)               # no restart token
+        """)
+    assert report.by_rule("TPU315") == []
+    assert report.exit_code() == 0
+    # the store module bakes (lower+compile) — exactly its job
+    store_dir = tmp_path / "train"
+    store_dir.mkdir()
+    report = _lint_source(
+        tmp_path, """
+        def bake_for_deploy(fn, abstract_args):
+            return fn.lower(*abstract_args).compile()
+        """, name="train/artifact_store.py")
+    assert report.by_rule("TPU315") == []

@@ -1,0 +1,425 @@
+"""Compiled-artifact store (ISSUE 12): cross-process warm restarts with
+zero JIT on the request path, stale-artifact refusal, manifest-backed
+corruption refusal, and the pre-bake deploy path.
+
+Restart coverage uses REAL subprocesses: an in-process "simulated
+restart" (clear caches, re-warm the same programs) would both lie about
+what a restart pays and tread on the one sequence the pool's
+first-wins insert exists to prevent (destroying a live executable and
+then running its deserialized twin)."""
+
+import json
+import os
+import subprocess
+import sys
+import zipfile
+
+import numpy as np
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build_net(width, seed=7):
+    from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.train import Sgd
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(OutputLayer(n_out=4, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(width)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _run_child(code, *argv, timeout=240):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "DL4J_TPU_COSTMODEL": "0",
+           "PYTHONPATH": REPO_ROOT + os.pathsep
+           + os.environ.get("PYTHONPATH", "")}
+    proc = subprocess.run([sys.executable, "-c", code, *argv],
+                          capture_output=True, text=True, timeout=timeout,
+                          cwd=REPO_ROOT, env=env)
+    assert proc.returncode == 0, \
+        f"child failed rc={proc.returncode}\n{proc.stderr[-3000:]}"
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+# --------------------------------------------------------------- bake side
+def test_bake_embeds_versioned_indexed_artifacts(tmp_path):
+    """Baking writes artifacts/ entries + an index whose every record
+    carries the full refusal key (format/jax/backend/donation), and the
+    zip stays manifest-intact — artifacts are inside the PR-4
+    durability story, not beside it."""
+    import jax
+
+    from deeplearning4j_tpu.resilience.checkpoint import is_valid_checkpoint
+    from deeplearning4j_tpu.train import artifact_store
+
+    net = _build_net(width=24)
+    zp = str(tmp_path / "model.zip")
+    net.save(zp)
+    assert artifact_store.read_index(zp) == []
+    baked = artifact_store.ensure_zip_artifacts(zp, net=net,
+                                                buckets=(1, 2, 4))
+    assert baked == 3
+    index = artifact_store.read_index(zp)
+    assert len(index) == 3
+    for entry in index:
+        assert entry["kind"] == "serve_forward"
+        assert entry["format"] == artifact_store.ARTIFACT_FORMAT
+        assert entry["jax"] == jax.__version__
+        assert entry["backend"] == jax.default_backend()
+        assert entry["donation"] == ""
+        assert entry["key"][-1] == "serve_forward"
+    # the manifest covers the new entries: still a verified checkpoint
+    assert is_valid_checkpoint(zp)
+    # the portable StableHLO module rides along (SameDiff → StableHLO)
+    with zipfile.ZipFile(zp) as zf:
+        mlir = [n for n in zf.namelist() if n.endswith(".stablehlo.mlir")]
+        assert len(mlir) == 3
+        assert b"stablehlo" in zf.read(mlir[0]) or b"module" in zf.read(mlir[0])
+    # idempotent: everything already baked for this env
+    assert artifact_store.ensure_zip_artifacts(zp, net=net,
+                                               buckets=(1, 2, 4)) == 0
+
+
+# ------------------------------------------------- cross-process warm serve
+_CHILD_SERVE = r"""
+import json, os, sys
+os.environ["DL4J_TPU_COSTMODEL"] = "0"
+import numpy as np
+from deeplearning4j_tpu.serve.registry import ModelRegistry
+from deeplearning4j_tpu.obs.registry import get_registry
+zp, buckets, width = sys.argv[1], json.loads(sys.argv[2]), int(sys.argv[3])
+reg = ModelRegistry(max_batch=max(buckets), buckets=tuple(buckets))
+eng = reg.deploy("m", zp).engine
+rng = np.random.default_rng(0)
+for b in buckets:
+    out = reg.predict("m", rng.normal(size=(b, width)).astype(np.float32),
+                      timeout_s=60)
+    assert out.shape[0] == b
+first = {"compiled_programs": eng.compiled_programs,
+         "warm_programs": eng.warm_programs}
+# warmed hot-swap: same architecture, new version — the swap window
+# must not compile either
+mv2 = reg.deploy("m", zp)
+for b in buckets:
+    reg.predict("m", rng.normal(size=(b, width)).astype(np.float32),
+                timeout_s=60)
+r = get_registry()
+print(json.dumps({
+    "first": first, "swap_version": mv2.version,
+    "swap_compiled": mv2.engine.compiled_programs,
+    "serve_recompiles": r.counter("tpudl_serve_recompiles_total").value,
+    "hits": r.counter("tpudl_compile_artifact_hits_total").value,
+    "loaded": r.counter("tpudl_compile_artifacts_loaded_total").value,
+    "rejects": r.counter("tpudl_compile_artifact_rejects_total").value}))
+reg.close()
+"""
+
+
+def test_cross_process_warm_restart_serves_with_zero_jit(tmp_path):
+    """The headline contract: a zip baked by THIS process is deployed by
+    a fresh subprocess ("the restarted server") which serves every
+    bucket — and hot-swaps once — with zero XLA traces on the request
+    path, pinned by the engine's jit-cache count and the serve
+    recompile counter."""
+    from deeplearning4j_tpu.train import artifact_store
+
+    width, buckets = 20, (1, 2, 4, 8)
+    net = _build_net(width=width)
+    zp = str(tmp_path / "model.zip")
+    net.save(zp)
+    assert artifact_store.ensure_zip_artifacts(zp, net=net,
+                                               buckets=buckets) == 4
+    result = _run_child(_CHILD_SERVE, zp, json.dumps(list(buckets)),
+                        str(width))
+    assert result["loaded"] == 4
+    assert result["rejects"] == 0
+    # zero JIT on the request path, across restart AND hot-swap
+    assert result["first"]["compiled_programs"] == 0
+    assert result["swap_compiled"] == 0
+    assert result["serve_recompiles"] == 0
+    assert result["swap_version"] == 2
+    assert result["first"]["warm_programs"] == len(buckets)
+    assert result["hits"] >= 2 * len(buckets)
+
+
+# ------------------------------------------------- cross-process warm train
+_CHILD_TRAIN = r"""
+import json, os, sys
+os.environ["DL4J_TPU_COSTMODEL"] = "0"
+import numpy as np
+from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.train import Sgd
+from deeplearning4j_tpu.train.trainer import Trainer
+from deeplearning4j_tpu.obs.registry import get_registry
+zp, width = sys.argv[1], int(sys.argv[2])
+conf = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.1)).list()
+        .layer(DenseLayer(n_out=32, activation="relu"))
+        .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(width)).build())
+net = MultiLayerNetwork(conf).init()
+rng = np.random.default_rng(0)
+X = rng.normal(size=(64, width)).astype(np.float32)
+Y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 64)]
+t = Trainer(net)
+t.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=3, resume_from=zp)
+r = get_registry()
+print(json.dumps({
+    "recompiles": r.counter("tpudl_train_recompiles_total").value,
+    "hits": r.counter("tpudl_compile_artifact_hits_total").value,
+    "iteration": net.iteration}))
+"""
+
+
+def test_trainer_resume_warms_train_step_zero_recompiles(tmp_path):
+    """A respawned worker's whole fine-tune epoch runs on the
+    deserialized train step: tpudl_train_recompiles_total stays at
+    exactly zero across the resumed fit (the supervisor-MTTR 'no
+    recompile the world' contract), pinned cross-process."""
+    from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+    from deeplearning4j_tpu.train.trainer import Trainer
+
+    width = 28
+    net = _build_net(width=width)
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(64, width)).astype(np.float32)
+    Y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 64)]
+    trainer = Trainer(net)
+    trainer.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=1)
+    # deploy/checkpoint-time bake: capture needs one completed step, so
+    # arm the capture and take one more batch through fit_batch
+    from deeplearning4j_tpu.config import set_config
+    set_config(artifact_bake=True)
+    try:
+        trainer.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=1)
+        from deeplearning4j_tpu.train import artifact_store
+        artifact_store.drain_bakes()
+        assert trainer.net._artifact_index
+        kinds = {ix["kind"] for ix in trainer.net._artifact_index}
+        assert kinds == {"train", "eval"}
+    finally:
+        set_config(artifact_bake=False)
+    zp = str(tmp_path / "ck.zip")
+    net.save(zp)
+    result = _run_child(_CHILD_TRAIN, zp, str(width))
+    assert result["recompiles"] == 0
+    assert result["hits"] >= 4          # 4 batches of the resumed epoch
+    assert result["iteration"] == 12    # 3 epochs total, 4 steps each
+
+
+# -------------------------------------------------------- refusal paths
+def _rewrite_index(zp, mutate):
+    """Rewrite the artifact index through the durable writer (manifest
+    stays consistent — this models a STALE artifact, not a torn one)."""
+    from deeplearning4j_tpu.resilience.checkpoint import (
+        MANIFEST_NAME, write_checkpoint_zip)
+    from deeplearning4j_tpu.train import artifact_store
+    entries = {}
+    with zipfile.ZipFile(zp) as zf:
+        for name in zf.namelist():
+            if name != MANIFEST_NAME:
+                entries[name] = zf.read(name)
+    data = json.loads(entries[artifact_store.INDEX_ENTRY].decode())
+    for ix in data["programs"]:
+        mutate(ix)
+    entries[artifact_store.INDEX_ENTRY] = json.dumps(data)
+    write_checkpoint_zip(zp, entries)
+
+
+@pytest.mark.parametrize("mutate,expect", [
+    (lambda ix: ix.update(jax="0.0.0"), "jax-version"),
+    (lambda ix: ix.update(backend="tpu"), "backend"),
+    (lambda ix: ix.update(donation="9,9"), "donation"),
+    (lambda ix: ix.update(kind="mystery_kind"), "unknown-kind"),
+])
+def test_stale_artifact_is_counted_reject_with_live_fallback(
+        tmp_path, mutate, expect):
+    """A cross-version/cross-backend/cross-donation artifact is refused
+    and COUNTED (tpudl_compile_artifact_rejects_total), and the deploy
+    falls back to live compilation — it never crashes and never trusts
+    the stale executable."""
+    from deeplearning4j_tpu.obs.registry import get_registry
+    from deeplearning4j_tpu.serve.registry import ModelRegistry
+    from deeplearning4j_tpu.train import artifact_store
+
+    # distinct widths per param case → distinct step-cache keys, so no
+    # case can be served by a sibling's still-resident programs; bake
+    # WITHOUT warming the local pool (warm=False) so this process
+    # really is the "restarted server holding only a stale zip"
+    width = 30 + 2 * len(expect)
+    net = _build_net(width=width)
+    zp = str(tmp_path / "model.zip")
+    net.save(zp)
+    entries, index = artifact_store.bake_serve_artifacts(net, (2,),
+                                                         warm=False)
+    artifact_store.attach_to_zip(zp, entries, index)
+    _rewrite_index(zp, mutate)
+    reg = get_registry()
+    rejects0 = reg.counter("tpudl_compile_artifact_rejects_total").value
+    loaded0 = reg.counter("tpudl_compile_artifacts_loaded_total").value
+    registry = ModelRegistry(max_batch=2, buckets=(2,))
+    try:
+        eng = registry.deploy("m", zp).engine
+        out = registry.predict(
+            "m", np.random.default_rng(0).normal(size=(2, width))
+            .astype(np.float32), timeout_s=60)
+        assert out.shape == (2, 4)
+        assert reg.counter(
+            "tpudl_compile_artifact_rejects_total").value == rejects0 + 1
+        assert reg.counter(
+            "tpudl_compile_artifacts_loaded_total").value == loaded0
+        # ... and the request was served by a LIVE compile
+        assert eng.compiled_programs == 1
+        assert eng.warm_programs == 0
+    finally:
+        registry.close()
+
+
+def test_corrupt_artifact_refused_through_manifest_verify(tmp_path):
+    """Bit-rot inside an artifact entry (no index tampering) fails the
+    PR-4 manifest verification, so the deploy refuses the WHOLE zip
+    with CheckpointCorruptError before anything serves — the artifact
+    payload is integrity-checked exactly like the weights."""
+    from deeplearning4j_tpu.resilience.checkpoint import (
+        CheckpointCorruptError, verify_checkpoint)
+    from deeplearning4j_tpu.serve.registry import ModelRegistry
+    from deeplearning4j_tpu.train import artifact_store
+
+    net = _build_net(width=26)
+    zp = str(tmp_path / "model.zip")
+    net.save(zp)
+    artifact_store.ensure_zip_artifacts(zp, net=net, buckets=(2,))
+    exec_name = artifact_store.read_index(zp)[0]["exec"]
+    # flip bytes INSIDE the exec entry, keeping the old manifest: a torn
+    # copy / bit-rot model (zipfile rewrite keeps per-entry CRCs of the
+    # new bytes, so only the manifest digest catches it — that is the
+    # point of the manifest)
+    corrupted = str(tmp_path / "corrupt.zip")
+    with zipfile.ZipFile(zp) as src, \
+            zipfile.ZipFile(corrupted, "w") as dst:
+        for name in src.namelist():
+            data = src.read(name)
+            if name == exec_name:
+                data = data[:64] + bytes(32) + data[96:]
+            dst.writestr(name, data)
+    problems = verify_checkpoint(corrupted)
+    assert any(exec_name in p for p in problems)
+    registry = ModelRegistry()
+    with pytest.raises(CheckpointCorruptError):
+        registry.deploy("m", corrupted)
+
+
+def test_warm_miss_falls_back_to_live_compile_and_counts(tmp_path):
+    """A bucket the store never baked live-compiles (counted as an
+    artifact miss) while baked buckets keep serving warm — a partial
+    store degrades to exactly the old behavior, per bucket."""
+    from deeplearning4j_tpu.obs.registry import get_registry
+    from deeplearning4j_tpu.serve.registry import ModelRegistry
+    from deeplearning4j_tpu.train import artifact_store
+
+    width = 22
+    net = _build_net(width=width)
+    zp = str(tmp_path / "model.zip")
+    net.save(zp)
+    artifact_store.ensure_zip_artifacts(zp, net=net, buckets=(2,))
+    reg = get_registry()
+    misses0 = reg.counter("tpudl_compile_artifact_misses_total").value
+    registry = ModelRegistry(max_batch=4, buckets=(2, 4))
+    try:
+        eng = registry.deploy("m", zp).engine
+        rng = np.random.default_rng(0)
+        registry.predict("m", rng.normal(size=(2, width))
+                         .astype(np.float32), timeout_s=60)
+        assert eng.compiled_programs == 0      # warm bucket
+        registry.predict("m", rng.normal(size=(4, width))
+                         .astype(np.float32), timeout_s=60)
+        assert eng.compiled_programs == 1      # live-compiled bucket
+        assert reg.counter(
+            "tpudl_compile_artifact_misses_total").value > misses0
+        assert eng.warm_programs == 1
+    finally:
+        registry.close()
+
+
+def test_resume_refuses_corrupt_zip_before_warming_pool(tmp_path):
+    """resume_state must verify the checkpoint BEFORE warming: a
+    bit-rotted zip is refused whole, and none of its artifacts may
+    enter the first-wins pool (a corrupted-but-unpicklable-looking
+    executable poisoning every later step would be far worse than the
+    recompile it saves)."""
+    from deeplearning4j_tpu.obs.registry import get_registry
+    from deeplearning4j_tpu.resilience.checkpoint import \
+        CheckpointCorruptError
+    from deeplearning4j_tpu.train import artifact_store
+    from deeplearning4j_tpu.train.trainer import Trainer
+
+    width = 34
+    net = _build_net(width=width)
+    zp = str(tmp_path / "ck.zip")
+    net.save(zp)
+    artifact_store.ensure_zip_artifacts(zp, net=net, buckets=(2,))
+    exec_name = artifact_store.read_index(zp)[0]["exec"]
+    corrupted = str(tmp_path / "rot.zip")
+    with zipfile.ZipFile(zp) as src, \
+            zipfile.ZipFile(corrupted, "w") as dst:
+        for name in src.namelist():
+            data = src.read(name)
+            if name == exec_name:
+                data = data[:64] + bytes(32) + data[96:]
+            dst.writestr(name, data)
+    reg = get_registry()
+    loaded0 = reg.counter("tpudl_compile_artifacts_loaded_total").value
+    rejects0 = reg.counter("tpudl_compile_artifact_rejects_total").value
+    trainer = Trainer(_build_net(width=width))
+    with pytest.raises(CheckpointCorruptError):
+        trainer.resume_state(corrupted)
+    assert reg.counter(
+        "tpudl_compile_artifacts_loaded_total").value == loaded0
+    assert reg.counter(
+        "tpudl_compile_artifact_rejects_total").value == rejects0
+
+
+# --------------------------------------------------------- gated pre-bake
+def test_gated_deployer_prebakes_candidate_before_flip(tmp_path):
+    """A gate-passing candidate's zip carries artifacts BEFORE the
+    registry flip (the deploy warms instead of compiling in the swap
+    window); a refused candidate is never baked."""
+    from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+    from deeplearning4j_tpu.online.gate import EvalGate, GatedDeployer
+    from deeplearning4j_tpu.serve.registry import ModelRegistry
+    from deeplearning4j_tpu.train import artifact_store
+
+    width = 18
+    net = _build_net(width=width)
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(32, width)).astype(np.float32)
+    Y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 32)]
+    holdout = ArrayDataSetIterator(X, Y, batch_size=16)
+    candidate = str(tmp_path / "candidate.zip")
+    net.save(candidate)
+    registry = ModelRegistry(max_batch=4, buckets=(2, 4))
+    try:
+        deployer = GatedDeployer(registry, EvalGate(holdout,
+                                                    metric="accuracy"))
+        decision = deployer.deploy_if_better("m", candidate,
+                                             prebake_artifacts=True)
+        assert decision.deploy
+        index = artifact_store.read_index(candidate)
+        assert {ix["kind"] for ix in index} == {"serve_forward"}
+        assert len(index) == 2                 # buckets (2, 4)
+        eng = registry.get("m").engine
+        for rows in (2, 4):
+            registry.predict("m", rng.normal(size=(rows, width))
+                             .astype(np.float32), timeout_s=60)
+        # the flip (and the traffic after it) never compiled
+        assert eng.compiled_programs == 0
+        assert eng.warm_programs == 2
+    finally:
+        registry.close()

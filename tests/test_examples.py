@@ -10,7 +10,8 @@ from examples import (bert_mlm_finetune, char_rnn_textgen,
                       lstm_uci_har, mlp_mnist, model_serving,
                       multislice_dcn_training, online_learning,
                       pipeline_parallel_bert, training_dashboard,
-                      transfer_learning, word2vec_embeddings)
+                      transfer_learning, warm_restart,
+                      word2vec_embeddings)
 
 
 def test_mlp_mnist_example():
@@ -88,6 +89,15 @@ def test_model_serving_example(tmp_path):
     # deploy → hot-swap → rollback: three versions answered over HTTP
     assert result["versions_served"] == [1, 2, 3]
     assert result["final_version"] == 3
+
+
+def test_warm_restart_example(tmp_path):
+    result = warm_restart.main(workdir=str(tmp_path), verbose=False)
+    # the restarted server answered from the artifact store: no XLA
+    # trace on the request path, and the first response got faster
+    assert result["zero_jit_after_warm"] is True
+    assert result["warm"]["classes"] == warm_restart.N_CLASSES
+    assert result["first_response_speedup"] > 1.0
 
 
 def test_online_learning_example(tmp_path):
