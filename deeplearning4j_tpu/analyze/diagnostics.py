@@ -284,6 +284,26 @@ _RULE_LIST = [
         "bake_artifacts/ensure_zip_artifacts) and let train.step_cache "
         "hand out the warmed step; one-time builders (make_/build_ "
         "factories) may compile."),
+    RuleInfo(
+        "TPU316", "deploy-bypasses-router", ERROR,
+        "registry.deploy/hot_swap called directly from router-scoped "
+        "code on a router-managed model, bypassing the atomic fan-out "
+        "(serve/router.py and online/gate.py exempt)",
+        "A ReplicaRouter serves one model through N replica engines; "
+        "the ONLY swap that reaches all of them atomically is the "
+        "router's fan-out deploy (or GatedDeployer above it).  A "
+        "direct ModelRegistry.deploy on a routed name moves the "
+        "registry's version book while every replica keeps serving "
+        "the old weights — version-skewed responses, a rollback "
+        "target that never actually served, and a fleet the deploy "
+        "plane no longer describes.  The registry refuses it at "
+        "runtime (RoutedModelError); this rule catches it before it "
+        "ships.",
+        "Deploy through ReplicaRouter.deploy (one verified load, "
+        "every replica flipped, old engines drained) or "
+        "online.gate.GatedDeployer.deploy_if_better, which fans out "
+        "automatically when a router is attached; "
+        "registry.rollback already delegates."),
     # ---- concurrency (AST, whole-repo thread model) -------------------
     RuleInfo(
         "TPU400", "bad-suppression", ERROR,

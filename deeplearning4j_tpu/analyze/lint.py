@@ -24,7 +24,9 @@ upcast or per-request dequantize inside serving-path functions — the
 quantized serve win undone on the request path), TPU315 (jax.jit build
 or eager lower().compile() inside a deploy/resume/respawn-path
 function — restart paths warm from the compiled-artifact store, they
-don't compile).
+don't compile), TPU316 (registry.deploy/hot_swap called from
+router-scoped code — a router-managed model swaps only through the
+atomic fan-out, never a single-engine registry deploy).
 Registry-backed rules that ride along in ``lint_package``/``--self``:
 TPU305 (metric names — the former ``obs.check`` lint) and TPU306
 (op-spec catalog integrity).
@@ -1141,6 +1143,99 @@ def _rule_live_compile_in_restart_path(mod: ModuleInfo) -> list[Diagnostic]:
                     f"removes; bake at checkpoint/deploy time and warm "
                     f"here instead",
                     path=mod.anchor(node)))
+    return out
+
+
+# whole-name tokens marking a function (or its enclosing class) as part
+# of the replica-routing plane for TPU316 — the code that manages the
+# fleet, where a direct single-engine deploy bypasses the fan-out
+_ROUTER_TOKENS = {"router", "replica", "replicas", "routed", "fanout",
+                  "autoscale", "fleet"}
+# the fan-out door itself, and the gate that calls it on routed names
+_ROUTER_EXEMPT_SUFFIXES = ("serve/router.py", "online/gate.py")
+
+
+# public names that mark a module as touching the routing plane — a
+# module that only imports Autoscaler (and manages a fleet through it)
+# is just as able to bypass the fan-out as one naming ReplicaRouter
+_ROUTING_PLANE_NAMES = {"ReplicaRouter", "Autoscaler", "AutoscaleConfig",
+                        "AdmissionControl"}
+
+
+def _imports_replica_router(mod: ModuleInfo) -> bool:
+    """True when the module binds ReplicaRouter/Autoscaler/... (any
+    alias) or imports the serve.router/serve.autoscale module tree —
+    the precondition that scopes TPU316 to code actually touching the
+    routing plane."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom):
+            m = node.module or ""
+            if any(alias.name in _ROUTING_PLANE_NAMES
+                   for alias in node.names):
+                return True
+            if m.endswith(".serve") and any(
+                    alias.name in ("router", "autoscale")
+                    for alias in node.names):
+                return True
+            if m.endswith("serve.router") or m.endswith("serve.autoscale"):
+                return True
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.endswith("serve.router") \
+                        or alias.name.endswith("serve.autoscale"):
+                    return True
+    return False
+
+
+@register_lint_rule("TPU316")
+def _rule_deploy_bypasses_router(mod: ModuleInfo) -> list[Diagnostic]:
+    """Direct ``<registry>.deploy(...)``/``hot_swap`` inside
+    router-scoped code: a router-managed model may change versions ONLY
+    through the router's atomic fan-out (``ReplicaRouter.deploy``, or
+    ``GatedDeployer`` above it) — a single-engine registry deploy moves
+    the version book while N replicas keep serving the old weights.
+    Flags calls whose receiver is a registry, in functions (or classes)
+    carrying a router token, in modules that import ReplicaRouter."""
+    norm = mod.path.replace(os.sep, "/")
+    if any(norm == suffix or norm.endswith("/" + suffix)
+           for suffix in _ROUTER_EXEMPT_SUFFIXES) or _is_test_path(norm):
+        return []
+    if not _imports_replica_router(mod):
+        return []
+    class_tokens: dict[int, set] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef):
+            tokens = set(_snake_tokens(node.name))
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    class_tokens[id(sub)] = tokens
+    out = []
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        tokens = set(_snake_tokens(fn.name)) \
+            | class_tokens.get(id(fn), set())
+        if not tokens & _ROUTER_TOKENS:
+            continue
+        for node in _walk_shallow(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _DEPLOY_ATTRS):
+                continue
+            recv = _dotted_receiver(node.func.value) or ""
+            recv_tokens = set(_snake_tokens(recv.rsplit(".", 1)[-1])) \
+                if recv else set()
+            if "registry" not in recv_tokens:
+                continue      # router.deploy / deployer.deploy are fine
+            out.append(Diagnostic(
+                "TPU316",
+                f"{recv}.{node.func.attr}() called directly from "
+                f"router-scoped '{fn.name}' — a router-managed model "
+                f"deploys only through the atomic fan-out "
+                f"(ReplicaRouter.deploy or GatedDeployer), never a "
+                f"single-engine registry swap (RoutedModelError at "
+                f"runtime)",
+                path=mod.anchor(node)))
     return out
 
 

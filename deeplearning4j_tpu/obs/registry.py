@@ -611,6 +611,43 @@ def install_standard_metrics(registry: Optional[MetricsRegistry] = None) -> dict
                 "Calibrated max abs output deviation of the quantized "
                 "forward vs full precision (quantize calibration pass "
                 "over the holdout iterator)"),
+        r.counter("tpudl_serve_stage_reuse_total",
+                  "Micro-batch flushes served from a REUSED continuous-"
+                  "batching staging buffer (per-bucket state reuse "
+                  "instead of per-flush re-allocation)"),
+        r.labeled_counter("tpudl_serve_tenant_requests_total",
+                          "Requests offered per tenant at the router's "
+                          "admission control (X-Tenant)", ("tenant",)),
+        r.labeled_counter("tpudl_serve_tenant_shed_total",
+                          "Requests shed per tenant (token-bucket quota "
+                          "exceeded, lane threshold, or fleet "
+                          "saturation)", ("tenant",)),
+        r.gauge("tpudl_router_replicas",
+                "Replica engines currently serving behind the "
+                "ReplicaRouter (moved by the autoscaler and manual "
+                "scale calls)"),
+        r.gauge("tpudl_router_queue_depth",
+                "Aggregate requests waiting across all replica queues "
+                "at the most recent router submit"),
+        r.gauge("tpudl_router_replica_unready",
+                "1 while some replica is mid-flip in a fan-out "
+                "hot-swap (the rest of the fleet keeps serving; "
+                "ready() stays true)"),
+        r.labeled_counter("tpudl_router_dispatch_total",
+                          "Requests dispatched per replica by the "
+                          "least-queue-depth router", ("replica",)),
+        r.labeled_counter("tpudl_router_shed_total",
+                          "Admission sheds per priority lane (low-"
+                          "priority lanes shed first as the aggregate "
+                          "queue fills)", ("lane",)),
+        r.counter("tpudl_router_swaps_total",
+                  "Fan-out hot-swaps completed across the replica set "
+                  "(deploys + rollbacks through the router door)"),
+        r.counter("tpudl_router_scale_ups_total",
+                  "Replicas added by autoscaling/heal/manual scale-up"),
+        r.counter("tpudl_router_scale_downs_total",
+                  "Replicas retired (always drained, never dropped) by "
+                  "autoscaling or manual scale-down"),
         r.counter("tpudl_online_candidates_total",
                   "Fine-tune candidates the online loop produced "
                   "(gated + aborted)"),

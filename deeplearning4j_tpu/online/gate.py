@@ -230,11 +230,22 @@ class GatedDeployer:
             # is recorded and ignored there (costs a live compile,
             # never the deploy).  Refused candidates above are never
             # baked: no point compiling a model that will not serve.
-            entry = self.registry.deploy(name, candidate_path,
-                                         precision=precision,
-                                         calibration=calibration,
-                                         bake_artifacts=prebake_artifacts,
-                                         **engine_kw)
+            # A router-managed name fans the verified swap across the
+            # WHOLE replica set atomically (this gate is the sanctioned
+            # caller — TPU313/TPU316 exempt): one verified load, every
+            # replica flipped, each old engine drained.
+            router = self.registry.router_for(name)
+            if router is not None:
+                entry = router.deploy(candidate_path,
+                                      precision=precision,
+                                      calibration=calibration,
+                                      bake_artifacts=prebake_artifacts,
+                                      **engine_kw)
+            else:
+                entry = self.registry.deploy(
+                    name, candidate_path, precision=precision,
+                    calibration=calibration,
+                    bake_artifacts=prebake_artifacts, **engine_kw)
         except Exception as e:
             # deploy re-verifies the zip; a failure here never touched
             # the serving pointer — the incumbent keeps serving
@@ -302,6 +313,9 @@ class DeployWatch:
     back through the registry's verified path and counts
     ``tpudl_online_rollbacks_total``.  Returns a verdict dict either
     way (``rolled_back``, ``reason``, ``mttr_s``: detection→restored).
+    On a router-managed model ``registry.rollback`` delegates to the
+    router, so the regression response rolls EVERY replica back
+    together — the watch stays router-agnostic.
     """
 
     def __init__(self, registry, name: str, window_s: float = 10.0,

@@ -257,6 +257,12 @@ class OnlineTrainer:
                          else "refused",
                          "gate": gate_decision.to_dict(),
                          "fine_tune_s": fine_tune_s})
+        # router-managed serving: the gate fanned the swap across the
+        # whole replica set — record how wide the deploy landed
+        router = self.registry.router_for(self.name) \
+            if hasattr(self.registry, "router_for") else None
+        if router is not None and gate_decision.deploy:
+            decision["replicas"] = router.replicas
         if gate_decision.deploy and cfg.watch_window_s > 0:
             watch = DeployWatch(
                 self.registry, self.name, window_s=cfg.watch_window_s,

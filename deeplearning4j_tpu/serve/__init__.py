@@ -19,7 +19,16 @@ of users"), composing the earlier PRs' substrate into one path:
   docs/serving.md "Quantized serving".
 - :class:`ModelServer` — stdlib HTTP JSON endpoint
   (``POST /v1/models/<name>:predict``, ``POST .../<name>:feedback``,
-  ``GET /v1/models``, ``GET /healthz`` readiness, ``GET /metrics``).
+  ``GET /v1/models``, ``GET /healthz`` readiness, ``GET /metrics``),
+  with per-tenant/per-lane admission headers (``X-Tenant``,
+  ``X-Lane``) on the predict route.
+- :class:`ReplicaRouter` + :class:`Autoscaler` — traffic scale-out on
+  one host: one registry model spread across N replica engines
+  (least-queue-depth dispatch, per-replica health), priority lanes +
+  per-tenant token-bucket quotas (low-priority traffic sheds first),
+  queue-depth-driven replica autoscaling (retiring always drains),
+  and atomic fan-out hot-swap/rollback across the whole replica set —
+  see docs/serving.md "Scale-out".
 - :class:`FeedbackLog` — bounded, never-blocking feedback spool: the
   intake of the ``tpudl.online`` continual-learning loop
   (docs/online.md).
@@ -28,13 +37,20 @@ of users"), composing the earlier PRs' substrate into one path:
 :class:`InferenceEngine`.  See docs/serving.md.
 """
 
+from deeplearning4j_tpu.serve.autoscale import AutoscaleConfig, Autoscaler
 from deeplearning4j_tpu.serve.engine import (DeadlineExceeded, EngineClosed,
                                              InferenceEngine, Overloaded)
 from deeplearning4j_tpu.serve.feedback import FeedbackLog
-from deeplearning4j_tpu.serve.registry import ModelRegistry, ModelVersion
+from deeplearning4j_tpu.serve.registry import (ModelRegistry, ModelVersion,
+                                               RoutedModelError)
+from deeplearning4j_tpu.serve.router import (AdmissionControl, Lane,
+                                             QuotaExceeded, ReplicaRouter,
+                                             TenantQuota)
 from deeplearning4j_tpu.serve.server import ModelServer
 
 __all__ = [
+    "AdmissionControl", "AutoscaleConfig", "Autoscaler",
     "DeadlineExceeded", "EngineClosed", "FeedbackLog", "InferenceEngine",
-    "ModelRegistry", "ModelServer", "ModelVersion", "Overloaded",
+    "Lane", "ModelRegistry", "ModelServer", "ModelVersion", "Overloaded",
+    "QuotaExceeded", "ReplicaRouter", "RoutedModelError", "TenantQuota",
 ]

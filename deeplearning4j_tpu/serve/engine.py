@@ -28,6 +28,18 @@ results are scattered back to callers, so batched outputs equal
 per-request outputs (inference mode is row-independent: no dropout,
 BatchNorm uses running statistics).
 
+**Continuous batching (sequence workloads).**  The worker keeps ONE
+persistent host staging buffer per request signature
+(:class:`_BatchStage`) and copies each request's rows into it *at
+admission time*, inside the batching window — the staging work overlaps
+the deadline wait instead of serializing after the flush decision, and
+the buffer, its zero padding, and its mask scratch are REUSED across
+flushes instead of re-allocated per dispatch.  For sequence workloads
+(BERT MLM, LSTM: ``[n, T, F]`` requests where one flush's padded batch
+is megabytes) this removes a per-flush allocate+concatenate+pad of the
+whole batch from the hot path.  Reuse is visible in
+``tpudl_serve_stage_reuse_total``.
+
 Observability: a ``serve`` span per dispatched batch (queue-wait vs
 device-time attribution) and the ``tpudl_serve_*`` metrics —
 see docs/serving.md for the full table.
@@ -91,6 +103,109 @@ def _default_buckets(max_batch: int) -> tuple[int, ...]:
     return tuple(buckets)
 
 
+class _BatchStage:
+    """Reusable host staging state for one request signature — the
+    continuous-batching buffer.
+
+    One ``(capacity, *tail)`` features buffer (and a lazily-created mask
+    buffer) lives across flushes; admitted requests copy their rows in
+    immediately, so by the time the flush decision lands the batch is
+    already staged.  ``dirty``/``mask_dirty`` track rows holding stale
+    data from earlier flushes so only the necessary tail is re-zeroed —
+    padding rows beyond the high-water mark are still zero from the
+    original allocation.
+
+    Single-threaded by construction: only the engine's worker thread
+    touches a stage, and a dispatch completes (device_sync) before the
+    next flush reuses the buffer, so the forward never reads a buffer
+    that is being rewritten.
+    """
+
+    __slots__ = ("features", "mask", "dirty", "mask_dirty", "has_mask",
+                 "uses")
+
+    def __init__(self, capacity: int, tail: tuple, dtype):
+        self.features = np.zeros((capacity,) + tail, dtype)
+        self.mask: Optional[np.ndarray] = None
+        self.dirty = 0          # feature rows stale from earlier flushes
+        self.mask_dirty = 0
+        self.has_mask = False   # any masked request staged THIS flush
+        self.uses = 0           # flushes served from this buffer
+
+    @property
+    def capacity(self) -> int:
+        return int(self.features.shape[0])
+
+    def begin(self) -> None:
+        """Start staging a new forming batch."""
+        self.has_mask = False
+
+    def put(self, req: "_Request", offset: int) -> bool:
+        """Stage one request's rows at ``offset``; False when the
+        request does not fit this buffer's signature (the flush then
+        falls back to the concat path)."""
+        x = req.x
+        if x.shape[1:] != self.features.shape[1:] \
+                or x.dtype != self.features.dtype \
+                or offset + req.n > self.capacity:
+            return False
+        if req.mask is not None:
+            mask = req.mask
+            if self.mask is None:
+                self.mask = np.zeros(
+                    (self.capacity,) + mask.shape[1:], np.float32)
+            elif mask.shape[1:] != self.mask.shape[1:]:
+                return False
+            if not self.has_mask and offset:
+                # earlier maskless rows in this batch get all-ones
+                self.mask[:offset] = 1.0
+            self.has_mask = True
+            self.mask[offset:offset + req.n] = mask
+            self.mask_dirty = max(self.mask_dirty, offset + req.n)
+        elif self.has_mask:
+            self.mask[offset:offset + req.n] = 1.0
+            self.mask_dirty = max(self.mask_dirty, offset + req.n)
+        self.features[offset:offset + req.n] = x
+        # the high-water mark moves at WRITE time: rows staged for a
+        # request that later dies (restage compacts past it) or for a
+        # flush that falls back to concat must still count as stale, or
+        # a later, smaller flush would ship them as "padding"
+        self.dirty = max(self.dirty, offset + req.n)
+        return True
+
+    def restage(self, live: list) -> None:
+        """Compact after some admitted requests died (deadline expiry /
+        cancellation) before dispatch: rewrite the surviving rows
+        contiguously — still into the persistent buffer, no allocation.
+        Rows beyond the survivors keep their dirty accounting (put
+        raised the high-water mark when they were first staged), so
+        ``view`` re-zeroes them before they could ship as padding."""
+        self.begin()
+        offset = 0
+        for req in live:
+            self.put(req, offset)
+            offset += req.n
+
+    def view(self, bucket: int, rows: int) -> np.ndarray:
+        """The ``[bucket, ...]`` dispatch view; zeroes only the stale
+        tail rows left by a previous, larger flush."""
+        if self.dirty > rows:
+            self.features[rows:self.dirty] = 0
+        self.dirty = rows
+        return self.features[:bucket]
+
+    def mask_view(self, bucket: int, rows: int) -> Optional[np.ndarray]:
+        """The mask dispatch view (padding rows zero, exactly like the
+        concat path's ``_pad_rows``); None when no request in this flush
+        carried a mask."""
+        if not self.has_mask:
+            return None
+        if self.mask_dirty > rows:
+            self.mask[rows:self.mask_dirty] = 0
+        self.mask_dirty = rows
+        return self.mask[:bucket]
+
+
 def _pure_forward_net(model) -> bool:
     """True for nets whose forward is a pure function of (params, state,
     x, mask) with one input — the MultiLayerNetwork family.  Those get a
@@ -145,6 +260,10 @@ class InferenceEngine:
             else _default_buckets(self.max_batch))
         self._queue: queue.Queue = queue.Queue(maxsize=self.queue_limit)
         self._closed = threading.Event()
+        # continuous-batching state: persistent staging buffers keyed by
+        # request signature, worker-thread-only (bounded: odd signatures
+        # evict the oldest — steady traffic has one or two)
+        self._stages: dict[tuple, _BatchStage] = {}
         self._fwd = None
         # quantized variant (nn.quantize): same class + config as its
         # full-precision sibling, so it SHARES the step-cached forward —
@@ -219,6 +338,23 @@ class InferenceEngine:
                            trace_id=trace_id).result(timeout=timeout_s)
 
     # ------------------------------------------------------------- worker
+    def _stage_for(self, req: _Request) -> Optional[_BatchStage]:
+        """The persistent staging buffer for this request's signature
+        (created on first sight); None when the request can't stage
+        (oversize single request — it defines its own sticky bucket and
+        rides the concat path)."""
+        if req.n > self.max_batch:
+            return None
+        key = (req.x.shape[1:], req.x.dtype.str)
+        stage = self._stages.get(key)
+        if stage is None:
+            if len(self._stages) >= 8:      # bounded scratch memory
+                self._stages.pop(next(iter(self._stages)))
+            stage = _BatchStage(self.max_batch, req.x.shape[1:],
+                                req.x.dtype)
+            self._stages[key] = stage
+        return stage
+
     def _run(self) -> None:
         carry = None       # request that would have overflowed max_batch
         while True:
@@ -228,6 +364,13 @@ class InferenceEngine:
                 return
             batch = [item]
             rows = item.n
+            # continuous staging: rows copy into the persistent buffer
+            # as requests are admitted, overlapping the batching window
+            stage = self._stage_for(item)
+            if stage is not None:
+                stage.begin()
+                if not stage.put(item, 0):
+                    stage = None
             flush_at = time.perf_counter() + self.max_latency_s
             while rows < self.max_batch:
                 remaining = flush_at - time.perf_counter()
@@ -238,14 +381,16 @@ class InferenceEngine:
                 except queue.Empty:
                     break                      # deadline flush (idle)
                 if nxt is self._SHUTDOWN:
-                    self._dispatch(batch)
+                    self._dispatch(batch, stage)
                     return
                 if rows + nxt.n > self.max_batch:
                     carry = nxt                # opens the NEXT batch
                     break                      # size flush (full)
+                if stage is not None and not stage.put(nxt, rows):
+                    stage = None    # mixed signature: concat fallback
                 batch.append(nxt)
                 rows += nxt.n
-            self._dispatch(batch)              # size flush when loop ended
+            self._dispatch(batch, stage)       # size flush when loop ended
 
     def _bucket_for(self, n: int) -> int:
         bucket = choose_bucket(n, self.buckets)
@@ -273,10 +418,14 @@ class InferenceEngine:
             return self.model.output(features, mask=mask)
         return self.model.output(features)
 
-    def _dispatch(self, batch: list) -> None:
+    def _dispatch(self, batch: list,
+                  stage: Optional[_BatchStage] = None) -> None:
         """Run one micro-batch end to end; every future in ``batch`` is
         resolved (result, deadline error, cancellation, or the forward's
-        exception) — the worker itself never dies."""
+        exception) — the worker itself never dies.  ``stage`` carries
+        the pre-staged continuous-batching buffer when every request in
+        ``batch`` copied in at admission; None falls back to the
+        concat+pad path."""
         reg = get_registry()
         requests_c = reg.labeled_counter("tpudl_serve_requests_total")
         now = time.perf_counter()
@@ -296,13 +445,21 @@ class InferenceEngine:
         rows = sum(r.n for r in live)
         queue_wait_s = now - min(r.t_submit for r in live)
         try:
-            features = (np.concatenate([r.x for r in live], axis=0)
-                        if len(live) > 1 else live[0].x)
-            mask = self._concat_masks(live)
             bucket, padded = rows, 0
             if self.bucketing:
                 bucket = self._bucket_for(rows)
                 padded = bucket - rows
+            if stage is not None and bucket > stage.capacity:
+                stage = None    # sticky bucket outgrew the buffer
+            if stage is not None:
+                if len(live) != len(batch):
+                    stage.restage(live)   # compact around dead requests
+                features = stage.view(bucket, rows)
+                mask = stage.mask_view(bucket, rows)
+            else:
+                features = (np.concatenate([r.x for r in live], axis=0)
+                            if len(live) > 1 else live[0].x)
+                mask = self._concat_masks(live)
                 if padded:
                     features = _pad_rows(features, bucket)
                     if mask is not None:
@@ -355,6 +512,10 @@ class InferenceEngine:
                 - traces_before
             if retraced > 0:
                 reg.counter("tpudl_serve_recompiles_total").inc(retraced)
+            if stage is not None:
+                stage.uses += 1
+                if stage.uses > 1:   # served from a REUSED staging buffer
+                    reg.counter("tpudl_serve_stage_reuse_total").inc()
             if analyze_args is not None:
                 kind = (costmodel.program_kind(self._fwd)
                         or f"serve:{type(self.model).__name__}")
@@ -388,6 +549,18 @@ class InferenceEngine:
             offset += req.n
 
     # ----------------------------------------------------------- lifecycle
+    @property
+    def queue_depth(self) -> int:
+        """Requests currently waiting (the router's least-queue-depth
+        dispatch signal — cheap, lock-free, approximate)."""
+        return self._queue.qsize()
+
+    @property
+    def healthy(self) -> bool:
+        """True while the worker thread is alive and the engine accepts
+        submits — the router's per-replica health signal."""
+        return self._worker.is_alive() and not self._closed.is_set()
+
     @property
     def compiled_programs(self) -> int:
         """Traced XLA programs behind this engine's forward (0 for

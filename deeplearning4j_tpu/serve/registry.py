@@ -43,6 +43,16 @@ SERVING = "serving"
 RETIRED = "retired"
 
 
+class RoutedModelError(RuntimeError):
+    """Direct ``ModelRegistry.deploy`` on a router-managed model: the
+    registry's single-engine swap would bypass the router's atomic
+    fan-out, leaving N replicas serving a version the registry no
+    longer records.  Deploy through
+    :meth:`~deeplearning4j_tpu.serve.router.ReplicaRouter.deploy` (or
+    :class:`~deeplearning4j_tpu.online.gate.GatedDeployer`, which fans
+    out automatically) — lint rule TPU316 catches this statically."""
+
+
 def _engine_buckets(kw: dict) -> tuple:
     """The static bucket set the engine built from ``kw`` will compile —
     what a deploy-time bake must cover.  Empty when bucketing is off
@@ -84,6 +94,40 @@ def _apply_precision(net, precision: Optional[str], calibration):
     return qnet, "int8"
 
 
+def load_for_serving(path: str, precision: Optional[str] = None,
+                     calibration=None, bake_artifacts: bool = False,
+                     engine_kw: Optional[dict] = None,
+                     model_name: str = ""):
+    """The shared serving load path of :meth:`ModelRegistry.deploy` and
+    :meth:`~deeplearning4j_tpu.serve.router.ReplicaRouter.deploy`:
+    verified restore (a torn zip raises ``CheckpointCorruptError``
+    before anything serves), precision resolve (``nn.quantize`` for
+    int8), optional artifact bake, and the warm-load of any serialized
+    executables the zip carries.  Returns ``(net, precision)``."""
+    from deeplearning4j_tpu.io.model_serializer import restore_model
+    net = restore_model(path, load_updater=False)
+    net, precision = _apply_precision(net, precision, calibration)
+    from deeplearning4j_tpu.train import artifact_store
+    if artifact_store.enabled():
+        if bake_artifacts:
+            try:
+                artifact_store.ensure_zip_artifacts(
+                    path, net=net,
+                    buckets=_engine_buckets(engine_kw or {}))
+            except Exception as e:
+                # baking is an optimization — a deploy must never fail
+                # (or stall the flip) because AOT serialization refused
+                # a program
+                from deeplearning4j_tpu.obs import flight_recorder
+                flight_recorder.record("artifact_bake_failed",
+                                       model=model_name,
+                                       error=repr(e)[:200])
+        # warm BEFORE any engine builds its forward: the first request
+        # then dispatches a preloaded executable
+        artifact_store.warm_from_zip(path)
+    return net, precision
+
+
 @dataclasses.dataclass
 class ModelVersion:
     """One deployed (name, version): the loaded net rides inside the
@@ -118,6 +162,7 @@ class ModelRegistry:
         self._current: dict[str, ModelVersion] = {}
         self._history: dict[str, list[ModelVersion]] = {}
         self._next_version: dict[str, int] = {}
+        self._routers: dict[str, object] = {}
         self._swaps_in_flight = 0
         self.engine_defaults = dict(engine_defaults)
 
@@ -135,7 +180,66 @@ class ModelRegistry:
 
     def ready(self) -> bool:
         with self._lock:
-            return self._swaps_in_flight == 0
+            if self._swaps_in_flight != 0:
+                return False
+            routers = list(self._routers.values())
+        # a routed model's fan-out swap keeps ready() TRUE (only the
+        # replica mid-flip is unready); false here only when a router
+        # has NO serving replica at all
+        return all(router.ready() for router in routers)
+
+    # --------------------------------------------------------- routers
+    def attach_router(self, name: str, router) -> None:
+        """Hand ``name``'s serving over to a
+        :class:`~deeplearning4j_tpu.serve.router.ReplicaRouter`: the
+        registry's own engine is drained (the router's replica set was
+        built from its net) and subsequent predicts dispatch through
+        the router.  The registry stays the verified version book —
+        ``deploy`` on a routed name raises :class:`RoutedModelError`
+        (the router, or the gate above it, is the fan-out door)."""
+        with self._lock:
+            entry = self._current.get(name)
+            if entry is None:
+                raise KeyError(f"no model deployed under {name!r}")
+            self._routers[name] = router
+            engine, entry.engine = entry.engine, None
+        if engine is not None:
+            engine.shutdown(drain=True)
+
+    def detach_router(self, name: str):
+        with self._lock:
+            return self._routers.pop(name, None)
+
+    def router_for(self, name: str):
+        with self._lock:
+            return self._routers.get(name)
+
+    def previous_version(self, name: str) -> Optional[ModelVersion]:
+        """Newest retired version (the rollback target), or None."""
+        with self._lock:
+            history = self._history.get(name, [])
+            return next((mv for mv in reversed(history)
+                         if mv.status == RETIRED), None)
+
+    def record_routed_version(self, name: str, path: str,
+                              precision: str) -> ModelVersion:
+        """Version bookkeeping for a router fan-out deploy: the router
+        owns the engines, the registry records the flip — one new
+        ``ModelVersion`` (engine-less), the old one retired, the
+        version gauge moved."""
+        with self._lock:
+            version = self._next_version.get(name, 0) + 1
+            self._next_version[name] = version
+            entry = ModelVersion(name, version, str(path), SERVING,
+                                 time.time(), None, precision=precision)
+            old = self._current.get(name)
+            self._current[name] = entry
+            self._history.setdefault(name, []).append(entry)
+            if old is not None:
+                old.status = RETIRED
+        get_registry().labeled_gauge("tpudl_serve_model_version").set(
+            version, model=name)
+        return entry
 
     # --------------------------------------------------------- deploy
     def deploy(self, name: str, path: str, precision: Optional[str] = None,
@@ -170,30 +274,23 @@ class ModelRegistry:
         an accuracy gate is deliberately NOT applied here — route
         quantized deploys through ``online.gate.GatedDeployer`` so a
         quantization that costs accuracy is refused, not served.
+
+        A name managed by a :class:`~deeplearning4j_tpu.serve.router.
+        ReplicaRouter` refuses this path with :class:`RoutedModelError`
+        — the router's fan-out deploy (or the gate above it) is the
+        only door that reaches every replica atomically (rule TPU316).
         """
-        from deeplearning4j_tpu.io.model_serializer import restore_model
+        if self.router_for(name) is not None:
+            raise RoutedModelError(
+                f"model {name!r} is router-managed: deploy through "
+                f"its ReplicaRouter (or GatedDeployer) so the swap "
+                f"fans out to every replica")
         # verified load happens OUTSIDE the swap window: readiness only
         # flips for the engine-build + pointer-flip + drain
-        net = restore_model(path, load_updater=False)
-        net, precision = _apply_precision(net, precision, calibration)
         kw = {**self.engine_defaults, **engine_kw}
-        from deeplearning4j_tpu.train import artifact_store
-        if artifact_store.enabled():
-            if bake_artifacts:
-                try:
-                    artifact_store.ensure_zip_artifacts(
-                        path, net=net, buckets=_engine_buckets(kw))
-                except Exception as e:
-                    # baking is an optimization — a deploy must never
-                    # fail (or stall the flip) because AOT serialization
-                    # refused a program
-                    from deeplearning4j_tpu.obs import flight_recorder
-                    flight_recorder.record(
-                        "artifact_bake_failed", model=name,
-                        error=repr(e)[:200])
-            # warm BEFORE the engine builds its forward: the first
-            # request then dispatches a preloaded executable
-            artifact_store.warm_from_zip(path)
+        net, precision = load_for_serving(
+            path, precision=precision, calibration=calibration,
+            bake_artifacts=bake_artifacts, engine_kw=kw, model_name=name)
         with self._swap():
             engine = InferenceEngine(net, name=name, **kw)
             with self._lock:
@@ -217,11 +314,14 @@ class ModelRegistry:
 
     def rollback(self, name: str) -> ModelVersion:
         """Redeploy the newest retired version's zip (re-verified, same
-        precision it served at) as a new version number."""
-        with self._lock:
-            history = self._history.get(name, [])
-            previous = next((mv for mv in reversed(history)
-                             if mv.status == RETIRED), None)
+        precision it served at) as a new version number.  On a routed
+        name this DELEGATES to the router — an emergency path must
+        never bypass the fan-out, so every replica rolls back together
+        (``DeployWatch`` stays router-agnostic)."""
+        router = self.router_for(name)
+        if router is not None:
+            return router.rollback()
+        previous = self.previous_version(name)
         if previous is None:
             raise LookupError(f"model {name!r} has no previous version "
                               f"to roll back to")
@@ -229,11 +329,16 @@ class ModelRegistry:
                            precision=previous.precision)
 
     def undeploy(self, name: str) -> None:
-        """Remove ``name`` entirely (drains its engine)."""
+        """Remove ``name`` entirely (drains its engine — or its whole
+        replica set when routed)."""
+        router = self.detach_router(name)
         with self._lock:
             entry = self._current.pop(name, None)
+        if router is not None:
+            router.close()
         if entry is not None and entry.engine is not None:
             entry.engine.shutdown(drain=True)
+        if entry is not None:
             entry.status = RETIRED
             entry.engine = None
 
@@ -264,6 +369,9 @@ class ModelRegistry:
             row["history"] = [
                 {"version": mv.version, "status": mv.status}
                 for mv in history.get(name, [])]
+            router = self.router_for(name)
+            if router is not None:
+                row["replicas"] = router.replica_stats()
             rows.append(row)
         return rows
 
@@ -271,25 +379,39 @@ class ModelRegistry:
     def predict(self, name: str, x, mask=None,
                 deadline_ms: Optional[float] = None,
                 timeout_s: Optional[float] = None,
-                trace_id: Optional[str] = None):
+                trace_id: Optional[str] = None,
+                tenant: Optional[str] = None,
+                lane: Optional[str] = None):
         """Route one request to the current version of ``name``.  A
         submit that races a hot-swap's drain retries against the freshly
         flipped engine — callers never observe the swap as an error."""
         return self.predict_versioned(name, x, mask=mask,
                                       deadline_ms=deadline_ms,
                                       timeout_s=timeout_s,
-                                      trace_id=trace_id)[0]
+                                      trace_id=trace_id,
+                                      tenant=tenant, lane=lane)[0]
 
     def predict_versioned(self, name: str, x, mask=None,
                           deadline_ms: Optional[float] = None,
                           timeout_s: Optional[float] = None,
-                          trace_id: Optional[str] = None):
+                          trace_id: Optional[str] = None,
+                          tenant: Optional[str] = None,
+                          lane: Optional[str] = None):
         """Like :meth:`predict`, but returns ``(outputs, version)`` with
         the version of the entry whose engine actually answered — the
         truthful attribution during a swap window, where the *current*
         version may already be newer than the one that served.
         ``trace_id`` propagates into the engine's serve span / flight
-        ring (the ``X-Trace-Id`` path)."""
+        ring (the ``X-Trace-Id`` path).  ``tenant``/``lane`` feed the
+        router's admission control on routed names (token-bucket quota
+        + priority-lane shed) and are ignored for single-engine
+        models."""
+        router = self.router_for(name)
+        if router is not None:
+            return router.predict_versioned(
+                x, mask=mask, deadline_ms=deadline_ms,
+                timeout_s=timeout_s, trace_id=trace_id,
+                tenant=tenant, lane=lane)
         for _ in range(8):
             entry = self.get(name)
             engine = entry.engine

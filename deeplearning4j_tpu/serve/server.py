@@ -9,7 +9,12 @@ Endpoints (TF-Serving-shaped paths):
   "model_version": n}``.  Error mapping: unknown model → 404, bad
   JSON/payload → 400, :class:`~deeplearning4j_tpu.serve.engine.
   Overloaded` → 429 (load shed — retry against another replica),
-  deadline/timeout → 504, anything else → 500.
+  deadline/timeout → 504, anything else → 500.  ``X-Tenant`` and
+  ``X-Lane`` request headers (or ``"tenant"``/``"lane"`` body fields)
+  feed the :class:`~deeplearning4j_tpu.serve.router.ReplicaRouter`
+  admission control on router-managed models: a tenant over its
+  token-bucket quota, or a lane past its shed threshold, gets the same
+  429 — "low-priority shed first" instead of binary overload.
 - ``GET /v1/models`` — every deployed model with version, status and
   version history.
 - ``GET /v1/models/<name>`` — one model's row.
@@ -170,6 +175,15 @@ class ModelServer:
                         400, {"error": "body must be JSON with an "
                                        "'instances' array"},
                         trace_id=trace_id)
+                # per-tenant / per-lane admission headers: on a
+                # router-managed model X-Tenant meters the caller's
+                # token-bucket quota and X-Lane picks its priority lane
+                # (low-priority lanes shed first under pressure); both
+                # are inert on single-engine models.  The body may also
+                # carry them ("tenant"/"lane") for header-less clients.
+                tenant = self.headers.get("X-Tenant") \
+                    or payload.get("tenant")
+                lane = self.headers.get("X-Lane") or payload.get("lane")
                 try:
                     x = np.asarray(instances, dtype=np.float32)
                     # version of the entry that ACTUALLY answered — the
@@ -177,7 +191,7 @@ class ModelServer:
                     out, version = server.registry.predict_versioned(
                         name, x, deadline_ms=payload.get("deadline_ms"),
                         timeout_s=server.request_timeout_s,
-                        trace_id=trace_id)
+                        trace_id=trace_id, tenant=tenant, lane=lane)
                 except BaseException as e:
                     return self._send(error_status(e),
                                       {"error": f"{type(e).__name__}: {e}"},

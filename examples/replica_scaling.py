@@ -1,0 +1,164 @@
+"""Traffic-scale serving: load ramp → autoscale → fan-out swap → rollback.
+
+Demonstrates the scale-out serving plane end to end
+(docs/serving.md "Scale-out"):
+
+1. deploy one model into a :class:`ModelRegistry` (verified load) and
+   attach a :class:`ReplicaRouter` with priority lanes, a per-tenant
+   token-bucket quota, and a queue-depth :class:`Autoscaler`;
+2. ramp closed-loop client load — the autoscaler grows the replica set
+   (scale-up is milliseconds: every replica shares the step-cached
+   compiled forward);
+3. fan-out hot-swap to v2 while the clients keep hammering — every
+   replica flips atomically, old engines drain, zero dropped or
+   garbled responses, ``ready()`` stays true throughout;
+4. force an all-replica rollback: ``registry.rollback`` delegates to
+   the router, so the WHOLE fleet returns to v1's weights together.
+
+Run: ``python -m examples.replica_scaling``
+"""
+
+import os
+import tempfile
+import threading
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serve import (AdmissionControl, AutoscaleConfig,
+                                      Autoscaler, Lane, ModelRegistry,
+                                      Overloaded, ReplicaRouter,
+                                      TenantQuota)
+from deeplearning4j_tpu.train import Adam
+
+N_IN, N_CLASSES, HIDDEN, DEPTH = 64, 8, 512, 4
+
+
+def _net(x, y, epochs):
+    builder = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-2))
+               .list())
+    for _ in range(DEPTH):
+        builder = builder.layer(DenseLayer(n_out=HIDDEN, activation="relu"))
+    conf = (builder
+            .layer(OutputLayer(n_out=N_CLASSES, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(N_IN)).build())
+    net = MultiLayerNetwork(conf).init()
+    if epochs:
+        batches = [DataSet(x[i:i + 16], y[i:i + 16])
+                   for i in range(0, len(x), 16)]
+        net.fit(ListDataSetIterator(batches), epochs=epochs)
+    return net
+
+
+def main(workdir=None, clients=12, reqs_per_client=30, verbose=True):
+    workdir = workdir or tempfile.mkdtemp(prefix="tpudl_replicas_")
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(64, N_IN)).astype(np.float32)
+    w = rng.normal(size=(N_IN, N_CLASSES)).astype(np.float32)
+    y = np.eye(N_CLASSES, dtype=np.float32)[np.argmax(x @ w, -1)]
+
+    p1 = os.path.join(workdir, "model_v1.zip")
+    p2 = os.path.join(workdir, "model_v2.zip")
+    net1 = _net(x, y, epochs=0)
+    net1.save(p1)
+    net2 = _net(x, y, epochs=1)        # same architecture, moved weights
+    net2.save(p2)
+    exp = {0: np.asarray(net1.output(x)), 1: np.asarray(net2.output(x))}
+
+    registry = ModelRegistry(max_batch=8, max_latency_ms=2.0,
+                             queue_limit=8)
+    registry.deploy("classifier", p1)                     # verified load
+    router = ReplicaRouter(
+        registry, "classifier", replicas=1, max_replicas=4,
+        admission=AdmissionControl(
+            lanes=[Lane("interactive", 0, shed_at=1.0),
+                   Lane("batch", 1, shed_at=0.5)],
+            quotas={"free-tier": TenantQuota(rate=200, burst=400)}))
+    scaler = Autoscaler(router, AutoscaleConfig(
+        scale_up_at=0.1, scale_down_at=0.01, poll_s=0.01,
+        up_cooldown_s=0.02, down_cooldown_s=60.0))
+
+    results, errors, sheds = [], [], [0]
+    lock = threading.Lock()
+
+    def client(cid, swap_evt):
+        crng = np.random.default_rng(100 + cid)
+        lane = "batch" if cid % 4 == 3 else "interactive"
+        for r in range(reqs_per_client):
+            i = int(crng.integers(0, x.shape[0] - 2))
+            try:
+                out = registry.predict(
+                    "classifier", x[i:i + 2], timeout_s=60,
+                    tenant="free-tier", lane=lane)
+            except Overloaded:       # admission shed — not a drop
+                with lock:
+                    sheds[0] += 1
+                continue
+            except BaseException as e:    # noqa: BLE001 — must stay empty
+                with lock:
+                    errors.append(repr(e))
+                continue
+            with lock:
+                results.append((i, np.asarray(out)))
+            if r == reqs_per_client // 2:
+                swap_evt.set()       # mid-ramp: the deploy plane acts
+
+    try:
+        # phase 1+2: load ramp under the autoscaler, with the fan-out
+        # hot-swap landing mid-ramp from the main thread
+        swap_evt = threading.Event()
+        threads = [threading.Thread(target=client, args=(c, swap_evt))
+                   for c in range(clients)]
+        for t in threads:
+            t.start()
+        swap_evt.wait(timeout=60)
+        entry = router.deploy(p2)              # atomic fan-out, v2
+        for t in threads:
+            t.join(timeout=120)
+        replicas_grown_to = router.replicas
+        versions = [1, entry.version]
+        if verbose:
+            print(f"ramp: {len(results)} answered, {sheds[0]} shed, "
+                  f"replicas grew 1 -> {replicas_grown_to}")
+            print(f"fan-out swap -> v{entry.version} across "
+                  f"{router.replicas} replicas "
+                  f"{[r['version'] for r in router.replica_stats()]}")
+
+        # phase 3: forced all-replica rollback (the DeployWatch path —
+        # registry.rollback delegates to the router)
+        rolled = registry.rollback("classifier")
+        versions.append(rolled.version)
+        out, version = registry.predict_versioned("classifier", x[:2],
+                                                  timeout_s=60)
+        assert version == rolled.version
+        assert np.allclose(out, exp[0][:2], rtol=1e-4, atol=1e-4)
+        if verbose:
+            print(f"rollback -> v{rolled.version} (v1 weights) across "
+                  f"{[r['version'] for r in router.replica_stats()]}")
+    finally:
+        scaler.close()
+        registry.close()
+
+    garbled = sum(
+        1 for i, rows in results
+        if not any(np.allclose(rows, exp[v][i:i + 2], rtol=1e-4, atol=1e-4)
+                   for v in exp))
+    if verbose:
+        print(f"dropped={len(errors)} garbled={garbled} "
+              f"versions={versions}")
+    return {"replicas_grown_to": replicas_grown_to,
+            "versions": versions,
+            "answered": len(results),
+            "shed": sheds[0],
+            "dropped": len(errors),
+            "garbled": garbled,
+            "rolled_back": versions[-1] == 3}
+
+
+if __name__ == "__main__":
+    main()

@@ -848,3 +848,75 @@ def test_live_compile_in_restart_path_exemptions(tmp_path):
             return fn.lower(*abstract_args).compile()
         """, name="train/artifact_store.py")
     assert report.by_rule("TPU315") == []
+
+
+# ------------------------------------------------------------ TPU316
+def test_deploy_bypasses_router_flags_direct_registry_calls(tmp_path):
+    """Seeded defects: registry.deploy in a router-token function and
+    self.registry.hot_swap in a Router-named class each flag — a
+    router-managed model swaps only through the atomic fan-out."""
+    report = _lint_source(tmp_path, """
+        from deeplearning4j_tpu.serve import ReplicaRouter
+
+        def swap_router_fleet(registry, path):
+            registry.deploy("m", path)            # bypasses the fan-out
+
+        class FleetRouterManager:
+            def promote(self, path):
+                self.model_registry.hot_swap("m", path)
+        """)
+    hits = report.by_rule("TPU316")
+    assert len(hits) == 2
+    assert any("swap_router_fleet" in h.message for h in hits)
+    assert any("hot_swap" in h.message for h in hits)
+    assert report.exit_code() == 1
+    # any routing-plane import scopes the module — a fleet manager that
+    # only names the Autoscaler can bypass the fan-out just as easily
+    report = _lint_source(tmp_path, """
+        from deeplearning4j_tpu.serve import Autoscaler
+
+        def rebalance_fleet(registry, path):
+            registry.deploy("m", path)
+        """)
+    assert len(report.by_rule("TPU316")) == 1
+
+
+def test_deploy_bypasses_router_scoping(tmp_path):
+    """Setup code (no router token) may deploy; router.deploy and the
+    gate's deploy_if_better are the sanctioned doors; modules that
+    never touch the routing plane are out of scope entirely."""
+    report = _lint_source(tmp_path, """
+        from deeplearning4j_tpu.serve import ModelRegistry, ReplicaRouter
+
+        def start_serving(registry, path):
+            registry.deploy("m", path)       # BEFORE the router attaches
+            return ReplicaRouter(registry, "m", replicas=2)
+
+        def swap_replica_fleet(router, deployer, path):
+            router.deploy(path)                        # the fan-out door
+            deployer.deploy_if_better("m", path)       # the gated door
+        """)
+    assert report.by_rule("TPU316") == []
+    assert report.exit_code() == 0
+    # no ReplicaRouter import → no routing plane → out of scope
+    report = _lint_source(tmp_path, """
+        from deeplearning4j_tpu.serve import ModelRegistry
+
+        def swap_router_fleet(registry, path):
+            registry.deploy("m", path)
+        """)
+    assert report.by_rule("TPU316") == []
+
+
+def test_deploy_bypasses_router_exempt_modules(tmp_path):
+    """serve/router.py (its registry hooks ARE the fan-out) and
+    online/gate.py (the sanctioned gated caller) stay clean."""
+    for name in ("serve/router.py", "online/gate.py"):
+        (tmp_path / name.split("/")[0]).mkdir(exist_ok=True)
+        report = _lint_source(tmp_path, """
+            from deeplearning4j_tpu.serve import ReplicaRouter
+
+            def fan_out_routed_deploy(self, registry, path):
+                return registry.deploy("m", path)
+            """, name=name)
+        assert report.by_rule("TPU316") == [], name

@@ -9,9 +9,9 @@ from examples import (bert_mlm_finetune, char_rnn_textgen,
                       fault_tolerant_training, lenet_cifar10,
                       lstm_uci_har, mlp_mnist, model_serving,
                       multislice_dcn_training, online_learning,
-                      pipeline_parallel_bert, training_dashboard,
-                      transfer_learning, warm_restart,
-                      word2vec_embeddings)
+                      pipeline_parallel_bert, replica_scaling,
+                      training_dashboard, transfer_learning,
+                      warm_restart, word2vec_embeddings)
 
 
 def test_mlp_mnist_example():
@@ -108,6 +108,18 @@ def test_online_learning_example(tmp_path):
     assert result["versions"] == [1, 2, 3]
     assert result["rolled_back"] is True
     assert result["deploys"] >= 1
+
+
+def test_replica_scaling_example(tmp_path):
+    result = replica_scaling.main(workdir=str(tmp_path), verbose=False)
+    # load ramp → autoscale → fan-out hot-swap → all-replica rollback:
+    # the fleet grew, three versions served, nothing dropped or garbled
+    assert result["replicas_grown_to"] >= 2
+    assert result["versions"] == [1, 2, 3]
+    assert result["rolled_back"] is True
+    assert result["dropped"] == 0
+    assert result["garbled"] == 0
+    assert result["answered"] > 0
 
 
 def test_fault_tolerant_training_example(tmp_path):

@@ -78,6 +78,13 @@ def test_bench_skip_path_runs_cpu_records_and_exits_zero(monkeypatch,
                  "cold_start": {"speedup": 2.2,
                                 "first_response_speedup": 19.7,
                                 "zero_jit_after_warm": True,
+                                "wins": True},
+                 "load_sweep": {"value": 1.9, "p99_held_2x": True,
+                                "offered_load_x": 10.0,
+                                "replicas_per_stage": [1, 1, 4, 4],
+                                "shed_by_lane": {"interactive": 0,
+                                                 "batch": 21},
+                                "zero_dropped_or_garbled": True,
                                 "wins": True}})
     monkeypatch.setattr(
         bench, "bench_multichip",
@@ -108,6 +115,16 @@ def test_bench_skip_path_runs_cpu_records_and_exits_zero(monkeypatch,
     cold_start = record["detail"]["serving"]["cold_start"]
     assert cold_start["zero_jit_after_warm"] is True
     assert cold_start["first_response_speedup"] == 19.7
+    # ... and the ISSUE-13 load-sweep row (10x offered load vs replica
+    # autoscaling, fan-out swap + all-replica rollback under load)
+    # rides the same tunnel-down record — traffic-scale evidence is
+    # CPU-measurable too
+    load_sweep = record["detail"]["serving"]["load_sweep"]
+    assert load_sweep["p99_held_2x"] is True
+    assert load_sweep["offered_load_x"] == 10.0
+    assert load_sweep["replicas_per_stage"][-1] == 4
+    assert load_sweep["zero_dropped_or_garbled"] is True
+    assert load_sweep["shed_by_lane"]["interactive"] == 0
     # the multichip scaling row rides the tunnel-down record too —
     # federated telemetry is CPU-measurable, so rc=0 with data, not rc=1
     multichip = record["detail"]["multichip"]

@@ -1,0 +1,590 @@
+"""tpudl.serve.router / autoscale — traffic-scale serving (ISSUE 13).
+
+Acceptance: one registry model spread across N replica engines behind a
+least-queue-depth router with per-replica health; priority lanes shed
+low-priority traffic FIRST and per-tenant token buckets meter noisy
+tenants without touching their neighbors; the queue-depth autoscaler
+grows/retires replicas within bounds (retiring always drains, never
+drops); a fan-out hot-swap flips every replica atomically under
+concurrent load with zero dropped or garbled responses while
+``ready()`` stays true; rollback returns the WHOLE replica set
+together; autoscaling racing a fan-out swap preserves every invariant;
+and the engine's continuous-batching staging state is reused across
+flushes with per-request outputs exact to 1e-6 on sequence workloads.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import LSTM, DenseLayer, OutputLayer, \
+    RnnOutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.obs.registry import (MetricsRegistry, get_registry,
+                                             set_registry)
+from deeplearning4j_tpu.serve import (AdmissionControl, AutoscaleConfig,
+                                      Autoscaler, InferenceEngine, Lane,
+                                      ModelRegistry, Overloaded,
+                                      QuotaExceeded, ReplicaRouter,
+                                      RoutedModelError, TenantQuota)
+from deeplearning4j_tpu.train import Sgd
+
+N_IN, N_OUT = 8, 4
+
+
+def _net(seed=11):
+    return MultiLayerNetwork(
+        NeuralNetConfiguration.builder()
+        .seed(seed).updater(Sgd(0.1)).weight_init("xavier").list()
+        .layer(DenseLayer(n_out=16, activation="relu"))
+        .layer(OutputLayer(n_out=N_OUT, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(N_IN))
+        .build()).init()
+
+
+def _data(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(n, N_IN)).astype(np.float32)
+
+
+@pytest.fixture
+def metrics():
+    prev = set_registry(MetricsRegistry())
+    yield get_registry()
+    set_registry(prev)
+
+
+def _routed(tmp_path, seed=11, replicas=2, max_replicas=4, admission=None,
+            **engine_kw):
+    """Deploy one net and attach a router; returns (registry, router,
+    net, zip_path)."""
+    net = _net(seed)
+    path = str(tmp_path / f"v{seed}.zip")
+    net.save(path)
+    registry = ModelRegistry(max_batch=8, max_latency_ms=2,
+                             queue_limit=64, **engine_kw)
+    registry.deploy("m", path)
+    router = ReplicaRouter(registry, "m", replicas=replicas,
+                           max_replicas=max_replicas, admission=admission)
+    return registry, router, net, path
+
+
+# ------------------------------------------------------------- dispatch
+def test_routed_predict_and_version_attribution(tmp_path, metrics):
+    registry, router, net, _ = _routed(tmp_path)
+    x = _data(4, 1)
+    out, version = registry.predict_versioned("m", x, timeout_s=30)
+    assert version == 1
+    np.testing.assert_allclose(out, np.asarray(net.output(x)),
+                               rtol=1e-5, atol=1e-6)
+    assert router.replicas == 2
+    assert metrics.gauge("tpudl_router_replicas").value == 2
+    # the registry's own engine was handed over: entry is engine-less,
+    # the models() row carries per-replica health instead
+    assert registry.get("m").engine is None
+    row = next(r for r in registry.models() if r["name"] == "m")
+    assert len(row["replicas"]) == 2
+    assert all(r["healthy"] and r["ready"] for r in row["replicas"])
+    registry.close()
+
+
+def test_dispatch_spreads_and_skips_unready(tmp_path, metrics):
+    registry, router, _, _ = _routed(tmp_path, replicas=2)
+    x = _data(2, 2)
+    rep0, rep1 = router._replicas
+    rep0.ready = False
+    for _ in range(6):
+        router.predict(x, timeout_s=30)
+    dispatch = metrics.labeled_counter("tpudl_router_dispatch_total",
+                                       label_names=("replica",))
+    assert dispatch.labeled_value(replica=f"r{rep0.id}") == 0
+    assert dispatch.labeled_value(replica=f"r{rep1.id}") == 6
+    rep0.ready = True
+    # both replicas serve once ready again (concurrent closed-loop load
+    # so the queues actually interleave)
+    def client(cid):
+        for _ in range(20):
+            router.predict(x, timeout_s=30)
+    threads = [threading.Thread(target=client, args=(c,)) for c in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert dispatch.labeled_value(replica=f"r{rep0.id}") > 0
+    registry.close()
+
+
+def test_direct_registry_deploy_refused_on_routed_model(tmp_path, metrics):
+    registry, router, _, path = _routed(tmp_path)
+    with pytest.raises(RoutedModelError):
+        registry.deploy("m", path)
+    # the fleet is untouched
+    assert router.replicas == 2
+    assert registry.get("m").version == 1
+    registry.close()
+
+
+# ----------------------------------------------------------- admission
+def test_lane_shed_low_priority_first(tmp_path, metrics):
+    """A lane past its shed threshold sheds while the high-priority
+    lane keeps serving — Overloaded stops being binary."""
+    admission = AdmissionControl(
+        lanes=[Lane("interactive", 0, shed_at=1.0),
+               Lane("batch", 1, shed_at=0.0)],     # sheds at ANY pressure
+        default_lane="interactive")
+    registry, router, net, _ = _routed(tmp_path, admission=admission)
+    x = _data(2, 3)
+    with pytest.raises(Overloaded):
+        router.predict(x, lane="batch", timeout_s=30)
+    out = router.predict(x, lane="interactive", timeout_s=30)
+    np.testing.assert_allclose(out, np.asarray(net.output(x)),
+                               rtol=1e-5, atol=1e-6)
+    shed = metrics.labeled_counter("tpudl_router_shed_total",
+                                   label_names=("lane",))
+    assert shed.labeled_value(lane="batch") == 1
+    assert shed.labeled_value(lane="interactive") == 0
+    # unknown lane rides the default (interactive) lane
+    assert router.predict(x, lane="nope", timeout_s=30).shape == (2, N_OUT)
+    registry.close()
+
+
+def test_tenant_token_bucket_quota(tmp_path, metrics):
+    """A tenant over its rate is shed with QuotaExceeded (→ 429) while
+    other tenants — and unmetered traffic — are untouched."""
+    admission = AdmissionControl(
+        quotas={"noisy": TenantQuota(rate=0.001, burst=2)})
+    registry, router, _, _ = _routed(tmp_path, admission=admission)
+    x = _data(1, 4)
+    router.predict(x, tenant="noisy", timeout_s=30)
+    router.predict(x, tenant="noisy", timeout_s=30)
+    with pytest.raises(QuotaExceeded):
+        router.predict(x, tenant="noisy", timeout_s=30)
+    router.predict(x, tenant="polite", timeout_s=30)   # unaffected
+    router.predict(x, timeout_s=30)                    # unmetered
+    requests = metrics.labeled_counter("tpudl_serve_tenant_requests_total",
+                                       label_names=("tenant",))
+    shed = metrics.labeled_counter("tpudl_serve_tenant_shed_total",
+                                   label_names=("tenant",))
+    assert requests.labeled_value(tenant="noisy") == 3
+    assert shed.labeled_value(tenant="noisy") == 1
+    assert shed.labeled_value(tenant="polite") == 0
+    registry.close()
+
+
+def test_server_tenant_and_lane_headers(tmp_path, metrics):
+    """X-Tenant/X-Lane ride the HTTP front door into the router's
+    admission control; a quota shed maps to 429 like any Overloaded."""
+    import http.client
+    import json
+
+    from deeplearning4j_tpu.serve import ModelServer
+    admission = AdmissionControl(
+        quotas={"noisy": TenantQuota(rate=0.001, burst=1)})
+    registry, router, _, _ = _routed(tmp_path, admission=admission)
+    server = ModelServer(registry, port=0)
+    try:
+        def post(tenant):
+            conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                              timeout=30)
+            conn.request("POST", "/v1/models/m:predict",
+                         json.dumps({"instances": _data(1, 5).tolist()}),
+                         {"X-Tenant": tenant, "X-Lane": "interactive"})
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+            conn.close()
+            return resp.status, body
+
+        status, body = post("noisy")
+        assert status == 200 and len(body["predictions"]) == 1
+        status, body = post("noisy")        # burst of 1 exhausted
+        assert status == 429
+        assert "quota" in body["error"]
+        status, _ = post("polite")
+        assert status == 200
+    finally:
+        server.stop()
+        registry.close()
+
+
+# ----------------------------------------------------------- autoscale
+def test_autoscaler_scales_up_down_and_heals(tmp_path, metrics,
+                                             monkeypatch):
+    registry, router, _, _ = _routed(tmp_path, replicas=1, max_replicas=3)
+    scaler = Autoscaler(router, AutoscaleConfig(
+        scale_up_at=0.5, scale_down_at=0.05, poll_s=30.0,
+        up_cooldown_s=0.0, down_cooldown_s=0.0, window=1))
+    try:
+        monkeypatch.setattr(router, "queue_fill", lambda: 0.9)
+        scaler.step()
+        scaler.step()
+        assert router.replicas == 3
+        scaler.step()                      # bounded at max_replicas
+        assert router.replicas == 3
+        assert metrics.counter("tpudl_router_scale_ups_total").value == 2
+        monkeypatch.setattr(router, "queue_fill", lambda: 0.0)
+        scaler.step()
+        scaler.step()
+        assert router.replicas == 1
+        scaler.step()                      # bounded at min_replicas
+        assert router.replicas == 1
+        assert metrics.counter("tpudl_router_scale_downs_total").value == 2
+        # heal: a replica whose engine died is replaced on the next poll
+        sick = router._replicas[0]
+        sick.engine.shutdown(drain=True)
+        assert not router.ready()
+        scaler.step()
+        assert router.replicas == 1
+        assert router.ready()
+        assert router._replicas[0].id != sick.id
+    finally:
+        scaler.close()
+        registry.close()
+
+
+def test_retire_always_drains_never_drops(tmp_path, metrics):
+    """Queued work on a retiring replica completes before its engine
+    goes away — scale-down can't fail a request."""
+    registry, router, net, _ = _routed(tmp_path, replicas=2)
+    x = _data(8, 6)
+    expected = np.asarray(net.output(x))
+    futures = []
+    for i in range(32):      # enough to queue on both replicas
+        fut, _ = router.submit(x[i % 8:i % 8 + 1])
+        futures.append((i % 8, fut))
+    assert router.retire_replica()
+    assert router.replicas == 1
+    for i, fut in futures:
+        np.testing.assert_allclose(fut.result(timeout=30), expected[i:i + 1],
+                                   rtol=1e-5, atol=1e-6)
+    assert metrics.counter("tpudl_router_scale_downs_total").value == 1
+    registry.close()
+
+
+# ------------------------------------------------------------- fan-out
+def test_fan_out_swap_under_concurrent_load(tmp_path, metrics):
+    """Deploy v2 through the router while clients hammer the fleet:
+    zero dropped, every response a valid output of exactly one version,
+    every replica on v2 afterwards — and ready() stays TRUE throughout
+    (only the replica mid-flip is ever unready)."""
+    registry, router, net1, _ = _routed(tmp_path, replicas=3)
+    net2 = _net(12)
+    p2 = str(tmp_path / "v2.zip")
+    net2.save(p2)
+    x = _data(16, 7)
+    exp1, exp2 = np.asarray(net1.output(x)), np.asarray(net2.output(x))
+
+    errors, results, ready_samples = [], [], []
+    stop = threading.Event()
+
+    def client(cid):
+        rng = np.random.default_rng(cid)
+        count = 0
+        while not (stop.is_set() and count >= 20):
+            i = int(rng.integers(0, x.shape[0]))
+            try:
+                out = registry.predict("m", x[i:i + 1], timeout_s=30)
+                results.append((i, np.asarray(out)[0]))
+            except BaseException as e:   # noqa: BLE001 — collect all
+                errors.append(e)
+            count += 1
+            if count > 500:
+                break
+
+    def ready_sampler():
+        while not stop.is_set():
+            ready_samples.append((registry.ready(), router.ready()))
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=client, args=(c,)) for c in range(6)]
+    sampler = threading.Thread(target=ready_sampler)
+    for t in threads:
+        t.start()
+    sampler.start()
+    time.sleep(0.2)
+    entry = router.deploy(p2)            # fan-out hot-swap mid-traffic
+    time.sleep(0.1)
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    sampler.join(timeout=10)
+
+    assert not errors, errors[:3]
+    assert len(results) >= 120
+    for i, row in results:
+        ok1 = np.allclose(row, exp1[i], rtol=1e-5, atol=1e-5)
+        ok2 = np.allclose(row, exp2[i], rtol=1e-5, atol=1e-5)
+        assert ok1 or ok2, f"garbled response for row {i}"
+    assert entry.version == 2
+    assert [r["version"] for r in router.replica_stats()] == [2, 2, 2]
+    assert registry.get("m").version == 2
+    assert metrics.labeled_gauge(
+        "tpudl_serve_model_version").labeled_value(model="m") == 2
+    assert metrics.counter("tpudl_router_swaps_total").value == 1
+    # the front door never closed: unlike a single-engine swap, the
+    # fan-out keeps /healthz green the whole time
+    assert ready_samples and all(reg and rt for reg, rt in ready_samples)
+    registry.close()
+
+
+def test_rollback_fans_all_replicas_together(tmp_path, metrics):
+    registry, router, net1, _ = _routed(tmp_path, replicas=3)
+    net2 = _net(12)
+    p2 = str(tmp_path / "v2.zip")
+    net2.save(p2)
+    router.deploy(p2)
+    rolled = registry.rollback("m")      # delegates to the router
+    assert rolled.version == 3
+    assert [r["version"] for r in router.replica_stats()] == [3, 3, 3]
+    x = _data(4, 8)
+    out, version = registry.predict_versioned("m", x, timeout_s=30)
+    assert version == 3
+    np.testing.assert_allclose(out, np.asarray(net1.output(x)),
+                               rtol=1e-5, atol=1e-6)
+    assert metrics.counter("tpudl_router_swaps_total").value == 2
+    registry.close()
+
+
+def test_swap_zero_recompiles_same_architecture(tmp_path, metrics):
+    """All replicas share the step-cached forward; a same-architecture
+    fan-out costs zero recompiles — and so does adding a replica."""
+    from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+    registry, router, net, _ = _routed(tmp_path, replicas=2)
+    x = _data(8, 9)
+    it = ArrayDataSetIterator(_data(32, 10),
+                              np.eye(N_OUT, dtype=np.float32)[
+                                  np.random.default_rng(0).integers(
+                                      0, N_OUT, 32)], 16)
+    net.fit(it, epochs=1)                # same config, moved weights
+    p2 = str(tmp_path / "v2.zip")
+    net.save(p2)
+    router.predict(x, timeout_s=30)      # compile bucket 8
+    before = metrics.counter("tpudl_serve_recompiles_total").value
+    router.deploy(p2)
+    router.add_replica()
+    out = router.predict(x, timeout_s=30)
+    assert metrics.counter("tpudl_serve_recompiles_total").value == before
+    np.testing.assert_allclose(
+        out, np.asarray(MultiLayerNetwork.load(p2, load_updater=False)
+                        .output(x)), rtol=1e-5, atol=1e-6)
+    registry.close()
+
+
+def test_gated_deployer_fans_out_routed_model(tmp_path, metrics):
+    """The online gate is the sanctioned door: deploy_if_better on a
+    routed name fans a gate-passing candidate across every replica and
+    leaves the fleet untouched on refusal."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.online.gate import EvalGate, GatedDeployer
+    registry, router, net1, p1 = _routed(tmp_path, replicas=2)
+    x = _data(32, 11)
+    labels = np.eye(N_OUT, dtype=np.float32)[
+        np.argmax(np.asarray(net1.output(x)), axis=1)]
+    holdout = [DataSet(x, labels)]
+    gate = EvalGate(holdout, metric="accuracy", min_delta=0.0)
+    deployer = GatedDeployer(registry, gate)
+    # candidate = the incumbent's own weights → ties pass (non-regression)
+    decision = deployer.deploy_if_better("m", p1)
+    assert decision.deploy
+    assert [r["version"] for r in router.replica_stats()] == [2, 2]
+    # a garbage candidate is refused and the fleet stays on v2
+    net_bad = _net(99)
+    p_bad = str(tmp_path / "bad.zip")
+    net_bad.save(p_bad)
+    decision = deployer.deploy_if_better("m", p_bad)
+    assert not decision.deploy
+    assert [r["version"] for r in router.replica_stats()] == [2, 2]
+    assert metrics.counter("tpudl_online_refusals_total").value == 1
+    registry.close()
+
+
+def test_autoscale_racing_fan_out_swap(tmp_path, metrics):
+    """The ISSUE-13 race: scaling (add + retire, via the autoscaler's
+    own step loop) races a fan-out hot-swap under client load.  After
+    the dust settles every surviving replica is on the new version,
+    bounds were respected, nothing was dropped or garbled."""
+    registry, router, net1, _ = _routed(tmp_path, replicas=2,
+                                        max_replicas=4)
+    net2 = _net(12)
+    p2 = str(tmp_path / "v2.zip")
+    net2.save(p2)
+    x = _data(16, 13)
+    exp1, exp2 = np.asarray(net1.output(x)), np.asarray(net2.output(x))
+    errors, results = [], []
+    stop = threading.Event()
+
+    def client(cid):
+        rng = np.random.default_rng(cid)
+        while not stop.is_set():
+            i = int(rng.integers(0, x.shape[0]))
+            try:
+                out = registry.predict("m", x[i:i + 1], timeout_s=30)
+                results.append((i, np.asarray(out)[0]))
+            except Overloaded:
+                pass                      # admission, not a drop
+            except BaseException as e:   # noqa: BLE001
+                errors.append(e)
+
+    def churn():
+        # alternate pressure/calm so the autoscaler adds AND retires
+        # while the fan-out runs
+        scaler = Autoscaler(router, AutoscaleConfig(
+            scale_up_at=0.5, scale_down_at=0.05, poll_s=30.0,
+            up_cooldown_s=0.0, down_cooldown_s=0.0, window=1))
+        try:
+            for step in range(60):
+                if stop.is_set():
+                    break
+                fill = 0.9 if step % 2 == 0 else 0.0
+                try:
+                    router.queue_fill = lambda f=fill: f
+                    scaler.step()
+                finally:
+                    del router.queue_fill   # back to the real method
+                time.sleep(0.005)
+        finally:
+            scaler.close()
+
+    threads = [threading.Thread(target=client, args=(c,)) for c in range(4)]
+    churner = threading.Thread(target=churn)
+    for t in threads:
+        t.start()
+    churner.start()
+    time.sleep(0.1)
+    entry = router.deploy(p2)            # fan-out races the churn
+    churner.join(timeout=60)
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+
+    assert not errors, errors[:3]
+    assert len(results) >= 50
+    for i, row in results:
+        ok1 = np.allclose(row, exp1[i], rtol=1e-5, atol=1e-5)
+        ok2 = np.allclose(row, exp2[i], rtol=1e-5, atol=1e-5)
+        assert ok1 or ok2, f"garbled response for row {i}"
+    # bounds respected, every surviving replica healthy and on v2
+    assert 1 <= router.replicas <= 4
+    stats = router.replica_stats()
+    assert all(r["version"] == entry.version for r in stats)
+    assert all(r["healthy"] for r in stats)
+    out = router.predict(x[:2], timeout_s=30)
+    assert np.allclose(out, exp2[:2], rtol=1e-5, atol=1e-5)
+    registry.close()
+
+
+# ---------------------------------------------- continuous batching (engine)
+def _lstm_net(seed=31, t=6, f=5, out=3):
+    return MultiLayerNetwork(
+        NeuralNetConfiguration.builder()
+        .seed(seed).updater(Sgd(0.1)).list()
+        .layer(LSTM(n_out=8, activation="tanh"))
+        .layer(RnnOutputLayer(n_out=out, activation="softmax",
+                              loss="mcxent"))
+        .set_input_type(InputType.recurrent(f, t))
+        .build()).init()
+
+
+def test_continuous_batching_state_reuse_sequence_workload(metrics):
+    """Sequence requests ([n, T, F], the BERT-MLM/LSTM serving shape)
+    ride the persistent per-signature staging buffer: outputs match the
+    per-request forward to 1e-6 across many flushes, and reuse (not
+    re-allocation) is counted after the first flush."""
+    t, f = 6, 5
+    net = _lstm_net(t=t, f=f)
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(32, t, f)).astype(np.float32)
+    expected = np.asarray(net.output(x))
+    with InferenceEngine(net, name="seq", max_batch=8, max_latency_ms=5,
+                         queue_limit=64, buckets=(4, 8)) as eng:
+        eng.predict(x[:8], timeout_s=120)        # compile bucket 8
+        eng.predict(x[:4], timeout_s=120)        # compile bucket 4
+        for round_idx in range(6):               # many flushes, one buffer
+            futures, offset = [], 0
+            sizes = [1, 3, 2, 4, 3, 2]
+            for n in sizes:
+                futures.append((offset, n,
+                                eng.submit(x[offset:offset + n])))
+                offset += n
+            for off, n, fut in futures:
+                np.testing.assert_allclose(
+                    fut.result(timeout=60), expected[off:off + n],
+                    rtol=1e-6, atol=1e-6)
+        assert metrics.counter("tpudl_serve_stage_reuse_total").value > 0
+        assert eng.compiled_programs <= 2        # still one per bucket
+
+
+def test_continuous_batching_masks_and_mixed_signatures(metrics):
+    """Masked and maskless sequence requests share one staged batch
+    (maskless rows get ones, padding rows zeros); a request with a
+    different signature mid-batch falls back to the concat path without
+    corrupting anyone's rows."""
+    t, f = 6, 5
+    net = _lstm_net(seed=32, t=t, f=f)
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(8, t, f)).astype(np.float32)
+    mask = np.ones((2, t), np.float32)
+    mask[:, 4:] = 0.0                            # truncate two sequences
+    exp_masked = np.asarray(net.output(x[:2], mask=mask))
+    exp_plain = np.asarray(net.output(x[2:5]))
+    with InferenceEngine(net, name="mix", max_batch=8, max_latency_ms=20,
+                         queue_limit=16) as eng:
+        f1 = eng.submit(x[:2], mask=mask)
+        f2 = eng.submit(x[2:5])                  # no mask, same flush
+        np.testing.assert_allclose(f1.result(timeout=60), exp_masked,
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(f2.result(timeout=60), exp_plain,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_batch_stage_restage_and_zeroing():
+    """_BatchStage unit semantics: stale tail rows re-zero on a smaller
+    flush, dead requests compact without allocation, late-arriving
+    masks backfill ones for earlier maskless rows."""
+    from concurrent.futures import Future
+
+    from deeplearning4j_tpu.serve.engine import _BatchStage, _Request
+
+    def req(rows, mask=None):
+        return _Request(np.full((rows, 2), float(rows), np.float32),
+                        None if mask is None else mask, Future(), 0.0, None)
+
+    stage = _BatchStage(8, (2,), np.float32)
+    stage.begin()
+    r1, r2 = req(3), req(2)
+    assert stage.put(r1, 0) and stage.put(r2, 3)
+    view = stage.view(8, 5)
+    assert (view[:3] == 3.0).all() and (view[3:5] == 2.0).all()
+    assert (view[5:] == 0.0).all()
+    assert stage.mask_view(8, 5) is None
+    # smaller next flush: rows 2..5 held stale data and must re-zero
+    stage.begin()
+    r3 = req(2)
+    assert stage.put(r3, 0)
+    view = stage.view(4, 2)
+    assert (view[:2] == 2.0).all() and (view[2:] == 0.0).all()
+    # dead-request compaction: restage only the survivors — and rows
+    # the dead request had already staged past the survivors' extent
+    # must re-zero on the NEXT flush (put moves the high-water mark at
+    # write time, not view time)
+    stage.begin()
+    a, b, c = req(2), req(1), req(3)
+    stage.put(a, 0), stage.put(b, 2), stage.put(c, 3)
+    stage.restage([a, c])                        # b expired pre-dispatch
+    view = stage.view(8, 5)
+    assert (view[:2] == 2.0).all() and (view[2:5] == 3.0).all()
+    assert (view[5:] == 0.0).all()
+    stage.begin()
+    stage.put(req(1), 0)
+    view = stage.view(8, 1)
+    assert (view[1:] == 0.0).all()               # rows 1..4 re-zeroed
+    # late mask: earlier maskless rows backfill with ones
+    stage.begin()
+    m = np.zeros((2, 3), np.float32)
+    stage.put(req(2), 0)
+    stage.put(req(2, mask=m), 2)
+    mask_view = stage.mask_view(8, 4)
+    assert (mask_view[:2] == 1.0).all() and (mask_view[2:4] == 0.0).all()
+    assert (mask_view[4:] == 0.0).all()
