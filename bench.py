@@ -543,7 +543,7 @@ def bench_online(timeout_s: float = 300.0) -> dict:
     return _cpu_subbench("online.py", timeout_s)
 
 
-def bench_multichip(timeout_s: float = 540.0) -> dict:
+def bench_multichip(timeout_s: float = 900.0) -> dict:
     """Multichip scaling record (ROADMAP item 2's deliverable, CPU
     form): a real spawn_local_cluster gang whose per-worker throughput
     is measured from FEDERATED telemetry (RemoteStatsRouter → the

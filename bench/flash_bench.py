@@ -23,7 +23,7 @@ import numpy as np
 
 from deeplearning4j_tpu.ops.attention import _auto_flash, FLASH_AUTO_SEQ_LEN
 from deeplearning4j_tpu.ops.pallas import flash_attention
-from deeplearning4j_tpu.parallel.context_parallel import reference_attention
+from deeplearning4j_tpu.parallel.unified import reference_attention
 
 
 STEPS = 20

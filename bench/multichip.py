@@ -166,6 +166,134 @@ def _run_recovery(server, steps, port, workdir):
     }
 
 
+def mesh_sweep_main():
+    """ISSUE-14 deliverable: the SAME model stepped under several
+    composable layouts on one host's 8-device virtual CPU mesh —
+    measured steps/s per layout, the analytic per-step collective-bytes
+    estimate from ``MeshLayout.collective_bytes_per_step``, and
+    per-layout arithmetic intensity pulled from the compiled program's
+    XLA cost_analysis (the PR-6 cost model; collectives show up as
+    bytes, so layout choices move the measured intensity).  Runs
+    in-process — ``main()`` launches it as a subprocess with the forced
+    device count so the gang runs above keep their 1-device children.
+    Prints ONE json line."""
+    import time
+
+    import numpy as np
+    import jax
+
+    from deeplearning4j_tpu.config import set_config
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.obs import costmodel
+    from deeplearning4j_tpu.train import Sgd
+    from deeplearning4j_tpu.train.trainer import Trainer
+
+    layouts = [s for s in os.environ.get(
+        "DL4J_TPU_MESH_SWEEP_LAYOUTS",
+        "dp4,tp4,dp2xtp2,dp2xpp2").split(",") if s]
+    steps = int(os.environ.get("DL4J_TPU_MESH_SWEEP_STEPS", "10"))
+    width, hidden, classes, batch = 64, 256, 8, 64
+    set_config(device_feed=False)   # direct fit_batch loop, no feeder thread
+
+    def build_net():
+        conf = (NeuralNetConfiguration.builder().seed(31)
+                .updater(Sgd(0.05)).list()
+                .layer(DenseLayer(n_out=hidden, activation="relu"))
+                .layer(DenseLayer(n_out=hidden, activation="tanh"))
+                .layer(OutputLayer(n_out=classes, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(width)).build())
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(batch, width)).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, batch)]
+    batch_ds = DataSet(x, y)
+
+    def run(layout):
+        net = build_net()
+        mb = 2 if layout and "pp" in layout else 1
+        trainer = Trainer(net, layout=layout, n_microbatches=mb)
+        key = jax.random.key(11)
+        for _ in range(2):      # compile + settle
+            key, sub = jax.random.split(key)
+            jax.block_until_ready(trainer.fit_batch(batch_ds, sub))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            key, sub = jax.random.split(key)
+            loss = trainer.fit_batch(batch_ds, sub)
+        jax.block_until_ready(loss)
+        dt = (time.perf_counter() - t0) / steps
+        row = {"steps_per_s": round(1.0 / dt, 3),
+               "step_ms": round(dt * 1e3, 3)}
+        stamp = None
+        if trainer._bake_args is not None:
+            stamp = costmodel.measure(trainer._step, trainer._bake_args,
+                                      dt, kind=f"train:{layout or 'single'}")
+        if stamp:
+            row.update({k: stamp[k] for k in
+                        ("arith_intensity", "flops_per_step",
+                         "bytes_per_step", "roofline_bound")
+                        if k in stamp})
+        if trainer._layout is not None:
+            param_bytes = sum(
+                int(l.size) * l.dtype.itemsize
+                for l in jax.tree_util.tree_leaves(net.params_)
+                if hasattr(l, "size"))
+            act_bytes = batch * hidden * 4
+            row["collective_bytes_per_step"] = \
+                trainer._layout.collective_bytes_per_step(param_bytes,
+                                                          act_bytes)
+            row["collective_bytes_source"] = "analytic_estimate"
+            row["layout"] = trainer._layout.describe()
+        return row
+
+    baseline = run(None)
+    rows = {}
+    for layout in layouts:
+        try:
+            rows[layout] = run(layout)
+        except Exception as e:   # a layout that cannot build on this host
+            rows[layout] = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+    print(json.dumps({
+        "metric": "mesh_layout_sweep",
+        "value": max((r.get("steps_per_s") or 0.0) for r in rows.values()),
+        "unit": "steps_per_s",
+        "model": f"mlp_{width}x{hidden}x{hidden}x{classes}",
+        "batch": batch,
+        "steps_timed": steps,
+        "single_device": baseline,
+        "layouts": rows,
+        "note": ("same model, same batches, one unified mesh — layouts "
+                 "selected via Trainer(layout=...); steps/s measured "
+                 "after compile, arith intensity from XLA cost_analysis "
+                 "of each layout's compiled step, collective bytes from "
+                 "the MeshLayout analytic model (virtual CPU devices: "
+                 "relative layout cost, not TPU wall time)"),
+    }))
+    return 0
+
+
+def _run_mesh_sweep(timeout_s=420.0):
+    """Run the sweep in a subprocess with the forced 8-device virtual
+    CPU topology (the parent keeps its own device view for the gangs)."""
+    import subprocess
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS=flags)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--mesh-sweep"],
+        capture_output=True, text=True, timeout=timeout_s, env=env)
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    if lines:
+        return json.loads(lines[-1])
+    return {"error": (proc.stderr or "no output")[-300:]}
+
+
 def _fetch_json(url):
     import urllib.request
     with urllib.request.urlopen(url, timeout=5) as resp:
@@ -234,6 +362,12 @@ def main():
         # measured from the same federated telemetry
         recovery = _run_recovery(server, recovery_steps, port + 391,
                                  tempfile.mkdtemp(prefix="dl4j_tpu_rec_"))
+        # the unified-mesh layout sweep (own subprocess: needs the
+        # forced 8-device topology the gang children must not inherit)
+        try:
+            mesh_sweep = _run_mesh_sweep()
+        except Exception as e:
+            mesh_sweep = {"error": str(e)[:200]}
         print(json.dumps({
             "metric": "multichip_scaling_efficiency",
             "value": round(efficiency, 4),
@@ -243,6 +377,7 @@ def main():
             "per_chip_scaling_efficiency": round(efficiency, 4),
             "straggler_skew": round(skew, 4),
             "recovery": recovery,
+            "mesh_sweep": mesh_sweep,
             "detail": {
                 "baseline_steps_per_s": round(baseline, 3),
                 "aggregate_steps_per_s": round(aggregate, 3),
@@ -261,4 +396,6 @@ def main():
 
 
 if __name__ == "__main__":
+    if "--mesh-sweep" in sys.argv:
+        sys.exit(mesh_sweep_main())
     sys.exit(main())

@@ -28,7 +28,7 @@ can gate.  Rule catalog: ``docs/static_analysis.md``.
 from deeplearning4j_tpu.analyze.diagnostics import (
     Diagnostic, Report, RULES, RuleInfo, ERROR, WARNING, INFO, rule_family)
 from deeplearning4j_tpu.analyze.model_checks import analyze_model, load_model_conf
-from deeplearning4j_tpu.analyze.sharding import check_sharding
+from deeplearning4j_tpu.analyze.sharding import check_layout, check_sharding
 from deeplearning4j_tpu.analyze.lint import (
     lint_paths, lint_package, check_metric_names, check_op_catalog)
 from deeplearning4j_tpu.analyze.concurrency import (
@@ -38,7 +38,7 @@ from deeplearning4j_tpu.analyze.concurrency import (
 __all__ = [
     "Diagnostic", "Report", "RULES", "RuleInfo", "ERROR", "WARNING", "INFO",
     "rule_family",
-    "analyze_model", "load_model_conf", "check_sharding",
+    "analyze_model", "load_model_conf", "check_sharding", "check_layout",
     "lint_paths", "lint_package", "check_metric_names", "check_op_catalog",
     "analyze_concurrency_paths", "analyze_concurrency_package",
     "register_concurrency_rule",

@@ -11,6 +11,11 @@ report and one exit code):
 - ``--concurrency [<path> ...]``: static race/deadlock analysis
   (TPU4xx) over the given paths — with no paths (or with ``--self``)
   over the ``deeplearning4j_tpu`` tree itself (also CI-gated).
+- ``--layout <layout>``: statically validate a composite mesh layout
+  (the ``Trainer(layout=...)`` flag, e.g. ``dp2xtp2xpp2``) against the
+  unified axis table, the device count, and the TP rule family
+  (TPU201–203) — combinable with ``--model`` so a model + its layout
+  gate together.
 
 Combined runs share one parsed AST per file (``analyze.source`` cache),
 so ``--self --lint --concurrency`` parses each module once.
@@ -62,6 +67,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated mesh axis names to resolve "
                         "PartitionSpecs against (default: "
                         "parallel.mesh.MESH_AXES)")
+    p.add_argument("--layout", metavar="LAYOUT",
+                   help="composite mesh layout to validate statically "
+                        "(the Trainer(layout=...) flag, e.g. 'dp2xtp2' "
+                        "or 'dp2xtp2xpp2') — checks the axis table, the "
+                        "device count, and the TP rule family "
+                        "(TPU201-203)")
+    p.add_argument("--tp-family", metavar="FAMILY", default=None,
+                   help="TP rule family for --layout (default 'dense'; "
+                        "see parallel.mesh.TP_RULE_FAMILIES)")
+    p.add_argument("--devices", type=int, default=None,
+                   help="device count to validate --layout against "
+                        "(default: this host's jax.devices())")
     p.add_argument("--format", choices=("text", "json"), default="text")
     p.add_argument("--no-hints", action="store_true",
                    help="omit fix hints from text output")
@@ -70,7 +87,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    if not (args.model or args.self_check or args.lint
+    if not (args.model or args.self_check or args.lint or args.layout
             or args.concurrency is not None):
         build_parser().print_usage(sys.stderr)
         print("error: nothing to do — pass --model, --self, --lint "
@@ -96,6 +113,13 @@ def main(argv=None) -> int:
         report.context["model"] = args.model
         report.extend(analyze_model(conf, batch=args.batch, hbm_budget=budget,
                                     mesh_axes=mesh_axes))
+    if args.layout:
+        from deeplearning4j_tpu.analyze.sharding import check_layout
+        mesh_axes = (tuple(a.strip() for a in args.mesh.split(",") if a.strip())
+                     if args.mesh else None)
+        report.extend(check_layout(args.layout, tp_family=args.tp_family,
+                                   n_devices=args.devices,
+                                   mesh_axes=mesh_axes))
     if args.self_check:
         report.extend(lint_package())
     if args.lint:

@@ -304,6 +304,22 @@ _RULE_LIST = [
         "online.gate.GatedDeployer.deploy_if_better, which fans out "
         "automatically when a router is attached; "
         "registry.rollback already delegates."),
+    RuleInfo(
+        "TPU317", "hardcoded-axis-name", ERROR,
+        "String literal 'data'/'model'/'pipe' (or the pre-rename "
+        "'stage') passed to a sharding constructor (PartitionSpec/P/"
+        "NamedSharding) outside parallel/mesh.py",
+        "The unified mesh has ONE axis vocabulary, declared once in "
+        "parallel.mesh.MESH_AXES — hardcoded axis strings are exactly "
+        "how the five sibling parallel modules grew incompatible "
+        "vocabularies that could not compose into DP×TP×PP layouts.  A "
+        "literal also silently misses renames (the 'stage' axis is now "
+        "'pipe'): the PartitionSpec resolves against nothing and GSPMD "
+        "replicates the tensor, quietly discarding the parallelism.",
+        "Import the axis constants (from deeplearning4j_tpu.parallel."
+        "mesh import AXIS_DATA, AXIS_MODEL, AXIS_PIPE) or take the "
+        "axis name as a parameter defaulted to one; only "
+        "parallel/mesh.py itself spells the strings."),
     # ---- concurrency (AST, whole-repo thread model) -------------------
     RuleInfo(
         "TPU400", "bad-suppression", ERROR,

@@ -1239,6 +1239,65 @@ def _rule_deploy_bypasses_router(mod: ModuleInfo) -> list[Diagnostic]:
     return out
 
 
+# axis names the unified mesh declares (parallel.mesh.MESH_AXES) that a
+# sharding constructor must reference via the AXIS_* constants, plus the
+# pre-rename 'stage' spelling (resolves against nothing since the
+# unified-mesh refactor — GSPMD silently replicates).  'seq'/'expert'
+# are not flagged: they double as common English identifiers in
+# non-sharding call args far too often for a literal scan.
+_AXIS_LITERALS = {"data", "model", "pipe", "stage"}
+_SHARDING_CTOR_NAMES = {"PartitionSpec", "P", "NamedSharding"}
+# the single source of truth spells the strings once
+_MESH_EXEMPT_SUFFIXES = ("parallel/mesh.py",)
+
+
+@register_lint_rule("TPU317")
+def _rule_hardcoded_axis_name(mod: ModuleInfo) -> list[Diagnostic]:
+    """String axis literals inside sharding constructors: the unified
+    mesh declares its vocabulary ONCE (parallel.mesh.MESH_AXES /
+    AXIS_*); a literal 'data'/'model'/'pipe' elsewhere re-grows the
+    incompatible per-module vocabularies the unified-mesh refactor
+    removed — and a stale one ('stage') silently resolves against
+    nothing, replicating the tensor instead of sharding it."""
+    norm = mod.path.replace(os.sep, "/")
+    if any(norm == suffix or norm.endswith("/" + suffix)
+           for suffix in _MESH_EXEMPT_SUFFIXES) or _is_test_path(norm):
+        return []
+
+    def literals_in(value):
+        if isinstance(value, ast.Constant) and isinstance(value.value, str) \
+                and value.value in _AXIS_LITERALS:
+            yield value.value
+        elif isinstance(value, (ast.Tuple, ast.List)):
+            for elt in value.elts:
+                yield from literals_in(elt)
+
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = (fn.id if isinstance(fn, ast.Name)
+                else fn.attr if isinstance(fn, ast.Attribute) else None)
+        if name not in _SHARDING_CTOR_NAMES:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for lit in literals_in(arg):
+                if lit == "stage":
+                    fix = ("the 'stage' axis was renamed 'pipe' — use "
+                           "parallel.mesh.AXIS_PIPE")
+                else:
+                    fix = (f"use parallel.mesh.AXIS_{lit.upper()} (or "
+                           f"take the axis as a parameter)")
+                out.append(Diagnostic(
+                    "TPU317",
+                    f"axis name {lit!r} hardcoded in {name}(...) — the "
+                    f"mesh axis vocabulary is declared once in "
+                    f"parallel.mesh.MESH_AXES; {fix}",
+                    path=mod.anchor(node)))
+    return out
+
+
 def _snake_tokens(name: str) -> list[str]:
     """CamelCase / snake_case → lowercase whole-name tokens
     (OnlineTrainer → ["online", "trainer"])."""

@@ -1,16 +1,21 @@
 """Sharding-spec consistency — resolve PartitionSpecs before GSPMD does.
 
-The parallel stack declares its layout in three places: the canonical
-mesh axes (:data:`parallel.mesh.MESH_AXES`), the data-parallel batch axes
-(:data:`parallel.data_parallel.DATA_AXES`) and the tensor-parallel
-parameter rules (:data:`parallel.tensor_parallel.BERT_TP_RULES` or a
-user-supplied list).  jax only cross-checks them at jit time, deep inside
-GSPMD, with an error that names none of them.  This module checks the
-same constraints statically:
+The parallel stack declares its layout in ONE place since the
+unified-mesh refactor: :mod:`deeplearning4j_tpu.parallel.mesh` — the
+canonical axis table (:data:`~deeplearning4j_tpu.parallel.mesh.MESH_AXES`),
+the batch-role axes (:data:`~deeplearning4j_tpu.parallel.mesh.DATA_AXES`)
+and the per-layer-family tensor-parallel rule tables
+(:data:`~deeplearning4j_tpu.parallel.mesh.TP_RULE_FAMILIES`).  jax only
+cross-checks them at jit time, deep inside GSPMD, with an error that
+names none of them.  This module checks the same constraints statically:
 
 - every axis a PartitionSpec mentions exists on the mesh (TPU201),
 - no axis serves both the DP batch role and a TP rule (TPU202),
-- every rule regex compiles (TPU203).
+- every rule regex compiles (TPU203),
+
+and :func:`check_layout` validates a COMPOSITE layout (``"dp2xtp2xpp2"``
+— the ``Trainer(layout=...)`` / ``analyze --layout`` flag) against the
+axis table and the host's device count before any program traces.
 """
 
 from __future__ import annotations
@@ -42,12 +47,10 @@ def check_sharding(tp_rules: Optional[Sequence] = None,
     the shipped configuration (and must stay clean).
     """
     from deeplearning4j_tpu.parallel import mesh as mesh_mod
-    from deeplearning4j_tpu.parallel import data_parallel as dp_mod
-    from deeplearning4j_tpu.parallel import tensor_parallel as tp_mod
 
-    rules = list(tp_rules) if tp_rules is not None else tp_mod.BERT_TP_RULES
+    rules = list(tp_rules) if tp_rules is not None else mesh_mod.BERT_TP_RULES
     axes = tuple(mesh_axes) if mesh_axes is not None else mesh_mod.MESH_AXES
-    dp_axes = tuple(data_axes) if data_axes is not None else dp_mod.DATA_AXES
+    dp_axes = tuple(data_axes) if data_axes is not None else mesh_mod.DATA_AXES
 
     report = Report(context={"mesh_axes": list(axes),
                              "data_axes": list(dp_axes),
@@ -57,7 +60,7 @@ def check_sharding(tp_rules: Optional[Sequence] = None,
             report.add("TPU201",
                        f"data-parallel batch axis '{axis}' is not a mesh "
                        f"axis (mesh declares {list(axes)})",
-                       path="data_parallel.DATA_AXES")
+                       path="mesh.DATA_AXES")
     for pattern, spec in rules:
         path = f"rule {pattern!r}"
         try:
@@ -76,4 +79,84 @@ def check_sharding(tp_rules: Optional[Sequence] = None,
                            f"but a tensor-parallel rule shards params over "
                            f"it",
                            path=path)
+    return report
+
+
+def check_layout(layout, tp_family: Optional[str] = None,
+                 n_devices: Optional[int] = None,
+                 mesh_axes: Optional[Sequence[str]] = None) -> Report:
+    """Statically validate a composite layout — the ``Trainer(layout=)``
+    / ``analyze --layout`` flag — before anything compiles:
+
+    - the layout string parses against the unified axis vocabulary
+      (unknown tokens are TPU201 — the same class of error as an
+      unresolvable PartitionSpec axis),
+    - the axis product fits the available device count (a smaller
+      product is fine — the layout takes the leading devices),
+    - the TP rule family exists and its rules resolve against the axis
+      table with the data/model role split intact (TPU201–203 via
+      :func:`check_sharding`),
+    - rule axes actually present on the layout are reported in context
+      (a ``tp2`` layout whose family only shards over ``model`` is
+      fine; a family naming no layout axis means the "TP" layout would
+      silently replicate everything — reported as TPU202 role-misuse's
+      sibling: an explicit context warning row).
+    """
+    from deeplearning4j_tpu.parallel import mesh as mesh_mod
+
+    axes = tuple(mesh_axes) if mesh_axes is not None else mesh_mod.MESH_AXES
+    report = Report()
+    path = f"layout {layout!r}" if isinstance(layout, str) else "layout"
+    if isinstance(layout, mesh_mod.MeshLayout):
+        spec = layout.spec
+        tp_family = tp_family or layout.tp_family
+    elif isinstance(layout, mesh_mod.MeshSpec):
+        spec = layout
+    else:
+        try:
+            spec = mesh_mod.MeshSpec.parse(str(layout))
+        except ValueError as e:
+            report.add("TPU201", f"unparseable layout: {e}", path=path)
+            return report
+    family = tp_family or "dense"
+    report.context["layout"] = spec.describe()
+    report.context["axis_sizes"] = spec.sizes()
+    report.context["tp_family"] = family
+
+    for axis, size in spec.sizes().items():
+        if axis not in axes:
+            report.add("TPU201",
+                       f"layout axis '{axis}' is not in the unified axis "
+                       f"table {list(axes)}", path=path)
+        if size < 1:
+            report.add("TPU201", f"axis '{axis}' has size {size} (< 1)",
+                       path=path)
+
+    if n_devices is None:
+        try:
+            import jax
+            n_devices = len(jax.devices())
+        except Exception:
+            n_devices = None
+    if n_devices is not None:
+        total = spec.total()
+        if total > n_devices:
+            report.add("TPU201",
+                       f"layout {spec.describe()!r} needs {total} devices "
+                       f"but only {n_devices} are available", path=path)
+
+    rules = mesh_mod.TP_RULE_FAMILIES.get(family)
+    if rules is None:
+        report.add("TPU203",
+                   f"unknown TP rule family {family!r} (have "
+                   f"{sorted(mesh_mod.TP_RULE_FAMILIES)})", path=path)
+    elif spec.model > 1:
+        report.extend(check_sharding(tp_rules=rules, mesh_axes=axes))
+        present = mesh_mod.rule_axes(rules)
+        if mesh_mod.AXIS_MODEL not in present:
+            report.add("TPU202",
+                       f"layout has model={spec.model} but rule family "
+                       f"{family!r} never shards over "
+                       f"'{mesh_mod.AXIS_MODEL}' — every parameter would "
+                       f"silently replicate", path=path)
     return report

@@ -18,6 +18,21 @@ import jax.numpy as jnp
 
 _ENV_PREFIX = "DL4J_TPU_"
 
+# Sharding-invariant random streams: with the legacy (non-partitionable)
+# threefry lowering, the VALUES jax.random produces under GSPMD depend on
+# how XLA happens to partition the op — a dropout mask computed on a
+# dp2xtp2 mesh differed from the single-device mask (measured on
+# XLA:CPU), which breaks the unified-mesh layout-equivalence contract
+# (same per-step losses to 1e-6 on ANY layout, dropout active).  The
+# partitionable implementation computes each element as a pure function
+# of (key, index), so every layout draws identical bits.  Set once,
+# process-wide, before any program traces.
+try:
+    import jax as _jax
+    _jax.config.update("jax_threefry_partitionable", True)
+except Exception:          # very old jax without the flag
+    pass
+
 
 @dataclasses.dataclass
 class DTypePolicy:

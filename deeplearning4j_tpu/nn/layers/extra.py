@@ -1004,14 +1004,14 @@ class MixtureOfExperts(Layer):
         return input_type
 
     def init_params(self, key, input_type):
-        from deeplearning4j_tpu.parallel.expert_parallel import init_moe_params
+        from deeplearning4j_tpu.parallel.unified import init_moe_params
         d = input_type.size if input_type.kind == "rnn" else input_type.flat_size()
         hidden = self.hidden or 4 * d
         return init_moe_params(key, d, hidden, self.n_experts,
                                dtype=self._param_dtype())
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
-        from deeplearning4j_tpu.parallel.expert_parallel import moe_ffn_dense
+        from deeplearning4j_tpu.parallel.unified import moe_ffn_dense
         x = self._maybe_dropout(x, train, rng)
         act = activations.get(self.activation or "gelu")
         shape = x.shape

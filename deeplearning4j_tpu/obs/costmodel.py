@@ -268,12 +268,22 @@ def costs_for(fn: Any, sig=None) -> Optional[ProgramCost]:
 
 def abstractify(tree: Any) -> Any:
     """args → ShapeDtypeStructs (None passes through), so analysis never
-    holds (or donates) real buffers."""
+    holds (or donates) real buffers.  Mesh placements (NamedSharding)
+    ride along: a unified-mesh layout's step is a DIFFERENT program than
+    its single-device sibling — an AOT lower/compile (cost analysis,
+    artifact bake) must reproduce the live call's SPMD partitioning, or
+    the baked executable would bind single-device shardings and refuse
+    (or mis-place) the sharded call.  Single-device placements stay
+    implicit, keeping pre-layout artifacts byte-identical."""
     import jax
+    from jax.sharding import NamedSharding
 
     def one(a):
         if a is None or not hasattr(a, "shape"):
             return a
+        sharding = getattr(a, "sharding", None)
+        if isinstance(sharding, NamedSharding):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sharding)
         return jax.ShapeDtypeStruct(a.shape, a.dtype)
 
     return jax.tree_util.tree_map(one, tree)

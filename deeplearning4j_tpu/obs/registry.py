@@ -525,6 +525,19 @@ def install_standard_metrics(registry: Optional[MetricsRegistry] = None) -> dict
                   "In-flight overlapped exchanges drained by finish()"),
         r.gauge("tpudl_parallel_mesh_devices",
                 "Devices in the active data-parallel mesh"),
+        r.gauge("tpudl_mesh_devices",
+                "Total devices in the active unified-mesh layout"),
+        r.labeled_gauge("tpudl_mesh_axis_size",
+                        "Unified-mesh axis sizes of the active layout "
+                        "(data/model/pipe/seq/expert)",
+                        label_names=("axis",)),
+        r.labeled_gauge("tpudl_mesh_layout_active",
+                        "1 for the layout string a trainer activated "
+                        "(dp2xtp2, ...)",
+                        label_names=("layout",)),
+        r.gauge("tpudl_mesh_collective_bytes",
+                "Analytic per-step collective-traffic estimate for the "
+                "active layout (MeshLayout.collective_bytes_per_step)"),
         r.counter("tpudl_parallel_avg_syncs_total",
                   "Parameter-averaging resyncs (averaging_frequency mode)"),
         r.counter("tpudl_parallel_pipeline_calls_total",

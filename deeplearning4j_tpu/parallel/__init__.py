@@ -1,41 +1,39 @@
-"""Distributed training & parallelism — the north-star replacement for the
-reference's three-transport stack (SURVEY.md §2.7/§3.4: Spark TCP
-orchestration + Aeron UDP parameter-server mesh + JNI threshold codecs).
+"""Distributed training & parallelism — ONE unified mesh, composable
+layouts (the north-star replacement for the reference's three-transport
+stack: Spark TCP orchestration + Aeron UDP parameter-server mesh + JNI
+threshold codecs — SURVEY.md §2.7/§3.4).
 
 On TPU the whole pyramid collapses into compiler-scheduled collectives
-over ICI/DCN inside jit-compiled programs:
+over ICI/DCN inside jit-compiled programs, expressed as PartitionSpec
+layouts over one ``jax.sharding.Mesh``:
 
-- ``mesh``              — device mesh builder (axes data/model/seq/stage),
-                          multi-slice/DCN aware (MeshOrganizer parity — the
-                          tree-mesh bookkeeping is jax runtime's job now).
-- ``data_parallel``     — DP trainer: batch sharded over ``data``, gradient
-                          allreduce = psum emitted by GSPMD (ParallelWrapper
-                          + SharedTrainingMaster/ParameterAveraging parity;
-                          synchronous dense allreduce replaces the async
-                          threshold-encoded Aeron path per BASELINE.json).
-- ``tensor_parallel``   — NamedSharding rules for BERT-class models over
-                          the ``model`` axis (capability beyond reference).
-- ``context_parallel``  — sequence parallelism over the ``seq`` axis:
-                          ring attention (shard_map + ppermute, online
-                          softmax, optional Pallas flash inner kernel)
-                          and Ulysses all_to_all head-resharding — both
-                          beyond reference (SURVEY.md §5.7).
-- ``pipeline``          — GPipe-style microbatched stage parallelism over
-                          the ``stage`` axis (beyond reference).
-- ``expert_parallel``   — mixture-of-experts FFN with all_to_all dispatch
-                          over the ``expert`` axis (beyond reference).
-- ``compression``       — threshold/bitmap gradient codec + residual
-                          accumulator (EncodedGradientsAccumulator +
-                          encodeThresholdP1..P3/encodeBitmap parity) for the
-                          optional DCN path; C++ kernel in ``native/``.
-- ``inference``         — ParallelInference parity: a compatibility shim
-                          over ``serve.InferenceEngine`` micro-batching.
-- ``launcher``          — multi-host SPMD bootstrap (jax.distributed),
-                          replacing Spark orchestration.
+- ``mesh``     — THE single source of truth: axis constants
+                 (``AXIS_DATA``/``AXIS_MODEL``/``AXIS_PIPE``/``AXIS_SEQ``/
+                 ``AXIS_EXPERT``), ``MeshSpec`` (parseable layout sizes,
+                 ``"dp2xtp2xpp2"``), ``MeshLayout`` (resolved layout +
+                 per-layer-family TP rules + placement + collective-bytes
+                 model + ``tpudl_mesh_*`` gauges), multi-slice/DCN aware.
+- ``unified``  — the composable collectives (ring/Ulysses attention over
+                 ``seq``, MoE all_to_all over ``expert``) and the 1F1B
+                 step builder behind ``Trainer(layout="...pp...")``.
+- ``pipeline`` / ``pipeline_stages`` — microbatched stage parallelism
+                 over ``pipe`` (GPipe / heterogeneous 1F1B machinery).
+- ``compression`` — threshold/bitmap gradient codec + residual
+                 accumulator for the cross-slice DCN path.
+- ``inference`` — ParallelInference parity shim over serve.InferenceEngine.
+- ``launcher`` — multi-host SPMD bootstrap (jax.distributed).
+
+Training selects a layout with ONE flag — ``Trainer(layout="dp2xtp2")``
+— instead of choosing a sibling wrapper class.  The old per-mode entry
+points (``data_parallel.ParallelWrapper``, ``tensor_parallel``,
+``context_parallel``, ``expert_parallel``) are deprecation shims that
+warn on import and route here (docs/PARALLELISM.md has the migration
+table).
 """
 
-from deeplearning4j_tpu.parallel.mesh import make_mesh, MeshSpec
-from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+from deeplearning4j_tpu.parallel.mesh import (
+    AXIS_DATA, AXIS_EXPERT, AXIS_MODEL, AXIS_PIPE, AXIS_SEQ, MESH_AXES,
+    MeshLayout, MeshSpec, make_mesh, resolve_layout)
 from deeplearning4j_tpu.parallel.compression import (
     threshold_encode, threshold_decode, bitmap_encode, bitmap_decode,
     threshold_encode_device, threshold_decode_device,
@@ -43,15 +41,15 @@ from deeplearning4j_tpu.parallel.compression import (
     EncodedGradientsAccumulator,
 )
 from deeplearning4j_tpu.parallel.inference import ParallelInference
-from deeplearning4j_tpu.parallel.expert_parallel import (
+from deeplearning4j_tpu.parallel.unified import (
     moe_ffn, moe_ffn_dense, init_moe_params, shard_moe_params,
-)
-from deeplearning4j_tpu.parallel.context_parallel import (
     ring_attention, ulysses_attention, reference_attention,
 )
 
 __all__ = [
-    "make_mesh", "MeshSpec", "ParallelWrapper",
+    "AXIS_DATA", "AXIS_EXPERT", "AXIS_MODEL", "AXIS_PIPE", "AXIS_SEQ",
+    "MESH_AXES", "make_mesh", "MeshSpec", "MeshLayout", "resolve_layout",
+    "ParallelWrapper",
     "threshold_encode", "threshold_decode", "bitmap_encode", "bitmap_decode",
     "threshold_encode_device", "threshold_decode_device",
     "bitmap_encode_device", "bitmap_decode_device",
@@ -59,3 +57,13 @@ __all__ = [
     "moe_ffn", "moe_ffn_dense", "init_moe_params", "shard_moe_params",
     "ring_attention", "ulysses_attention", "reference_attention",
 ]
+
+
+def __getattr__(name):
+    # ParallelWrapper resolves lazily: its home module is a deprecation
+    # shim that warns on import, and the package must not fire that
+    # warning for users who never touch the legacy class
+    if name == "ParallelWrapper":
+        from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+        return ParallelWrapper
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

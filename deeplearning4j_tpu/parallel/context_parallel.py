@@ -1,196 +1,26 @@
-"""Context / sequence parallelism — ring attention.
+"""Deprecated shim — context/sequence parallelism moved to the unified
+path.
 
-Capability BEYOND the reference (SURVEY.md §5.7: the reference's
-``dot_product_attention`` materializes O(T²) scores, practical max a few
-thousand tokens).  Here sequences shard over the mesh ``seq`` axis;
-each device holds a [B, T/n, ...] slice, K/V blocks rotate around the
-ring via ``ppermute`` (ICI neighbor links — ring topology matches TPU
-torus), and softmax is accumulated online (running max + normalizer), so
-per-device memory is O(T/n · T/n) per step and the full [T,T] matrix
-never exists.
-
-Ring vs Ulysses decision (SURVEY.md §5.7): ring's neighbor-only traffic
-fits ICI better than all-to-all head-resharding at pod scale — this is
-the default CP strategy.
+.. deprecated::
+    Ring and Ulysses attention live in
+    :mod:`deeplearning4j_tpu.parallel.unified` (the canonical home for
+    every composable collective over the unified mesh — axis names come
+    from ``parallel.mesh.MESH_AXES``, ``AXIS_SEQ`` here).  This module
+    stays so existing imports keep working; new code imports from
+    ``parallel.unified`` (or the ``deeplearning4j_tpu.parallel``
+    package, which re-exports it).
 """
 
 from __future__ import annotations
 
-import functools
-import math
-from typing import Optional
+import warnings
 
-import jax
-import jax.numpy as jnp
-from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
-from deeplearning4j_tpu.utils.jax_compat import pcast, shard_map
+from deeplearning4j_tpu.parallel.unified import (  # noqa: F401
+    NEG_INF, _block_attention, reference_attention, ring_attention,
+    ulysses_attention)
 
-NEG_INF = -1e30
-
-
-def _block_attention(q, k, v, scale, mask):
-    """Scores for one (q-block, kv-block) pair.
-    q [B,H,Tq,D], k/v [B,H,Tk,D], mask broadcastable [Tq,Tk] or None.
-    Returns (unnormalized out, row max, row sumexp)."""
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-    if mask is not None:
-        scores = jnp.where(mask, scores, NEG_INF)
-    m = jnp.max(scores, axis=-1)                      # [B,H,Tq]
-    p = jnp.exp(scores - m[..., None])
-    if mask is not None:
-        # rows with no visible keys: exp(NEG_INF - NEG_INF) = 1 → zero them
-        any_visible = jnp.any(mask, axis=-1)          # [Tq,Tk] → [Tq]
-        p = p * jnp.broadcast_to(any_visible[None, None, :, None], p.shape)
-        m = jnp.where(any_visible[None, None, :], m, NEG_INF)
-    l = jnp.sum(p, axis=-1)                           # [B,H,Tq]
-    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
-    return o, m, l
-
-
-def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                   mesh: Mesh, axis: str = "seq", n_heads: int = 1,
-                   causal: bool = False, data_axis: str | None = None,
-                   head_axis: str | None = None, use_flash: bool = False,
-                   flash_block: int = 128) -> jnp.ndarray:
-    """Multi-head ring attention.  q/k/v: [B, T, H*D] GLOBALLY, sharded
-    over ``axis`` on dim 1.  Returns [B, T, H*D] with the same sharding.
-
-    Inside shard_map each device sees its local [B, T/n, H*D] slice; K/V
-    rotate n steps around the ring; online-softmax accumulators merge
-    per-block partial results exactly.
-
-    Composable mesh axes: ``data_axis`` shards the batch dim (dp×sp);
-    ``head_axis`` shards the HEADS across a tensor-parallel axis (tp×sp —
-    the ring rotates within each head group, Ulysses-meets-ring layout;
-    ``n_heads`` is the GLOBAL head count and must divide by the axis size).
-    """
-    n_dev = mesh.shape[axis]
-    if head_axis and n_heads % mesh.shape[head_axis]:
-        raise ValueError(f"n_heads={n_heads} not divisible by mesh axis "
-                         f"'{head_axis}' size {mesh.shape[head_axis]}")
-    local_heads = n_heads // mesh.shape[head_axis] if head_axis else n_heads
-
-    def local(q, k, v):
-        b, t_local, dmodel = q.shape
-        n_heads = local_heads
-        dh = dmodel // n_heads
-        scale = 1.0 / math.sqrt(dh)
-        qh = q.reshape(b, t_local, n_heads, dh).transpose(0, 2, 1, 3)
-        kh = k.reshape(b, t_local, n_heads, dh).transpose(0, 2, 1, 3)
-        vh = v.reshape(b, t_local, n_heads, dh).transpose(0, 2, 1, 3)
-        my_idx = lax.axis_index(axis)
-
-        def step(carry, s):
-            k_blk, v_blk, o, m, l = carry
-            src_idx = (my_idx - s) % n_dev  # which device this kv block came from
-            if use_flash:
-                # Pallas blockwise kernel: VMEM score tiles, no per-block
-                # [Tq,Tk] matrix in HBM (SURVEY §5.7/§7.7)
-                from deeplearning4j_tpu.ops.pallas import flash_attention_block
-                o_b, m_b, l_b = flash_attention_block(
-                    qh, k_blk, v_blk, scale=scale, causal=causal,
-                    q_offset=my_idx * t_local, k_offset=src_idx * t_local,
-                    block_q=flash_block, block_k=flash_block)
-                # kernel accumulates in f32; match the scan carry dtypes
-                # (bf16 inputs carry bf16 accumulators like the jnp path)
-                o_b = o_b.astype(o.dtype)
-                m_b = m_b.astype(m.dtype)
-                l_b = l_b.astype(l.dtype)
-            else:
-                if causal:
-                    q_pos = my_idx * t_local + jnp.arange(t_local)
-                    k_pos = src_idx * t_local + jnp.arange(t_local)
-                    mask = q_pos[:, None] >= k_pos[None, :]
-                else:
-                    mask = None
-                o_b, m_b, l_b = _block_attention(qh, k_blk, v_blk, scale, mask)
-            # merge online-softmax accumulators
-            m_new = jnp.maximum(m, m_b)
-            c_old = jnp.exp(m - m_new)
-            c_blk = jnp.exp(m_b - m_new)
-            o = o * c_old[..., None] + o_b * c_blk[..., None]
-            l = l * c_old + l_b * c_blk
-            # rotate kv to the next device (neighbor ring over ICI)
-            perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
-            k_blk = lax.ppermute(k_blk, axis, perm)
-            v_blk = lax.ppermute(v_blk, axis, perm)
-            return (k_blk, v_blk, o, m_new, l), None
-
-        # initial accumulators must be marked device-varying for the scan
-        # carry to type-check under shard_map's VMA tracking — over EVERY
-        # sharded axis in play (seq ring + optional data/head axes)
-        varying = tuple(a for a in (axis, data_axis, head_axis) if a)
-        o0 = jnp.zeros_like(qh)
-        m0 = pcast(jnp.full(qh.shape[:-1], NEG_INF, qh.dtype), varying, to="varying")
-        l0 = pcast(jnp.zeros(qh.shape[:-1], qh.dtype), varying, to="varying")
-        (k_f, v_f, o, m, l), _ = lax.scan(step, (kh, vh, o0, m0, l0),
-                                          jnp.arange(n_dev))
-        out = o / jnp.maximum(l[..., None], 1e-20)
-        return out.transpose(0, 2, 1, 3).reshape(b, t_local, dmodel)
-
-    spec = P(data_axis, axis, head_axis)
-    # check_vma off on the flash path: the Pallas interpreter (CPU tests)
-    # can't yet thread varying-manual-axes through its internal jaxpr eval
-    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_vma=not use_flash)(q, k, v)
-
-
-def reference_attention(q, k, v, n_heads: int, causal: bool = False):
-    """Single-device ground truth for ring_attention tests."""
-    from deeplearning4j_tpu.ops.attention import multi_head_attention
-    return multi_head_attention(q, k, v, n_heads=n_heads, causal=causal)
-
-
-def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                      mesh: Mesh, axis: str = "seq", n_heads: int = 1,
-                      causal: bool = False,
-                      data_axis: str | None = None) -> jnp.ndarray:
-    """DeepSpeed-Ulysses-style sequence parallelism: two ``all_to_all``s
-    instead of a ring.  q/k/v: [B, T, H*D] globally, sharded over
-    ``axis`` on the token dim.  The first all_to_all re-shards from
-    token-sharded to HEAD-sharded (each device receives every token for
-    H/n of the heads), attention runs dense per local head group, and the
-    inverse all_to_all restores token sharding.
-
-    Complement to :func:`ring_attention` (SURVEY §5.7): Ulysses moves
-    activations twice through all-to-all (bandwidth ∝ T·H·D/n per
-    device) but runs each head's attention un-tiled, so it wins when
-    n ≪ heads and sequence blocks are small; the ring wins at pod scale
-    where neighbor-only ICI traffic matters.  Requires n_heads % n == 0.
-    """
-    n_dev = mesh.shape[axis]
-    if n_heads % n_dev:
-        raise ValueError(f"n_heads={n_heads} must be divisible by the "
-                         f"'{axis}' axis size {n_dev} for Ulysses SP")
-
-    def local(q, k, v):
-        b, t_local, dmodel = q.shape
-        dh = dmodel // n_heads
-
-        def scatter_heads(x):
-            xh = x.reshape(b, t_local, n_heads, dh)
-            # tokens gathered, heads scattered: [B, T, H/n, dh]
-            return lax.all_to_all(xh, axis, split_axis=2, concat_axis=1,
-                                  tiled=True)
-
-        qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
-        qh = qh.transpose(0, 2, 1, 3)     # [B, H/n, T, dh]
-        kh = kh.transpose(0, 2, 1, 3)
-        vh = vh.transpose(0, 2, 1, 3)
-        scale = 1.0 / math.sqrt(dh)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
-        if causal:
-            t = scores.shape[-1]
-            mask = jnp.tril(jnp.ones((t, t), bool))
-            scores = jnp.where(mask[None, None], scores, NEG_INF)
-        out = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, -1), vh)
-        out = out.transpose(0, 2, 1, 3)   # [B, T, H/n, dh]
-        # inverse: tokens scattered back, heads gathered
-        out = lax.all_to_all(out, axis, split_axis=1, concat_axis=2,
-                             tiled=True)  # [B, T/n, H, dh]
-        return out.reshape(b, t_local, dmodel)
-
-    spec = P(data_axis, axis)
-    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec)(q, k, v)
+warnings.warn(
+    "deeplearning4j_tpu.parallel.context_parallel is deprecated; import "
+    "ring_attention/ulysses_attention from deeplearning4j_tpu.parallel "
+    "(unified-mesh path, docs/PARALLELISM.md)",
+    DeprecationWarning, stacklevel=2)

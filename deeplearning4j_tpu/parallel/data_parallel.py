@@ -1,27 +1,22 @@
-"""Data-parallel training — ParallelWrapper / SharedTrainingMaster parity.
+"""Deprecated shim — data parallelism is a layout on the unified mesh.
 
-The reference's three DP strategies (SURVEY.md §2.7):
-  1. ``ParallelWrapper`` (single node, per-GPU threads, param averaging or
-     encoded gradient sharing via shared-memory accumulator),
-  2. ``ParameterAveragingTrainingMaster`` (Spark, periodic tree-aggregate),
-  3. ``SharedTrainingMaster`` (Spark + Aeron async threshold-encoded push)
-are all subsumed by ONE synchronous construct: batch sharded over the
-``data`` mesh axis, parameters replicated, gradient psum emitted by GSPMD
-inside the jit step, allreduce riding ICI.  BASELINE.json authorizes
-exactly this swap (dense sync allreduce ≫ sparse async codec on-chip).
-
-``ParallelWrapper`` here keeps the reference's class name and fit()
-surface but is a thin shell: sharding + the SAME jit train step the
-single-chip Trainer builds.  Exact parameter-averaging parity (average
-every N steps instead of every step) is available via
-``averaging_frequency > 1`` — gradients then apply locally per shard and
-params re-sync by periodic mean, which is semantically what
-ParameterAveragingTrainingMaster does; the default (1) is the stronger
-every-step allreduce.
+.. deprecated::
+    ``ParallelWrapper``'s default (every-step allreduce) mode is exactly
+    ``Trainer(layout="dp<N>")`` — batch sharded over ``data``, params
+    replicated, gradient psum emitted by GSPMD inside the one donated
+    jit step — and this class is now a thin subclass that passes its
+    mesh straight to the unified Trainer flag (docs/PARALLELISM.md).
+    It survives for the reference's class name (DL4J ``ParallelWrapper``
+    / the Spark TrainingMasters), for the parameter-averaging parity
+    mode (``averaging_frequency > 1``: per-shard divergent replicas,
+    periodic mean resync — semantics no single jit layout expresses),
+    and for ZeRO-1 updater-state sharding.  New code calls
+    ``Trainer(net, layout=...)``.
 """
 
 from __future__ import annotations
 
+import warnings
 from typing import Optional
 
 import jax
@@ -31,13 +26,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deeplearning4j_tpu.obs import tracing
 from deeplearning4j_tpu.obs.registry import get_registry
 from deeplearning4j_tpu.parallel import mesh as mesh_mod
+from deeplearning4j_tpu.parallel.mesh import AXIS_DATA, DATA_AXES  # noqa: F401  (canonical home: mesh.py)
 from deeplearning4j_tpu.train import step_cache
 from deeplearning4j_tpu.train.trainer import Trainer
 
-# Mesh axes the data-parallel path shards batches (and psums gradients)
-# over — the analyzer cross-checks these against tensor-parallel rule
-# axes (one axis must not serve both roles).
-DATA_AXES = ("data",)
+warnings.warn(
+    "deeplearning4j_tpu.parallel.data_parallel is deprecated; use "
+    "Trainer(layout='dp<N>') — ParallelWrapper remains as a thin shim "
+    "over the unified mesh path (docs/PARALLELISM.md)",
+    DeprecationWarning, stacklevel=2)
 
 
 class ParallelWrapper(Trainer):
@@ -46,12 +43,16 @@ class ParallelWrapper(Trainer):
 
     The global batch from the iterator is split across devices (its
     leading dim must be divisible by the data-axis size).
+
+    Default mode routes through the unified layout path
+    (``Trainer(mesh=...)``); ``averaging_frequency > 1`` keeps the
+    ParameterAveragingTrainingMaster parity machinery (stacked divergent
+    replicas, periodic mean) that no single-program layout expresses.
     """
 
     def __init__(self, net, mesh: Optional[Mesh] = None, listeners=None,
                  averaging_frequency: int = 1, average_updater_state: bool = True,
                  zero_optimizer_sharding: bool = False):
-        super().__init__(net, listeners=listeners)
         self.mesh = mesh if mesh is not None else mesh_mod.make_mesh()
         self.averaging_frequency = max(1, averaging_frequency)
         self.average_updater_state = average_updater_state
@@ -59,6 +60,13 @@ class ParallelWrapper(Trainer):
         if zero_optimizer_sharding and averaging_frequency > 1:
             raise ValueError("zero_optimizer_sharding requires the "
                              "every-step allreduce mode (averaging_frequency=1)")
+        if self.averaging_frequency == 1:
+            # the unified path IS the old default mode: batch sharded
+            # over 'data', params replicated, GSPMD gradient psum
+            super().__init__(net, listeners=listeners, mesh=self.mesh)
+        else:
+            # averaging mode keeps its own placement (stacked replicas)
+            super().__init__(net, listeners=listeners)
         self._placed = False
         self._steps_since_avg = 0
         self._avg_step = None
@@ -70,14 +78,14 @@ class ParallelWrapper(Trainer):
         leaves stay replicated).  Absent in the reference (pre-ZeRO era,
         SURVEY §2.7) — per-device updater memory drops ~n_data-fold for
         Adam-class updaters."""
-        n = int(self.mesh.shape["data"])
+        n = int(self.mesh.shape[AXIS_DATA])
 
         def spec(leaf):
             shape = getattr(leaf, "shape", ())
             for i, d in enumerate(shape):
                 if d % n == 0 and d > 0:
                     return NamedSharding(
-                        self.mesh, P(*([None] * i), "data"))
+                        self.mesh, P(*([None] * i), AXIS_DATA))
             return NamedSharding(self.mesh, P())
 
         return jax.tree_util.tree_map(spec, opt_state)
@@ -92,36 +100,17 @@ class ParallelWrapper(Trainer):
             if self.net.opt_state is None:
                 self.net.opt_state = self.tx.init(self.net.params_)
             self._opt_state_shardings = self._zero_shardings(self.net.opt_state)
+        if self.averaging_frequency > 1 and not self._placed:
+            net = self.net
+            if net.params_ is None:
+                net.init()
+            if net.opt_state is None:
+                net.opt_state = self.tx.init(net.params_)
+            self._place_replicas()
+            self._placed = True
         super()._ensure_ready()
         get_registry().gauge("tpudl_parallel_mesh_devices").set(
-            int(self.mesh.shape["data"]))
-        if not self._placed:
-            net = self.net
-            if self.averaging_frequency == 1:
-                net.params_ = mesh_mod.replicate(self.mesh, net.params_)
-                net.state_ = mesh_mod.replicate(self.mesh, net.state_)
-                if self._opt_state_shardings is not None:
-                    net.opt_state = jax.tree_util.tree_map(
-                        jax.device_put, net.opt_state,
-                        self._opt_state_shardings)
-                else:
-                    net.opt_state = mesh_mod.replicate(self.mesh, net.opt_state)
-            else:
-                self._place_replicas()
-            self._placed = True
-
-    def _prepare_batch(self, batch):
-        """Shard every array in the batch over the ``data`` axis — the
-        single-device jit step then runs SPMD with the gradient psum over
-        ICI inserted by GSPMD (params replicated).  Used by both the
-        standard and the tBPTT paths via the Trainer hook."""
-        import dataclasses as _dc
-        fields = {}
-        for name in ("features", "labels", "features_mask", "labels_mask",
-                     "features_masks", "labels_masks"):
-            if hasattr(batch, name) and getattr(batch, name) is not None:
-                fields[name] = mesh_mod.shard_batch(self.mesh, getattr(batch, name))
-        return _dc.replace(batch, **fields)
+            int(self.mesh.shape[AXIS_DATA]))
 
     def _jit_step_fns(self) -> tuple:
         return super()._jit_step_fns() + (self._avg_step, self._avg_fn)
@@ -129,10 +118,10 @@ class ParallelWrapper(Trainer):
     def fit_batch(self, batch, rng, prepared: bool = False) -> float:
         """One DP step.
 
-        ``averaging_frequency == 1`` (default): params replicated, GSPMD
-        partitions forward/backward and inserts the gradient psum over ICI
-        automatically — the SharedTrainingMaster/ParallelWrapper
-        gradient-sharing swap.
+        ``averaging_frequency == 1`` (default): the unified layout path —
+        params replicated, GSPMD partitions forward/backward and inserts
+        the gradient psum over ICI automatically (the
+        SharedTrainingMaster/ParallelWrapper gradient-sharing swap).
 
         ``averaging_frequency > 1``: ParameterAveragingTrainingMaster
         parity — each data shard trains LOCALLY (divergent per-shard
@@ -159,7 +148,7 @@ class ParallelWrapper(Trainer):
 
     # ------------------------------------------------ param-averaging mode
     def _n_shards(self) -> int:
-        return int(self.mesh.shape["data"])
+        return int(self.mesh.shape[AXIS_DATA])
 
     def _place_replicas(self):
         """Stack per-shard replicas on a new leading axis sharded over

@@ -106,9 +106,25 @@ class MultiSliceTrainer:
                  device_encode: bool = True, capacity: Optional[int] = None,
                  overlap: bool = False,
                  world_size: Optional[int] = None, rank_offset: int = 0,
-                 listeners=None, retry_policy: Optional[RetryPolicy] = None):
+                 listeners=None, retry_policy: Optional[RetryPolicy] = None,
+                 layout=None):
         from deeplearning4j_tpu.obs.listeners import ListenerBus
         from deeplearning4j_tpu.train import updaters as updater_mod
+        if layout is not None:
+            # the unified layout flag (docs/PARALLELISM.md): the PER-SLICE
+            # mesh layout in the same vocabulary Trainer speaks — "dp2"
+            # = 2 data-parallel devices per slice.  Cross-slice traffic
+            # stays the compressed DCN path; model/pipe axes inside a
+            # slice ride the single-slice Trainer today.
+            spec = (layout if isinstance(layout, mesh_mod.MeshSpec)
+                    else mesh_mod.MeshSpec.parse(str(layout)))
+            if spec.model > 1 or spec.pipe > 1 or spec.seq > 1 \
+                    or spec.expert > 1:
+                raise NotImplementedError(
+                    f"MultiSliceTrainer layouts compose DCN × data today "
+                    f"(got {spec.describe()!r}); run model/pipe/seq/expert "
+                    f"axes through Trainer(layout=...) on one slice")
+            data_per_slice = spec.data
         self.net = net
         self.n_slices = n_slices                      # local slices
         self.world_size = world_size or n_slices      # global slices

@@ -1,180 +1,25 @@
-"""Expert parallelism: mixture-of-experts FFN with all_to_all dispatch.
+"""Deprecated shim — expert parallelism moved to the unified path.
 
-The reference has NO expert parallelism (SURVEY.md §2.7: pre-LLM
-framework, DP only) — this module is beyond-parity capability the TPU
-build provides natively, alongside TP/PP/SP.
-
-Design (GShard/Switch-style, TPU-first):
-
-- **Gating**: per-token top-k softmax over expert logits, with a fixed
-  per-expert capacity ``C = ceil(tokens·k/E · capacity_factor)`` so every
-  shape is static under jit.  Tokens over capacity are dropped (their
-  combine weight is zero) — the standard static-shape MoE contract.
-- **Dispatch**: one-hot dispatch/combine tensors contract token activations
-  to ``[E, C, D]`` expert batches on the MXU (einsum, no gathers), then a
-  single ``lax.all_to_all`` over the ``expert`` mesh axis moves each
-  expert's batch onto the device that owns its weights; the inverse
-  all_to_all brings outputs home.  Both transfers ride ICI.
-- **Sharding**: expert weights are sharded ``[E_local, ...]`` per device
-  over the ``expert`` axis; tokens are data-sharded over the same axis
-  (each device contributes its local tokens), so the whole layer is a
-  ``shard_map`` region composable with the other mesh axes.
+.. deprecated::
+    The MoE FFN (capacity-bounded top-k routing, all_to_all dispatch
+    over the ``expert`` axis) lives in
+    :mod:`deeplearning4j_tpu.parallel.unified`.  This module stays so
+    existing imports keep working; new code imports from
+    ``parallel.unified`` (or the ``deeplearning4j_tpu.parallel``
+    package, which re-exports it).
 """
 
 from __future__ import annotations
 
-import math
-from functools import partial
-from typing import Optional
+import warnings
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from deeplearning4j_tpu.utils.jax_compat import shard_map
+from deeplearning4j_tpu.parallel.unified import (  # noqa: F401
+    _dispatch_tensors, _top_k_gates, init_moe_params, moe_ffn,
+    moe_ffn_dense, shard_moe_params)
 
-
-def init_moe_params(key, d_model: int, d_hidden: int, n_experts: int,
-                    dtype=jnp.float32) -> dict:
-    """Gate + per-expert FFN (w_in, b_in, w_out, b_out) parameter pytree."""
-    kg, k1, k2 = jax.random.split(key, 3)
-    scale_in = 1.0 / math.sqrt(d_model)
-    scale_out = 1.0 / math.sqrt(d_hidden)
-    return {
-        "gate": jax.random.normal(kg, (d_model, n_experts), dtype) * scale_in,
-        "w_in": jax.random.normal(k1, (n_experts, d_model, d_hidden), dtype) * scale_in,
-        "b_in": jnp.zeros((n_experts, d_hidden), dtype),
-        "w_out": jax.random.normal(k2, (n_experts, d_hidden, d_model), dtype) * scale_out,
-        "b_out": jnp.zeros((n_experts, d_model), dtype),
-    }
-
-
-def _top_k_gates(logits, k):
-    """Top-k softmax gating: returns (weights [N,k], indices [N,k]).
-    Weights renormalized over the selected k (GShard convention)."""
-    top_vals, top_idx = lax.top_k(logits, k)
-    weights = jax.nn.softmax(top_vals, axis=-1)
-    return weights, top_idx
-
-
-def _dispatch_tensors(gates, top_idx, n_experts, capacity):
-    """Build combine [N, E, C] (weights) and dispatch (bool) tensors.
-
-    Position of a token within its expert's capacity buffer = its rank
-    among tokens routed to that expert (cumsum order); ranks ≥ capacity
-    are dropped (combine weight 0).
-    """
-    n, k = top_idx.shape
-    combine = jnp.zeros((n, n_experts, capacity), gates.dtype)
-    # Rank bookkeeping runs in int32 regardless of the activation dtype:
-    # under a bf16 policy a cumsum in gates.dtype would stop representing
-    # ranks past 256 and distinct tokens would silently collide in the
-    # same capacity cell.
-    # per-expert slots already claimed by earlier gate slots — without
-    # this offset a slot-0 token and a slot-1 token routed to the same
-    # expert could collide in the same capacity position
-    claimed = jnp.zeros((n_experts,), jnp.int32)
-    for slot in range(k):   # k is tiny (1 or 2) — unrolled at trace time
-        onehot_i = jax.nn.one_hot(top_idx[:, slot], n_experts,
-                                  dtype=jnp.int32)          # [N, E]
-        rank = jnp.cumsum(onehot_i, axis=0) - onehot_i + claimed[None, :]
-        pos = jnp.sum(rank * onehot_i, axis=1)              # [N] int32
-        keep = (pos < capacity).astype(gates.dtype)
-        onehot = onehot_i.astype(gates.dtype)
-        cap_onehot = jax.nn.one_hot(pos, capacity, dtype=gates.dtype)  # [N, C]
-        combine = combine + (gates[:, slot:slot + 1] * keep[:, None]
-                             )[:, :, None] * onehot[:, :, None] * cap_onehot[:, None, :]
-        claimed = claimed + onehot_i.sum(axis=0)
-    dispatch = (combine > 0).astype(gates.dtype)
-    return combine, dispatch
-
-
-def moe_ffn_dense(params, x, *, top_k: int = 2,
-                  capacity_factor: float = 2.0,
-                  activation=jax.nn.gelu):
-    """Single-device MoE forward (the oracle for the sharded path).
-
-    ``x``: [N, D] token activations → [N, D].
-    """
-    n, d = x.shape
-    n_experts = params["gate"].shape[1]
-    capacity = max(1, math.ceil(n * top_k / n_experts * capacity_factor))
-    logits = x @ params["gate"]
-    gates, top_idx = _top_k_gates(logits, top_k)
-    combine, dispatch = _dispatch_tensors(gates, top_idx, n_experts, capacity)
-    expert_in = jnp.einsum("nec,nd->ecd", dispatch, x)       # [E, C, D]
-    h = activation(jnp.einsum("ecd,edh->ech", expert_in, params["w_in"])
-                   + params["b_in"][:, None, :])
-    expert_out = (jnp.einsum("ech,ehd->ecd", h, params["w_out"])
-                  + params["b_out"][:, None, :])             # [E, C, D]
-    return jnp.einsum("nec,ecd->nd", combine, expert_out)
-
-
-def shard_moe_params(params: dict, mesh: Mesh, axis: str = "expert") -> dict:
-    """Place expert-major arrays sharded over the expert axis; gate
-    replicated."""
-    out = {}
-    for name, arr in params.items():
-        if name == "gate":
-            out[name] = jax.device_put(arr, NamedSharding(mesh, P()))
-        else:
-            out[name] = jax.device_put(
-                arr, NamedSharding(mesh, P(axis, *([None] * (arr.ndim - 1)))))
-    return out
-
-
-def moe_ffn(params, x, mesh: Optional[Mesh] = None, *, axis: str = "expert",
-            data_axis: Optional[str] = None, top_k: int = 2,
-            capacity_factor: float = 2.0, activation=jax.nn.gelu):
-    """MoE FFN.  With a mesh: expert-parallel via shard_map + all_to_all
-    (tokens sharded over ``axis`` — and ``data_axis`` if given — experts'
-    weights sharded over ``axis``); without: the dense oracle."""
-    if mesh is None or mesh.shape.get(axis, 1) == 1:
-        return moe_ffn_dense(params, x, top_k=top_k,
-                             capacity_factor=capacity_factor,
-                             activation=activation)
-    ep = mesh.shape[axis]
-    n, d = x.shape
-    n_experts = params["gate"].shape[1]
-    if n_experts % ep:
-        raise ValueError(f"n_experts={n_experts} not divisible by "
-                         f"expert-axis size {ep}")
-    token_shards = ep * (mesh.shape[data_axis] if data_axis else 1)
-    if n % token_shards:
-        raise ValueError(f"token count {n} not divisible by token-shard "
-                         f"count {token_shards}")
-    n_local = n // token_shards
-    # capacity is computed from LOCAL token count: each shard dispatches
-    # [E, C, D] and the all_to_all'd expert batch is [E/ep, C·ep, D]
-    capacity = max(1, math.ceil(n_local * top_k / n_experts * capacity_factor))
-
-    token_spec = P(axis) if data_axis is None else P((data_axis, axis))
-    weight_spec = P(axis)
-
-    def local(gate, w_in, b_in, w_out, b_out, xs):
-        # xs: [n_local, D]; w_in: [E/ep, D, H]
-        logits = xs @ gate
-        gates, top_idx = _top_k_gates(logits, top_k)
-        combine, dispatch = _dispatch_tensors(gates, top_idx, n_experts,
-                                              capacity)
-        expert_in = jnp.einsum("nec,nd->ecd", dispatch, xs)   # [E, C, D]
-        # all_to_all: split E over the axis, gather every shard's C —
-        # each device ends with its OWN experts' tokens from ALL shards
-        expert_in = lax.all_to_all(expert_in, axis, split_axis=0,
-                                   concat_axis=1, tiled=True)  # [E/ep, C·ep, D]
-        h = activation(jnp.einsum("ecd,edh->ech", expert_in, w_in)
-                       + b_in[:, None, :])
-        out = (jnp.einsum("ech,ehd->ecd", h, w_out)
-               + b_out[:, None, :])                            # [E/ep, C·ep, D]
-        out = lax.all_to_all(out, axis, split_axis=1,
-                             concat_axis=0, tiled=True)        # [E, C, D]
-        return jnp.einsum("nec,ecd->nd", combine, out)
-
-    fn = shard_map(
-        local, mesh=mesh,
-        in_specs=(P(), weight_spec, weight_spec, weight_spec, weight_spec,
-                  token_spec),
-        out_specs=token_spec)
-    return fn(params["gate"], params["w_in"], params["b_in"],
-              params["w_out"], params["b_out"], x)
+warnings.warn(
+    "deeplearning4j_tpu.parallel.expert_parallel is deprecated; import "
+    "moe_ffn/init_moe_params/shard_moe_params from "
+    "deeplearning4j_tpu.parallel (unified-mesh path, "
+    "docs/PARALLELISM.md)",
+    DeprecationWarning, stacklevel=2)

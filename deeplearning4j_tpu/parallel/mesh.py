@@ -1,16 +1,37 @@
-"""Device-mesh construction.
+"""The unified device mesh — single source of truth for every layout.
 
 Replaces the reference's ``MeshOrganizer`` (nd4j-parameter-server
 ``v2/util/MeshOrganizer.java`` — the Aeron tree-mesh bookkeeping): on TPU
 the runtime already knows the topology; we just lay axes over it.
 
-Axis conventions (SURVEY.md §7.7):
+Axis conventions (SURVEY.md §7.7) — import the ``AXIS_*`` constants, not
+string literals (lint rule TPU317):
+
 - ``data``   — batch sharding (DP); gradients psum over this axis.
 - ``model``  — tensor-parallel sharding of weight matrices (TP).
 - ``seq``    — sequence/context parallelism (ring attention).
-- ``stage``  — pipeline stages.
+- ``pipe``   — pipeline stages (1F1B schedule; was ``stage`` before the
+  unified-mesh refactor — ``make_mesh(stage=...)`` still accepted).
 - ``expert`` — expert parallelism (MoE all_to_all dispatch); absent in
   the reference (pre-MoE era), provided beyond-parity.
+
+Since the unified-mesh refactor this module is the SINGLE source of
+truth the whole stack agrees on:
+
+- :class:`MeshSpec` — axis sizes, parseable from layout strings
+  (``"dp2xtp2"``, ``"dp2xtp2xpp2"``) and buildable into a
+  ``jax.sharding.Mesh``;
+- :class:`MeshLayout` — a resolved layout: the mesh, the
+  per-layer-family tensor-parallel rule table (:data:`TP_RULE_FAMILIES`),
+  PartitionSpec/NamedSharding builders for params and batches, a stable
+  cache signature (flows into ``train.step_cache`` keys and the PR-12
+  artifact store so a sharded step warm-restarts with zero JIT), an
+  analytic per-step collective-bytes estimate, and the ``tpudl_mesh_*``
+  gauges;
+- ``Trainer(mesh=... / layout=...)`` consumes a MeshLayout directly —
+  the one flag that picks DP×TP×PP (docs/PARALLELISM.md);
+- ``tpudl.analyze`` resolves PartitionSpecs against :data:`MESH_AXES`
+  and validates layouts statically (TPU201–203).
 
 Multi-slice: when devices expose ``slice_index`` (multi-slice TPU pods),
 the ``data`` axis is laid out so that intra-slice neighbors ride ICI and
@@ -21,51 +42,198 @@ the slice boundary rides DCN (jax's device order already groups by slice;
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Sequence
+import re
+from typing import Any, Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# Canonical axis names.  Code outside this module must reference these
+# constants — string literals passed to sharding constructors elsewhere
+# are a lint error (TPU317): the literal is exactly how the five sibling
+# modules grew incompatible axis vocabularies in the first place.
+AXIS_PIPE = "pipe"
+AXIS_DATA = "data"
+AXIS_SEQ = "seq"
+AXIS_EXPERT = "expert"
+AXIS_MODEL = "model"
 
 # The canonical axis set every mesh built here declares, in layout order
 # (outermost → innermost).  ``tpudl.analyze`` resolves PartitionSpecs
 # against this tuple; parallelism modules name their axes from it.
-MESH_AXES = ("stage", "data", "seq", "expert", "model")
+MESH_AXES = (AXIS_PIPE, AXIS_DATA, AXIS_SEQ, AXIS_EXPERT, AXIS_MODEL)
+
+# Axes that shard the BATCH role (the analyzer cross-checks that no TP
+# rule shards parameters over one of these — TPU202).  The canonical
+# home; ``parallel.data_parallel.DATA_AXES`` aliases it for the old
+# import path.
+DATA_AXES = (AXIS_DATA,)
+
+# layout-token → axis-name for MeshSpec.parse ("dp2xtp2xpp2")
+_LAYOUT_TOKENS = {
+    "dp": AXIS_DATA, "tp": AXIS_MODEL, "pp": AXIS_PIPE,
+    "sp": AXIS_SEQ, "ep": AXIS_EXPERT,
+    # long forms, for self-describing configs
+    AXIS_DATA: AXIS_DATA, AXIS_MODEL: AXIS_MODEL, AXIS_PIPE: AXIS_PIPE,
+    AXIS_SEQ: AXIS_SEQ, AXIS_EXPERT: AXIS_EXPERT,
+}
+
+_TOKEN_RE = re.compile(r"([a-z]+)(\d+)")
+
+
+# ---------------------------------------------------- per-family TP rules
+# Tensor-parallel sharding rules by LAYER FAMILY: parameter-path regex →
+# PartitionSpec over the ``model`` axis.  Paths are "a/b/c" strings from
+# tree_map_with_path (list indices stringify, so MultiLayerNetwork
+# params match as "0/W", "1/b", ...).  Unmatched leaves replicate.
+#
+# ``bert``: the Megatron/GSPMD recipe — attention QKV and FFN
+# in-projection column-sharded (output features over ``model``),
+# attention output and FFN out-projection row-sharded; XLA inserts the
+# all-gather / reduce-scatter pair.
+BERT_TP_RULES: list[tuple[str, P]] = [
+    (r"attention/(query|key|value)/kernel$", P(None, AXIS_MODEL)),  # column
+    (r"attention/output/kernel$", P(AXIS_MODEL, None)),             # row
+    (r"intermediate/kernel$", P(None, AXIS_MODEL)),                 # column
+    (r"(?<!attention/)output/kernel$", P(AXIS_MODEL, None)),        # FFN out, row
+    (r"attention/(query|key|value)/bias$", P(AXIS_MODEL)),
+    (r"intermediate/bias$", P(AXIS_MODEL)),
+    (r"embeddings/word_embeddings$", P(None, None)),        # replicated (tied head)
+]
+
+# ``dense``: the layer-zoo family (MultiLayerNetwork /
+# ComputationGraph dense stacks) — every 2-D kernel column-sharded on
+# its output features, its bias alongside; 1-D norm/scale params
+# (gamma/beta) and everything else replicate.  Column-only keeps GSPMD's
+# partitioning exact under dropout (activations gather to full width
+# before every elementwise op).
+DENSE_TP_RULES: list[tuple[str, P]] = [
+    (r"(^|/)W$", P(None, AXIS_MODEL)),
+    (r"(^|/)b$", P(AXIS_MODEL)),
+]
+
+TP_RULE_FAMILIES: dict[str, list[tuple[str, P]]] = {
+    "dense": DENSE_TP_RULES,
+    "bert": BERT_TP_RULES,
+}
 
 
 @dataclasses.dataclass
 class MeshSpec:
+    """Axis sizes of a unified mesh — the parse target of every layout
+    flag (``Trainer(layout=...)``, ``analyze --layout``, the bench
+    sweep).  ``pipe`` was called ``stage`` before the unified-mesh
+    refactor; the old keyword survives on :func:`make_mesh` only."""
+
     data: int = 1
     model: int = 1
     seq: int = 1
-    stage: int = 1
+    pipe: int = 1
     expert: int = 1
 
     def total(self) -> int:
-        return self.data * self.model * self.seq * self.stage * self.expert
+        return self.data * self.model * self.seq * self.pipe * self.expert
+
+    def sizes(self) -> dict[str, int]:
+        """Axis-name → size in :data:`MESH_AXES` vocabulary."""
+        return {AXIS_PIPE: self.pipe, AXIS_DATA: self.data,
+                AXIS_SEQ: self.seq, AXIS_EXPERT: self.expert,
+                AXIS_MODEL: self.model}
+
+    @classmethod
+    def parse(cls, layout: str) -> "MeshSpec":
+        """``"dp2xtp2xpp2"`` (or ``"data2_model2"``) → MeshSpec.
+        Tokens: dp=data, tp=model, pp=pipe, sp=seq, ep=expert; sizes are
+        positive ints; separators ``x``/``_``/``,`` are equivalent."""
+        spec = cls()
+        seen: set[str] = set()
+        text = layout.strip().lower()
+        if not text:
+            raise ValueError("empty layout string")
+        for part in re.split(r"[x_,*]+", text):
+            if not part:
+                continue
+            m = _TOKEN_RE.fullmatch(part)
+            if not m or m.group(1) not in _LAYOUT_TOKENS:
+                raise ValueError(
+                    f"unparseable layout token {part!r} in {layout!r} "
+                    f"(tokens: dp/tp/pp/sp/ep or data/model/pipe/seq/expert "
+                    f"+ a positive size, e.g. 'dp2xtp2')")
+            axis = _LAYOUT_TOKENS[m.group(1)]
+            if axis in seen:
+                raise ValueError(f"axis {axis!r} given twice in {layout!r}")
+            seen.add(axis)
+            size = int(m.group(2))
+            if size < 1:
+                raise ValueError(f"axis size must be >= 1 in {layout!r}")
+            field = "pipe" if axis == AXIS_PIPE else axis
+            setattr(spec, field, size)
+        if not seen:
+            raise ValueError(f"layout {layout!r} names no axis (tokens: "
+                             f"dp/tp/pp/sp/ep + a positive size)")
+        return spec
+
+    @classmethod
+    def from_mesh(cls, mesh: Mesh) -> "MeshSpec":
+        shape = dict(mesh.shape)
+        legacy = shape.pop("stage", 1)   # pre-rename meshes
+        return cls(data=int(shape.get(AXIS_DATA, 1)),
+                   model=int(shape.get(AXIS_MODEL, 1)),
+                   seq=int(shape.get(AXIS_SEQ, 1)),
+                   pipe=int(shape.get(AXIS_PIPE, 1)) * int(legacy),
+                   expert=int(shape.get(AXIS_EXPERT, 1)))
+
+    def describe(self) -> str:
+        """Stable short form ("dp2xtp2xpp2"; "single" when trivial) —
+        the layout label on metrics, bench rows, and cache keys."""
+        parts = []
+        for token, size in (("dp", self.data), ("tp", self.model),
+                            ("pp", self.pipe), ("sp", self.seq),
+                            ("ep", self.expert)):
+            if size > 1:
+                parts.append(f"{token}{size}")
+        return "x".join(parts) if parts else "single"
+
+    def build(self, devices: Optional[Sequence] = None) -> Mesh:
+        if devices is None:
+            # a layout names its total degree; take the leading devices
+            # (a "dp2" layout on an 8-device host uses 2 of them)
+            avail = jax.devices()
+            if len(avail) < self.total():
+                raise ValueError(f"layout {self.describe()!r} needs "
+                                 f"{self.total()} devices, have {len(avail)}")
+            devices = avail[:self.total()]
+        return make_mesh(data=self.data, model=self.model, seq=self.seq,
+                         pipe=self.pipe, expert=self.expert,
+                         devices=devices)
 
 
 def make_mesh(data: Optional[int] = None, model: int = 1, seq: int = 1,
-              stage: int = 1, expert: int = 1,
-              devices: Optional[Sequence] = None) -> Mesh:
-    """Build a Mesh with axes ('stage','data','seq','expert','model').
+              pipe: int = 1, expert: int = 1,
+              devices: Optional[Sequence] = None,
+              stage: Optional[int] = None) -> Mesh:
+    """Build a Mesh with axes ('pipe','data','seq','expert','model').
     ``data`` defaults to all remaining devices.  Axis order puts
     ``model``/``expert``/``seq`` innermost (fastest-varying device index
     = densest ICI links — TP/EP-all_to_all/CP traffic per step ≫ DP
-    traffic)."""
+    traffic).  ``stage=`` is the pre-rename spelling of ``pipe=``."""
+    if stage is not None:
+        if pipe != 1 and pipe != stage:
+            raise ValueError(f"pass pipe= or stage=, not both ({pipe} vs {stage})")
+        pipe = stage
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if data is None:
-        denom = model * seq * stage * expert
+        denom = model * seq * pipe * expert
         if n % denom:
             raise ValueError(
-                f"{n} devices not divisible by model*seq*stage*expert={denom}")
+                f"{n} devices not divisible by model*seq*pipe*expert={denom}")
         data = n // denom
-    spec = MeshSpec(data, model, seq, stage, expert)
+    spec = MeshSpec(data=data, model=model, seq=seq, pipe=pipe, expert=expert)
     if spec.total() != n:
         raise ValueError(f"mesh {spec} needs {spec.total()} devices, have {n}")
-    arr = np.asarray(devices).reshape(stage, data, seq, expert, model)
+    arr = np.asarray(devices).reshape(pipe, data, seq, expert, model)
     return Mesh(arr, axis_names=MESH_AXES)
 
 
@@ -73,12 +241,12 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+def batch_sharding(mesh: Mesh, axis: str = AXIS_DATA) -> NamedSharding:
     """Shard the leading (batch) dim."""
     return NamedSharding(mesh, P(axis))
 
 
-def shard_batch(mesh: Mesh, tree, axis: str = "data"):
+def shard_batch(mesh: Mesh, tree, axis: str = AXIS_DATA):
     """Place every array in ``tree`` with its leading dim sharded over
     ``axis`` (host→device with layout)."""
     sharding = batch_sharding(mesh, axis)
@@ -90,3 +258,311 @@ def replicate(mesh: Mesh, tree):
     sharding = replicated(mesh)
     return jax.tree_util.tree_map(
         lambda a: jax.device_put(a, sharding) if a is not None else None, tree)
+
+
+# -------------------------------------------------- param-rule machinery
+def _path_str(path) -> str:
+    parts = []
+    for entry in path:
+        if hasattr(entry, "key"):
+            parts.append(str(entry.key))
+        elif hasattr(entry, "idx"):
+            parts.append(str(entry.idx))
+        else:
+            parts.append(str(entry))
+    return "/".join(parts)
+
+
+def tp_spec_tree(params: Any,
+                 rules: Optional[list[tuple[str, P]]] = None) -> Any:
+    """Pytree of PartitionSpecs matching ``params`` from a rule list
+    (first matching regex wins; unmatched leaves get ``P()``)."""
+    rules = rules if rules is not None else BERT_TP_RULES
+    compiled = [(re.compile(pattern), spec) for pattern, spec in rules]
+
+    def spec_for(path, leaf):
+        s = _path_str(path)
+        for pattern, spec in compiled:
+            if pattern.search(s):
+                return spec
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def tp_sharding_tree(params: Any, mesh: Mesh,
+                     rules: Optional[list[tuple[str, P]]] = None) -> Any:
+    """Pytree of NamedShardings matching ``params``; unmatched leaves are
+    replicated."""
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        tp_spec_tree(params, rules),
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_params(params: Any, mesh: Mesh,
+                 rules: Optional[list[tuple[str, P]]] = None) -> Any:
+    """Place ``params`` according to the TP rules (device_put with layout —
+    the one-time resharding cost of entering TP execution)."""
+    shardings = tp_sharding_tree(params, mesh, rules)
+    return jax.tree_util.tree_map(jax.device_put, params, shardings)
+
+
+def rule_axes(rules: Optional[list[tuple[str, P]]] = None) -> set[str]:
+    """Every mesh-axis name a TP rule set mentions (the analyzer resolves
+    these against :data:`MESH_AXES` and against :data:`DATA_AXES`)."""
+    rules = rules if rules is not None else BERT_TP_RULES
+    axes: set[str] = set()
+    for _, spec in rules:
+        for entry in spec:
+            if entry is None:
+                continue
+            if isinstance(entry, (tuple, list)):
+                axes.update(str(a) for a in entry)
+            else:
+                axes.add(str(entry))
+    return axes
+
+
+# ------------------------------------------------------------- MeshLayout
+class MeshLayout:
+    """A resolved composite layout over ONE unified mesh.
+
+    Everything a trainer (or bench, or the analyzer) needs to run a
+    DP×TP×PP combination: the mesh, the TP rule family, placement
+    helpers, a deterministic cache signature, and the analytic
+    collective-bytes model.  Construct via :func:`resolve_layout`.
+    """
+
+    def __init__(self, spec: MeshSpec, mesh: Optional[Mesh] = None,
+                 tp_family: str = "dense",
+                 tp_rules: Optional[list[tuple[str, P]]] = None,
+                 devices: Optional[Sequence] = None):
+        self.spec = spec
+        self.mesh = mesh if mesh is not None else spec.build(devices)
+        self.tp_family = tp_family
+        if tp_rules is not None:
+            self.tp_rules = tp_rules
+        else:
+            if tp_family not in TP_RULE_FAMILIES:
+                # a typo'd family would silently replicate every param
+                # under a model>1 layout — same verdict as TPU203
+                raise ValueError(
+                    f"unknown TP rule family {tp_family!r} (have "
+                    f"{sorted(TP_RULE_FAMILIES)})")
+            self.tp_rules = TP_RULE_FAMILIES[tp_family]
+        # sanity: the mesh must actually carry the spec's sizes
+        built = MeshSpec.from_mesh(self.mesh)
+        if built.sizes() != spec.sizes():
+            raise ValueError(
+                f"mesh shape {dict(self.mesh.shape)} does not match "
+                f"layout spec {spec.sizes()}")
+
+    # ------------------------------------------------------------ facts
+    @property
+    def data(self) -> int:
+        return self.spec.data
+
+    @property
+    def model(self) -> int:
+        return self.spec.model
+
+    @property
+    def pipe(self) -> int:
+        return self.spec.pipe
+
+    def describe(self) -> str:
+        return self.spec.describe()
+
+    def is_trivial(self) -> bool:
+        return self.spec.total() == 1
+
+    def cache_signature(self) -> str:
+        """Deterministic string for step-cache / artifact-store keys:
+        axis sizes + TP family + device kind.  Stable across processes
+        (no object ids), so a DP=2 child resumes onto the parent's
+        baked executables."""
+        kind = ""
+        try:
+            kind = str(self.mesh.devices.flat[0].platform)
+        except Exception:
+            pass
+        return (f"layout:{self.describe()}|tp:{self.tp_family}"
+                f"|devs:{self.spec.total()}:{kind}")
+
+    # -------------------------------------------------------- placement
+    def batch_sharding(self) -> NamedSharding:
+        """Batches shard their leading dim over ``data`` (replicated on
+        every other axis)."""
+        return NamedSharding(self.mesh, P(AXIS_DATA))
+
+    def shard_batch(self, tree):
+        return jax.tree_util.tree_map(
+            lambda a: (jax.device_put(a, self.batch_sharding())
+                       if a is not None else None), tree)
+
+    def param_spec_tree(self, params):
+        """PartitionSpec per param leaf: the TP family rules when
+        ``model > 1``, fully replicated otherwise.  A rule whose
+        sharded dim does not divide by its axis size falls back to
+        replicated for THAT leaf (e.g. a 5-class output kernel under
+        tp2) — correctness never depends on the rule matching."""
+        if self.model <= 1:
+            return jax.tree_util.tree_map(lambda _: P(), params)
+        sizes = self.spec.sizes()
+
+        def fits(spec, shape):
+            for i, entry in enumerate(spec):
+                if entry is None:
+                    continue
+                names = entry if isinstance(entry, (tuple, list)) else (entry,)
+                degree = 1
+                for n in names:
+                    degree *= int(sizes.get(str(n), 1))
+                if i >= len(shape) or degree == 0 or shape[i] % degree:
+                    return False
+            return True
+
+        specs = tp_spec_tree(params, self.tp_rules)
+        return jax.tree_util.tree_map(
+            lambda leaf, spec: spec if fits(spec, np.shape(leaf)) else P(),
+            params, specs)
+
+    def param_sharding_tree(self, params):
+        return jax.tree_util.tree_map(
+            lambda spec: NamedSharding(self.mesh, spec),
+            self.param_spec_tree(params),
+            is_leaf=lambda x: isinstance(x, P))
+
+    def shard_params(self, params):
+        return jax.tree_util.tree_map(
+            jax.device_put, params, self.param_sharding_tree(params))
+
+    def replicate(self, tree):
+        return replicate(self.mesh, tree)
+
+    def opt_state_sharding_tree(self, opt_state, params,
+                                param_shardings=None):
+        """NamedSharding tree for an optimizer state: subtrees that
+        mirror the params treedef (Adam mu/nu, momentum, ...) take the
+        params' placement; everything else (step counts, empty states)
+        replicates.  Deterministic — derived from structure and rules,
+        never from object identity — so two processes building the same
+        config produce identical sharding signatures (the warm-restart
+        key contract)."""
+        pdef = jax.tree_util.tree_structure(params)
+        if param_shardings is None:
+            param_shardings = self.param_sharding_tree(params)
+        rep = NamedSharding(self.mesh, P())
+
+        def is_param_tree(x):
+            try:
+                return jax.tree_util.tree_structure(x) == pdef
+            except Exception:
+                return False
+
+        def map_node(node):
+            if is_param_tree(node):
+                return param_shardings
+            return jax.tree_util.tree_map(lambda _: rep, node)
+
+        return jax.tree_util.tree_map(map_node, opt_state,
+                                      is_leaf=is_param_tree)
+
+    # ------------------------------------------------------- cost model
+    def collective_bytes_per_step(self, param_bytes: int,
+                                  activation_bytes: int = 0) -> int:
+        """Analytic per-step collective traffic (bytes) for this layout —
+        the number the ``mesh_sweep`` bench reports next to measured
+        steps/s.  Ring-allreduce/all-gather volume models:
+
+        - DP: gradient psum ≈ ``2·(n−1)/n · param_bytes``;
+        - TP (GSPMD column rules): activation all-gather + grad
+          reduce-scatter ≈ ``2·(n−1)/n · activation_bytes``;
+        - PP: boundary activations ride the ring ≈ ``activation_bytes``
+          per exchanged boundary (forward + cotangent), and param grads
+          stay stage-local (no psum in the stage-local form; the
+          replicated form psums ≈ ``2·(n−1)/n · param_bytes``).
+        An estimate, clearly labeled as such in bench records — compiled
+        collectives are attributed per-program by the PR-6 cost model.
+        """
+        total = 0.0
+        if self.data > 1:
+            total += 2.0 * (self.data - 1) / self.data * param_bytes
+        if self.model > 1:
+            total += 2.0 * (self.model - 1) / self.model * max(
+                activation_bytes, 0)
+            # model-sharded params gather on use + reduce-scatter grads
+            total += 2.0 * (self.model - 1) / self.model * param_bytes
+        if self.pipe > 1:
+            total += 2.0 * max(activation_bytes, 0)
+            total += 2.0 * (self.pipe - 1) / self.pipe * param_bytes
+        return int(total)
+
+    # ---------------------------------------------------------- metrics
+    def publish_metrics(self, param_bytes: Optional[int] = None,
+                        activation_bytes: int = 0) -> None:
+        """Stamp the ``tpudl_mesh_*`` gauges for this layout (the active
+        layout, axis sizes, and the per-step collective-bytes estimate —
+        docs/observability.md)."""
+        from deeplearning4j_tpu.obs.registry import get_registry
+        reg = get_registry()
+        reg.gauge("tpudl_mesh_devices").set(self.spec.total())
+        axis_gauge = reg.labeled_gauge("tpudl_mesh_axis_size",
+                                       label_names=("axis",))
+        for axis, size in self.spec.sizes().items():
+            axis_gauge.set(size, axis=axis)
+        reg.labeled_gauge("tpudl_mesh_layout_active",
+                          label_names=("layout",)).set(
+            1, layout=self.describe())
+        if param_bytes is not None:
+            reg.gauge("tpudl_mesh_collective_bytes").set(
+                self.collective_bytes_per_step(param_bytes,
+                                               activation_bytes))
+
+
+def resolve_layout(mesh: Optional[Any] = None, layout: Optional[Any] = None,
+                   tp_family: str = "dense",
+                   devices: Optional[Sequence] = None) -> Optional[MeshLayout]:
+    """The ONE resolution rule behind every ``mesh=`` / ``layout=`` flag.
+
+    - ``layout``: a layout string (``"dp2xtp2"``), a :class:`MeshSpec`,
+      or an already-resolved :class:`MeshLayout` (returned as-is);
+    - ``mesh``: a ``jax.sharding.Mesh`` whose axis sizes define the
+      layout (built elsewhere, e.g. ``make_mesh(data=8)``) — combined
+      with ``layout`` they must agree;
+    - both ``None`` → ``None`` (the single-device path).
+
+    Returns ``None`` for a fully trivial layout (1 device total) so
+    callers can keep the exact pre-refactor single-device behavior.
+    """
+    if layout is None and mesh is None:
+        return None
+    if isinstance(layout, MeshLayout):
+        if mesh is not None and layout.mesh is not mesh:
+            raise ValueError("pass mesh= or a resolved MeshLayout, not both")
+        # same trivial→None contract as every other input form (a
+        # 1-device MeshLayout must not grow a distinct cache signature)
+        return None if layout.is_trivial() else layout
+    spec: Optional[MeshSpec] = None
+    if layout is not None:
+        spec = layout if isinstance(layout, MeshSpec) else MeshSpec.parse(
+            str(layout))
+    if mesh is not None:
+        mesh_spec = MeshSpec.from_mesh(mesh)
+        if spec is not None and mesh_spec.sizes() != spec.sizes():
+            raise ValueError(
+                f"layout {spec.describe()!r} disagrees with the mesh's "
+                f"axis sizes {dict(mesh.shape)}")
+        spec = mesh_spec
+        # legacy 'stage'-axis meshes cannot carry the unified specs
+        if "stage" in mesh.shape and mesh.shape["stage"] > 1:
+            raise ValueError(
+                "mesh still uses the pre-refactor 'stage' axis — rebuild "
+                "it with make_mesh(pipe=...) / MeshSpec(pipe=...)")
+        result = MeshLayout(spec, mesh=mesh, tp_family=tp_family)
+    else:
+        result = MeshLayout(spec, tp_family=tp_family, devices=devices)
+    if result.is_trivial():
+        return None
+    return result

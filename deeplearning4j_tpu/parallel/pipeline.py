@@ -1,5 +1,5 @@
 """Pipeline parallelism — microbatched stage execution over the ``stage``
-mesh axis.
+mesh axis (named ``stage`` before the unified-mesh refactor).
 
 Capability BEYOND the reference (SURVEY.md §2.7: no PP anywhere in DL4J).
 GPipe-style schedule via ``shard_map`` + ``ppermute``: each device holds
@@ -26,11 +26,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+from deeplearning4j_tpu.parallel.mesh import AXIS_PIPE
 from deeplearning4j_tpu.utils.jax_compat import pcast, shard_map
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, x: jnp.ndarray,
-                   mesh: Mesh, n_microbatches: int, axis: str = "stage",
+                   mesh: Mesh, n_microbatches: int, axis: str = AXIS_PIPE,
                    data_axis: str | None = None):
     """Run a homogeneous S-stage pipeline.
 

@@ -27,13 +27,14 @@ ring ppermute.
 
 from __future__ import annotations
 
-from typing import Callable, Sequence
+from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+from deeplearning4j_tpu.parallel.mesh import AXIS_PIPE
 from deeplearning4j_tpu.utils.jax_compat import pcast, shard_map
 
 
@@ -148,18 +149,57 @@ def _unpad(buf, shape, dtype):
 
 
 # ---------------------------------------------------------- the train step
+def _spec_mentions(spec, axis_name: str) -> bool:
+    """True when a PartitionSpec shards any dim over ``axis_name``."""
+    for entry in spec:
+        if entry is None:
+            continue
+        entries = entry if isinstance(entry, (tuple, list)) else (entry,)
+        if any(str(a) == axis_name for a in entries):
+            return True
+    return False
+
+
 def pipeline_train_step(stage_fns: Sequence[Callable], stage_params,
                         x, labels, loss_fn, mesh: Mesh,
-                        n_microbatches: int, axis: str = "stage",
-                        schedule: str = "1f1b"):
+                        n_microbatches: int, axis: str = AXIS_PIPE,
+                        schedule: str = "1f1b",
+                        data_axis: Optional[str] = None,
+                        model_axis: Optional[str] = None,
+                        rng=None, head_loss: Optional[Callable] = None,
+                        param_specs=None, boundary_shapes=None):
     """One pipelined training step over heterogeneous stages.
 
     - ``stage_fns[i](params_i, h) -> h'``: arbitrary per-stage pytrees
-      and activation shapes (batch dim preserved).
+      and activation shapes (batch dim preserved).  With ``rng`` given,
+      the convention becomes ``stage_fns[i](params_i, h, rng) -> h'`` —
+      the SAME key reaches every stage (fold per layer inside the fn),
+      so per-layer dropout reproduces the single-device masks exactly
+      when ``n_microbatches == 1``.
     - ``loss_fn(y, labels_mb) -> scalar``: evaluated on the LAST stage
       per microbatch (mean over microbatches is returned).
+      Alternatively ``head_loss(params_last, h, labels_mb[, rng])``
+      computes the loss FROM the last stage's params and input — the
+      hook the unified trainer uses for output layers whose loss needs
+      the layer's own parameters (``compute_score_array``); the last
+      stage fn is then used only for shape chaining.
+    - ``data_axis``: composes DP×PP on one mesh — batch and labels
+      shard their leading dim over it, each data replica runs the
+      schedule on its shard, and loss/grads pmean across replicas.
+    - ``model_axis`` + ``param_specs``: composes TP×PP — parameter
+      leaves sharded over ``model_axis`` per ``param_specs`` enter the
+      program as local shards; stage fns gather them on use
+      (``lax.all_gather``), so activations stay full-width and dropout
+      masks match the single-device run.  The all_gather transpose
+      reduce-scatters identical per-rank contributions, so sharded
+      leaves' grads are renormalized by the axis size here.
+    - ``boundary_shapes``: explicit per-stage-input GLOBAL batch shapes
+      ``[(B, ...), ...]`` (one per stage).  Required when stage fns
+      contain collectives (the eval_shape chain runs outside shard_map
+      where mesh axes are unbound); otherwise inferred.
     - returns ``(loss, grads)`` with ``grads`` a tuple of per-stage
-      pytrees (cotangents of ``stage_params``), replicated.
+      pytrees (cotangents of ``stage_params``), replicated (sharded
+      leaves keep their ``param_specs`` layout).
 
     ``schedule='1f1b'`` bounds stashed activations at ``S - s`` per
     stage; ``'gpipe'`` runs all-fwd-then-all-bwd with an M-deep stash
@@ -168,15 +208,41 @@ def pipeline_train_step(stage_fns: Sequence[Callable], stage_params,
     """
     S = int(mesh.shape[axis])
     M = n_microbatches
+    dp = int(mesh.shape[data_axis]) if data_axis else 1
     if len(stage_fns) != S:
         raise ValueError(f"{len(stage_fns)} stage fns for {S}-way '{axis}' axis")
-    if x.shape[0] % M:
-        raise ValueError(f"batch {x.shape[0]} not divisible by {M} microbatches")
-    bm = x.shape[0] // M
+    if x.shape[0] % (M * dp):
+        raise ValueError(f"batch {x.shape[0]} not divisible by "
+                         f"microbatches*data_par={M * dp}")
+    bm = x.shape[0] // (M * dp)
 
-    mb_shape = (bm,) + tuple(x.shape[1:])
-    shapes = _stage_shapes(stage_fns, stage_params,
-                           mb_shape, x.dtype)
+    threaded_rng = rng is not None
+
+    def call_stage(i, p, h, r=None):
+        return stage_fns[i](p, h, r) if threaded_rng else stage_fns[i](p, h)
+
+    if boundary_shapes is not None:
+        if len(boundary_shapes) != S:
+            raise ValueError(f"{len(boundary_shapes)} boundary shapes for "
+                             f"{S} stages")
+        # per-stage INPUT shapes, local microbatch rows; trailing dims
+        # come from the declared global shapes
+        shapes = [jax.ShapeDtypeStruct((bm,) + tuple(s[1:]), x.dtype)
+                  for s in boundary_shapes]
+        # the last stage's output never rides the ring (see `width`);
+        # close the chain with its input so max() below stays correct
+        shapes = shapes + [shapes[-1]]
+    else:
+        mb_shape = (bm,) + tuple(x.shape[1:])
+        if threaded_rng:
+            # shape probe outside shard_map: a dummy key stands in (the
+            # real key is a same-shape operand at run time)
+            key0 = jax.random.key(0)
+            probe = [(lambda p, h, _i=i: stage_fns[_i](p, h, key0))
+                     for i in range(S)]
+            shapes = _stage_shapes(probe, stage_params, mb_shape, x.dtype)
+        else:
+            shapes = _stage_shapes(stage_fns, stage_params, mb_shape, x.dtype)
     # ring/stash width covers stage INPUT boundaries only: the last
     # stage's forward output (e.g. vocab-wide MLM logits) never rides
     # the ring — its backward tick recomputes it for the loss — so
@@ -199,29 +265,35 @@ def pipeline_train_step(stage_fns: Sequence[Callable], stage_params,
     # conditional miscompiles on the CPU backend).
     def fwd_branch(i):
         def run(operand):
-            params, buf = operand
+            params, buf, r = operand
             if i == S - 1:
                 # output never consumed (the B tick recomputes it with
                 # the loss attached) — skip the compute entirely
                 return jnp.zeros((bm, width), jnp.float32) + buf[0, 0] * 0
             h = _unpad(buf, shapes[i].shape, shapes[i].dtype)
-            y = stage_fns[i](params[i], h)
+            y = call_stage(i, params[i], h, r)
             return _pad_to(y, width)
         return run
 
     def bwd_branch(i):
         def run(operand):
-            params, in_buf, ct_buf, labels_mb = operand
+            params, in_buf, ct_buf, labels_mb, r = operand
             h = _unpad(in_buf, shapes[i].shape, shapes[i].dtype)
             vzero = jnp.zeros((), jnp.float32) * in_buf[0, 0]  # varying 0
 
             if i == S - 1:
-                def head(p, hh):
-                    return loss_fn(stage_fns[i](p, hh), labels_mb)
+                if head_loss is not None:
+                    def head(p, hh):
+                        if threaded_rng:
+                            return head_loss(p, hh, labels_mb, r)
+                        return head_loss(p, hh, labels_mb)
+                else:
+                    def head(p, hh):
+                        return loss_fn(call_stage(i, p, hh, r), labels_mb)
                 loss, (gp, gh) = jax.value_and_grad(
                     head, argnums=(0, 1))(params[i], h)
             else:
-                y, vjp = jax.vjp(lambda p, hh: stage_fns[i](p, hh),
+                y, vjp = jax.vjp(lambda p, hh: call_stage(i, p, hh, r),
                                  params[i], h)
                 ct = _unpad(ct_buf, shapes[i + 1].shape, jnp.float32)
                 gp, gh = vjp(ct.astype(y.dtype))
@@ -240,7 +312,12 @@ def pipeline_train_step(stage_fns: Sequence[Callable], stage_params,
     f_branches = [fwd_branch(i) for i in range(S)]
     b_branches = [bwd_branch(i) for i in range(S)]
 
-    def local(params, x_local, labels_local):
+    if param_specs is None:
+        param_specs = jax.tree_util.tree_map(lambda _: P(),
+                                             tuple(stage_params))
+
+    def local(params, x_local, labels_local, *rng_args):
+        r = rng_args[0] if rng_args else None
         idx = lax.axis_index(axis)
         micro_x = x_local.reshape((M, bm) + x_local.shape[1:])
         micro_y = labels_local.reshape((M, bm) + labels_local.shape[1:])
@@ -250,8 +327,11 @@ def pipeline_train_step(stage_fns: Sequence[Callable], stage_params,
         fwd_buf = dv(jnp.zeros((bm, width), jnp.float32))
         bwd_buf = dv(jnp.zeros((bm, width), jnp.float32))
         stash = dv(jnp.zeros((stash_depth, bm, width), jnp.float32))
+        # accumulators mirror the LOCAL argument (sharded leaves arrive
+        # as their per-device blocks — zeros_like the closed-over full
+        # tree would shape-mismatch them)
         grads0 = jax.tree_util.tree_map(
-            lambda a: dv(jnp.zeros_like(a, dtype=jnp.float32)), tuple(stage_params))
+            lambda a: dv(jnp.zeros_like(a, dtype=jnp.float32)), params)
         loss0 = dv(jnp.float32(0.0))
         fsched = jnp.asarray(F_sched)
         bsched = jnp.asarray(B_sched)
@@ -266,7 +346,7 @@ def pipeline_train_step(stage_fns: Sequence[Callable], stage_params,
                              _pad_to(micro_x[jnp.maximum(f_mb, 0)], width),
                              fwd_buf)
             do_f = f_mb >= 0
-            y_out = lax.switch(idx, f_branches, (params, x_in))
+            y_out = lax.switch(idx, f_branches, (params, x_in, r))
             stash = stash.at[jnp.maximum(f_mb, 0) % stash_depth].set(
                 jnp.where(do_f, x_in, stash[jnp.maximum(f_mb, 0) % stash_depth]))
 
@@ -274,7 +354,8 @@ def pipeline_train_step(stage_fns: Sequence[Callable], stage_params,
             slot = jnp.maximum(b_mb, 0) % stash_depth
             gh, gp, mb_loss = lax.switch(
                 idx, b_branches,
-                (params, stash[slot], bwd_buf, micro_y[jnp.maximum(b_mb, 0)]))
+                (params, stash[slot], bwd_buf, micro_y[jnp.maximum(b_mb, 0)],
+                 r))
             do_b = b_mb >= 0
             grads = jax.tree_util.tree_map(
                 lambda acc, g: acc + jnp.where(do_b, g.astype(jnp.float32), 0.0),
@@ -301,9 +382,24 @@ def pipeline_train_step(stage_fns: Sequence[Callable], stage_params,
         # by M: returned grads are d(mean-over-microbatch loss)/dp.
         grads = jax.tree_util.tree_map(lambda g: lax.psum(g, axis) / M, grads)
         loss = lax.psum(loss_acc, axis) / M
+        if data_axis is not None:
+            # DP×PP: each data replica saw an equal-size batch shard —
+            # the mean of per-replica means IS the global-batch mean
+            grads = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, data_axis), grads)
+            loss = lax.pmean(loss, data_axis)
+        if model_axis is not None:
+            # every model rank ran the identical gathered computation, so
+            # the all_gather transpose reduce-scattered `tp` identical
+            # contributions into each shard — renormalize sharded leaves
+            tp = int(mesh.shape[model_axis])
+            grads = jax.tree_util.tree_map(
+                lambda g, spec: (g / tp if _spec_mentions(spec, model_axis)
+                                 else g),
+                grads, param_specs, is_leaf=lambda v: isinstance(v, P))
         return grads, loss
 
-    param_spec = jax.tree_util.tree_map(lambda _: P(), tuple(stage_params))
+    x_spec = P(data_axis) if data_axis else P()
     # check_vma=False — pinned down in round 5 (r4 Weak #4):
     #  * in a FRESH CPU-only process the checked path is sound: the full
     #    pipeline test suite and a minimal switch-on-axis_index repro
@@ -316,12 +412,18 @@ def pipeline_train_step(stage_fns: Sequence[Callable], stage_params,
     #    (reproducible 3/3; flipping only this flag fixes it).
     # The unchecked path lowers switch to a plain local conditional and
     # is verified against the autodiff reference in both environments.
+    operands = (tuple(stage_params), x, labels)
+    in_specs = (param_specs, x_spec, x_spec)
+    if threaded_rng:
+        # the key enters as an explicit replicated operand — shard_map
+        # cannot close over traced values from an enclosing jit
+        operands = operands + (rng,)
+        in_specs = in_specs + (P(),)
     grads, loss = shard_map(
         local, mesh=mesh,
-        in_specs=(param_spec, P(), P()),
-        out_specs=(jax.tree_util.tree_map(lambda _: P(), tuple(stage_params)),
-                   P()),
-        check_vma=False)(tuple(stage_params), x, labels)
+        in_specs=in_specs,
+        out_specs=(param_specs, P()),
+        check_vma=False)(*operands)
     return loss, grads
 
 
@@ -331,7 +433,7 @@ def flatten_stage_params(stage_params):
     """Per-stage pytrees → ([S, Pmax] f32 buffer, unravel fns, sizes).
 
     The uniform padded buffer is what lets heterogeneous stages live
-    STAGE-SHARDED in one SPMD program: shard it ``P('stage')`` and each
+    STAGE-SHARDED in one SPMD program: shard it ``P(AXIS_PIPE)`` and each
     device holds exactly its own stage's parameters (1/S of the model),
     reconstructing the pytree locally with its static ``unravel``.
     Padding slots are zero and stay zero under any elementwise updater.
@@ -354,7 +456,7 @@ def unflatten_stage_params(params_flat, unravels, sizes):
                  for i, (u, s) in enumerate(zip(unravels, sizes)))
 
 
-def init_stage_local_opt(tx, params_flat, mesh, axis: str = "stage"):
+def init_stage_local_opt(tx, params_flat, mesh, axis: str = AXIS_PIPE):
     """Optimizer state over the [S, Pmax] buffer, stage-sharded: array
     leaves (mu/nu/momentum — elementwise, param-shaped) shard along the
     stage axis; scalar leaves (step counts) replicate."""
@@ -369,7 +471,7 @@ def init_stage_local_opt(tx, params_flat, mesh, axis: str = "stage"):
 def pipeline_fit_step_local(stage_fns: Sequence[Callable], params_flat,
                             opt_state, tx, unravels, sizes,
                             x, labels, loss_fn, mesh: Mesh,
-                            n_microbatches: int, axis: str = "stage",
+                            n_microbatches: int, axis: str = AXIS_PIPE,
                             schedule: str = "1f1b"):
     """1F1B train step with STAGE-LOCAL gradients and optimizer
     (VERDICT r4 missing #5): no full-tuple psum — the scan carries ONE
@@ -531,7 +633,7 @@ def pipeline_fit_step_local(stage_fns: Sequence[Callable], params_flat,
 
 def pipeline_apply_stages(stage_fns: Sequence[Callable], stage_params,
                           x, mesh: Mesh, n_microbatches: int,
-                          axis: str = "stage"):
+                          axis: str = AXIS_PIPE):
     """Forward-only heterogeneous pipeline (GPipe fill-drain): per-stage
     pytrees + non-uniform widths, same padded-ring machinery as
     :func:`pipeline_train_step`.  Returns y [B, ...] from the last stage.

@@ -1,91 +1,25 @@
-"""Tensor parallelism — NamedSharding rules over the ``model`` axis.
+"""Deprecated shim — tensor parallelism is a layout on the unified mesh.
 
-Capability BEYOND the reference (it has no TP; SURVEY.md §2.7).  Design
-per the Megatron/GSPMD recipe: attention QKV projections and FFN
-in-projection shard column-wise (output features over ``model``),
-attention output and FFN out-projection shard row-wise (input features
-over ``model``); XLA inserts the (all-gather / reduce-scatter) pair —
-no manual collectives.
-
-The rules are keyed by parameter-path regexes so they apply to the BERT
-module's named pytree and to any ComputationGraph with matching names.
+.. deprecated::
+    The per-layer-family TP rule tables and the sharding-tree builders
+    live in :mod:`deeplearning4j_tpu.parallel.mesh` (the single source
+    of truth every layout resolves against); ``tp_jit`` lives in
+    :mod:`deeplearning4j_tpu.parallel.unified`.  Training with TP no
+    longer needs this module at all: ``Trainer(layout="tp2")`` (or
+    ``"dp2xtp2"``) places parameters by the same rules.  This module
+    stays so existing imports keep working.
 """
 
 from __future__ import annotations
 
-import re
-from typing import Any, Optional
+import warnings
 
-import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
+    BERT_TP_RULES, rule_axes, shard_params, tp_sharding_tree)
+from deeplearning4j_tpu.parallel.unified import tp_jit  # noqa: F401
 
-# path-regex → PartitionSpec for 2-D kernels ([in, out]); 1-D arrays
-# (bias, layernorm) follow their producing kernel's OUT sharding when that
-# dim is sharded column-wise, else replicate.
-BERT_TP_RULES: list[tuple[str, P]] = [
-    (r"attention/(query|key|value)/kernel$", P(None, "model")),   # column
-    (r"attention/output/kernel$", P("model", None)),              # row
-    (r"intermediate/kernel$", P(None, "model")),                  # column
-    (r"(?<!attention/)output/kernel$", P("model", None)),         # FFN out, row
-    (r"attention/(query|key|value)/bias$", P("model")),
-    (r"intermediate/bias$", P("model")),
-    (r"embeddings/word_embeddings$", P(None, None)),              # replicated (tied head)
-]
-
-
-def rule_axes(rules: Optional[list[tuple[str, P]]] = None) -> set[str]:
-    """Every mesh-axis name a TP rule set mentions (the analyzer resolves
-    these against ``mesh.MESH_AXES`` and against the DP batch axes)."""
-    rules = rules if rules is not None else BERT_TP_RULES
-    axes: set[str] = set()
-    for _, spec in rules:
-        for entry in spec:
-            if entry is None:
-                continue
-            if isinstance(entry, (tuple, list)):
-                axes.update(str(a) for a in entry)
-            else:
-                axes.add(str(entry))
-    return axes
-
-
-def _path_str(path) -> str:
-    parts = []
-    for entry in path:
-        if hasattr(entry, "key"):
-            parts.append(str(entry.key))
-        elif hasattr(entry, "idx"):
-            parts.append(str(entry.idx))
-        else:
-            parts.append(str(entry))
-    return "/".join(parts)
-
-
-def tp_sharding_tree(params: Any, mesh: Mesh,
-                     rules: Optional[list[tuple[str, P]]] = None) -> Any:
-    """Pytree of NamedShardings matching ``params``; unmatched leaves are
-    replicated."""
-    rules = rules if rules is not None else BERT_TP_RULES
-    compiled = [(re.compile(pattern), spec) for pattern, spec in rules]
-
-    def spec_for(path, leaf):
-        s = _path_str(path)
-        for pattern, spec in compiled:
-            if pattern.search(s):
-                return NamedSharding(mesh, spec)
-        return NamedSharding(mesh, P())
-
-    return jax.tree_util.tree_map_with_path(spec_for, params)
-
-
-def shard_params(params: Any, mesh: Mesh,
-                 rules: Optional[list[tuple[str, P]]] = None) -> Any:
-    """Place ``params`` according to the TP rules (device_put with layout —
-    the one-time resharding cost of entering TP execution)."""
-    shardings = tp_sharding_tree(params, mesh, rules)
-    return jax.tree_util.tree_map(jax.device_put, params, shardings)
-
-
-def tp_jit(fn, params_shardings, **jit_kwargs):
-    """jit with parameter in_shardings bound (GSPMD partitions the rest)."""
-    return jax.jit(fn, in_shardings=(params_shardings,), **jit_kwargs)
+warnings.warn(
+    "deeplearning4j_tpu.parallel.tensor_parallel is deprecated; TP "
+    "rule tables live in parallel.mesh (TP_RULE_FAMILIES) and training "
+    "uses Trainer(layout='tp2'/'dp2xtp2') — docs/PARALLELISM.md",
+    DeprecationWarning, stacklevel=2)

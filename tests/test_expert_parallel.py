@@ -10,7 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from deeplearning4j_tpu.parallel.expert_parallel import (
+from deeplearning4j_tpu.parallel.unified import (
     init_moe_params, moe_ffn, moe_ffn_dense, shard_moe_params,
     _dispatch_tensors, _top_k_gates)
 from deeplearning4j_tpu.parallel.mesh import make_mesh

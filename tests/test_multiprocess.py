@@ -202,7 +202,7 @@ class TestResumableIterator:
     def test_ring_attention_head_axis_divisibility(self):
         import jax.numpy as jnp
         from deeplearning4j_tpu.parallel.mesh import make_mesh
-        from deeplearning4j_tpu.parallel.context_parallel import ring_attention
+        from deeplearning4j_tpu.parallel.unified import ring_attention
         mesh = make_mesh(data=1, model=2, seq=4)
         q = jnp.zeros((2, 16, 24), jnp.float32)   # 3 heads × dh 8
         with pytest.raises(ValueError):

@@ -90,7 +90,16 @@ def test_bench_skip_path_runs_cpu_records_and_exits_zero(monkeypatch,
         bench, "bench_multichip",
         lambda: {"metric": "multichip_scaling_efficiency", "value": 0.8,
                  "per_chip_scaling_efficiency": 0.8,
-                 "straggler_skew": 1.1, "n_workers": 4})
+                 "straggler_skew": 1.1, "n_workers": 4,
+                 "mesh_sweep": {
+                     "metric": "mesh_layout_sweep",
+                     "layouts": {
+                         "dp4": {"steps_per_s": 280.0,
+                                 "arith_intensity": 7.5,
+                                 "collective_bytes_per_step": 506928},
+                         "dp2xpp2": {"steps_per_s": 90.0,
+                                     "arith_intensity": 1.5,
+                                     "collective_bytes_per_step": 806976}}}})
     monkeypatch.setattr(
         bench, "bench_online",
         lambda: {"metric": "online_feedback_to_deploy_seconds",
@@ -130,6 +139,15 @@ def test_bench_skip_path_runs_cpu_records_and_exits_zero(monkeypatch,
     multichip = record["detail"]["multichip"]
     assert multichip["per_chip_scaling_efficiency"] == 0.8
     assert multichip["straggler_skew"] == 1.1
+    # ... and the ISSUE-14 unified-mesh layout sweep rides inside the
+    # multichip record on both paths: per-layout steps/s + collective
+    # bytes + cost-model arith intensity stay CPU-measurable
+    sweep = multichip["mesh_sweep"]
+    assert set(sweep["layouts"]) == {"dp4", "dp2xpp2"}
+    for row in sweep["layouts"].values():
+        assert row["steps_per_s"] > 0
+        assert row["collective_bytes_per_step"] > 0
+        assert "arith_intensity" in row
     # ... and so does the continual-learning loop row: feedback→deploy
     # latency, gate eval seconds and rollback MTTR are CPU-measurable
     online = record["detail"]["online"]

@@ -12,7 +12,7 @@ import numpy as np
 import pytest
 
 from deeplearning4j_tpu.ops.pallas import flash_attention, flash_attention_block
-from deeplearning4j_tpu.parallel.context_parallel import (
+from deeplearning4j_tpu.parallel.unified import (
     _block_attention, reference_attention, ring_attention)
 from deeplearning4j_tpu.parallel.mesh import make_mesh
 

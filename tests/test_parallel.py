@@ -11,9 +11,9 @@ from deeplearning4j_tpu.parallel import (
     bitmap_encode, bitmap_decode, EncodedGradientsAccumulator,
     ParallelInference,
 )
-from deeplearning4j_tpu.parallel.context_parallel import ring_attention, reference_attention
+from deeplearning4j_tpu.parallel.unified import ring_attention, reference_attention
 from deeplearning4j_tpu.parallel.pipeline import pipeline_apply
-from deeplearning4j_tpu.parallel import tensor_parallel as tp
+from deeplearning4j_tpu.parallel import mesh as tp
 from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
 from deeplearning4j_tpu.data.dataset import DataSet
 from deeplearning4j_tpu.nn import NeuralNetConfiguration, InputType
@@ -45,7 +45,7 @@ def test_make_mesh_axes():
     mesh = make_mesh()
     assert mesh.shape["data"] == 8
     mesh2 = make_mesh(data=2, model=2, seq=2)
-    assert mesh2.shape == {"stage": 1, "data": 2, "seq": 2, "expert": 1,
+    assert mesh2.shape == {"pipe": 1, "data": 2, "seq": 2, "expert": 1,
                            "model": 2}
     with pytest.raises(ValueError):
         make_mesh(data=3, model=3)
@@ -137,7 +137,7 @@ def test_zero_sharding_rejects_averaging_mode():
 
 @pytest.mark.parametrize("causal", [False, True])
 def test_ulysses_attention_matches_reference(causal):
-    from deeplearning4j_tpu.parallel.context_parallel import ulysses_attention
+    from deeplearning4j_tpu.parallel.unified import ulysses_attention
     mesh = make_mesh(data=1, seq=4, devices=jax.devices()[:4])
     b, t, heads, dh = 2, 32, 8, 8    # heads % seq-axis == 0
     rng = np.random.default_rng(4)
@@ -153,7 +153,7 @@ def test_ulysses_attention_matches_reference(causal):
 
 
 def test_ulysses_dp_combo_and_validation():
-    from deeplearning4j_tpu.parallel.context_parallel import ulysses_attention
+    from deeplearning4j_tpu.parallel.unified import ulysses_attention
     mesh = make_mesh(data=2, seq=4, devices=jax.devices()[:8])
     b, t, heads, dh = 4, 16, 4, 4
     rng = np.random.default_rng(5)

@@ -242,7 +242,7 @@ class TestStageLocalOptimizer:
         tx = optax.adam(1e-2)
         flat, unravels, sizes = flatten_stage_params(params)
         from jax.sharding import NamedSharding, PartitionSpec as P
-        flat = jax.device_put(flat, NamedSharding(mesh, P("stage")))
+        flat = jax.device_put(flat, NamedSharding(mesh, P("pipe")))
         opt = init_stage_local_opt(tx, flat, mesh)
         return mesh, fns, params, x, y, tx, flat, unravels, sizes, opt
 
@@ -370,11 +370,11 @@ class TestVmaSwitchRegression:
         branches = [mk(i) for i in range(S)]
 
         def local(x):
-            idx = lax.axis_index("stage")
+            idx = lax.axis_index("pipe")
             outs = lax.switch(idx, branches, x[0])
-            return tuple(lax.psum(o, "stage") for o in outs)
+            return tuple(lax.psum(o, "pipe") for o in outs)
 
-        y = shard_map(local, mesh=mesh, in_specs=(P("stage"),),
+        y = shard_map(local, mesh=mesh, in_specs=(P("pipe"),),
                       out_specs=tuple(P() for _ in range(S)),
                       check_vma=True)(jnp.arange(S, dtype=jnp.float32))
         np.testing.assert_allclose([float(v) for v in y],

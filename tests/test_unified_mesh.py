@@ -1,0 +1,319 @@
+"""Unified device mesh (ISSUE 14): composable DP×TP×PP layouts behind
+ONE Trainer flag.
+
+The load-bearing contract: every layout reproduces the single-device
+run — per-step losses and final params to 1e-6, dropout ACTIVE — with
+exactly one compiled step per layout, and the layout is a first-class
+part of the program's identity (step-cache key, artifact store,
+tpudl_mesh_* gauges).  The deprecated per-mode entry points warn once
+and route here.
+"""
+
+import importlib
+import json
+import sys
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.obs.registry import get_registry
+from deeplearning4j_tpu.parallel import mesh as mesh_mod
+from deeplearning4j_tpu.parallel.mesh import (
+    AXIS_DATA, AXIS_MODEL, AXIS_PIPE, MESH_AXES, MeshSpec, make_mesh,
+    resolve_layout)
+from deeplearning4j_tpu.train import Sgd
+from deeplearning4j_tpu.train.trainer import Trainer
+
+
+def _mlp(seed=11, dropout=True):
+    drop = 0.8 if dropout else None
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=16, activation="relu", dropout=drop))
+            .layer(DenseLayer(n_out=16, activation="tanh", dropout=drop))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    return MultiLayerNetwork(conf)
+
+
+def _data(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 4)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[np.argmax(x @ w, -1)]
+    return x, y
+
+
+def _run(layout=None, dropout=True, n_microbatches=1, epochs=2):
+    """Fit and return (per-step losses, flat final params, retraces)."""
+    x, y = _data()
+    net = _mlp(dropout=dropout)
+    trainer = Trainer(net, layout=layout, n_microbatches=n_microbatches)
+    losses = []
+
+    class Rec:
+        def iteration_done(self, net, it, ep, loss):
+            losses.append(float(loss))
+
+    trainer.bus.listeners.append(Rec())
+    reg = get_registry()
+    before = reg.counter("tpudl_train_recompiles_total").value
+    trainer.fit(ArrayDataSetIterator(x, y, 16, shuffle=False), epochs=epochs)
+    retraced = reg.counter("tpudl_train_recompiles_total").value - before
+    return losses, np.asarray(net.params()), retraced
+
+
+# one baseline per module — every layout case compares against it
+_BASELINE = {}
+
+
+def _baseline(dropout):
+    if dropout not in _BASELINE:
+        _BASELINE[dropout] = _run(None, dropout=dropout)
+    return _BASELINE[dropout]
+
+
+@pytest.mark.parametrize("layout", ["dp2", "tp2", "dp2xtp2", "pp2"])
+def test_layout_matches_single_device_with_dropout(layout):
+    """The satellite contract: DP=2, TP=2, DP×TP=2×2 and PP=2 layouts
+    all reproduce the single-device per-step losses and final params to
+    1e-6 with dropout active, one compile per layout."""
+    base_losses, base_params, _ = _baseline(True)
+    losses, params, retraced = _run(layout)
+    assert len(losses) == len(base_losses)
+    np.testing.assert_allclose(losses, base_losses, rtol=0, atol=1e-6)
+    np.testing.assert_allclose(params, base_params, rtol=0, atol=1e-6)
+    # one compiled step per layout: the first step traced, nothing after
+    assert retraced == 1, f"{layout} retraced {retraced} times"
+
+
+@pytest.mark.parametrize("layout,mb", [
+    pytest.param("dp2xpp2", 2, marks=pytest.mark.slow),
+    ("dp2xtp2xpp2", 2),
+])
+def test_composed_pipe_layouts_match_single_device(layout, mb):
+    """DP×PP and the full DP×TP×PP composition on one 8-device mesh:
+    real 1F1B microbatching (M=2) + batch shards + model-axis param
+    shards, still 1e-6 against single-device (dropout off — per-layer
+    masks regenerate per microbatch shape at M>1, documented).  The
+    full composition runs tier-1 (it exercises every axis at once);
+    the DP×PP-only case is @slow (suite-wall budget)."""
+    base_losses, base_params, _ = _baseline(False)
+    losses, params, retraced = _run(layout, dropout=False, n_microbatches=mb)
+    np.testing.assert_allclose(losses, base_losses, rtol=0, atol=1e-6)
+    np.testing.assert_allclose(params, base_params, rtol=0, atol=1e-6)
+    assert retraced == 1
+
+
+def test_pp_params_actually_shard_and_metrics_publish():
+    """dp2xtp2xpp2 is real parallelism, not a relabeling: model-axis
+    leaves live sharded (each device holds 1/tp of dim 0), and the
+    tpudl_mesh_* gauges describe the active layout."""
+    x, y = _data()
+    net = _mlp(dropout=False)
+    trainer = Trainer(net, layout="dp2xtp2xpp2", n_microbatches=2)
+    trainer.fit(ArrayDataSetIterator(x, y, 16, shuffle=False), epochs=1)
+    w0 = net.params_[0]["W"]          # [8, 16] — dim0 divisible by tp=2
+    assert str(w0.sharding.spec) == str(jax.sharding.PartitionSpec("model"))
+    shard = w0.addressable_shards[0]
+    assert shard.data.shape[0] == w0.shape[0] // 2
+    reg = get_registry()
+    assert reg.gauge("tpudl_mesh_devices").value == 8
+    axis = reg.labeled_gauge("tpudl_mesh_axis_size", label_names=("axis",))
+    assert axis.labeled_value(axis=AXIS_DATA) == 2
+    assert axis.labeled_value(axis=AXIS_MODEL) == 2
+    assert axis.labeled_value(axis=AXIS_PIPE) == 2
+    layout_g = reg.labeled_gauge("tpudl_mesh_layout_active",
+                                 label_names=("layout",))
+    assert layout_g.labeled_value(layout="dp2xtp2xpp2") == 1
+    assert reg.gauge("tpudl_mesh_collective_bytes").value > 0
+
+
+def test_layout_signature_separates_step_cache_keys():
+    """A sharded layout's step is a different program: its step-cache
+    key (and therefore its artifact-store identity) must differ from
+    the single-device sibling AND from a different layout."""
+    net = _mlp()
+    keys = set()
+    for layout in (None, "dp2", "dp2xtp2"):
+        t = Trainer(net, layout=layout)
+        keys.add(t._step_key("train"))
+    assert len(keys) == 3
+
+
+# ------------------------------------------------------------ MeshSpec
+def test_meshspec_parse_roundtrip_and_errors():
+    spec = MeshSpec.parse("dp2xtp2xpp2")
+    assert spec.sizes() == {"data": 2, "model": 2, "pipe": 2, "seq": 1,
+                            "expert": 1}
+    assert spec.describe() == "dp2xtp2xpp2"
+    assert MeshSpec.parse("data4_model2").describe() == "dp4xtp2"
+    assert MeshSpec().describe() == "single"
+    for bad in ("bogus3", "dp2xdp4", "xx", ""):
+        with pytest.raises(ValueError):
+            MeshSpec.parse(bad)
+
+
+def test_make_mesh_pipe_axis_and_stage_alias():
+    mesh = make_mesh(data=2, pipe=2, devices=jax.devices()[:4])
+    assert mesh.shape[AXIS_PIPE] == 2
+    legacy = make_mesh(data=2, stage=2, devices=jax.devices()[:4])
+    assert legacy.shape == mesh.shape
+    assert tuple(mesh.axis_names) == MESH_AXES
+    with pytest.raises(ValueError):
+        make_mesh(data=2, pipe=3, stage=2, devices=jax.devices()[:4])
+
+
+def test_resolve_layout_rules():
+    from deeplearning4j_tpu.parallel.mesh import MeshLayout
+    assert resolve_layout() is None
+    assert resolve_layout(layout="dp1") is None      # trivial → single path
+    # the trivial→None contract holds for a pre-resolved MeshLayout too
+    # (a 1-device layout must not grow a distinct cache signature)
+    trivial = MeshLayout(MeshSpec(), devices=jax.devices()[:1])
+    assert resolve_layout(layout=trivial) is None
+    # a typo'd TP family raises instead of silently replicating
+    with pytest.raises(ValueError, match="unknown TP rule family"):
+        MeshLayout(MeshSpec(model=2), tp_family="brt",
+                   devices=jax.devices()[:2])
+    lay = resolve_layout(layout="dp2")
+    assert lay.data == 2 and lay.describe() == "dp2"
+    mesh = make_mesh(data=4, devices=jax.devices()[:4])
+    assert resolve_layout(mesh=mesh).data == 4
+    with pytest.raises(ValueError, match="disagrees"):
+        resolve_layout(mesh=mesh, layout="dp2")
+    with pytest.raises(ValueError, match="needs"):
+        resolve_layout(layout="dp64")
+
+
+def test_pp_layout_rejects_unsupported_nets():
+    from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1)).list()
+            .layer(LSTM(n_out=8))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(4)).build())
+    net = MultiLayerNetwork(conf)
+    x = np.zeros((4, 6, 4), np.float32)
+    y = np.zeros((4, 6, 2), np.float32)
+    trainer = Trainer(net, layout="pp2")
+    with pytest.raises(ValueError, match="recurrent"):
+        trainer.fit_batch(__import__(
+            "deeplearning4j_tpu.data.dataset",
+            fromlist=["DataSet"]).DataSet(x, y), jax.random.key(0))
+
+
+# ------------------------------------------------------- analyze layouts
+def test_check_layout_static_validation():
+    from deeplearning4j_tpu.analyze.sharding import check_layout
+    assert check_layout("dp2xtp2xpp2", n_devices=8).exit_code() == 0
+    report = check_layout("dp64", n_devices=8)
+    assert report.by_rule("TPU201")
+    report = check_layout("nope2", n_devices=8)
+    assert report.by_rule("TPU201")
+    report = check_layout("tp2", tp_family="mystery", n_devices=8)
+    assert report.by_rule("TPU203")
+    # a model axis whose family never shards over it = silent replication
+    mesh_mod.TP_RULE_FAMILIES["_norule"] = [
+        (r"nothing$", jax.sharding.PartitionSpec())]
+    try:
+        report = check_layout("tp2", tp_family="_norule", n_devices=8)
+        assert report.by_rule("TPU202")
+    finally:
+        del mesh_mod.TP_RULE_FAMILIES["_norule"]
+
+
+def test_analyze_cli_model_plus_layout(tmp_path):
+    """`analyze --model <conf> --layout dp2xtp2` gates a model and its
+    layout together — zero TPU2xx on the shipped configuration."""
+    from deeplearning4j_tpu.analyze.__main__ import main as analyze_main
+    conf = _mlp().conf
+    path = tmp_path / "conf.json"
+    path.write_text(conf.to_json())
+    assert analyze_main(["--model", str(path), "--layout", "dp2xtp2",
+                         "--devices", "8"]) == 0
+    assert analyze_main(["--layout", "dp64", "--devices", "8"]) == 1
+
+
+# ------------------------------------------------------- deprecation shims
+@pytest.mark.parametrize("module,names", [
+    ("tensor_parallel", ("BERT_TP_RULES", "shard_params",
+                         "tp_sharding_tree", "rule_axes", "tp_jit")),
+    ("context_parallel", ("ring_attention", "ulysses_attention",
+                          "reference_attention")),
+    ("expert_parallel", ("moe_ffn", "moe_ffn_dense", "init_moe_params",
+                         "shard_moe_params")),
+    ("data_parallel", ("ParallelWrapper", "DATA_AXES")),
+])
+def test_deprecated_entry_points_warn_once_and_route(module, names):
+    """The shim contract: importing an old per-mode module raises ONE
+    DeprecationWarning and every public name still works, routed to the
+    unified implementations."""
+    modname = f"deeplearning4j_tpu.parallel.{module}"
+    sys.modules.pop(modname, None)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        mod = importlib.import_module(modname)
+    dep = [w for w in caught if issubclass(w.category, DeprecationWarning)
+           and "deprecated" in str(w.message)]
+    assert len(dep) == 1, f"{module}: expected exactly one warning"
+    for name in names:
+        assert hasattr(mod, name), f"{module}.{name} missing from shim"
+    # routed, not copied: the shim's callables ARE the unified ones
+    from deeplearning4j_tpu.parallel import mesh, unified
+    if module == "tensor_parallel":
+        assert mod.shard_params is mesh.shard_params
+        assert mod.tp_jit is unified.tp_jit
+    if module == "context_parallel":
+        assert mod.ring_attention is unified.ring_attention
+    if module == "expert_parallel":
+        assert mod.moe_ffn is unified.moe_ffn
+
+
+def test_parallel_package_reexports_without_warning():
+    import subprocess
+    code = ("import warnings; warnings.simplefilter('error', "
+            "DeprecationWarning); import deeplearning4j_tpu.parallel as p; "
+            "print(p.ring_attention.__module__, p.moe_ffn.__module__)")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    assert "unified" in proc.stdout
+
+
+# ------------------------------------------------------------ mesh sweep
+def test_mesh_sweep_reports_per_layout_rows(monkeypatch, capsys):
+    """The bench/multichip.py mesh_sweep record: same model under
+    multiple layouts, steps/s + collective-bytes estimate + per-layout
+    arith intensity from the cost model."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "multichip_sweep",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench", "multichip.py"))
+    mc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mc)
+    monkeypatch.setenv("DL4J_TPU_MESH_SWEEP_LAYOUTS", "dp2")
+    monkeypatch.setenv("DL4J_TPU_MESH_SWEEP_STEPS", "2")
+    from deeplearning4j_tpu.config import set_config
+    try:
+        assert mc.mesh_sweep_main() == 0
+    finally:
+        set_config(device_feed=True)
+    record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert record["metric"] == "mesh_layout_sweep"
+    assert set(record["layouts"]) == {"dp2"}
+    for name, row in record["layouts"].items():
+        assert row.get("steps_per_s", 0) > 0, row
+        assert row["collective_bytes_per_step"] > 0
+        assert row["layout"] == name
+    assert record["single_device"]["steps_per_s"] > 0
+    # the cost model stamped at least the arith intensity per layout
+    assert any("arith_intensity" in r for r in record["layouts"].values())
