@@ -553,6 +553,32 @@ def bench_multichip(timeout_s: float = 900.0) -> dict:
     return _cpu_subbench("multichip.py", timeout_s)
 
 
+def _tunnel_shaped(message: str) -> bool:
+    """Does this failure text mean "the accelerator was unreachable"
+    (→ structured skip) rather than "the bench harness is broken"
+    (→ rc=1 error)?  Shares the marker list with the trajectory
+    sentinel so the writer and the reader agree on what a tunnel-down
+    looks like."""
+    try:
+        from deeplearning4j_tpu.obs.trend import looks_tunnel_down
+        return looks_tunnel_down(message)
+    except Exception:
+        return "tunnel" in (message or "").lower()
+
+
+def _stamp_trend(record: dict) -> dict:
+    """Write-time trajectory verdict: every new bench record carries
+    its own stale/ok/regression classification against the committed
+    BENCH_r* history (``record["trend"]``).  Best-effort by contract —
+    a missing trajectory costs the stamp, never the record."""
+    try:
+        from deeplearning4j_tpu.obs import trend
+        trend.stamp_verdict(record)
+    except Exception:
+        pass
+    return record
+
+
 def _probe_device(timeout_s: float = 30.0) -> tuple[str, str] | None:
     """Touch the accelerator in a SUBPROCESS with a hard timeout: a down
     TPU tunnel makes backend init HANG (not raise) in some environments
@@ -575,8 +601,19 @@ def _probe_device(timeout_s: float = 30.0) -> tuple[str, str] | None:
         return ("skipped",
                 f"device probe timed out after {timeout_s:.0f}s (tunnel down?)")
     if p.returncode != 0:
+        stderr = p.stderr.decode()[-400:]
+        if _tunnel_shaped(stderr):
+            # the probe ANSWERED, but with a tunnel-shaped failure
+            # (connection refused / deadline exceeded): same verdict as
+            # a hang — nothing TPU-measurable, structured skip, rc=0.
+            # BENCH_r05 took this exact situation to an rc=1 with
+            # value 0.0 and no status key; the skip contract says a 0.0
+            # must never read as a measurement.
+            return ("skipped",
+                    f"TPU tunnel down at probe (rc={p.returncode}): "
+                    f"{stderr[-200:]}")
         return ("error", f"device probe failed (rc={p.returncode}): "
-                         f"{p.stderr.decode()[-200:]}")
+                         f"{stderr[-200:]}")
     answer = p.stdout.decode().strip()
     if answer.startswith("cpu"):
         return ("skipped",
@@ -624,10 +661,11 @@ def main():
                     detail[key] = record.get(key)
                 detail["perf"] = record.get("perf")
                 break
-        print(json.dumps({"metric": "resnet50_train_images_per_sec_per_chip",
-                          "value": 0.0, "unit": "images/sec/chip",
-                          "vs_baseline": 0.0, "status": status, "error": err,
-                          "detail": detail}))
+        print(json.dumps(_stamp_trend(
+            {"metric": "resnet50_train_images_per_sec_per_chip",
+             "value": 0.0, "unit": "images/sec/chip",
+             "vs_baseline": 0.0, "status": status, "error": err,
+             "detail": detail})))
         return 0 if status == "skipped" else 1
     batch = 256  # HBM-bound workload: large batch amortizes weight traffic
                  # (see bench/PROFILE.md; 256 ≈ saturation point on v5e)
@@ -678,20 +716,29 @@ def main():
                     costmodel.top_programs(5)
             except Exception:
                 pass
-            print(json.dumps(result))
+            print(json.dumps(_stamp_trend(result)))
             return 0
         except Exception as e:  # OOM etc. → halve the batch and retry
             msg = str(e)
             if "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower():
                 batch //= 2
                 continue
-            print(json.dumps({"metric": "resnet50_train_images_per_sec_per_chip",
-                              "value": 0.0, "unit": "images/sec/chip",
-                              "vs_baseline": 0.0, "error": msg[:400]}))
-            return 1
-    print(json.dumps({"metric": "resnet50_train_images_per_sec_per_chip",
-                      "value": 0.0, "unit": "images/sec/chip",
-                      "vs_baseline": 0.0, "error": "OOM at batch>=64"}))
+            # a tunnel that DIES MID-RUN is the same verdict as one
+            # that never answered: structured skip, rc=0 (BENCH_r05
+            # recorded this very case as rc=1/value 0.0 — the shape
+            # trend.py must special-case forever as "legacy")
+            status = "skipped" if _tunnel_shaped(msg) else "error"
+            print(json.dumps(_stamp_trend(
+                {"metric": "resnet50_train_images_per_sec_per_chip",
+                 "value": 0.0, "unit": "images/sec/chip",
+                 "vs_baseline": 0.0, "status": status,
+                 "error": msg[:400], "detail": {}})))
+            return 0 if status == "skipped" else 1
+    print(json.dumps(_stamp_trend(
+        {"metric": "resnet50_train_images_per_sec_per_chip",
+         "value": 0.0, "unit": "images/sec/chip",
+         "vs_baseline": 0.0, "status": "error",
+         "error": "OOM at batch>=64", "detail": {}})))
     return 1
 
 

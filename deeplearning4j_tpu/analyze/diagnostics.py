@@ -320,6 +320,25 @@ _RULE_LIST = [
         "mesh import AXIS_DATA, AXIS_MODEL, AXIS_PIPE) or take the "
         "axis name as a parameter defaulted to one; only "
         "parallel/mesh.py itself spells the strings."),
+    RuleInfo(
+        "TPU318", "adhoc-latency-measurement", ERROR,
+        "time.time()/perf_counter() delta computed in a serving/"
+        "step-path function without ever reaching a registry "
+        "histogram/gauge (obs/ measurement modules exempt)",
+        "SLO burn-rate evaluation (obs.slo) judges availability and "
+        "latency objectives from registry snapshots ONLY — a latency "
+        "measured into a raw float (printed, compared against a local "
+        "threshold, returned bare) is invisible to every error budget "
+        "and every /metrics scrape.  Each ad-hoc stopwatch is a "
+        "measurement the fleet dashboard silently lacks; five of them "
+        "are five different definitions of 'latency' that never "
+        "reconcile.  Cadence checks against stored state (now - "
+        "self._last_save) are not measurements and do not flag.",
+        "Observe the delta into the metric family the SLO reads "
+        "(reg.histogram('tpudl_serve_latency_seconds').observe(dt), a "
+        "tpudl_*_seconds histogram, or a gauge.set) or hand it to the "
+        "buffered cluster router (notify_step) — then delete the raw "
+        "float."),
     # ---- concurrency (AST, whole-repo thread model) -------------------
     RuleInfo(
         "TPU400", "bad-suppression", ERROR,

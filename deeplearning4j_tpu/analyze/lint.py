@@ -26,7 +26,11 @@ or eager lower().compile() inside a deploy/resume/respawn-path
 function — restart paths warm from the compiled-artifact store, they
 don't compile), TPU316 (registry.deploy/hot_swap called from
 router-scoped code — a router-managed model swaps only through the
-atomic fan-out, never a single-engine registry deploy).
+atomic fan-out, never a single-engine registry deploy), TPU317
+(hardcoded mesh-axis string outside parallel/mesh.py), TPU318 (ad-hoc
+latency measurement in serving/step-path code — a time delta that
+never reaches a registry histogram/gauge is invisible to SLO burn-rate
+evaluation).
 Registry-backed rules that ride along in ``lint_package``/``--self``:
 TPU305 (metric names — the former ``obs.check`` lint) and TPU306
 (op-spec catalog integrity).
@@ -1294,6 +1298,83 @@ def _rule_hardcoded_axis_name(mod: ModuleInfo) -> list[Diagnostic]:
                     f"axis name {lit!r} hardcoded in {name}(...) — the "
                     f"mesh axis vocabulary is declared once in "
                     f"parallel.mesh.MESH_AXES; {fix}",
+                    path=mod.anchor(node)))
+    return out
+
+
+# registry metric sinks: a measured latency is "routed" when some call
+# in the function feeds a value into a histogram ``observe(dt)`` or a
+# gauge ``set(v)`` (the registry accessor idiom
+# ``reg.histogram(...).observe(dt)``).  Zero-arg ``.set()`` calls are
+# threading.Event.set, not a metric write.  ``notify_step`` is the
+# buffered cluster router's ingest — durations handed to it land in
+# the tpudl_cluster_* family, so it counts as routed too.
+_METRIC_SINK_ATTRS = {"observe", "set"}
+_METRIC_SINK_NAMES = {"notify_step"}
+
+
+@register_lint_rule("TPU318")
+def _rule_adhoc_latency_measurement(mod: ModuleInfo) -> list[Diagnostic]:
+    """``time.time()``/``perf_counter()`` deltas computed inside a
+    serving/step-path function that never feeds a registry
+    histogram/gauge: the SLO evaluator (obs.slo) judges burn rates from
+    registry snapshots ONLY, so a latency measured into a raw float —
+    printed, compared against a local threshold, returned bare — is
+    invisible to every budget.  The obs/ measurement layer itself is
+    exempt (it IS the plumbing these deltas are supposed to reach)."""
+    norm = mod.path.replace(os.sep, "/")
+    if "/obs/" in norm or norm.startswith("obs/"):
+        return []
+    out = []
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        tokens = set(fn.name.lower().strip("_").split("_"))
+        if fn.name not in _HTTP_HANDLER_NAMES:
+            if not tokens & (_SERVING_TOKENS | _STEP_PATH_TOKENS) \
+                    or tokens & _BUILDER_TOKENS:
+                continue
+        fence_names: set[str] = set()
+        deltas: list[ast.BinOp] = []
+        has_sink = False
+        for node in _walk_shallow(fn):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and mod.is_time_fence(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        fence_names.add(tgt.id)
+            elif isinstance(node, ast.Call):
+                f = node.func
+                attr = f.attr if isinstance(f, ast.Attribute) else \
+                    f.id if isinstance(f, ast.Name) else None
+                if attr in _METRIC_SINK_NAMES \
+                        or (attr in _METRIC_SINK_ATTRS
+                            and isinstance(f, ast.Attribute)
+                            and (node.args or node.keywords)):
+                    has_sink = True
+
+        def _is_stamp(expr: ast.expr) -> bool:
+            return ((isinstance(expr, ast.Call) and mod.is_time_fence(expr))
+                    or (isinstance(expr, ast.Name)
+                        and expr.id in fence_names))
+
+        for node in _walk_shallow(fn):
+            # BOTH operands must be fence stamps: now - t0 is a latency;
+            # now - self._last_X is a cadence/cooldown check against
+            # stored state, which is not a measurement at all
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub) \
+                    and _is_stamp(node.left) and _is_stamp(node.right):
+                deltas.append(node)
+        if deltas and not has_sink:
+            for node in deltas:
+                out.append(Diagnostic(
+                    "TPU318",
+                    f"ad-hoc latency measurement in serving/step-path "
+                    f"'{fn.name}' — the time delta never reaches a "
+                    f"registry histogram/gauge, so SLO burn-rate "
+                    f"evaluation cannot see it; observe() it into the "
+                    f"metric family the SLO reads",
                     path=mod.anchor(node)))
     return out
 

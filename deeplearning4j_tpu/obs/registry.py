@@ -139,6 +139,15 @@ class _LabeledMixin:
         with self._lock:
             return self._children.get(self._key(labels), 0.0)
 
+    def child_values(self) -> dict:
+        """Snapshot of every child as ``{label_tuple: value}`` (label
+        values in declared order).  Readers that judge whole families —
+        the SLO evaluator sweeping per-worker freshness gauges — use
+        this instead of guessing label values one at a time."""
+        with self._lock:
+            return {k: (v.count if isinstance(v, Histogram) else v)
+                    for k, v in self._children.items()}
+
     def _series(self, key: tuple) -> str:
         pairs = ",".join(f'{n}="{_escape_label(v)}"'
                          for n, v in zip(self.label_names, key))
@@ -458,6 +467,9 @@ def install_standard_metrics(registry: Optional[MetricsRegistry] = None) -> dict
         r.histogram("tpudl_train_step_seconds",
                     "Wall time per training step (sync-inclusive when "
                     "tracing is on, dispatch-only otherwise)"),
+        r.histogram("tpudl_train_epoch_seconds",
+                    "Wall time per completed epoch (fit loop, feed "
+                    "included)"),
         r.gauge("tpudl_train_compile_seconds",
                 "Wall time of the most recent first-call (trace+compile) "
                 "step through a jit boundary"),
@@ -781,6 +793,25 @@ def install_standard_metrics(registry: Optional[MetricsRegistry] = None) -> dict
         r.gauge("tpudl_health_loss_zscore",
                 "Robust z-score (median/MAD) of the most recent loss "
                 "against the rolling window"),
+        r.counter("tpudl_slo_evaluations_total",
+                  "SLO evaluator passes (every registered objective "
+                  "judged once per pass)"),
+        r.labeled_counter("tpudl_slo_breaches_total",
+                          "Burn-rate breaches by objective (fired on "
+                          "the healthy→breached transition, re-armed "
+                          "when the burn clears)", ("slo",)),
+        r.labeled_gauge("tpudl_slo_burn_rate",
+                        "Worst-window error-budget burn rate per "
+                        "objective (1.0 = burning exactly the budget; "
+                        "the fast-window page threshold is 14.4)",
+                        ("slo",)),
+        r.labeled_gauge("tpudl_slo_budget_remaining",
+                        "Fraction of the error budget left over the "
+                        "longest configured window per objective "
+                        "(1.0 = untouched, <=0 = exhausted)", ("slo",)),
+        r.labeled_gauge("tpudl_slo_healthy",
+                        "1 while the objective's burn is below every "
+                        "window threshold, 0 while breached", ("slo",)),
     ]
     return {m.name: m for m in metrics}
 

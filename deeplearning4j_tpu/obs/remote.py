@@ -62,6 +62,8 @@ STEP_WINDOW = 64
 # restart annotations kept for the /cluster dashboard
 RESTART_ANNOTATIONS = 64
 
+DASHBOARD_ANNOTATIONS = 64
+
 
 def _jsonable(value: Any) -> Any:
     """Best-effort JSON coercion at FLUSH time — device scalars are
@@ -352,6 +354,7 @@ class ClusterStore:
                  min_straggler_samples: int = 4):
         self._workers: dict[str, _WorkerState] = {}
         self._restarts: deque = deque(maxlen=RESTART_ANNOTATIONS)
+        self._annotations: deque = deque(maxlen=DASHBOARD_ANNOTATIONS)
         self._lock = threading.Lock()
         self.straggler_factor = float(straggler_factor)
         self.min_straggler_samples = int(min_straggler_samples)
@@ -549,15 +552,30 @@ class ClusterStore:
                     "resumed_iteration": s.resumed_iteration,
                 }
             restarts = list(self._restarts)
+            annotations = list(self._annotations)
         return {"n_workers": len(workers),
                 "straggler_skew": self.straggler_skew(),
                 "workers": workers,
-                "restarts": restarts}
+                "restarts": restarts,
+                "annotations": annotations}
 
     def records_for(self, worker: str) -> list:
         with self._lock:
             state = self._workers.get(worker)
             return list(state.records) if state else []
+
+    # -------------------------------------------------------- annotations
+    def annotate(self, kind: str, message: str, **facts) -> dict:
+        """Pin an event onto the ``/cluster`` dashboard timeline (SLO
+        breaches from :class:`~deeplearning4j_tpu.obs.slo.SLOMonitor`,
+        deploy markers, operator notes).  Facts ride verbatim into
+        ``/cluster.json`` for machine consumers; the HTML view renders
+        the timestamped message."""
+        note = {"kind": str(kind), "message": str(message),
+                "time": time.time(), **facts}
+        with self._lock:
+            self._annotations.append(note)
+        return note
 
     # -------------------------------------------------------------- html
     def render_html(self, refresh_seconds: int = 5) -> str:
@@ -600,6 +618,20 @@ class ClusterStore:
                     f"supervisor incident for generation "
                     f"{r['from_generation']}</li>")
             notes = ("<h2>Restarts</h2><ul>" + "".join(items) + "</ul>")
+        # dashboard annotations: SLO breaches / deploy markers / operator
+        # notes pinned by ClusterStore.annotate (an slo_breach annotation
+        # pairs with the flight dump whose reason is slo:<name> — see
+        # docs/observability.md "SLOs & error budgets")
+        if summary["annotations"]:
+            import datetime
+            items = []
+            for a in summary["annotations"]:
+                stamp = datetime.datetime.fromtimestamp(
+                    a["time"]).strftime("%H:%M:%S")
+                items.append(
+                    f"<li>{stamp} — [{_html.escape(str(a['kind']))}] "
+                    f"{_html.escape(str(a['message']))}</li>")
+            notes += ("<h2>Annotations</h2><ul>" + "".join(items) + "</ul>")
         return (
             f"<html><head><meta charset='utf-8'>{refresh}"
             f"<title>Cluster telemetry</title>"

@@ -1,0 +1,464 @@
+"""Declarative SLOs with multi-window burn-rate evaluation.
+
+The stack measures everything — serve request outcomes, end-to-end
+latency histograms, per-worker gang liveness — but a metric is not a
+verdict.  This module adds the verdict layer: declarative
+:class:`SLO` objects evaluated from registry snapshots by a background
+:class:`SLOMonitor`, using the multi-window multi-burn-rate method
+(page when the error budget burns faster than threshold on BOTH a
+short and a long window — the short window gives detection speed, the
+long window keeps a transient blip from paging).
+
+``burn_rate = bad_fraction / (1 - target)``: 1.0 means the budget is
+being spent exactly at the sustainable rate; 14.4 over 5 minutes means
+a 30-day budget would be gone in ~2 days.  Defaults follow the classic
+fast (5m + 1h @ 14.4) / slow (30m + 6h @ 6.0) pairs; tests and tight
+deploy-watch loops pass their own window table and a fake clock.
+
+A breach (healthy → breached transition; re-armed when the burn
+clears) does four things:
+
+- publishes the ``tpudl_slo_*`` family (burn rate, budget remaining,
+  healthy flag, breach counter),
+- fires a flight-recorder dump with ``reason="slo:<name>"``,
+- annotates the ``/cluster`` dashboard when a :class:`ClusterStore`
+  is attached,
+- lands in :meth:`SLOMonitor.breach_count`, which ``DeployWatch``
+  polls so a post-deploy budget burn rides the existing rollback path.
+
+Counter resets (a restarted serving process re-zeroing its cumulative
+totals) are detected per objective and discard the pre-reset history
+instead of reading the negative delta as a recovery.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional, Sequence
+
+from . import flight_recorder
+from .registry import MetricsRegistry, get_registry
+
+log = logging.getLogger("tpudl.obs.slo")
+
+
+@dataclasses.dataclass(frozen=True)
+class BurnWindow:
+    """One page condition: burn above ``threshold`` on BOTH the short
+    and the long window."""
+
+    name: str
+    short_s: float
+    long_s: float
+    threshold: float
+
+
+# the classic multi-window pairs (Google SRE workbook ch.5): fast pages
+# on an acute burn, slow catches a persistent simmer
+DEFAULT_WINDOWS: tuple = (
+    BurnWindow("fast", 300.0, 3600.0, 14.4),
+    BurnWindow("slow", 1800.0, 21600.0, 6.0),
+)
+
+
+class SLO:
+    """One objective.  Subclasses read (bad, total) event counts from a
+    registry; ``cumulative`` says whether those counts are lifetime
+    totals (counters — the monitor diffs snapshots) or instantaneous
+    observations (gauge sweeps — the monitor accumulates them)."""
+
+    cumulative = True
+
+    def __init__(self, name: str, target: float, description: str = ""):
+        if not 0.0 < target < 1.0:
+            raise ValueError(f"SLO target must be in (0, 1), got {target}")
+        self.name = name
+        self.target = float(target)
+        self.description = description
+
+    def counts(self, registry: MetricsRegistry
+               ) -> Optional[tuple[float, float]]:
+        """(bad_events, total_events) right now, or None when the
+        backing metric does not exist in this registry yet."""
+        raise NotImplementedError
+
+    @property
+    def budget(self) -> float:
+        """Allowed bad fraction: 1 - target."""
+        return 1.0 - self.target
+
+
+class AvailabilitySLO(SLO):
+    """Request availability from the ``tpudl_serve_requests_total``
+    status counter: bad = error + expired outcomes."""
+
+    def __init__(self, name: str = "availability", target: float = 0.999,
+                 metric: str = "tpudl_serve_requests_total",
+                 bad_statuses: Sequence[str] = ("error", "expired"),
+                 good_statuses: Sequence[str] = ("ok",)):
+        super().__init__(name, target,
+                         f"fraction of requests ending ok (bad = "
+                         f"{'/'.join(bad_statuses)})")
+        self.metric = metric
+        self.bad_statuses = tuple(bad_statuses)
+        self.good_statuses = tuple(good_statuses)
+
+    def counts(self, registry):
+        m = registry.get(self.metric)
+        if m is None or not hasattr(m, "labeled_value"):
+            return None
+        bad = sum(m.labeled_value(status=s) for s in self.bad_statuses)
+        good = sum(m.labeled_value(status=s) for s in self.good_statuses)
+        return (bad, bad + good)
+
+
+class LatencySLO(SLO):
+    """Latency objective from cumulative histogram buckets: a request
+    is bad when it lands above ``threshold_s``.  The threshold snaps to
+    the smallest bucket upper bound >= ``threshold_s`` (bucket edges
+    are the only resolution a histogram has)."""
+
+    def __init__(self, name: str = "latency", target: float = 0.99,
+                 threshold_s: float = 0.5,
+                 metric: str = "tpudl_serve_latency_seconds"):
+        super().__init__(name, target,
+                         f"fraction of requests under {threshold_s:g}s")
+        self.metric = metric
+        self.threshold_s = float(threshold_s)
+
+    def counts(self, registry):
+        m = registry.get(self.metric)
+        if m is None or not hasattr(m, "bucket_counts"):
+            return None
+        buckets = m.bucket_counts()
+        if not buckets:
+            return None
+        total = buckets.get(math.inf, 0.0)
+        edges = [ub for ub in buckets if ub >= self.threshold_s]
+        good = buckets[min(edges)] if edges else 0.0
+        return (max(0.0, total - good), total)
+
+
+class FreshnessSLO(SLO):
+    """Gang liveness/freshness from per-worker last-seen gauges: a
+    worker is bad when its last report is older than ``max_age_s``.
+    Instantaneous — each evaluator pass contributes one observation per
+    worker to the budget stream."""
+
+    cumulative = False
+
+    def __init__(self, name: str = "gang_freshness", target: float = 0.99,
+                 max_age_s: float = 60.0,
+                 metric: str = "tpudl_cluster_worker_last_seen_time",
+                 wall_clock: Callable[[], float] = time.time):
+        super().__init__(name, target,
+                         f"fraction of workers reporting within "
+                         f"{max_age_s:g}s")
+        self.metric = metric
+        self.max_age_s = float(max_age_s)
+        self.wall_clock = wall_clock
+
+    def counts(self, registry):
+        m = registry.get(self.metric)
+        if m is None or not hasattr(m, "child_values"):
+            return None
+        ages = self.wall_clock()
+        last_seen = m.child_values()
+        if not last_seen:
+            return None
+        bad = sum(1.0 for t in last_seen.values()
+                  if ages - t > self.max_age_s)
+        return (bad, float(len(last_seen)))
+
+
+def default_slos() -> list:
+    """The stack-wide objective set the report/monitor default to."""
+    return [
+        AvailabilitySLO("availability", target=0.999),
+        LatencySLO("latency_p99_500ms", target=0.99, threshold_s=0.5),
+        FreshnessSLO("gang_freshness", target=0.99, max_age_s=60.0),
+    ]
+
+
+@dataclasses.dataclass
+class BreachEvent:
+    """One healthy→breached transition, consumable by DeployWatch."""
+
+    slo: str
+    time: float              # monitor clock
+    burn_rate: float         # worst window burn at breach
+    windows: tuple           # names of the window pairs that fired
+    budget_remaining: float
+    detail: dict
+
+
+@dataclasses.dataclass
+class SLOStatus:
+    """Per-objective verdict from the latest evaluation."""
+
+    slo: str
+    target: float
+    healthy: bool
+    burn_rate: float          # worst across all windows (0 if no data)
+    budget_remaining: float   # over the longest window, clamped to >=0
+    bad: float                # cumulative bad events seen
+    total: float              # cumulative total events seen
+    description: str = ""
+
+
+class _SLOState:
+    __slots__ = ("snapshots", "cum_bad", "cum_total", "healthy",
+                 "last_raw")
+
+    def __init__(self):
+        self.snapshots: deque = deque()   # (t, bad, total) cumulative
+        self.cum_bad = 0.0                # for non-cumulative SLOs
+        self.cum_total = 0.0
+        self.healthy = True
+        self.last_raw: Optional[tuple] = None
+
+
+class SLOMonitor:
+    """Evaluates a set of :class:`SLO` objects against registry
+    snapshots — ``evaluate_once()`` for deterministic callers (tests,
+    DeployWatch loops), ``start()`` for the background evaluator
+    thread.  ``close()`` stops and joins the thread.
+
+    All shared state lives behind one lock; registry reads, metric
+    publication, flight-recorder dumps and dashboard annotations happen
+    OUTSIDE it (the evaluator must never hold its lock across I/O or a
+    foreign lock).
+    """
+
+    def __init__(self, slos: Optional[Sequence[SLO]] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 windows: Sequence[BurnWindow] = DEFAULT_WINDOWS,
+                 poll_s: float = 15.0,
+                 cluster=None,
+                 dump_path: Optional[str] = None,
+                 on_breach: Optional[Callable[[BreachEvent], None]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.slos = list(slos) if slos is not None else default_slos()
+        names = [s.name for s in self.slos]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate SLO names: {names}")
+        self.registry = registry
+        self.windows = tuple(windows)
+        if not self.windows:
+            raise ValueError("at least one BurnWindow is required")
+        self.poll_s = max(0.01, float(poll_s))
+        self.cluster = cluster
+        self.dump_path = dump_path
+        self.on_breach = on_breach
+        self.clock = clock
+        self._horizon_s = max(w.long_s for w in self.windows)
+        self._lock = threading.Lock()
+        self._state = {s.name: _SLOState() for s in self.slos}
+        self._status: dict[str, SLOStatus] = {}
+        self._breaches: list[BreachEvent] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------ math
+    @staticmethod
+    def _window_burn(snapshots, now: float, window_s: float,
+                     budget: float) -> Optional[float]:
+        """Burn rate over [now - window_s, now]: bad_fraction in the
+        window divided by the allowed bad fraction.  Baseline is the
+        newest snapshot at or before the window start (the oldest one
+        during warm-up — short histories judge what they have rather
+        than staying silent while the budget burns)."""
+        if len(snapshots) < 2:
+            return None
+        start = now - window_s
+        base = snapshots[0]
+        for snap in snapshots:
+            if snap[0] <= start:
+                base = snap
+            else:
+                break
+        head = snapshots[-1]
+        d_total = head[2] - base[2]
+        if d_total <= 0:
+            return None
+        d_bad = max(0.0, head[1] - base[1])
+        return (d_bad / d_total) / budget
+
+    # ------------------------------------------------------- evaluation
+    def evaluate_once(self) -> dict[str, SLOStatus]:
+        """One evaluator pass: snapshot every objective, update burn
+        windows, publish metrics, fire breach actions on healthy→
+        breached transitions.  Returns {slo name: SLOStatus}."""
+        reg = self.registry or get_registry()
+        now = self.clock()
+
+        # registry reads first, outside the monitor lock
+        raw = {slo.name: slo.counts(reg) for slo in self.slos}
+
+        new_breaches: list[BreachEvent] = []
+        statuses: dict[str, SLOStatus] = {}
+        with self._lock:
+            for slo in self.slos:
+                state = self._state[slo.name]
+                counts = raw[slo.name]
+                if counts is not None:
+                    bad, total = float(counts[0]), float(counts[1])
+                    if slo.cumulative:
+                        last = state.last_raw
+                        if last is not None and (bad < last[0]
+                                                 or total < last[1]):
+                            # counter reset (process restart): the old
+                            # totals are gone; judging the negative
+                            # delta would read a restart as recovery
+                            state.snapshots.clear()
+                        state.last_raw = (bad, total)
+                        cum_bad, cum_total = bad, total
+                    else:
+                        state.cum_bad += bad
+                        state.cum_total += total
+                        cum_bad, cum_total = state.cum_bad, state.cum_total
+                    state.snapshots.append((now, cum_bad, cum_total))
+                    while (len(state.snapshots) > 2
+                           and state.snapshots[1][0]
+                           < now - self._horizon_s):
+                        state.snapshots.popleft()
+
+                burns = {}
+                fired = []
+                for w in self.windows:
+                    b_short = self._window_burn(state.snapshots, now,
+                                                w.short_s, slo.budget)
+                    b_long = self._window_burn(state.snapshots, now,
+                                               w.long_s, slo.budget)
+                    burns[w.name] = (b_short, b_long)
+                    if (b_short is not None and b_long is not None
+                            and b_short > w.threshold
+                            and b_long > w.threshold):
+                        fired.append(w.name)
+                worst = max((b for pair in burns.values() for b in pair
+                             if b is not None), default=0.0)
+                longest = max(self.windows, key=lambda w: w.long_s)
+                burn_longest = self._window_burn(
+                    state.snapshots, now, longest.long_s, slo.budget)
+                remaining = max(0.0, 1.0 - burn_longest) \
+                    if burn_longest is not None else 1.0
+
+                breached = bool(fired)
+                if breached and state.healthy:
+                    state.healthy = False
+                    head = state.snapshots[-1]
+                    new_breaches.append(BreachEvent(
+                        slo.name, now, worst, tuple(fired), remaining,
+                        detail={
+                            "target": slo.target,
+                            "bad": head[1], "total": head[2],
+                            "burns": {name: [b for b in pair]
+                                      for name, pair in burns.items()},
+                        }))
+                elif not breached and not state.healthy:
+                    state.healthy = True   # burn cleared: re-arm
+                head = state.snapshots[-1] if state.snapshots \
+                    else (now, 0.0, 0.0)
+                statuses[slo.name] = SLOStatus(
+                    slo.name, slo.target, state.healthy, worst,
+                    remaining, head[1], head[2], slo.description)
+            self._status = dict(statuses)
+            self._breaches.extend(new_breaches)
+
+        # publication and breach actions, outside the lock
+        reg.counter("tpudl_slo_evaluations_total").inc()
+        burn_g = reg.labeled_gauge("tpudl_slo_burn_rate",
+                                   label_names=("slo",))
+        budget_g = reg.labeled_gauge("tpudl_slo_budget_remaining",
+                                     label_names=("slo",))
+        healthy_g = reg.labeled_gauge("tpudl_slo_healthy",
+                                      label_names=("slo",))
+        for name, st in statuses.items():
+            burn_g.set(st.burn_rate, slo=name)
+            budget_g.set(st.budget_remaining, slo=name)
+            healthy_g.set(1.0 if st.healthy else 0.0, slo=name)
+        for event in new_breaches:
+            reg.labeled_counter("tpudl_slo_breaches_total",
+                                label_names=("slo",)).inc(slo=event.slo)
+            message = (f"SLO {event.slo} breached: burn rate "
+                       f"{event.burn_rate:.1f}x on window(s) "
+                       f"{'/'.join(event.windows)}, budget remaining "
+                       f"{event.budget_remaining:.0%}")
+            log.warning("%s", message)
+            flight_recorder.record("slo_breach", slo=event.slo,
+                                   burn_rate=round(event.burn_rate, 3),
+                                   windows=list(event.windows))
+            flight_recorder.dump(self.dump_path,
+                                 reason=f"slo:{event.slo}",
+                                 detail={"message": message,
+                                         **event.detail})
+            if self.cluster is not None:
+                try:
+                    self.cluster.annotate(
+                        "slo_breach", message, slo=event.slo,
+                        burn_rate=round(event.burn_rate, 3),
+                        budget_remaining=round(
+                            event.budget_remaining, 4))
+                except Exception:
+                    log.exception("cluster annotation failed")
+            if self.on_breach is not None:
+                try:
+                    self.on_breach(event)
+                except Exception:
+                    log.exception("on_breach callback failed")
+        return statuses
+
+    # --------------------------------------------------------- readers
+    def status(self) -> dict[str, SLOStatus]:
+        """Latest per-objective verdicts (empty before the first
+        evaluation)."""
+        with self._lock:
+            return dict(self._status)
+
+    def breaches(self) -> list[BreachEvent]:
+        with self._lock:
+            return list(self._breaches)
+
+    def breach_count(self, slo: Optional[str] = None) -> int:
+        """Total breaches so far (optionally one objective) — the
+        monotone count DeployWatch snapshots and diffs."""
+        with self._lock:
+            return sum(1 for b in self._breaches
+                       if slo is None or b.slo == slo)
+
+    # ---------------------------------------------------------- thread
+    def start(self) -> "SLOMonitor":
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="tpudl-slo-evaluator", daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.evaluate_once()
+            except Exception:
+                log.exception("SLO evaluation pass failed")
+
+    def close(self) -> None:
+        """Stop and JOIN the evaluator thread (idempotent)."""
+        self._stop.set()
+        with self._lock:
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=10.0)
+
+    def __enter__(self) -> "SLOMonitor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()

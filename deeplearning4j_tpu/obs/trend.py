@@ -1,0 +1,494 @@
+"""Perf-trajectory sentinel — ``python -m deeplearning4j_tpu.obs.trend``.
+
+The repo commits one ``BENCH_r<NN>.json`` / ``MULTICHIP_r<NN>.json``
+record per bench round, but until now nothing *consumed* the trajectory:
+a quiet MFU slide, a p99 regression, or five consecutive tunnel-down
+records all looked identical to a healthy run until a human read the
+JSON by hand.  This module is the automated verdict layer:
+
+- **Typed parsing** (:func:`load_trajectory`): every committed record
+  becomes a :class:`TrendRecord` with a status — ``real`` (measured
+  numbers), ``stale`` (tunnel down / skipped / dryrun-only: nothing was
+  measured, honestly classified, NEVER a regression), or ``failed``
+  (the harness itself died, rc != 0 with no skip shape).  Both the
+  current skip schema (``status: "skipped"``, rc=0) and the legacy
+  r05 shape (rc=1, ``value: 0.0``, an ``error`` string, no ``status``
+  key) classify ``stale`` — a 0.0 must never read as a measurement.
+- **Robust regression gating** (:func:`gate`): each metric of the
+  newest real record is judged against the median of the trailing
+  window of *real* records (median/MAD — robust to the outliers it
+  exists to find), with a per-metric direction + tolerance table
+  (:data:`METRIC_POLICY`).  Stale/failed records never feed the
+  baseline and never regress.
+- **Staleness verdict**: "the last real TPU measurement is r04,
+  N round(s) ago" — five tunnel-down rounds are a first-class fleet
+  problem, not five green checkmarks.
+- **ROADMAP-target tracking** (:data:`ROADMAP_TARGETS`): the open
+  ROADMAP item 1 MFU targets (ResNet-50 0.25 → ≥0.4, BERT 0.52 →
+  ≥0.65) ride as *pending* objectives that flip to pass/fail the
+  moment a real record newer than the r04 frontier lands.
+- **``--check`` CLI**: exits nonzero on a regression (naming the exact
+  metric, its value, and the trailing-window baseline) for CI;
+  ``obs.selfcheck`` runs it over the committed trajectory (tier-1
+  gated), and ``bench.py`` stamps each new record with its trend
+  verdict at write time (:func:`stamp_verdict`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import glob
+import json
+import math
+import os
+import re
+import statistics
+import sys
+import time
+from typing import Optional
+
+# MAD → stdev for a normal distribution (obs.health uses the same)
+_MAD_SCALE = 1.4826
+
+_RECORD_RE = re.compile(r"(BENCH|MULTICHIP)_r(\d+)\.json$")
+
+# error strings that mean "the accelerator was unreachable", not "the
+# bench harness is broken" — the legacy records (BENCH_r05) carry these
+# with rc=1 instead of the structured skip schema
+_TUNNEL_MARKERS = ("tunnel", "timed out", "timeout", "unreachable",
+                   "unavailable", "deadline_exceeded", "failed to connect",
+                   "connection refused", "fell back to cpu")
+
+
+def looks_tunnel_down(message: str) -> bool:
+    msg = (message or "").lower()
+    return any(marker in msg for marker in _TUNNEL_MARKERS)
+
+
+@dataclasses.dataclass
+class MetricPolicy:
+    """Regression policy for one trajectory metric.  ``direction`` +1
+    means higher is better; ``tolerance`` is the relative worsening vs
+    the trailing-window median that still passes (noise floor)."""
+
+    direction: int
+    tolerance: float
+
+
+# the per-metric direction + tolerance table the gate judges against.
+# Tolerances are noise floors from the committed trajectory itself
+# (r01→r04 headline throughput wobbles ~0.4%; step-time micro-rows are
+# noisier on a shared host).
+METRIC_POLICY: dict[str, MetricPolicy] = {
+    "resnet50_train_images_per_sec_per_chip": MetricPolicy(+1, 0.05),
+    "resnet50_mfu": MetricPolicy(+1, 0.05),
+    "hbm_roof_fraction": MetricPolicy(+1, 0.10),
+    "bert_mfu": MetricPolicy(+1, 0.05),
+    "bert_step_time_ms": MetricPolicy(-1, 0.10),
+    "flash_speedup": MetricPolicy(+1, 0.10),
+    "flash_mfu": MetricPolicy(+1, 0.10),
+    "mlp_mnist_step_ms": MetricPolicy(-1, 0.30),
+    "lenet_cifar10_step_ms": MetricPolicy(-1, 0.30),
+    "lstm_har_step_ms": MetricPolicy(-1, 0.30),
+    "per_chip_scaling_efficiency": MetricPolicy(+1, 0.10),
+    "straggler_skew": MetricPolicy(-1, 0.25),
+}
+
+# ROADMAP item 1: when hardware returns, r06 is judged against the r04
+# frontier the moment it lands.  ``baseline_round`` is the frontier
+# round — the target stays "pending" until a REAL record newer than it
+# exists, then flips to pass/fail.
+@dataclasses.dataclass
+class RoadmapTarget:
+    metric: str
+    target: float
+    baseline: float          # the frontier value the target moves from
+    baseline_round: int
+
+
+ROADMAP_TARGETS: tuple = (
+    RoadmapTarget("resnet50_mfu", 0.40, 0.25, 4),
+    RoadmapTarget("bert_mfu", 0.65, 0.52, 4),
+)
+
+# default trailing window of real records the baseline median runs over
+TRAILING_WINDOW = 4
+
+
+@dataclasses.dataclass
+class TrendRecord:
+    """One committed bench round, typed and classified."""
+
+    kind: str                 # "bench" | "multichip"
+    round: int                # rNN
+    status: str               # "real" | "stale" | "failed"
+    reason: str               # why stale/failed ("" for real)
+    metrics: dict             # metric name → float (real records only)
+    path: str = ""
+    mtime: Optional[float] = None   # file mtime (staleness-age estimate)
+    trend: Optional[dict] = None    # write-time verdict stamp, if present
+
+    @property
+    def label(self) -> str:
+        return f"{'BENCH' if self.kind == 'bench' else 'MULTICHIP'}" \
+               f"_r{self.round:02d}"
+
+
+def _get(d: dict, *path, default=None):
+    for key in path:
+        if not isinstance(d, dict):
+            return default
+        d = d.get(key)
+    return d if d is not None else default
+
+
+def _num(value) -> Optional[float]:
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        return None
+    return float(value) if math.isfinite(float(value)) else None
+
+
+def _bench_metrics(parsed: dict) -> dict:
+    """Lift the judged metric set out of a real bench record's parsed
+    payload.  Absent rows (r01–r03 predate the MFU stamp) just don't
+    contribute — the gate only judges metrics both sides measured."""
+    detail = parsed.get("detail") or {}
+    out = {}
+    pairs = [
+        ("resnet50_train_images_per_sec_per_chip", _num(parsed.get("value"))),
+        ("resnet50_mfu", _num(detail.get("mfu"))),
+        ("hbm_roof_fraction", _num(detail.get("hbm_roof_fraction"))),
+        ("bert_mfu", _num(_get(detail, "bert_base_mlm", "mfu"))),
+        ("bert_step_time_ms",
+         _num(_get(detail, "bert_base_mlm", "step_time_ms"))),
+        ("flash_speedup", _num(_get(detail, "bert_long_seq",
+                                    "flash_speedup"))),
+        ("flash_mfu", _num(_get(detail, "bert_long_seq", "flash_mfu"))),
+        ("mlp_mnist_step_ms", _num(_get(detail, "workloads",
+                                        "mlp_mnist_step_ms"))),
+        ("lenet_cifar10_step_ms", _num(_get(detail, "workloads",
+                                            "lenet_cifar10_step_ms"))),
+        ("lstm_har_step_ms", _num(_get(detail, "workloads",
+                                       "lstm_har_step_ms"))),
+    ]
+    for name, value in pairs:
+        if value is not None:
+            out[name] = value
+    return out
+
+
+def classify_bench(raw: dict) -> tuple[str, str, dict]:
+    """(status, reason, metrics) for one BENCH record.  The honesty
+    rules, in order:
+
+    1. ``parsed.status == "skipped"`` — the structured tunnel-down
+       record (rc=0 by contract) → ``stale``.
+    2. legacy skip shape (r05): an ``error`` string with value 0.0 and
+       no ``status`` key → ``stale`` (nothing was measured; rc=1 was
+       the old contract violation, not a measurement).
+    3. ``parsed.status == "error"`` or rc != 0 → ``failed``.
+    4. measured value > 0 → ``real``.
+    """
+    parsed = raw.get("parsed")
+    if not isinstance(parsed, dict):
+        rc = raw.get("rc")
+        return ("failed", f"no parsable bench line (rc={rc})", {})
+    status = parsed.get("status")
+    error = parsed.get("error")
+    value = _num(parsed.get("value")) or 0.0
+    if status == "skipped":
+        return ("stale", str(error or "skipped"), {})
+    if status is None and error is not None and value == 0.0:
+        # the legacy (pre-honesty-fix) skip shape: BENCH_r05
+        reason = str(error)
+        if looks_tunnel_down(reason):
+            return ("stale", reason, {})
+        return ("failed", reason, {})
+    if status == "error" or raw.get("rc", 0) != 0:
+        return ("failed", str(error or f"rc={raw.get('rc')}"), {})
+    if value <= 0.0:
+        return ("failed", "zero-valued record with no error shape", {})
+    return ("real", "", _bench_metrics(parsed))
+
+
+def classify_multichip(raw: dict) -> tuple[str, str, dict]:
+    """(status, reason, metrics) for one MULTICHIP record.  Records
+    with rc != 0 / ok=false are ``failed`` (r05 died rc=124); rc=0
+    records that are dryrun-only (no measured scaling metrics) are
+    ``stale`` — a dryrun proves the program compiles, it measures
+    nothing, and must never count as a completed measurement."""
+    if raw.get("skipped"):
+        return ("stale", "skipped (tunnel down)", {})
+    rc = raw.get("rc", 0)
+    if rc != 0 or not raw.get("ok", False):
+        tail = (raw.get("tail") or "").strip().splitlines()
+        return ("failed",
+                f"rc={rc}" + (f": {tail[-1][:120]}" if tail else ""), {})
+    metrics = {}
+    for name in ("per_chip_scaling_efficiency", "straggler_skew"):
+        value = _num(raw.get(name))
+        if value is not None:
+            metrics[name] = value
+    if not metrics:
+        return ("stale", "dryrun-only record (no measured metrics)", {})
+    return ("real", "", metrics)
+
+
+def parse_record(path: str, raw: Optional[dict] = None) -> TrendRecord:
+    m = _RECORD_RE.search(os.path.basename(path))
+    if not m:
+        raise ValueError(f"not a trajectory record name: {path}")
+    kind = "bench" if m.group(1) == "BENCH" else "multichip"
+    rnd = int(m.group(2))
+    if raw is None:
+        with open(path) as f:
+            raw = json.load(f)
+    status, reason, metrics = (classify_bench(raw) if kind == "bench"
+                               else classify_multichip(raw))
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        mtime = None
+    trend = raw.get("trend") if isinstance(raw.get("trend"), dict) else None
+    return TrendRecord(kind, rnd, status, reason, metrics, path=path,
+                       mtime=mtime, trend=trend)
+
+
+def default_records_dir() -> str:
+    """The repo root (where BENCH_r*.json are committed)."""
+    import deeplearning4j_tpu
+    return os.path.dirname(os.path.dirname(os.path.abspath(
+        deeplearning4j_tpu.__file__)))
+
+
+def load_trajectory(records_dir: Optional[str] = None) -> list[TrendRecord]:
+    """Every committed BENCH/MULTICHIP record in round order (bench
+    first within a round).  Unreadable/corrupt files classify
+    ``failed`` rather than raise — the sentinel must not be DOSed by
+    one torn record."""
+    root = records_dir or default_records_dir()
+    records = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))
+                       + glob.glob(os.path.join(root, "MULTICHIP_r*.json"))):
+        try:
+            records.append(parse_record(path))
+        except (ValueError, OSError, json.JSONDecodeError) as e:
+            m = _RECORD_RE.search(os.path.basename(path))
+            if m:
+                records.append(TrendRecord(
+                    "bench" if m.group(1) == "BENCH" else "multichip",
+                    int(m.group(2)), "failed",
+                    f"unreadable record: {e}", {}, path=path))
+    records.sort(key=lambda r: (r.round, r.kind))
+    return records
+
+
+# ------------------------------------------------------------------ gating
+@dataclasses.dataclass
+class Regression:
+    metric: str
+    value: float
+    baseline: float          # trailing-window median
+    delta_pct: float         # signed relative change (negative = drop
+                             # for higher-is-better metrics)
+    window: int              # real records the baseline median ran over
+    record: str              # label of the regressing record
+
+    def render(self) -> str:
+        return (f"{self.record}: {self.metric} = {self.value:g} regressed "
+                f"{abs(self.delta_pct):.1f}% vs trailing-window median "
+                f"{self.baseline:g} (n={self.window})")
+
+
+def judge_metric(name: str, value: float,
+                 history: list[float]) -> Optional[Regression]:
+    """Judge one metric value against its trailing real history.
+    Median/MAD: the regression threshold is the LOOSER of the policy
+    tolerance and 3 robust sigmas, so a noisy metric's natural spread
+    widens its own gate instead of crying wolf."""
+    policy = METRIC_POLICY.get(name)
+    if policy is None or not history:
+        return None
+    med = statistics.median(history)
+    if med == 0:
+        return None
+    mad = statistics.median(abs(v - med) for v in history) \
+        if len(history) >= 2 else 0.0
+    threshold = max(policy.tolerance * abs(med), 3.0 * _MAD_SCALE * mad)
+    worsening = (med - value) if policy.direction > 0 else (value - med)
+    if worsening <= threshold:
+        return None
+    delta_pct = 100.0 * (value - med) / abs(med)
+    return Regression(name, value, med, delta_pct, len(history), "")
+
+
+def gate(records: list[TrendRecord],
+         window: int = TRAILING_WINDOW) -> list[Regression]:
+    """Regression verdicts for the NEWEST real record of each kind,
+    judged per metric against the median of the up-to-``window``
+    preceding real records that measured that metric.  Stale and failed
+    records neither regress nor feed the baseline."""
+    out = []
+    for kind in ("bench", "multichip"):
+        real = [r for r in records if r.kind == kind and r.status == "real"]
+        if len(real) < 2:
+            continue
+        newest, prior = real[-1], real[:-1]
+        for name, value in sorted(newest.metrics.items()):
+            history = [r.metrics[name] for r in prior[-window:]
+                       if name in r.metrics]
+            verdict = judge_metric(name, value, history)
+            if verdict is not None:
+                verdict.record = newest.label
+                out.append(verdict)
+    return out
+
+
+# --------------------------------------------------------------- staleness
+def staleness(records: list[TrendRecord],
+              now: Optional[float] = None) -> dict:
+    """First-class freshness verdict: which round last carried a real
+    TPU measurement, how many rounds (and roughly how many days, from
+    file mtimes) have passed since."""
+    bench = [r for r in records if r.kind == "bench"]
+    real = [r for r in bench if r.status == "real"]
+    latest = max((r.round for r in bench), default=0)
+    if not real:
+        return {"stale": True, "last_real_round": None,
+                "rounds_since_real": latest, "days_since_real": None,
+                "message": "no real TPU measurement in the trajectory"}
+    frontier = real[-1]
+    rounds_since = latest - frontier.round
+    days = None
+    if frontier.mtime is not None:
+        days = max(0.0, ((now if now is not None else time.time())
+                         - frontier.mtime) / 86400.0)
+    message = (f"last real TPU measurement is r{frontier.round:02d}"
+               + (f", {rounds_since} round(s) ago" if rounds_since else
+                  " (the newest round)")
+               + (f" (~{days:.0f} day(s) by file age)"
+                  if days is not None and rounds_since else ""))
+    return {"stale": rounds_since > 0,
+            "last_real_round": frontier.round,
+            "rounds_since_real": rounds_since,
+            "days_since_real": days,
+            "message": message}
+
+
+def roadmap_status(records: list[TrendRecord]) -> list[dict]:
+    """ROADMAP item 1 MFU targets as machine-checked objectives:
+    ``pending`` until a real bench record NEWER than the target's
+    baseline round exists, then ``pass``/``fail`` on the frontier
+    record's value."""
+    real = [r for r in records if r.kind == "bench" and r.status == "real"]
+    frontier = real[-1] if real else None
+    out = []
+    for tgt in ROADMAP_TARGETS:
+        row = {"metric": tgt.metric, "target": tgt.target,
+               "baseline": tgt.baseline,
+               "baseline_round": tgt.baseline_round}
+        if frontier is None or frontier.round <= tgt.baseline_round \
+                or tgt.metric not in frontier.metrics:
+            row.update(status="pending", value=None,
+                       note=f"waiting for a real record past "
+                            f"r{tgt.baseline_round:02d}")
+        else:
+            value = frontier.metrics[tgt.metric]
+            row.update(status="pass" if value >= tgt.target else "fail",
+                       value=value,
+                       note=f"r{frontier.round:02d} measured {value:g} "
+                            f"vs target >={tgt.target:g}")
+        out.append(row)
+    return out
+
+
+# ------------------------------------------------------- write-time stamp
+def stamp_verdict(parsed_record: dict,
+                  records_dir: Optional[str] = None) -> dict:
+    """The verdict ``bench.py`` stamps into each NEW record at write
+    time: the fresh record is judged against the committed trajectory
+    as if it had just landed.  Returns the stamp (also attached under
+    ``parsed_record["trend"]``).  Never raises — a missing trajectory
+    costs the stamp, not the bench record."""
+    try:
+        history = load_trajectory(records_dir)
+        status, reason, metrics = classify_bench(
+            {"parsed": parsed_record, "rc": 0})
+        if status != "real":
+            stamp = {"verdict": status, "reason": reason,
+                     "regressions": []}
+        else:
+            nxt = 1 + max((r.round for r in history if r.kind == "bench"),
+                          default=0)
+            candidate = TrendRecord("bench", nxt, "real", "", metrics)
+            regressions = gate([r for r in history if r.kind == "bench"]
+                               + [candidate])
+            stamp = {"verdict": ("regression" if regressions else "ok"),
+                     "reason": "",
+                     "regressions": [r.render() for r in regressions]}
+    except Exception as e:          # the stamp is best-effort by contract
+        stamp = {"verdict": "unknown", "reason": f"stamping failed: {e!r}",
+                 "regressions": []}
+    parsed_record["trend"] = stamp
+    return stamp
+
+
+# ------------------------------------------------------------------- CLI
+def summarize(records_dir: Optional[str] = None,
+              window: int = TRAILING_WINDOW) -> dict:
+    """The machine-readable trajectory summary (obs.report embeds it)."""
+    records = load_trajectory(records_dir)
+    regressions = gate(records, window=window)
+    return {
+        "records": [{
+            "record": r.label, "kind": r.kind, "round": r.round,
+            "status": r.status, "reason": r.reason, "metrics": r.metrics,
+        } for r in records],
+        "regressions": [dataclasses.asdict(r) for r in regressions],
+        "staleness": staleness(records),
+        "roadmap_targets": roadmap_status(records),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.obs.trend",
+        description="perf-trajectory sentinel over the committed "
+                    "BENCH_r*/MULTICHIP_r* records")
+    p.add_argument("--dir", default=None,
+                   help="records directory (default: the repo root)")
+    p.add_argument("--window", type=int, default=TRAILING_WINDOW,
+                   help=f"trailing real-record window for the baseline "
+                        f"median (default {TRAILING_WINDOW})")
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 on any regression (CI gate)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the machine-readable summary")
+    args = p.parse_args(argv)
+
+    summary = summarize(args.dir, window=args.window)
+    if args.json:
+        print(json.dumps(summary, indent=1))
+    else:
+        for row in summary["records"]:
+            mark = {"real": "+", "stale": "~", "failed": "!"}[row["status"]]
+            note = f" — {row['reason']}" if row["reason"] else ""
+            print(f" {mark} {row['record']}: {row['status']}{note}")
+        print(f"staleness: {summary['staleness']['message']}")
+        for tgt in summary["roadmap_targets"]:
+            print(f"target {tgt['metric']} >= {tgt['target']:g}: "
+                  f"{tgt['status']} ({tgt['note']})")
+        if summary["regressions"]:
+            print(f"{len(summary['regressions'])} regression(s):")
+            for r in summary["regressions"]:
+                print("  - " + Regression(**r).render())
+        else:
+            print("regressions: none (stale/failed records never count)")
+    if args.check and summary["regressions"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

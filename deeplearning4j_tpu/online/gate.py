@@ -316,6 +316,14 @@ class DeployWatch:
     On a router-managed model ``registry.rollback`` delegates to the
     router, so the regression response rolls EVERY replica back
     together — the watch stays router-agnostic.
+
+    With ``slo_monitor`` (an :class:`~deeplearning4j_tpu.obs.slo.
+    SLOMonitor`), any NEW SLO breach inside the watch window is a
+    regression too: a post-deploy error-budget burn rides the same
+    rollback path as a raw error-rate spike, so the budget policy and
+    the deploy gate can never disagree.  The watch drives the monitor
+    itself (``evaluate_once`` per poll) so a short watch window never
+    races the monitor's own cadence.
     """
 
     def __init__(self, registry, name: str, window_s: float = 10.0,
@@ -323,7 +331,8 @@ class DeployWatch:
                  error_rate_max: float = 0.25,
                  p99_max_s: Optional[float] = None,
                  min_requests: int = 4,
-                 health_verdicts_max: int = 0):
+                 health_verdicts_max: int = 0,
+                 slo_monitor=None):
         self.registry = registry
         self.name = name
         self.window_s = float(window_s)
@@ -332,6 +341,7 @@ class DeployWatch:
         self.p99_max_s = p99_max_s
         self.min_requests = max(1, int(min_requests))
         self.health_verdicts_max = max(0, int(health_verdicts_max))
+        self.slo_monitor = slo_monitor
 
     def _snapshot(self) -> dict:
         reg = get_registry()
@@ -345,10 +355,21 @@ class DeployWatch:
             "health": reg.labeled_counter(
                 "tpudl_health_anomalies_total",
                 label_names=("kind",)).value,
+            "slo_breaches": (self.slo_monitor.breach_count()
+                            if self.slo_monitor is not None else 0),
         }
 
     def _regression(self, before: dict) -> Optional[str]:
+        if self.slo_monitor is not None:
+            self.slo_monitor.evaluate_once()
         now = self._snapshot()
+        breach_delta = now["slo_breaches"] - before["slo_breaches"]
+        if breach_delta > 0:
+            names = sorted({b.slo for b in
+                            self.slo_monitor.breaches()
+                            [-int(breach_delta):]})
+            return (f"{int(breach_delta)} new SLO breach(es) in the "
+                    f"watch window ({', '.join(names)})")
         bad = (now["error"] - before["error"]) \
             + (now["expired"] - before["expired"])
         ok = now["ok"] - before["ok"]

@@ -59,6 +59,7 @@ import numpy as np
 from deeplearning4j_tpu.data.device_pipeline import _pad_rows, choose_bucket
 from deeplearning4j_tpu.obs import costmodel, flight_recorder, tracing
 from deeplearning4j_tpu.obs.registry import get_registry
+from deeplearning4j_tpu.resilience import faults
 from deeplearning4j_tpu.train import step_cache
 
 
@@ -445,6 +446,11 @@ class InferenceEngine:
         rows = sum(r.n for r in live)
         queue_wait_s = now - min(r.t_submit for r in live)
         try:
+            # chaos hook: an injected dispatch fault takes the real
+            # error path below (per-request status="error" + serve_error
+            # flight event) — how the SLO breach tests drive the
+            # availability budget without a broken model
+            faults.fire("serve.dispatch")
             bucket, padded = rows, 0
             if self.bucketing:
                 bucket = self._bucket_for(rows)

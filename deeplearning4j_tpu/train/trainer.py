@@ -912,7 +912,13 @@ class Trainer:
                         # the NEXT epoch's first batch
                         net._completed_epochs = net.epoch + 1
                         net._epoch_batches = 0
-                        info = {"epoch_time_s": time.perf_counter() - epoch_t0,
+                        epoch_s = time.perf_counter() - epoch_t0
+                        # the epoch wall time rides the registry (where
+                        # SLO/trend evaluation can see it), not only the
+                        # listener-bus info dict
+                        get_registry().histogram(
+                            "tpudl_train_epoch_seconds").observe(epoch_s)
+                        info = {"epoch_time_s": epoch_s,
                                 "batches": n_batches, "score": net._score}
                         self.bus.dispatch("on_epoch_end", net, net.epoch, info)
                     get_registry().counter("tpudl_train_epochs_total").inc()

@@ -976,3 +976,102 @@ def test_hardcoded_axis_name_scope_and_exemptions(tmp_path):
         """)
     assert report.by_rule("TPU317") == []
     assert report.suppressed
+
+
+# ------------------------------------------------------------ TPU318
+def test_adhoc_latency_in_serving_path_flagged(tmp_path):
+    """A time delta measured in a request handler that never reaches a
+    registry sink is invisible to SLO burn-rate evaluation."""
+    report = _lint_source(tmp_path, """
+        import time
+
+        def handle_request(self, x):
+            t0 = time.perf_counter()
+            out = self.engine.predict(x)
+            latency = time.perf_counter() - t0
+            if latency > 0.5:
+                print("slow request", latency)
+            return out
+        """)
+    hits = report.by_rule("TPU318")
+    assert len(hits) == 1 and "handle_request" in hits[0].message
+    assert "histogram" in hits[0].message
+    assert report.exit_code() == 1
+
+
+def test_adhoc_latency_in_step_path_flagged(tmp_path):
+    report = _lint_source(tmp_path, """
+        import time
+
+        def train_step(self, batch):
+            start = time.monotonic()
+            loss = self._step(batch)
+            self.last_step_s = time.monotonic() - start
+            return loss
+        """)
+    hits = report.by_rule("TPU318")
+    assert len(hits) == 1 and "train_step" in hits[0].message
+
+
+def test_latency_that_reaches_a_registry_sink_is_fine(tmp_path):
+    report = _lint_source(tmp_path, """
+        import time
+        from deeplearning4j_tpu.obs.registry import get_registry
+
+        def handle_request(self, x):
+            t0 = time.perf_counter()
+            out = self.engine.predict(x)
+            get_registry().histogram(
+                "tpudl_serve_latency_seconds").observe(
+                time.perf_counter() - t0)
+            return out
+
+        def fit_batch(self, batch):
+            t0 = time.perf_counter()
+            loss = self._step(batch)
+            self.router.notify_step(step_seconds=time.perf_counter() - t0)
+            return loss
+        """)
+    assert report.by_rule("TPU318") == []
+    assert report.exit_code() == 0
+
+
+def test_cadence_checks_and_non_serving_functions_are_fine(tmp_path):
+    """now - self._last_flush is a cooldown decision, not a latency;
+    deltas outside serving/step-path functions are out of scope."""
+    report = _lint_source(tmp_path, """
+        import time
+
+        def serve_step(self):
+            now = time.monotonic()
+            if now - self._last_up > self.cooldown_s:
+                self._scale_up()
+                self._last_up = now
+
+        def build_serving_engine(self):
+            t0 = time.perf_counter()
+            engine = self._compile()
+            print("cold start took", time.perf_counter() - t0)
+            return engine
+
+        def load_config(path):
+            t0 = time.perf_counter()
+            cfg = open(path).read()
+            return cfg, time.perf_counter() - t0
+        """)
+    assert report.by_rule("TPU318") == []
+    assert report.exit_code() == 0
+
+
+def test_obs_measurement_modules_are_exempt_from_tpu318(tmp_path):
+    (tmp_path / "obs").mkdir(exist_ok=True)
+    report = _lint_source(tmp_path, """
+        import time
+
+        def observe_request(self, x):
+            t0 = time.perf_counter()
+            out = self._forward(x)
+            self._raw_latency = time.perf_counter() - t0
+            return out
+        """, name="obs/probe.py")
+    assert report.by_rule("TPU318") == []

@@ -1,0 +1,379 @@
+"""tpudl.obs.slo (ISSUE 16): burn-rate SLOs + breach wiring.
+
+Acceptance pins:
+- burn-rate math on synthetic event streams with a fake clock: steady
+  burn above threshold breaches; a burst the long window vetoes does
+  not; a counter reset (process restart) discards history instead of
+  breaching or reading as recovery;
+- a breach does the full action set: ``tpudl_slo_*`` metrics, a
+  flight-recorder dump with ``reason="slo:<name>"``, a ``/cluster``
+  annotation, the ``on_breach`` callback, and ``breach_count()``;
+- END TO END: an injected error burst (``faults.py``) against a served
+  model breaches the availability SLO within one evaluation —
+  ``tpudl_slo_burn_rate`` crosses the threshold, the flight dump
+  lands, and ``DeployWatch(slo_monitor=...)`` rolls the deploy back.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.obs import flight_recorder, slo
+from deeplearning4j_tpu.obs.registry import (MetricsRegistry, get_registry,
+                                             set_registry)
+from deeplearning4j_tpu.obs.remote import ClusterStore
+from deeplearning4j_tpu.online import DeployWatch
+from deeplearning4j_tpu.resilience import faults
+from deeplearning4j_tpu.serve import ModelRegistry
+from deeplearning4j_tpu.train import Adam
+
+
+@pytest.fixture
+def metrics():
+    prev = set_registry(MetricsRegistry())
+    try:
+        yield get_registry()
+    finally:
+        set_registry(prev)
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_fault_plan():
+    faults.clear_fault_plan()
+    yield
+    faults.clear_fault_plan()
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+WINDOWS = (slo.BurnWindow("fast", 60.0, 300.0, 10.0),)
+
+
+def _availability_monitor(metrics, clock, **kw):
+    return slo.SLOMonitor([slo.AvailabilitySLO(target=0.99)],
+                          registry=metrics, windows=WINDOWS,
+                          clock=clock, **kw)
+
+
+# ------------------------------------------------------- burn-rate math
+def test_window_burn_math():
+    # 5 bad over 1000 total against a 1% budget: burn 0.5x
+    snaps = [(0.0, 0.0, 0.0), (100.0, 5.0, 1000.0)]
+    burn = slo.SLOMonitor._window_burn(snaps, 100.0, 100.0, 0.01)
+    assert burn == pytest.approx(0.5)
+    # bad fraction exactly at budget burns at 1.0x (sustainable)
+    snaps = [(0.0, 0.0, 0.0), (100.0, 10.0, 1000.0)]
+    assert slo.SLOMonitor._window_burn(snaps, 100.0, 100.0, 0.01) \
+        == pytest.approx(1.0)
+    # one snapshot / zero traffic: no verdict, not zero
+    assert slo.SLOMonitor._window_burn([(0.0, 0.0, 0.0)], 0, 60, 0.01) is None
+    assert slo.SLOMonitor._window_burn(
+        [(0.0, 1.0, 10.0), (10.0, 1.0, 10.0)], 10, 60, 0.01) is None
+
+
+def test_steady_burn_breaches_on_both_windows(metrics):
+    clock = FakeClock()
+    requests = metrics.labeled_counter("tpudl_serve_requests_total")
+    mon = _availability_monitor(metrics, clock)
+    for _ in range(3):                    # 90% errors vs a 1% budget
+        requests.inc(9, status="error")
+        requests.inc(1, status="ok")
+        mon.evaluate_once()
+        clock.advance(10.0)
+    assert mon.breach_count() == 1        # transition fires exactly once
+    status = mon.status()["availability"]
+    assert not status.healthy
+    assert status.burn_rate > WINDOWS[0].threshold
+    assert status.budget_remaining == 0.0
+    # the published family crossed with it
+    burn_g = metrics.labeled_gauge("tpudl_slo_burn_rate",
+                                   label_names=("slo",))
+    assert burn_g.labeled_value(slo="availability") > WINDOWS[0].threshold
+    healthy_g = metrics.labeled_gauge("tpudl_slo_healthy",
+                                      label_names=("slo",))
+    assert healthy_g.labeled_value(slo="availability") == 0.0
+    breaches = metrics.labeled_counter("tpudl_slo_breaches_total",
+                                       label_names=("slo",))
+    assert breaches.labeled_value(slo="availability") == 1
+    assert metrics.counter("tpudl_slo_evaluations_total").value == 3
+
+
+def test_burst_is_vetoed_by_the_long_window(metrics):
+    # an hour of sustainable traffic, then ONE bursty tick: the short
+    # window spikes past threshold but the long window still sees a
+    # sub-threshold average — no page (the whole point of the pairing)
+    clock = FakeClock()
+    requests = metrics.labeled_counter("tpudl_serve_requests_total")
+    mon = slo.SLOMonitor(
+        [slo.AvailabilitySLO(target=0.99)], registry=metrics,
+        windows=(slo.BurnWindow("fast", 60.0, 600.0, 5.0),),
+        clock=clock)
+    for _ in range(61):                   # 1% errors: burn 1.0x
+        requests.inc(1, status="error")
+        requests.inc(99, status="ok")
+        mon.evaluate_once()
+        clock.advance(10.0)
+    requests.inc(30, status="error")      # the burst tick
+    requests.inc(70, status="ok")
+    statuses = mon.evaluate_once()
+    st = statuses["availability"]
+    assert st.healthy and mon.breach_count() == 0
+    assert st.burn_rate > 5.0             # the short window DID spike
+
+
+def test_breach_rearms_after_the_burn_clears(metrics):
+    clock = FakeClock()
+    requests = metrics.labeled_counter("tpudl_serve_requests_total")
+    mon = _availability_monitor(metrics, clock)
+    for _ in range(2):
+        requests.inc(9, status="error")
+        requests.inc(1, status="ok")
+        mon.evaluate_once()
+        clock.advance(10.0)
+    assert mon.breach_count() == 1
+    # quiet, clean traffic until both windows roll past the burst
+    for _ in range(40):
+        requests.inc(100, status="ok")
+        mon.evaluate_once()
+        clock.advance(10.0)
+    status = mon.status()["availability"]
+    assert status.healthy                 # re-armed
+    assert mon.breach_count() == 1        # no double-fire on the way out
+    healthy_g = metrics.labeled_gauge("tpudl_slo_healthy",
+                                      label_names=("slo",))
+    assert healthy_g.labeled_value(slo="availability") == 1.0
+
+
+def test_counter_reset_discards_history_instead_of_breaching(metrics):
+    # a restarted serving process re-zeroes its counters: the monitor
+    # must drop pre-reset snapshots, not diff across the restart
+    clock = FakeClock()
+    reg1 = MetricsRegistry()
+    reg1.labeled_counter("tpudl_serve_requests_total").inc(
+        50, status="error")
+    reg1.labeled_counter("tpudl_serve_requests_total").inc(
+        950, status="ok")
+    mon = slo.SLOMonitor([slo.AvailabilitySLO(target=0.99)],
+                         registry=reg1, windows=WINDOWS, clock=clock)
+    mon.evaluate_once()
+    clock.advance(10.0)
+    # restart: fresh registry, tiny clean totals (bad 50 → 0)
+    reg2 = MetricsRegistry()
+    reg2.labeled_counter("tpudl_serve_requests_total").inc(
+        10, status="ok")
+    mon.registry = reg2
+    mon.evaluate_once()                   # reset detected, history cleared
+    clock.advance(10.0)
+    reg2.labeled_counter("tpudl_serve_requests_total").inc(
+        90, status="ok")
+    statuses = mon.evaluate_once()
+    st = statuses["availability"]
+    assert mon.breach_count() == 0
+    assert st.healthy
+    assert st.burn_rate == pytest.approx(0.0)   # only post-reset deltas
+    assert st.budget_remaining == pytest.approx(1.0)
+
+
+# ------------------------------------------------------- objective math
+def test_latency_slo_counts_from_bucket_edges(metrics):
+    h = metrics.histogram("tpudl_serve_latency_seconds")
+    for _ in range(97):
+        h.observe(0.01)
+    for _ in range(3):
+        h.observe(2.0)                    # above the 0.5s objective
+    objective = slo.LatencySLO(target=0.99, threshold_s=0.5)
+    bad, total = objective.counts(metrics)
+    assert total == 100 and bad == 3
+
+
+def test_freshness_slo_counts_stale_workers(metrics):
+    g = metrics.labeled_gauge("tpudl_cluster_worker_last_seen_time",
+                              label_names=("worker",))
+    now = 1000.0
+    g.set(now - 5.0, worker="w0")
+    g.set(now - 300.0, worker="w1")       # silent for 5 minutes
+    objective = slo.FreshnessSLO(max_age_s=60.0, wall_clock=lambda: now)
+    bad, total = objective.counts(metrics)
+    assert (bad, total) == (1.0, 2.0)
+    assert objective.cumulative is False
+
+
+def test_slo_counts_none_when_metric_absent(metrics):
+    for objective in slo.default_slos():
+        assert objective.counts(MetricsRegistry()) is None
+    # and an evaluation over an empty registry stays healthy
+    mon = slo.SLOMonitor(registry=MetricsRegistry(),
+                         windows=WINDOWS, clock=FakeClock())
+    statuses = mon.evaluate_once()
+    assert all(st.healthy for st in statuses.values())
+
+
+def test_slo_target_validation():
+    with pytest.raises(ValueError):
+        slo.AvailabilitySLO(target=1.0)
+    with pytest.raises(ValueError):
+        slo.SLOMonitor([slo.AvailabilitySLO(), slo.AvailabilitySLO()],
+                       registry=MetricsRegistry())
+
+
+# ------------------------------------------------------- breach actions
+def test_breach_fires_dump_annotation_and_callback(tmp_path, metrics):
+    clock = FakeClock()
+    requests = metrics.labeled_counter("tpudl_serve_requests_total")
+    cluster = ClusterStore()
+    events = []
+    dump_path = str(tmp_path / "slo_flight.jsonl")
+    mon = _availability_monitor(metrics, clock, cluster=cluster,
+                                dump_path=dump_path,
+                                on_breach=events.append)
+    for _ in range(2):
+        requests.inc(9, status="error")
+        requests.inc(1, status="ok")
+        mon.evaluate_once()
+        clock.advance(10.0)
+    assert len(events) == 1
+    event = events[0]
+    assert event.slo == "availability"
+    assert event.burn_rate > WINDOWS[0].threshold
+    assert "fast" in event.windows
+    # flight dump with the slo: reason landed at the configured path
+    assert os.path.exists(dump_path)
+    lines = flight_recorder.read_dump(dump_path)
+    header = next(l for l in lines if l.get("type") == "header")
+    assert header["reason"] == "slo:availability"
+    assert "burn rate" in header["detail"]["message"]
+    # /cluster dashboard annotation
+    notes = cluster.summary()["annotations"]
+    assert any(n["kind"] == "slo_breach" and n["slo"] == "availability"
+               for n in notes)
+    assert mon.breach_count("availability") == 1
+    assert mon.breach_count("latency_p99_500ms") == 0
+
+
+def test_on_breach_exceptions_do_not_kill_the_evaluator(metrics):
+    clock = FakeClock()
+    requests = metrics.labeled_counter("tpudl_serve_requests_total")
+
+    def boom(event):
+        raise RuntimeError("pager down")
+
+    mon = _availability_monitor(metrics, clock, on_breach=boom)
+    for _ in range(2):
+        requests.inc(9, status="error")
+        requests.inc(1, status="ok")
+        mon.evaluate_once()               # must not raise
+        clock.advance(10.0)
+    assert mon.breach_count() == 1
+
+
+def test_background_evaluator_thread_starts_and_joins(metrics):
+    metrics.labeled_counter("tpudl_serve_requests_total").inc(
+        10, status="ok")
+    mon = slo.SLOMonitor([slo.AvailabilitySLO(target=0.99)],
+                         registry=metrics, windows=WINDOWS, poll_s=0.01)
+    with mon:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if metrics.counter("tpudl_slo_evaluations_total").value >= 2:
+                break
+            time.sleep(0.01)
+    assert metrics.counter("tpudl_slo_evaluations_total").value >= 2
+    assert mon._thread is None            # close() joined it
+    mon.close()                           # idempotent
+
+
+# ----------------------------------------------------------- end to end
+N_IN, N_OUT = 6, 3
+
+
+def _conf(seed=42):
+    return (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Adam(1e-2)).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=N_OUT, activation="identity",
+                               loss="mse"))
+            .set_input_type(InputType.feed_forward(N_IN)).build())
+
+
+def _trained_zip(tmp_path, name, seed):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(64, N_IN)).astype(np.float32)
+    y = rng.normal(size=(64, N_OUT)).astype(np.float32)
+    net = MultiLayerNetwork(_conf(seed)).init()
+    net.fit(ListDataSetIterator([DataSet(x[i:i + 16], y[i:i + 16])
+                                 for i in range(0, 64, 16)]), epochs=1)
+    path = str(tmp_path / name)
+    net.save(path)
+    return path
+
+
+def test_injected_error_burst_breaches_slo_and_rolls_back(tmp_path,
+                                                          metrics):
+    """The ISSUE 16 end-to-end pin: a deployed model serves clean
+    traffic, then a faults.py error burst drives the availability
+    budget — one SLOMonitor evaluation breaches, the flight dump
+    lands with reason="slo:availability", and DeployWatch's rollback
+    path restores the previous version naming the breach."""
+    v1 = _trained_zip(tmp_path, "v1.zip", seed=7)
+    v2 = _trained_zip(tmp_path, "v2.zip", seed=8)
+    dump_path = str(tmp_path / "slo_flight.jsonl")
+    registry = ModelRegistry(max_batch=8, max_latency_ms=1.0)
+    mon = slo.SLOMonitor(
+        [slo.AvailabilitySLO(target=0.999)],
+        windows=(slo.BurnWindow("fast", 300.0, 3600.0, 14.4),),
+        dump_path=dump_path)
+    x = np.zeros((1, N_IN), np.float32)
+    try:
+        registry.deploy("m", v1)
+        registry.deploy("m", v2)                 # the suspect deploy
+        for _ in range(4):                       # clean baseline traffic
+            registry.predict("m", x)
+        mon.evaluate_once()                      # healthy first snapshot
+        assert mon.status()["availability"].healthy
+        # the burst: every dispatch for the next 9 events raises inside
+        # the engine and takes the REAL per-request error path
+        with faults.inject("serve.dispatch@0:error:0:9"):
+            for _ in range(9):
+                with pytest.raises(faults.InjectedFault):
+                    registry.predict("m", x)
+        watch = DeployWatch(registry, "m", window_s=10.0, poll_s=0.02,
+                            min_requests=10_000,      # only the SLO path
+                            error_rate_max=1.0,
+                            slo_monitor=mon)
+        verdict = watch.run()
+        assert verdict["rolled_back"]
+        assert "SLO breach" in verdict["reason"]
+        assert "availability" in verdict["reason"]
+        assert registry.get("m").version == 3    # v1's zip, new version
+        assert registry.get("m").path == v1
+        assert metrics.counter("tpudl_online_rollbacks_total").value == 1
+        # the breach crossed in the published burn-rate family
+        burn = metrics.labeled_gauge(
+            "tpudl_slo_burn_rate",
+            label_names=("slo",)).labeled_value(slo="availability")
+        assert burn > 14.4
+        assert mon.breach_count("availability") == 1
+        # and the black-box dump landed with the slo: reason
+        lines = flight_recorder.read_dump(dump_path)
+        header = next(l for l in lines if l.get("type") == "header")
+        assert header["reason"] == "slo:availability"
+        assert any(l.get("kind") == "slo_breach" for l in lines
+                   if l.get("type") == "event")
+    finally:
+        registry.close()

@@ -1,0 +1,228 @@
+"""tpudl.obs.trend (ISSUE 16): the perf-trajectory sentinel.
+
+Acceptance pins:
+- the committed r01–r05 trajectory classifies honestly: BENCH r01–r04
+  real, BENCH_r05 (the legacy tunnel-down shape: rc=1, value 0.0, an
+  error string, no ``status`` key) and every MULTICHIP dryrun record
+  ``stale``, MULTICHIP_r05 (rc=124) ``failed`` — and the gate reports
+  ZERO regressions over them (a tunnel-down is never a perf drop);
+- the staleness verdict names r04 as the last real TPU measurement;
+- a synthetic r06 with ResNet-50 MFU 0.20 in a temp dir is flagged as
+  a regression naming the metric and the trailing-window baseline, and
+  ``--check`` exits nonzero on it;
+- both the legacy AND the current structured skip shapes classify
+  ``stale`` — never ``regression`` (the bench.py honesty fix).
+"""
+
+import copy
+import json
+import shutil
+
+import pytest
+
+from deeplearning4j_tpu.obs import trend
+
+
+def _committed():
+    return trend.load_trajectory()   # repo-root records
+
+
+# ------------------------------------------------- committed trajectory
+def test_committed_records_classify_honestly():
+    by = {r.label: r for r in _committed()}
+    for rnd in (1, 2, 3, 4):
+        rec = by[f"BENCH_r{rnd:02d}"]
+        assert rec.status == "real" and rec.metrics, rec
+        assert rec.metrics["resnet50_train_images_per_sec_per_chip"] > 0
+    r05 = by["BENCH_r05"]
+    assert r05.status == "stale"          # legacy tunnel-down, NOT failed
+    assert trend.looks_tunnel_down(r05.reason)
+    for rnd in (1, 2, 3, 4):
+        rec = by[f"MULTICHIP_r{rnd:02d}"]
+        assert rec.status == "stale" and "dryrun" in rec.reason
+    assert by["MULTICHIP_r05"].status == "failed"
+    assert "rc=124" in by["MULTICHIP_r05"].reason
+
+
+def test_committed_trajectory_has_zero_false_regressions():
+    # five stale/failed rounds must read as staleness, not perf drops
+    assert trend.gate(_committed()) == []
+
+
+def test_staleness_names_the_r04_frontier():
+    verdict = trend.staleness(_committed())
+    assert verdict["stale"] is True
+    assert verdict["last_real_round"] == 4
+    assert verdict["rounds_since_real"] == 1
+    assert "r04" in verdict["message"]
+
+
+def test_roadmap_targets_pending_until_a_record_past_r04():
+    rows = {r["metric"]: r for r in trend.roadmap_status(_committed())}
+    assert rows["resnet50_mfu"]["status"] == "pending"
+    assert rows["bert_mfu"]["status"] == "pending"
+    assert rows["resnet50_mfu"]["target"] == pytest.approx(0.40)
+    assert rows["bert_mfu"]["target"] == pytest.approx(0.65)
+
+
+def test_check_cli_exits_zero_on_the_committed_trajectory(capsys):
+    assert trend.main(["--check"]) == 0
+    out = capsys.readouterr().out
+    assert "BENCH_r05: stale" in out
+    assert "regressions: none" in out
+
+
+# ------------------------------------------------------ the skip shapes
+def test_legacy_r05_skip_shape_is_stale_never_regression():
+    # the exact BENCH_r05 shape: rc=1, value 0.0, error text, NO status
+    raw = {"rc": 1, "parsed": {
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": 0.0, "unit": "images/sec/chip", "vs_baseline": 0.0,
+        "error": "device probe timed out after 300s (tunnel down?)",
+        "detail": {}}}
+    status, reason, metrics = trend.classify_bench(raw)
+    assert status == "stale" and metrics == {}
+    assert "timed out" in reason
+
+
+def test_current_structured_skip_shape_is_stale():
+    # the post-fix shape bench.py writes: status="skipped", rc=0
+    raw = {"rc": 0, "parsed": {
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": 0.0, "unit": "images/sec/chip", "vs_baseline": 0.0,
+        "status": "skipped",
+        "error": "TPU tunnel down: jax fell back to CPU",
+        "detail": {"note": "see BENCH_r04"}}}
+    status, reason, metrics = trend.classify_bench(raw)
+    assert status == "stale" and metrics == {}
+
+
+def test_non_tunnel_legacy_error_is_failed_not_stale():
+    raw = {"rc": 1, "parsed": {"value": 0.0, "detail": {},
+                               "error": "segfault in the XLA runtime"}}
+    assert trend.classify_bench(raw)[0] == "failed"
+
+
+def test_multichip_dryrun_is_stale_and_measured_is_real():
+    dryrun = {"rc": 0, "ok": True, "tail": "dryrun ok"}
+    assert trend.classify_multichip(dryrun)[0] == "stale"
+    measured = {"rc": 0, "ok": True,
+                "per_chip_scaling_efficiency": 0.93,
+                "straggler_skew": 1.1}
+    status, _, metrics = trend.classify_multichip(measured)
+    assert status == "real"
+    assert metrics["per_chip_scaling_efficiency"] == pytest.approx(0.93)
+
+
+def test_corrupt_record_classifies_failed_not_crash(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text("{torn json")
+    records = trend.load_trajectory(str(tmp_path))
+    assert len(records) == 1 and records[0].status == "failed"
+    assert trend.gate(records) == []
+
+
+# --------------------------------------------------- synthetic r06 gate
+def _seed_r06(tmp_path, mfu=0.20):
+    """A temp trajectory = the committed BENCH records + an r06 whose
+    ResNet-50 MFU slid to ``mfu`` (throughput stays plausible)."""
+    for rec in trend.load_trajectory():
+        if rec.kind == "bench":
+            shutil.copy(rec.path, tmp_path / f"BENCH_r{rec.round:02d}.json")
+    with open(tmp_path / "BENCH_r04.json") as f:
+        raw = copy.deepcopy(json.load(f))
+    raw["parsed"]["detail"]["mfu"] = mfu
+    raw["parsed"]["value"] = 2200.0
+    (tmp_path / "BENCH_r06.json").write_text(json.dumps(raw))
+    return str(tmp_path)
+
+
+def test_synthetic_r06_mfu_slide_is_flagged_with_baseline(tmp_path):
+    records = trend.load_trajectory(_seed_r06(tmp_path))
+    regressions = trend.gate(records)
+    assert len(regressions) == 1
+    reg = regressions[0]
+    assert reg.metric == "resnet50_mfu"
+    assert reg.record == "BENCH_r06"
+    assert reg.value == pytest.approx(0.20)
+    # baseline = median over the trailing window of REAL records that
+    # measured the metric (the MFU stamp exists from r03 on)
+    history = [r.metrics["resnet50_mfu"] for r in records
+               if r.status == "real" and r.round < 6
+               and "resnet50_mfu" in r.metrics]
+    import statistics
+    assert reg.baseline == pytest.approx(statistics.median(history))
+    assert reg.window == len(history)
+    rendered = reg.render()
+    assert "resnet50_mfu" in rendered
+    assert "trailing-window median" in rendered
+
+
+def test_check_cli_exits_nonzero_on_the_synthetic_regression(tmp_path,
+                                                             capsys):
+    root = _seed_r06(tmp_path)
+    assert trend.main(["--check", "--dir", root]) == 1
+    out = capsys.readouterr().out
+    assert "resnet50_mfu" in out
+    assert "regression" in out
+
+
+def test_roadmap_targets_flip_once_r06_lands(tmp_path):
+    records = trend.load_trajectory(_seed_r06(tmp_path))
+    rows = {r["metric"]: r for r in trend.roadmap_status(records)}
+    assert rows["resnet50_mfu"]["status"] == "fail"     # 0.20 < 0.40
+    assert rows["resnet50_mfu"]["value"] == pytest.approx(0.20)
+    assert rows["bert_mfu"]["status"] == "fail"         # 0.523 < 0.65
+
+
+def test_stale_r06_never_reads_as_regression(tmp_path):
+    # a tunnel-down r06 on top of the committed history: staleness
+    # moves, the gate stays silent
+    for rec in trend.load_trajectory():
+        if rec.kind == "bench":
+            shutil.copy(rec.path, tmp_path / f"BENCH_r{rec.round:02d}.json")
+    (tmp_path / "BENCH_r06.json").write_text(json.dumps(
+        {"rc": 0, "parsed": {"value": 0.0, "status": "skipped",
+                             "error": "tunnel down", "detail": {}}}))
+    records = trend.load_trajectory(str(tmp_path))
+    assert trend.gate(records) == []
+    verdict = trend.staleness(records)
+    assert verdict["last_real_round"] == 4
+    assert verdict["rounds_since_real"] == 2
+
+
+# ----------------------------------------------------- write-time stamp
+def test_stamp_verdict_marks_skip_records_stale():
+    record = {"value": 0.0, "status": "skipped",
+              "error": "tunnel down", "detail": {}}
+    stamp = trend.stamp_verdict(record)
+    assert record["trend"] is stamp
+    assert stamp["verdict"] == "stale" and stamp["regressions"] == []
+
+
+def test_stamp_verdict_flags_a_regressing_record():
+    import os
+    r04 = os.path.join(trend.default_records_dir(), "BENCH_r04.json")
+    with open(r04) as f:
+        parsed = copy.deepcopy(json.load(f)["parsed"])
+    parsed["detail"]["mfu"] = 0.20
+    parsed["value"] = 2200.0
+    stamp = trend.stamp_verdict(parsed)
+    assert stamp["verdict"] == "regression"
+    assert any("resnet50_mfu" in line for line in stamp["regressions"])
+
+
+def test_stamp_verdict_ok_on_a_healthy_record():
+    import os
+    r04 = os.path.join(trend.default_records_dir(), "BENCH_r04.json")
+    with open(r04) as f:
+        parsed = copy.deepcopy(json.load(f)["parsed"])
+    stamp = trend.stamp_verdict(parsed)
+    assert stamp["verdict"] == "ok" and stamp["regressions"] == []
+
+
+def test_stamp_verdict_never_raises_on_a_broken_trajectory(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text("{torn")
+    record = {"value": 1.0, "detail": {}}
+    stamp = trend.stamp_verdict(record, records_dir=str(tmp_path))
+    assert stamp["verdict"] in ("ok", "failed", "unknown")
+    assert "trend" in record
