@@ -34,6 +34,13 @@ from deeplearning4j_tpu.analyze.lint import (
 from deeplearning4j_tpu.analyze.concurrency import (
     analyze_concurrency_paths, analyze_concurrency_package,
     register_concurrency_rule)
+from deeplearning4j_tpu.analyze.dataflow import (
+    analyze_dataflow_paths, analyze_dataflow_package, build_project,
+    env_table_markdown, register_dataflow_rule)
+from deeplearning4j_tpu.analyze.callgraph import build_callgraph
+from deeplearning4j_tpu.analyze.sarif import (
+    report_to_sarif, report_to_sarif_json, sarif_to_findings)
+from deeplearning4j_tpu.analyze.pragmas import collect_pragmas, pragma_report
 
 __all__ = [
     "Diagnostic", "Report", "RULES", "RuleInfo", "ERROR", "WARNING", "INFO",
@@ -42,4 +49,8 @@ __all__ = [
     "lint_paths", "lint_package", "check_metric_names", "check_op_catalog",
     "analyze_concurrency_paths", "analyze_concurrency_package",
     "register_concurrency_rule",
+    "analyze_dataflow_paths", "analyze_dataflow_package", "build_project",
+    "build_callgraph", "env_table_markdown", "register_dataflow_rule",
+    "report_to_sarif", "report_to_sarif_json", "sarif_to_findings",
+    "collect_pragmas", "pragma_report",
 ]

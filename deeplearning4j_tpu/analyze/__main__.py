@@ -11,25 +11,42 @@ report and one exit code):
 - ``--concurrency [<path> ...]``: static race/deadlock analysis
   (TPU4xx) over the given paths — with no paths (or with ``--self``)
   over the ``deeplearning4j_tpu`` tree itself (also CI-gated).
+- ``--dataflow [<path> ...]``: whole-program interprocedural analysis
+  (TPU5xx: donation-after-use, traced host escapes, DL4J_TPU_* env
+  contract drift, Python shape dependence) — the given paths are
+  analyzed as ONE program; with no paths, the ``deeplearning4j_tpu``
+  tree itself (also CI-gated).
 - ``--layout <layout>``: statically validate a composite mesh layout
   (the ``Trainer(layout=...)`` flag, e.g. ``dp2xtp2xpp2``) against the
   unified axis table, the device count, and the TP rule family
   (TPU201–203) — combinable with ``--model`` so a model + its layout
   gate together.
+- ``--pragmas [<path> ...]``: suppression-debt report — every
+  ``# tpudl: ok(...)`` with its rules, reason and blame age; pragmas
+  naming rule IDs no longer in the catalog are errors.
+
+``--changed [REF]`` scopes any AST family to the files ``git diff
+--name-only REF`` reports (default REF ``HEAD``) — cheap enough for a
+pre-commit hook.  ``--dataflow`` still builds the whole-program model
+(facts cross files) but reports only findings anchored in changed files.
 
 Combined runs share one parsed AST per file (``analyze.source`` cache),
-so ``--self --lint --concurrency`` parses each module once.
+so ``--self --lint --concurrency --dataflow`` parses each module once.
 
 Exit code 0 = no error-severity diagnostics; 1 = errors found;
 2 = usage/load failure.  ``--format json`` emits one machine-readable
 document for tooling: every family reports the same finding-object
 schema (rule/slug/family/severity/path/message/hint), with
 pragma-suppressed findings carried separately under ``"suppressed"``.
+``--format sarif`` emits the same report as a SARIF 2.1.0 log for CI
+inline annotation.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
+import subprocess
 import sys
 
 from deeplearning4j_tpu.analyze.diagnostics import Report
@@ -38,6 +55,8 @@ from deeplearning4j_tpu.analyze.model_checks import (
 from deeplearning4j_tpu.analyze.lint import lint_paths, lint_package
 from deeplearning4j_tpu.analyze.concurrency import (
     analyze_concurrency_package, analyze_concurrency_paths)
+from deeplearning4j_tpu.analyze.dataflow import (
+    analyze_dataflow_package, analyze_dataflow_paths)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -57,6 +76,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="static race/deadlock analysis (TPU4xx) over the "
                         "given files/directories; with no paths, over the "
                         "deeplearning4j_tpu tree itself")
+    p.add_argument("--dataflow", nargs="*", metavar="PATH", default=None,
+                   help="whole-program interprocedural analysis (TPU5xx) "
+                        "over the given files/directories as ONE program; "
+                        "with no paths, over the deeplearning4j_tpu tree "
+                        "itself")
+    p.add_argument("--pragmas", nargs="*", metavar="PATH", default=None,
+                   help="suppression-debt report: every '# tpudl: ok(...)' "
+                        "with rules, reason and blame age; with no paths, "
+                        "over the deeplearning4j_tpu tree itself")
+    p.add_argument("--changed", nargs="?", metavar="REF", const="HEAD",
+                   default=None,
+                   help="scope AST families to files changed vs the given "
+                        "git ref (default HEAD) — the pre-commit shape; "
+                        "--dataflow still builds the whole program but "
+                        "reports only findings in changed files")
     p.add_argument("--hbm-budget", metavar="SIZE",
                    help="fail if the estimated training footprint exceeds "
                         "this (e.g. 16GiB)")
@@ -79,19 +113,74 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--devices", type=int, default=None,
                    help="device count to validate --layout against "
                         "(default: this host's jax.devices())")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text")
     p.add_argument("--no-hints", action="store_true",
                    help="omit fix hints from text output")
     return p
 
 
+def changed_files(ref: str) -> list[str]:
+    """Python files ``git diff --name-only <ref>`` reports (tracked
+    changes + staged adds), as absolute paths that still exist."""
+    out = subprocess.run(
+        ["git", "diff", "--name-only", ref, "--"],
+        capture_output=True, text=True, timeout=30)
+    if out.returncode != 0:
+        raise ValueError(
+            f"git diff --name-only {ref!r} failed: "
+            f"{out.stderr.strip() or out.stdout.strip()}")
+    top = subprocess.run(["git", "rev-parse", "--show-toplevel"],
+                         capture_output=True, text=True, timeout=30)
+    root = top.stdout.strip() if top.returncode == 0 else os.getcwd()
+    files = []
+    for line in out.stdout.splitlines():
+        line = line.strip()
+        if line.endswith(".py"):
+            path = os.path.join(root, line)
+            if os.path.exists(path):
+                files.append(path)
+    return files
+
+
+def _scope_to(paths, changed: list[str]):
+    """Intersect requested paths with the changed set (a changed file
+    counts when it sits under a requested directory)."""
+    changed_abs = {os.path.abspath(c) for c in changed}
+    keep = []
+    for c in changed_abs:
+        for p in paths:
+            ap = os.path.abspath(p)
+            if c == ap or c.startswith(ap.rstrip(os.sep) + os.sep):
+                keep.append(c)
+                break
+    return sorted(keep)
+
+
+def _filter_report_to(report: Report, files: list[str]) -> Report:
+    """Keep only findings anchored in ``files`` (whole-program modes
+    under --changed: the model spans the tree, the report doesn't)."""
+    keep = {os.path.abspath(f) for f in files}
+
+    def _kept(d):
+        anchor = (d.path or "")
+        base = anchor.rpartition(":")[0] or anchor
+        return not base or os.path.abspath(base) in keep
+
+    report.diagnostics = [d for d in report.diagnostics if _kept(d)]
+    report.suppressed = [d for d in report.suppressed if _kept(d)]
+    return report
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if not (args.model or args.self_check or args.lint or args.layout
-            or args.concurrency is not None):
+            or args.concurrency is not None or args.dataflow is not None
+            or args.pragmas is not None):
         build_parser().print_usage(sys.stderr)
-        print("error: nothing to do — pass --model, --self, --lint "
-              "and/or --concurrency", file=sys.stderr)
+        print("error: nothing to do — pass --model, --self, --lint, "
+              "--concurrency, --dataflow and/or --pragmas",
+              file=sys.stderr)
         return 2
 
     try:
@@ -100,7 +189,22 @@ def main(argv=None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
 
+    changed = None
+    if args.changed is not None:
+        try:
+            changed = changed_files(args.changed)
+        except (ValueError, OSError, subprocess.SubprocessError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+
+    import deeplearning4j_tpu
+    package_dir = os.path.dirname(os.path.abspath(
+        deeplearning4j_tpu.__file__))
+
     report = Report()
+    if changed is not None:
+        report.context["changed_ref"] = args.changed
+        report.context["changed_files"] = len(changed)
     if args.model:
         try:
             conf = load_model_conf(args.model)
@@ -121,17 +225,48 @@ def main(argv=None) -> int:
                                    n_devices=args.devices,
                                    mesh_axes=mesh_axes))
     if args.self_check:
-        report.extend(lint_package())
+        if changed is not None:
+            scoped = _scope_to([package_dir], changed)
+            if scoped:
+                report.extend(lint_paths(scoped))
+        else:
+            report.extend(lint_package())
     if args.lint:
-        report.extend(lint_paths(args.lint))
+        paths = _scope_to(args.lint, changed) if changed is not None \
+            else args.lint
+        if paths:
+            report.extend(lint_paths(paths))
     if args.concurrency is not None:
-        report.extend(analyze_concurrency_paths(args.concurrency)
-                      if args.concurrency
-                      else analyze_concurrency_package())
+        base = args.concurrency or [package_dir]
+        paths = _scope_to(base, changed) if changed is not None else base
+        if paths:
+            report.extend(analyze_concurrency_paths(paths)
+                          if args.concurrency or changed is not None
+                          else analyze_concurrency_package())
+    if args.dataflow is not None:
+        sub = (analyze_dataflow_paths(args.dataflow) if args.dataflow
+               else analyze_dataflow_package())
+        if changed is not None:
+            sub = _filter_report_to(sub, changed)
+        report.extend(sub)
+    pragma_records = None
+    if args.pragmas is not None:
+        from deeplearning4j_tpu.analyze.pragmas import pragma_report
+        sub = pragma_report(args.pragmas or None)
+        pragma_records = sub.context.get("pragma_inventory", [])
+        report.extend(sub)
 
     if args.format == "json":
         print(report.to_json())
+    elif args.format == "sarif":
+        from deeplearning4j_tpu.analyze.sarif import report_to_sarif_json
+        print(report_to_sarif_json(report))
     else:
+        if pragma_records is not None:
+            from deeplearning4j_tpu.analyze.pragmas import render_pragmas_text
+            print(render_pragmas_text(pragma_records))
+            # the inventory is printed above; keep the text report lean
+            report.context.pop("pragma_inventory", None)
         print(report.render_text(show_hints=not args.no_hints))
     return report.exit_code()
 

@@ -1,0 +1,485 @@
+"""Project-wide call graph for the whole-program dataflow analyzer.
+
+Every analyzer family before this one (TPU1xx model, TPU2xx sharding,
+TPU3xx lint, TPU4xx concurrency) reasons one module at a time.  The
+TPU5xx dataflow family needs the piece they all lack: *who calls whom
+across module boundaries*, with enough argument-position information to
+carry value facts (donated buffer, traced value, env-var literal)
+along the edge.
+
+The graph is built once per analyzed path set, over the shared
+``analyze.source`` AST cache (one parse per file, shared with every
+other family in the same process).  Resolution is deliberately
+syntactic — no imports are executed:
+
+- **module naming** — a file inside a package tree gets its dotted name
+  relative to the topmost ``__init__.py`` ancestor
+  (``deeplearning4j_tpu.train.trainer``); a loose file (fixtures,
+  scripts) gets its stem.
+- **def/use** — module-level functions, class methods under
+  class-qualified names (``Trainer.fit``), and nested defs under their
+  parent (``fit.worker``) — the same unit shapes the concurrency model
+  discovers thread entry points in.
+- **call edges** — bare names resolve through nested siblings, module
+  functions, then ``from mod import name`` aliases; ``alias.attr``
+  resolves through ``import mod as alias``; ``self.m`` resolves to the
+  method on the owning class (then string-matched project bases);
+  ``obj.m`` resolves when ``obj`` is a local constructed from a
+  resolvable project class (``t = Trainer(...)`` → ``Trainer.m``).
+  Constructor calls edge to ``__init__``.
+
+Each edge carries its ``ast.Call`` so the dataflow pass can map caller
+argument expressions onto callee parameter names (``bind_args``).
+``cross_module_edges()`` is the resolver's health metric — the tier-1
+floor test asserts it stays above a minimum on the real tree, so a
+refactor that silently blinds resolution fails CI instead of quietly
+hollowing out the TPU5xx family.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, Optional
+
+from deeplearning4j_tpu.analyze import source as source_cache
+
+UnitKey = tuple[str, str]          # (module dotted name, qualified name)
+
+
+def module_name_for(path: str) -> str:
+    """Dotted module name: walk up while ``__init__.py`` siblings exist
+    so ``…/deeplearning4j_tpu/train/trainer.py`` names itself
+    ``deeplearning4j_tpu.train.trainer`` regardless of cwd."""
+    path = os.path.abspath(path)
+    parts = [os.path.splitext(os.path.basename(path))[0]]
+    d = os.path.dirname(path)
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        parts.append(os.path.basename(d))
+        d = os.path.dirname(d)
+    if parts[0] == "__init__":
+        parts = parts[1:] or [os.path.basename(os.path.dirname(path))]
+    return ".".join(reversed(parts))
+
+
+class FunctionUnit:
+    """One callable: module function, method, or nested def."""
+
+    __slots__ = ("key", "node", "path", "params", "cls", "decorators")
+
+    def __init__(self, key: UnitKey, node: ast.AST, path: str,
+                 cls: Optional[str]):
+        self.key = key
+        self.node = node
+        self.path = path
+        self.cls = cls                       # owning class name or None
+        args = node.args
+        self.params = [a.arg for a in (args.posonlyargs + args.args)]
+        self.decorators = list(node.decorator_list)
+
+    @property
+    def name(self) -> str:
+        return f"{self.key[0]}:{self.key[1]}"
+
+    @property
+    def lineno(self) -> int:
+        return getattr(self.node, "lineno", 0)
+
+    def bind_args(self, call: ast.Call) -> dict[str, ast.expr]:
+        """Map callee parameter names → caller argument expressions for
+        one call site (best-effort: *args/**kwargs are skipped).  The
+        implicit ``self`` of a method is skipped for attribute calls."""
+        params = self.params
+        if self.cls is not None and params and params[0] in ("self", "cls"):
+            params = params[1:]
+        bound: dict[str, ast.expr] = {}
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                break
+            if i < len(params):
+                bound[params[i]] = arg
+        for kw in call.keywords:
+            if kw.arg is not None and kw.arg in self.params:
+                bound[kw.arg] = kw.value
+        return bound
+
+    def param_index(self, name: str) -> Optional[int]:
+        try:
+            return self.params.index(name)
+        except ValueError:
+            return None
+
+
+class ModuleGraph:
+    """Per-module symbol facts the resolver needs."""
+
+    def __init__(self, module: str, path: str, tree: ast.Module):
+        self.module = module
+        self.path = path
+        self.tree = tree
+        self.import_aliases: dict[str, str] = {}   # alias → module dotted
+        self.from_imports: dict[str, tuple[str, str]] = {}  # name → (mod, attr)
+        self.functions: dict[str, FunctionUnit] = {}        # qual → unit
+        self.classes: dict[str, list[str]] = {}             # name → base names
+        self.str_constants: dict[str, str] = {}    # NAME → literal value
+        # NAME = other.CONST / NAME = CONST at module level (the
+        # supervisor's `GENERATION_ENV = obs_remote.GENERATION_ENV` re-
+        # export idiom): NAME → (receiver name or None, attr)
+        self.const_aliases: dict[str, tuple[Optional[str], str]] = {}
+        self._collect()
+
+    def _collect(self) -> None:
+        # imports anywhere in the file — this tree leans on function-
+        # local imports (cycle breaking), and an import is an import
+        for stmt in ast.walk(self.tree):
+            if isinstance(stmt, ast.Import):
+                for alias in stmt.names:
+                    self.import_aliases[alias.asname or
+                                        alias.name.split(".")[0]] = alias.name
+            elif isinstance(stmt, ast.ImportFrom):
+                if stmt.module is None:
+                    continue
+                mod = stmt.module
+                if stmt.level:
+                    # relative import: resolve against this module's package
+                    base = self.module.split(".")
+                    base = base[:len(base) - stmt.level]
+                    mod = ".".join(base + [stmt.module]) if base \
+                        else stmt.module
+                for alias in stmt.names:
+                    self.from_imports[alias.asname or alias.name] = \
+                        (mod, alias.name)
+        for stmt in self.tree.body:
+            if isinstance(stmt, ast.Assign) \
+                    and isinstance(stmt.value, ast.Constant) \
+                    and isinstance(stmt.value.value, str):
+                for target in stmt.targets:
+                    if isinstance(target, ast.Name):
+                        self.str_constants[target.id] = stmt.value.value
+            elif isinstance(stmt, ast.Assign) \
+                    and isinstance(stmt.value, ast.Attribute) \
+                    and isinstance(stmt.value.value, ast.Name):
+                for target in stmt.targets:
+                    if isinstance(target, ast.Name):
+                        self.const_aliases[target.id] = \
+                            (stmt.value.value.id, stmt.value.attr)
+            elif isinstance(stmt, ast.Assign) \
+                    and isinstance(stmt.value, ast.Name):
+                for target in stmt.targets:
+                    if isinstance(target, ast.Name):
+                        self.const_aliases[target.id] = \
+                            (None, stmt.value.id)
+
+
+class CallSite:
+    """One resolved (or unresolved) call edge out of a unit."""
+
+    __slots__ = ("caller", "callee", "call", "lineno")
+
+    def __init__(self, caller: UnitKey, callee: Optional[UnitKey],
+                 call: ast.Call):
+        self.caller = caller
+        self.callee = callee               # None when unresolvable
+        self.call = call
+        self.lineno = call.lineno
+
+
+class CallGraph:
+    """The whole-program model: modules, units, and call edges."""
+
+    def __init__(self, paths: Iterable[str]):
+        self.modules: dict[str, ModuleGraph] = {}
+        self.by_basename: dict[str, str] = {}     # last segment → dotted
+        self.units: dict[UnitKey, FunctionUnit] = {}
+        self.edges: dict[UnitKey, list[CallSite]] = {}
+        self.unparsed: list[tuple[str, str]] = []  # (path, reason)
+        self.files: list[str] = []
+        self._load(paths)
+        self._register_units()
+        self._build_edges()
+
+    # ------------------------------------------------------------ loading
+    def _load(self, paths: Iterable[str]) -> None:
+        from deeplearning4j_tpu.analyze.lint import iter_python_files
+        files, missing = iter_python_files(
+            [paths] if isinstance(paths, str) else list(paths))
+        for path in missing:
+            self.unparsed.append((path, "path does not exist"))
+        for path in files:
+            try:
+                sf = source_cache.load_source(path)
+            except SyntaxError as e:
+                self.unparsed.append((f"{path}:{e.lineno}",
+                                      f"does not parse: {e.msg}"))
+                continue
+            except (OSError, ValueError) as e:
+                self.unparsed.append((path, f"unreadable: {e}"))
+                continue
+            mod = module_name_for(path)
+            mg = ModuleGraph(mod, path, sf.tree)
+            self.modules[mod] = mg
+            self.by_basename.setdefault(mod.rsplit(".", 1)[-1], mod)
+            self.files.append(path)
+
+    # ------------------------------------------------------ unit registry
+    def _register_units(self) -> None:
+        for mg in self.modules.values():
+            for stmt in mg.tree.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._register(mg, stmt, prefix="", cls=None)
+                elif isinstance(stmt, ast.ClassDef):
+                    bases = []
+                    for b in stmt.bases:
+                        if isinstance(b, ast.Name):
+                            bases.append(b.id)
+                        elif isinstance(b, ast.Attribute):
+                            bases.append(b.attr)
+                    mg.classes[stmt.name] = bases
+                    for sub in stmt.body:
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            self._register(mg, sub, prefix=stmt.name,
+                                           cls=stmt.name)
+
+    def _register(self, mg: ModuleGraph, node, prefix: str,
+                  cls: Optional[str]) -> None:
+        qual = f"{prefix}.{node.name}" if prefix else node.name
+        key = (mg.module, qual)
+        unit = FunctionUnit(key, node, mg.path, cls)
+        self.units[key] = unit
+        mg.functions[qual] = unit
+        for sub in node.body:
+            self._walk_nested(mg, sub, qual, cls)
+
+    def _walk_nested(self, mg: ModuleGraph, stmt, prefix: str,
+                     cls: Optional[str]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._register(mg, stmt, prefix=prefix, cls=cls)
+            return
+        for sub in ast.iter_child_nodes(stmt):
+            if isinstance(sub, ast.stmt):
+                self._walk_nested(mg, sub, prefix, cls)
+
+    # --------------------------------------------------------- resolution
+    def resolve_module(self, dotted: str) -> Optional[str]:
+        """A dotted import target → a loaded module's key, tolerating
+        partial path sets (fixtures import by bare stem)."""
+        if dotted in self.modules:
+            return dotted
+        tail = dotted.rsplit(".", 1)[-1]
+        return self.by_basename.get(tail)
+
+    def resolve_name(self, mg: ModuleGraph, name: str,
+                     scope: Optional[UnitKey] = None) -> Optional[UnitKey]:
+        """A bare name in ``mg`` → unit key (nested sibling, module
+        function, then from-import)."""
+        if scope is not None:
+            nested = (scope[0], f"{scope[1]}.{name}")
+            if nested in self.units:
+                return nested
+        if name in mg.functions:
+            return (mg.module, name)
+        target = mg.from_imports.get(name)
+        if target is not None:
+            mod = self.resolve_module(target[0])
+            if mod is not None:
+                key = (mod, target[1])
+                if key in self.units:
+                    return key
+                # from mod import Cls → constructor
+                init = (mod, f"{target[1]}.__init__")
+                if init in self.units:
+                    return init
+                if target[1] in self.modules[mod].classes:
+                    return None
+        if name in mg.classes:
+            init = (mg.module, f"{name}.__init__")
+            return init if init in self.units else None
+        return None
+
+    def resolve_method(self, module: str, cls: str,
+                       meth: str) -> Optional[UnitKey]:
+        """``cls.meth`` with project-base-class fallback (by name)."""
+        seen: set[tuple[str, str]] = set()
+        stack = [(module, cls)]
+        while stack:
+            mod, cname = stack.pop()
+            if (mod, cname) in seen:
+                continue
+            seen.add((mod, cname))
+            key = (mod, f"{cname}.{meth}")
+            if key in self.units:
+                return key
+            mg = self.modules.get(mod)
+            if mg is None:
+                continue
+            for base in mg.classes.get(cname, ()):
+                if base in mg.classes:
+                    stack.append((mod, base))
+                else:
+                    target = mg.from_imports.get(base)
+                    if target is not None:
+                        bmod = self.resolve_module(target[0])
+                        if bmod is not None:
+                            stack.append((bmod, target[1]))
+        return None
+
+    def resolve_call(self, unit: FunctionUnit, call: ast.Call,
+                     local_types: Optional[dict[str, tuple[str, str]]] = None
+                     ) -> Optional[UnitKey]:
+        """Resolve one call expression from inside ``unit``."""
+        mg = self.modules.get(unit.key[0])
+        if mg is None:
+            return None
+        func = call.func
+        if isinstance(func, ast.Name):
+            return self.resolve_name(mg, func.id, scope=unit.key)
+        if not isinstance(func, ast.Attribute):
+            return None
+        recv, attr = func.value, func.attr
+        if isinstance(recv, ast.Name):
+            if recv.id in ("self", "cls") and unit.cls is not None:
+                return self.resolve_method(unit.key[0], unit.cls, attr)
+            # module alias: import X as alias / import X
+            dotted = mg.import_aliases.get(recv.id)
+            if dotted is not None:
+                mod = self.resolve_module(dotted)
+                if mod is not None:
+                    key = (mod, attr)
+                    if key in self.units:
+                        return key
+                    init = (mod, f"{attr}.__init__")
+                    if init in self.units:
+                        return init
+                return None
+            # from X import sub (a submodule): sub.attr
+            target = mg.from_imports.get(recv.id)
+            if target is not None:
+                mod = self.resolve_module(f"{target[0]}.{target[1]}")
+                if mod is not None:
+                    key = (mod, attr)
+                    if key in self.units:
+                        return key
+                # from X import Cls;  Cls.static_method(...)
+                mod = self.resolve_module(target[0])
+                if mod is not None:
+                    key = (mod, f"{target[1]}.{attr}")
+                    if key in self.units:
+                        return key
+            # typed local: obj = Trainer(...);  obj.m(...)
+            if local_types is not None and recv.id in local_types:
+                mod, cname = local_types[recv.id]
+                return self.resolve_method(mod, cname, attr)
+            # Cls.method(...) on a module-local class
+            if recv.id in mg.classes:
+                return self.resolve_method(mg.module, recv.id, attr)
+        if isinstance(recv, ast.Attribute) \
+                and isinstance(recv.value, ast.Name):
+            # pkg.mod.fn(...) via `import pkg.mod`
+            dotted = mg.import_aliases.get(recv.value.id)
+            if dotted is not None:
+                mod = self.resolve_module(f"{dotted}.{recv.attr}")
+                if mod is None:
+                    mod = self.resolve_module(recv.attr)
+                if mod is not None:
+                    key = (mod, attr)
+                    if key in self.units:
+                        return key
+        return None
+
+    def class_of_ctor(self, unit: FunctionUnit,
+                      call: ast.Call) -> Optional[tuple[str, str]]:
+        """``Trainer(...)`` → (module, class) when the ctor resolves to a
+        project class — drives ``obj.m`` resolution for typed locals."""
+        mg = self.modules.get(unit.key[0])
+        if mg is None:
+            return None
+        func = call.func
+        name = None
+        if isinstance(func, ast.Name):
+            name = func.id
+            if name in mg.classes:
+                return (mg.module, name)
+            target = mg.from_imports.get(name)
+            if target is not None:
+                mod = self.resolve_module(target[0])
+                if mod is not None and target[1] in self.modules[mod].classes:
+                    return (mod, target[1])
+        elif isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name):
+            dotted = mg.import_aliases.get(func.value.id)
+            if dotted is not None:
+                mod = self.resolve_module(dotted)
+                if mod is not None and func.attr in self.modules[mod].classes:
+                    return (mod, func.attr)
+        return None
+
+    # ------------------------------------------------------- edge building
+    def _build_edges(self) -> None:
+        for key, unit in self.units.items():
+            sites: list[CallSite] = []
+            local_types = self._local_types(unit)
+            for node in self._own_nodes(unit):
+                if isinstance(node, ast.Call):
+                    callee = self.resolve_call(unit, node,
+                                               local_types=local_types)
+                    sites.append(CallSite(key, callee, node))
+            self.edges[key] = sites
+
+    def _local_types(self, unit: FunctionUnit) -> dict[str, tuple[str, str]]:
+        types: dict[str, tuple[str, str]] = {}
+        for node in self._own_nodes(unit):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                cls = self.class_of_ctor(unit, node.value)
+                if cls is not None:
+                    for target in node.targets:
+                        if isinstance(target, ast.Name):
+                            types[target.id] = cls
+        return types
+
+    def _own_nodes(self, unit: FunctionUnit):
+        """Walk the unit's body without descending into nested defs
+        (they are their own units)."""
+        stack = list(ast.iter_child_nodes(unit.node))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    # ----------------------------------------------------------- queries
+    def callers_of(self, key: UnitKey) -> list[CallSite]:
+        return [s for sites in self.edges.values() for s in sites
+                if s.callee == key]
+
+    def cross_module_edges(self) -> list[CallSite]:
+        """Resolved edges whose caller and callee live in different
+        modules — the resolver's health metric (floor-tested)."""
+        return [s for sites in self.edges.values() for s in sites
+                if s.callee is not None and s.callee[0] != s.caller[0]]
+
+    def resolved_edges(self) -> int:
+        return sum(1 for sites in self.edges.values() for s in sites
+                   if s.callee is not None)
+
+    def reachable_from(self, roots: Iterable[UnitKey]) -> set[UnitKey]:
+        seen: set[UnitKey] = set()
+        stack = [r for r in roots if r in self.units]
+        while stack:
+            key = stack.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            for site in self.edges.get(key, ()):
+                if site.callee is not None and site.callee not in seen:
+                    stack.append(site.callee)
+        return seen
+
+
+def build_callgraph(paths: Iterable[str]) -> CallGraph:
+    """Public entry: the project call graph over files/directories,
+    sharing parsed ASTs with every other analyzer family."""
+    return CallGraph(paths)

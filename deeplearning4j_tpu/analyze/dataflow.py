@@ -1,0 +1,1165 @@
+"""``tpudl.analyze.dataflow`` — whole-program interprocedural analysis.
+
+Every prior rule family reasons one module at a time; the bug classes
+that bite this codebase now are *cross-module contracts*: a buffer
+donated by the jit train step and read again two frames up, a
+``DL4J_TPU_*`` variable the supervisor sets and nobody reads (or reads
+and nobody sets), a traced value leaking out of a jit boundary into a
+``print`` three calls away, a ``len(batch)`` baked into an allocation
+inside the step the bucketing guard exists to protect.  This module
+runs a forward dataflow pass over the :mod:`.callgraph` project model,
+propagating value facts across call edges in both directions:
+
+- **down** (caller → callee): a traced value passed into a parameter
+  that reaches a host sink; an env-var literal passed into a parameter
+  that reaches ``os.environ.get``; a batch-shape value passed into a
+  parameter that reaches a ``jnp.zeros``/``reshape`` shape slot.
+- **up** (callee → caller): "calling me donates my parameter i"
+  summaries, "I return a traced value", "I return a donating jit
+  callable" (the ``make_train_step`` builder idiom).
+
+Summaries are computed to a fixpoint (the call graph is shallow; a
+handful of rounds converge), then one final pass emits findings.
+
+Rules (pluggable via :func:`register_dataflow_rule`):
+
+- **TPU501** donation-after-use: an argument handed to a
+  ``donate_argnums`` jit step (directly, or through a callee that
+  forwards its own parameter into a donated slot) is read again
+  afterwards in any reachable caller frame.  XLA reuses donated
+  buffers for the outputs; the read observes freed/overwritten memory
+  on TPU while silently "working" on CPU, where donation is ignored.
+- **TPU502** traced-value host escape: a value born inside a
+  jit-compiled callable flows — possibly through returns and calls —
+  into ``print``/``float``/``int``/``.item()``/a branch test without a
+  ``block_until_ready``/``device_get`` fence: a hidden device sync on
+  every call, invisible in profiles because it hides inside dispatch.
+- **TPU503** cross-process env contract drift: every ``DL4J_TPU_*``
+  literal in the tree is resolved (through module constants, imported
+  constants, and parameters that flow into ``environ`` accessors) into
+  setter/reader/declaration sets.  A var set but never read, read but
+  never set (and not declared as a user-facing knob in
+  ``config.ENV_KNOBS``), or spelled but never wired is an error — the
+  launcher/supervisor/bootstrap env contract is checked as one
+  program, and the same collection generates the docs env-var table.
+- **TPU504** Python-value shape dependence: ``len(batch)`` /
+  ``batch.shape[i]`` of a traced batch argument of a jit step flowing
+  (intra- or interprocedurally) into a ``jnp.zeros``-family or
+  ``reshape`` shape slot — every distinct batch size then compiles a
+  distinct program, the recompile-storm class ``shape_bucketing``
+  exists to prevent.
+
+Suppression: ``# tpudl: ok(TPU5xx) — reason`` at the finding's anchor
+line, same grammar and TPU400 reason contract as TPU3xx/TPU4xx.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Callable, Iterable, Optional
+
+from deeplearning4j_tpu.analyze import source as source_cache
+from deeplearning4j_tpu.analyze.callgraph import (
+    CallGraph, FunctionUnit, UnitKey, build_callgraph)
+from deeplearning4j_tpu.analyze.diagnostics import Diagnostic, Report
+
+ENV_NAME_RE = re.compile(r"^DL4J_TPU_[A-Z0-9_]+$")
+_ENV_RECEIVER_TOKENS = {"env", "environ", "envs"}
+_BATCH_PARAM_TOKENS = {"batch", "batches", "minibatch", "inputs",
+                       "examples", "xb"}
+_HOST_CAST_NAMES = {"float", "int", "bool"}
+_FENCE_ATTRS = {"block_until_ready", "device_get"}
+_STATIC_VALUE_ATTRS = {"shape", "ndim", "size", "dtype", "itemsize"}
+_ALLOC_NAMES = {"zeros", "ones", "full", "empty", "arange"}
+_MAX_ROUNDS = 12
+
+
+def _name_tokens(name: str) -> set[str]:
+    return set(name.lower().strip("_").split("_"))
+
+
+# ------------------------------------------------------------------ facts
+@dataclasses.dataclass(frozen=True)
+class CallableInfo:
+    """What calling a value does: donated positions + traced returns."""
+    donates: frozenset = frozenset()     # positional indices donated
+    returns_traced: bool = True
+    label: str = "jit callable"          # for messages
+    site: str = ""                       # where the callable was built
+
+
+@dataclasses.dataclass
+class Fact:
+    kind: str            # donated | traced | shape | envname | callable
+    detail: object       # CallableInfo / env var name / origin description
+    path: str
+    lineno: int
+
+
+@dataclasses.dataclass
+class SinkSite:
+    desc: str
+    path: str
+    lineno: int
+
+
+@dataclasses.dataclass
+class Summary:
+    """Per-unit interprocedural summary (fixpoint state)."""
+    donates: frozenset = frozenset()           # my params donated by calling me
+    returns_traced: bool = False
+    returns_callable: Optional[CallableInfo] = None
+    param_host_sink: dict = dataclasses.field(default_factory=dict)
+    param_shape_sink: dict = dataclasses.field(default_factory=dict)
+    param_env_read: frozenset = frozenset()
+    param_env_set: frozenset = frozenset()
+
+
+@dataclasses.dataclass
+class EnvSite:
+    var: str
+    kind: str            # set | read | declare | const | mention
+    path: str
+    lineno: int
+    module: str
+
+
+class ProjectModel:
+    """The whole-program model: call graph + jit roots + summaries +
+    dataflow findings + env-var sites."""
+
+    def __init__(self, paths: Iterable[str]):
+        self.graph: CallGraph = build_callgraph(paths)
+        # unit key → (donate indices, static argnames) for jit roots
+        self.jit_roots: dict[UnitKey, tuple[frozenset, frozenset]] = {}
+        # (module, name) → CallableInfo for module-level jit assignments
+        self.module_callables: dict[tuple[str, str], CallableInfo] = {}
+        # (module, class, attr) → CallableInfo for self.X = jax.jit(...)
+        self.class_attr_callables: dict[tuple, CallableInfo] = {}
+        self.summaries: dict[UnitKey, Summary] = {}
+        self.findings: list[Diagnostic] = []
+        self.env_sites: list[EnvSite] = []
+        self.env_declared: dict[str, str] = {}    # var → description
+        self.rounds = 0
+        self._site_by_call: dict[UnitKey, dict[int, Optional[UnitKey]]] = {}
+        self._detect_jit()
+        self._scan_module_level_env()
+        self._fixpoint()
+
+    # ---------------------------------------------------------- jit roots
+    def _jax_jit_ref(self, mg, node: ast.AST) -> bool:
+        if isinstance(node, ast.Attribute) and node.attr == "jit":
+            return (isinstance(node.value, ast.Name)
+                    and mg.import_aliases.get(node.value.id, "") == "jax")
+        if isinstance(node, ast.Name):
+            return mg.from_imports.get(node.id) == ("jax", "jit")
+        return False
+
+    def _partial_ref(self, mg, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return mg.from_imports.get(node.id) == ("functools", "partial")
+        return (isinstance(node, ast.Attribute) and node.attr == "partial"
+                and isinstance(node.value, ast.Name)
+                and node.value.id in ("functools", "ft"))
+
+    @staticmethod
+    def _jit_call_meta(call: ast.Call,
+                       params: list[str]) -> tuple[frozenset, frozenset]:
+        donate: set[int] = set()
+        static: set[str] = set()
+        for kw in call.keywords:
+            if kw.arg in ("donate_argnums", "donate_argnames"):
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant):
+                        if isinstance(n.value, int):
+                            donate.add(n.value)
+                        elif isinstance(n.value, str) and n.value in params:
+                            donate.add(params.index(n.value))
+            elif kw.arg == "static_argnames":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                        static.add(n.value)
+            elif kw.arg == "static_argnums":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                        if 0 <= n.value < len(params):
+                            static.add(params[n.value])
+        return frozenset(donate), frozenset(static)
+
+    def _detect_jit(self) -> None:
+        for key, unit in self.graph.units.items():
+            mg = self.graph.modules.get(key[0])
+            if mg is None:
+                continue
+            for d in unit.decorators:
+                if self._jax_jit_ref(mg, d):
+                    self.jit_roots[key] = (frozenset(), frozenset())
+                elif isinstance(d, ast.Call):
+                    if self._jax_jit_ref(mg, d.func):
+                        self.jit_roots[key] = self._jit_call_meta(
+                            d, unit.params)
+                    elif self._partial_ref(mg, d.func) and d.args \
+                            and self._jax_jit_ref(mg, d.args[0]):
+                        self.jit_roots[key] = self._jit_call_meta(
+                            d, unit.params)
+        # name = jax.jit(fn, ...) at module level; self.X = jax.jit(...)
+        for mg in self.graph.modules.values():
+            for stmt in mg.tree.body:
+                if isinstance(stmt, ast.Assign) \
+                        and isinstance(stmt.value, ast.Call) \
+                        and self._jax_jit_ref(mg, stmt.value.func):
+                    info = self._jit_value_info(mg, stmt.value, None)
+                    for target in stmt.targets:
+                        if isinstance(target, ast.Name):
+                            self.module_callables[(mg.module, target.id)] = \
+                                info
+        for key, unit in self.graph.units.items():
+            mg = self.graph.modules[key[0]]
+            for node in self.graph._own_nodes(unit):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call) \
+                        and self._jax_jit_ref(mg, node.value.func):
+                    info = self._jit_value_info(mg, node.value, unit)
+                    for target in node.targets:
+                        if isinstance(target, ast.Attribute) \
+                                and isinstance(target.value, ast.Name) \
+                                and target.value.id == "self" \
+                                and unit.cls is not None:
+                            self.class_attr_callables[
+                                (key[0], unit.cls, target.attr)] = info
+
+    def _jit_value_info(self, mg, call: ast.Call,
+                        scope: Optional[FunctionUnit]) -> CallableInfo:
+        """``jax.jit(fn, donate_argnums=…)`` → CallableInfo; when ``fn``
+        resolves to a project unit, that unit becomes a jit root too."""
+        params: list[str] = []
+        target_key = None
+        if call.args and isinstance(call.args[0], ast.Name):
+            target_key = self.graph.resolve_name(
+                mg, call.args[0].id,
+                scope=scope.key if scope is not None else None)
+            if target_key is not None:
+                params = self.graph.units[target_key].params
+        donate, static = self._jit_call_meta(call, params)
+        if target_key is not None:
+            self.jit_roots.setdefault(target_key, (donate, static))
+        return CallableInfo(
+            donates=donate, returns_traced=True,
+            label=(self.graph.units[target_key].name
+                   if target_key is not None else "jax.jit(...)"),
+            site=f"{mg.path}:{call.lineno}")
+
+    # --------------------------------------------- module-level env scan
+    def _scan_module_level_env(self) -> None:
+        for mg in self.graph.modules.values():
+            for stmt in mg.tree.body:
+                if isinstance(stmt, ast.AnnAssign):
+                    tnames = ([stmt.target.id]
+                              if isinstance(stmt.target, ast.Name) else [])
+                elif isinstance(stmt, ast.Assign):
+                    tnames = [t.id for t in stmt.targets
+                              if isinstance(t, ast.Name)]
+                else:
+                    continue
+                if stmt.value is None:
+                    continue
+                if isinstance(stmt.value, ast.Constant) \
+                        and isinstance(stmt.value.value, str) \
+                        and ENV_NAME_RE.match(stmt.value.value):
+                    self.env_sites.append(EnvSite(
+                        stmt.value.value, "const", mg.path,
+                        stmt.lineno, mg.module))
+                elif isinstance(stmt.value, ast.Dict):
+                    declares = any("KNOB" in n.upper() for n in tnames)
+                    for k, v in zip(stmt.value.keys, stmt.value.values):
+                        if isinstance(k, ast.Constant) \
+                                and isinstance(k.value, str) \
+                                and ENV_NAME_RE.match(k.value):
+                            kind = "declare" if declares else "set"
+                            self.env_sites.append(EnvSite(
+                                k.value, kind, mg.path, k.lineno, mg.module))
+                            if declares:
+                                desc = (v.value if isinstance(v, ast.Constant)
+                                        and isinstance(v.value, str) else "")
+                                self.env_declared[k.value] = desc
+
+    # ------------------------------------------------------------ fixpoint
+    def _fixpoint(self) -> None:
+        for key in self.graph.units:
+            self.summaries[key] = Summary()
+        for key, unit in self.graph.units.items():
+            self._site_by_call[key] = {
+                id(s.call): s.callee for s in self.graph.edges.get(key, ())}
+        changed = True
+        while changed and self.rounds < _MAX_ROUNDS:
+            changed = False
+            self.rounds += 1
+            for key, unit in self.graph.units.items():
+                new = _FlowWalker(self, unit).run()
+                if new != self.summaries[key]:
+                    self.summaries[key] = new
+                    changed = True
+        # final pass: emit findings + env sites
+        self.env_sites = [s for s in self.env_sites
+                          if s.kind in ("const", "declare")]
+        seen: set[tuple] = set()
+        for key, unit in self.graph.units.items():
+            walker = _FlowWalker(self, unit, collect=True)
+            walker.run()
+            for d in walker.findings:
+                fp = (d.rule, d.path, d.message)
+                if fp not in seen:
+                    seen.add(fp)
+                    self.findings.append(d)
+            self.env_sites.extend(walker.env_sites)
+
+    # ------------------------------------------------------------ queries
+    def callable_info(self, unit: FunctionUnit,
+                      call: ast.Call) -> tuple[Optional[CallableInfo],
+                                               Optional[UnitKey]]:
+        """(what calling this expression does, resolved unit key)."""
+        callee = self._site_by_call.get(unit.key, {}).get(id(call))
+        func = call.func
+        if isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id == "self" and unit.cls is not None:
+            info = self.class_attr_callables.get(
+                (unit.key[0], unit.cls, func.attr))
+            if info is not None:
+                return info, callee
+        if isinstance(func, ast.Name):
+            info = self.module_callables.get((unit.key[0], func.id))
+            if info is not None:
+                return info, callee
+        if callee is not None:
+            if callee in self.jit_roots:
+                donate, _static = self.jit_roots[callee]
+                return CallableInfo(
+                    donates=donate, returns_traced=True,
+                    label=self.graph.units[callee].name,
+                    site=(f"{self.graph.units[callee].path}:"
+                          f"{self.graph.units[callee].lineno}")), callee
+            summ = self.summaries.get(callee)
+            if summ is not None and (summ.donates or summ.returns_traced):
+                return CallableInfo(
+                    donates=summ.donates,
+                    returns_traced=summ.returns_traced,
+                    label=self.graph.units[callee].name,
+                    site=(f"{self.graph.units[callee].path}:"
+                          f"{self.graph.units[callee].lineno}")), callee
+        return None, callee
+
+    def findings_for(self, rule: str) -> list[Diagnostic]:
+        return [d for d in self.findings if d.rule == rule]
+
+
+# ------------------------------------------------------------- flow walker
+class _FlowWalker:
+    """One forward pass over a unit's statements, in source order,
+    carrying per-variable facts.  Branches are walked sequentially (a
+    fact set in an ``if`` arm survives — the analyzer over-approximates
+    reachability, which is the right bias for contract checking)."""
+
+    def __init__(self, project: ProjectModel, unit: FunctionUnit,
+                 collect: bool = False):
+        self.project = project
+        self.unit = unit
+        self.mg = project.graph.modules.get(unit.key[0])
+        self.collect = collect
+        self.findings: list[Diagnostic] = []
+        self.env_sites: list[EnvSite] = []
+        self.facts: dict[str, Fact] = {}
+        self.summary = Summary()
+        # params still "live" (never rebound/fenced) — host-sink tracking
+        self.live_params: set[str] = set(unit.params)
+        self.reported_vars: set[tuple] = set()
+        jit = project.jit_roots.get(unit.key)
+        self.is_jit_root = jit is not None
+        static = jit[1] if jit is not None else frozenset()
+        self.batch_params = {
+            p for p in unit.params
+            if p not in static and _name_tokens(p) & _BATCH_PARAM_TOKENS
+        } if self.is_jit_root else set()
+
+    # --------------------------------------------------------------- run
+    def run(self) -> Summary:
+        self._scan_stmts(getattr(self.unit.node, "body", []))
+        return self.summary
+
+    def anchor(self, lineno: int) -> str:
+        return f"{self.unit.path}:{lineno}"
+
+    def _emit(self, rule: str, message: str, lineno: int,
+              path: Optional[str] = None, hint: Optional[str] = None) -> None:
+        if self.collect:
+            self.findings.append(Diagnostic(
+                rule, message, path=path or self.anchor(lineno), hint=hint))
+
+    # ---------------------------------------------------------- statements
+    def _scan_stmts(self, stmts: list) -> None:
+        for i, stmt in enumerate(stmts):
+            # docstrings are not env-var mentions
+            if i == 0 and isinstance(stmt, ast.Expr) \
+                    and isinstance(stmt.value, ast.Constant) \
+                    and isinstance(stmt.value.value, str):
+                continue
+            self._scan_stmt(stmt)
+
+    def _scan_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return                      # separate units / not our frame
+        if isinstance(stmt, ast.Assign):
+            self._scan_expr(stmt.value)
+            self._handle_env_subscript_store(stmt)
+            fact = self._expr_fact(stmt.value)
+            for target in stmt.targets:
+                self._bind_target(target, fact, stmt.value)
+            return
+        if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            if stmt.value is not None:
+                self._scan_expr(stmt.value)
+            fact = (self._expr_fact(stmt.value)
+                    if stmt.value is not None else None)
+            self._bind_target(stmt.target, fact, stmt.value)
+            return
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._scan_expr(stmt.value)
+                fact = self._expr_fact(stmt.value)
+                if fact is not None and fact.kind == "traced":
+                    self.summary = dataclasses.replace(
+                        self.summary, returns_traced=True)
+                if fact is not None and fact.kind == "callable":
+                    self.summary = dataclasses.replace(
+                        self.summary, returns_callable=fact.detail)
+                elif isinstance(stmt.value, ast.Name):
+                    # return step  (a nested jit def)
+                    key = self.project.graph.resolve_name(
+                        self.mg, stmt.value.id, scope=self.unit.key) \
+                        if self.mg else None
+                    if key is not None and key in self.project.jit_roots:
+                        donate, _ = self.project.jit_roots[key]
+                        u = self.project.graph.units[key]
+                        self.summary = dataclasses.replace(
+                            self.summary, returns_callable=CallableInfo(
+                                donates=donate, returns_traced=True,
+                                label=u.name,
+                                site=f"{u.path}:{u.lineno}"))
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._scan_expr(stmt.test)
+            self._check_branch_sink(stmt.test)
+            self._scan_stmts(stmt.body)
+            self._scan_stmts(stmt.orelse)
+            return
+        if isinstance(stmt, ast.For):
+            self._scan_expr(stmt.iter)
+            fact = self._expr_fact(stmt.iter)
+            self._bind_target(stmt.target, fact, stmt.iter)
+            self._scan_stmts(stmt.body)
+            self._scan_stmts(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._scan_expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._bind_target(item.optional_vars, None, None)
+            self._scan_stmts(stmt.body)
+            return
+        if isinstance(stmt, ast.Try):
+            self._scan_stmts(stmt.body)
+            for handler in stmt.handlers:
+                self._scan_stmts(handler.body)
+            self._scan_stmts(stmt.orelse)
+            self._scan_stmts(stmt.finalbody)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._scan_expr(stmt.value)
+            return
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    self.facts.pop(t.id, None)
+            return
+        for sub in ast.iter_child_nodes(stmt):
+            if isinstance(sub, ast.expr):
+                self._scan_expr(sub)
+            elif isinstance(sub, ast.stmt):
+                self._scan_stmt(sub)
+
+    def _bind_target(self, target: ast.expr, fact: Optional[Fact],
+                     value: Optional[ast.expr]) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind_target(elt, fact, value)
+            return
+        if isinstance(target, ast.Name):
+            self.facts.pop(target.id, None)
+            self.live_params.discard(target.id)
+            if fact is not None:
+                self.facts[target.id] = fact
+        elif isinstance(target, ast.Subscript):
+            self._scan_expr(target.value)
+
+    def _handle_env_subscript_store(self, stmt: ast.Assign) -> None:
+        for target in stmt.targets:
+            if isinstance(target, ast.Subscript) \
+                    and self._env_receiver(target.value):
+                var = self._env_name_of(target.slice)
+                if var is not None:
+                    self._record_env(var, "set", target.lineno)
+
+    # --------------------------------------------------------- expressions
+    def _scan_expr(self, expr: ast.expr) -> None:
+        """Post-order-ish walk: children (uses) first, then call
+        effects — a donating call must not flag its own arguments."""
+        if expr is None:
+            return
+        if isinstance(expr, (ast.Lambda, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            return
+        if isinstance(expr, ast.Call):
+            for arg in expr.args:
+                self._scan_expr(arg.value if isinstance(arg, ast.Starred)
+                                else arg)
+            for kw in expr.keywords:
+                self._scan_expr(kw.value)
+            if isinstance(expr.func, ast.Attribute):
+                self._scan_expr(expr.func.value)
+            self._apply_call(expr)
+            return
+        if isinstance(expr, ast.Name) and isinstance(expr.ctx, ast.Load):
+            self._check_use(expr)
+            return
+        if isinstance(expr, ast.Dict):
+            for k in expr.keys:
+                if k is not None:
+                    var = self._env_name_of(k)
+                    if var is not None:
+                        self._record_env(var, "set", k.lineno)
+                    self._scan_expr(k)
+            for v in expr.values:
+                self._scan_expr(v)
+            return
+        if isinstance(expr, ast.Compare):
+            # K in os.environ  → read
+            if len(expr.ops) == 1 and isinstance(expr.ops[0], ast.In) \
+                    and self._env_receiver(expr.comparators[0]):
+                var = self._env_name_of(expr.left)
+                if var is not None:
+                    self._record_env(var, "read", expr.lineno)
+            for sub in ast.iter_child_nodes(expr):
+                if isinstance(sub, ast.expr):
+                    self._scan_expr(sub)
+            return
+        if isinstance(expr, ast.Subscript) \
+                and isinstance(expr.ctx, ast.Load) \
+                and self._env_receiver(expr.value):
+            var = self._env_name_of(expr.slice)
+            if var is not None:
+                self._record_env(var, "read", expr.lineno)
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str) \
+                and ENV_NAME_RE.match(expr.value):
+            self._record_env(expr.value, "mention", expr.lineno)
+            return
+        for sub in ast.iter_child_nodes(expr):
+            if isinstance(sub, ast.expr):
+                self._scan_expr(sub)
+
+    def _check_use(self, node: ast.Name) -> None:
+        fact = self.facts.get(node.id)
+        if fact is None or fact.kind != "donated":
+            return
+        key = (node.id, fact.lineno)
+        if key in self.reported_vars:
+            return
+        self.reported_vars.add(key)
+        self._emit(
+            "TPU501",
+            f"'{node.id}' is read after being donated to {fact.detail} "
+            f"(donated at {fact.path}:{fact.lineno}) — XLA reuses donated "
+            f"buffers for the step outputs, so this read observes "
+            f"freed/overwritten device memory on TPU (CPU silently "
+            f"ignores donation, which is why it passed locally)",
+            node.lineno)
+
+    def _check_branch_sink(self, test: ast.expr) -> None:
+        for node in ast.walk(test):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                fact = self.facts.get(node.id)
+                if fact is not None and fact.kind == "traced":
+                    self._emit(
+                        "TPU502",
+                        f"branch test on '{node.id}', a traced value from "
+                        f"{fact.detail} — comparing it forces a hidden "
+                        f"device→host sync every evaluation; fence with "
+                        f"jax.block_until_ready/device_get first (or keep "
+                        f"the decision on device)",
+                        node.lineno)
+                    self.facts.pop(node.id, None)
+
+    # -------------------------------------------------------- value facts
+    def _expr_fact(self, expr: Optional[ast.expr]) -> Optional[Fact]:
+        if expr is None:
+            return None
+        if isinstance(expr, ast.Name):
+            return self.facts.get(expr.id)
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str) \
+                and ENV_NAME_RE.match(expr.value):
+            return Fact("envname", expr.value, self.unit.path, expr.lineno)
+        if isinstance(expr, ast.Call):
+            return self._call_result_fact(expr)
+        if isinstance(expr, (ast.BinOp, ast.UnaryOp, ast.IfExp)):
+            for sub in ast.walk(expr):
+                if isinstance(sub, ast.Name):
+                    f = self.facts.get(sub.id)
+                    if f is not None and f.kind == "traced":
+                        return f
+                if isinstance(sub, ast.Call):
+                    f = self._call_result_fact(sub)
+                    if f is not None and f.kind == "traced":
+                        return f
+            return None
+        if isinstance(expr, ast.Subscript):
+            base = self._expr_fact(expr.value)
+            if base is not None and base.kind == "traced":
+                return base
+            # batch.shape[i] of a jit batch param → shape fact
+            if self.is_jit_root and isinstance(expr.value, ast.Attribute) \
+                    and expr.value.attr == "shape" \
+                    and isinstance(expr.value.value, ast.Name) \
+                    and expr.value.value.id in self.batch_params:
+                return Fact("shape",
+                            f"{expr.value.value.id}.shape[…]",
+                            self.unit.path, expr.lineno)
+            return None
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _STATIC_VALUE_ATTRS:
+                return None
+            base = self._expr_fact(expr.value)
+            if base is not None and base.kind == "traced":
+                return base
+            return None
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            for elt in expr.elts:
+                f = self._expr_fact(elt)
+                if f is not None and f.kind == "traced":
+                    return f
+        return None
+
+    def _call_result_fact(self, call: ast.Call) -> Optional[Fact]:
+        func = call.func
+        # len(batch) of a jit batch param → shape fact
+        if self.is_jit_root and isinstance(func, ast.Name) \
+                and func.id == "len" and call.args \
+                and isinstance(call.args[0], ast.Name) \
+                and call.args[0].id in self.batch_params:
+            return Fact("shape", f"len({call.args[0].id})",
+                        self.unit.path, call.lineno)
+        if self._is_fence_call(call):
+            return None
+        # v.item() / v.mean() on traced → .item() is a sink, rest traced
+        if isinstance(func, ast.Attribute):
+            base = self._expr_fact(func.value)
+            if base is not None and base.kind == "traced" \
+                    and func.attr not in _STATIC_VALUE_ATTRS \
+                    and func.attr != "item":
+                return base
+        info, callee = self.project.callable_info(self.unit, call)
+        if info is not None and info.returns_traced:
+            return Fact("traced", f"'{info.label}'",
+                        self.unit.path, call.lineno)
+        if callee is not None:
+            summ = self.project.summaries.get(callee)
+            if summ is not None and summ.returns_callable is not None:
+                return Fact("callable", summ.returns_callable,
+                            self.unit.path, call.lineno)
+        return None
+
+    def _is_fence_call(self, call: ast.Call) -> bool:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in _FENCE_ATTRS:
+                return True
+            if func.attr in ("asarray", "array") \
+                    and isinstance(func.value, ast.Name) \
+                    and func.value.id in ("np", "numpy", "onp"):
+                return True
+        return False
+
+    # ------------------------------------------------------- call effects
+    def _apply_call(self, call: ast.Call) -> None:
+        func = call.func
+        fname = func.id if isinstance(func, ast.Name) else None
+        attr = func.attr if isinstance(func, ast.Attribute) else None
+
+        # fences clear traced facts on their arguments (and live params)
+        if self._is_fence_call(call):
+            for sub in ast.walk(call):
+                if isinstance(sub, ast.Name):
+                    f = self.facts.get(sub.id)
+                    if f is not None and f.kind == "traced":
+                        self.facts.pop(sub.id, None)
+                    self.live_params.discard(sub.id)
+            return
+
+        info, callee = self.project.callable_info(self.unit, call)
+
+        # ---- TPU502 sinks ------------------------------------------------
+        if fname == "print" or fname in _HOST_CAST_NAMES:
+            for arg in call.args:
+                self._host_sink(arg, f"{fname}()", call.lineno)
+        if attr == "item" and isinstance(func, ast.Attribute):
+            self._host_sink(func.value, ".item()", call.lineno)
+
+        # ---- env accessors ----------------------------------------------
+        self._apply_env_call(call, fname, attr, callee)
+
+        # ---- donation ---------------------------------------------------
+        if info is not None and info.donates:
+            pos_args = [a for a in call.args
+                        if not isinstance(a, ast.Starred)]
+            for idx in sorted(info.donates):
+                if idx >= len(pos_args):
+                    continue
+                arg = pos_args[idx]
+                if not isinstance(arg, ast.Name):
+                    continue
+                desc = f"'{info.label}' (argument {idx} is donated)"
+                self.facts[arg.id] = Fact("donated", desc,
+                                          self.unit.path, call.lineno)
+                pidx = self.unit.param_index(arg.id)
+                if pidx is not None and arg.id in self.live_params:
+                    self.summary = dataclasses.replace(
+                        self.summary,
+                        donates=self.summary.donates | {pidx})
+
+        # ---- interprocedural sink/shape propagation ----------------------
+        if callee is not None:
+            self._apply_callee_summaries(call, callee)
+
+        # ---- TPU504 direct shape sinks -----------------------------------
+        self._check_shape_sink(call, fname, attr)
+
+    def _host_sink(self, arg: ast.expr, desc: str, lineno: int) -> None:
+        fact = self._expr_fact(arg)
+        if fact is not None and fact.kind == "traced":
+            self._emit(
+                "TPU502",
+                f"traced value from {fact.detail} escapes to host via "
+                f"{desc} without a fence — every call pays a hidden "
+                f"device→host sync inside dispatch; make the readback "
+                f"explicit with jax.block_until_ready/device_get first",
+                lineno)
+            if isinstance(arg, ast.Name):
+                self.facts.pop(arg.id, None)
+            return
+        # a still-live parameter reaching a host sink → summary entry
+        if isinstance(arg, ast.Name) and arg.id in self.live_params:
+            pidx = self.unit.param_index(arg.id)
+            if pidx is not None:
+                sinks = dict(self.summary.param_host_sink)
+                sinks.setdefault(pidx, (desc, self.unit.path, lineno))
+                self.summary = dataclasses.replace(
+                    self.summary, param_host_sink=sinks)
+
+    def _apply_callee_summaries(self, call: ast.Call,
+                                callee: UnitKey) -> None:
+        summ = self.project.summaries.get(callee)
+        if summ is None:
+            return
+        cunit = self.project.graph.units[callee]
+        bound = cunit.bind_args(call)
+        for pname, arg in bound.items():
+            pidx = cunit.param_index(pname)
+            if pidx is None:
+                continue
+            fact = self._expr_fact(arg)
+            # traced value into a host-sinking parameter
+            if fact is not None and fact.kind == "traced" \
+                    and pidx in summ.param_host_sink:
+                desc, spath, sline = summ.param_host_sink[pidx]
+                self._emit(
+                    "TPU502",
+                    f"traced value from {fact.detail} (passed at "
+                    f"{self.unit.path}:{call.lineno}) escapes to host via "
+                    f"{desc} inside '{cunit.name}' without a fence — a "
+                    f"hidden device→host sync crossing the call boundary; "
+                    f"fence before the call or inside the callee",
+                    sline, path=f"{spath}:{sline}")
+            # env literal/constant into an environ-accessing parameter
+            if pidx in summ.param_env_read or pidx in summ.param_env_set:
+                env_name = self._env_name_of(arg)
+                if env_name is not None:
+                    if pidx in summ.param_env_read:
+                        self._record_env(env_name, "read", call.lineno)
+                    if pidx in summ.param_env_set:
+                        self._record_env(env_name, "set", call.lineno)
+            # batch-shape value into an allocating parameter
+            if fact is not None and fact.kind == "shape" \
+                    and pidx in summ.param_shape_sink:
+                desc, spath, sline = summ.param_shape_sink[pidx]
+                self._emit(
+                    "TPU504",
+                    f"{fact.detail} of jit step '{self.unit.name}' flows "
+                    f"into {desc} inside '{cunit.name}' — the batch's "
+                    f"Python size is baked into the program, so every "
+                    f"distinct batch size compiles a distinct executable "
+                    f"(the recompile storm shape_bucketing exists to "
+                    f"prevent); derive the size from a static bucket "
+                    f"constant or a static_argnames argument",
+                    sline, path=f"{spath}:{sline}")
+
+    def _check_shape_sink(self, call: ast.Call, fname: Optional[str],
+                          attr: Optional[str]) -> None:
+        """jnp.zeros/ones/…/reshape with a batch-shape value in a shape
+        slot; also records which *parameters* reach shape slots (the
+        interprocedural summary)."""
+        is_alloc = (attr in _ALLOC_NAMES
+                    and isinstance(call.func, ast.Attribute)
+                    and isinstance(call.func.value, ast.Name)
+                    and self._is_jnp_alias(call.func.value.id))
+        is_reshape = attr == "reshape"
+        if not (is_alloc or is_reshape):
+            return
+        desc = (f"jnp.{attr}(...)" if is_alloc else ".reshape(...)")
+        shape_args = list(call.args) + [kw.value for kw in call.keywords
+                                        if kw.arg == "shape"]
+        for arg in shape_args:
+            for node in ast.walk(arg):
+                nfact = None
+                if isinstance(node, (ast.Name, ast.Call, ast.Subscript)):
+                    nfact = self._expr_fact(node)
+                if nfact is not None and nfact.kind == "shape":
+                    self._emit(
+                        "TPU504",
+                        f"{nfact.detail} flows into {desc} inside jit "
+                        f"step '{self.unit.name}' — the batch's Python "
+                        f"size is baked into the compiled program, so "
+                        f"every distinct batch size recompiles (the "
+                        f"storm shape_bucketing exists to prevent); use "
+                        f"a static bucket size instead",
+                        node.lineno)
+                if isinstance(node, ast.Name) \
+                        and node.id in self.live_params:
+                    pidx = self.unit.param_index(node.id)
+                    if pidx is not None:
+                        sinks = dict(self.summary.param_shape_sink)
+                        sinks.setdefault(
+                            pidx, (desc, self.unit.path, node.lineno))
+                        self.summary = dataclasses.replace(
+                            self.summary, param_shape_sink=sinks)
+
+    def _is_jnp_alias(self, name: str) -> bool:
+        if name == "jnp":
+            return True
+        if self.mg is None:
+            return False
+        return (self.mg.import_aliases.get(name) == "jax.numpy"
+                or self.mg.from_imports.get(name) == ("jax", "numpy"))
+
+    # ------------------------------------------------------------- env I/O
+    def _env_receiver(self, expr: ast.expr) -> bool:
+        if isinstance(expr, ast.Attribute):
+            return (expr.attr == "environ"
+                    or bool(_name_tokens(expr.attr) & _ENV_RECEIVER_TOKENS))
+        if isinstance(expr, ast.Name):
+            return bool(_name_tokens(expr.id) & _ENV_RECEIVER_TOKENS)
+        return False
+
+    def _remote_const(self, recv_name: str, attr: str) -> Optional[str]:
+        """``recv.attr`` → the string constant it names in another
+        loaded module (``flight_recorder.DUMP_ENV``)."""
+        if self.mg is None:
+            return None
+        dotted = self.mg.import_aliases.get(recv_name)
+        if dotted is None:
+            target = self.mg.from_imports.get(recv_name)
+            dotted = f"{target[0]}.{target[1]}" if target else None
+        if dotted is None:
+            return None
+        mod = self.project.graph.resolve_module(dotted)
+        if mod is None:
+            return None
+        value = self.project.graph.modules[mod].str_constants.get(attr)
+        if value is not None and ENV_NAME_RE.match(value):
+            return value
+        return None
+
+    def _env_name_of(self, expr: ast.expr,
+                     _depth: int = 0) -> Optional[str]:
+        if _depth > 4:
+            return None
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str) \
+                and ENV_NAME_RE.match(expr.value):
+            return expr.value
+        if isinstance(expr, ast.Name):
+            fact = self.facts.get(expr.id)
+            if fact is not None and fact.kind == "envname":
+                return fact.detail
+            if self.mg is not None:
+                value = self.mg.str_constants.get(expr.id)
+                if value is not None and ENV_NAME_RE.match(value):
+                    return value
+                target = self.mg.from_imports.get(expr.id)
+                if target is not None:
+                    mod = self.project.graph.resolve_module(target[0])
+                    if mod is not None:
+                        value = self.project.graph.modules[mod] \
+                            .str_constants.get(target[1])
+                        if value is not None and ENV_NAME_RE.match(value):
+                            return value
+                # NAME = other.CONST / NAME = OTHER at module level
+                alias = self.mg.const_aliases.get(expr.id)
+                if alias is not None:
+                    recv, attr = alias
+                    if recv is None:
+                        return self._env_name_of(
+                            ast.Name(id=attr, ctx=ast.Load()), _depth + 1)
+                    return self._remote_const(recv, attr)
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name):
+            return self._remote_const(expr.value.id, expr.attr)
+        return None
+
+    def _record_env(self, var: str, kind: str, lineno: int) -> None:
+        if self.collect:
+            self.env_sites.append(EnvSite(
+                var, kind, self.unit.path, lineno, self.unit.key[0]))
+
+    def _apply_env_call(self, call: ast.Call, fname: Optional[str],
+                        attr: Optional[str],
+                        callee: Optional[UnitKey]) -> None:
+        func = call.func
+        key_arg = call.args[0] if call.args else None
+        if attr in ("get", "pop") and isinstance(func, ast.Attribute) \
+                and self._env_receiver(func.value) and key_arg is not None:
+            var = self._env_name_of(key_arg)
+            if var is not None:
+                self._record_env(var, "read", call.lineno)
+            self._note_param_env(key_arg, "read")
+            return
+        if attr == "setdefault" and isinstance(func, ast.Attribute) \
+                and self._env_receiver(func.value) and key_arg is not None:
+            var = self._env_name_of(key_arg)
+            if var is not None:
+                self._record_env(var, "set", call.lineno)
+            self._note_param_env(key_arg, "set")
+            return
+        if (attr == "getenv" or fname == "getenv") and key_arg is not None:
+            var = self._env_name_of(key_arg)
+            if var is not None:
+                self._record_env(var, "read", call.lineno)
+            self._note_param_env(key_arg, "read")
+            return
+        if (attr == "putenv" or fname == "putenv") and key_arg is not None:
+            var = self._env_name_of(key_arg)
+            if var is not None:
+                self._record_env(var, "set", call.lineno)
+            self._note_param_env(key_arg, "set")
+
+    def _note_param_env(self, key_arg: ast.expr, kind: str) -> None:
+        """``os.environ.get(name)`` where ``name`` is a still-live
+        parameter: callers passing a literal through this parameter are
+        env readers/setters (the ``_env_peak`` helper idiom)."""
+        if not isinstance(key_arg, ast.Name) \
+                or key_arg.id not in self.live_params:
+            return
+        pidx = self.unit.param_index(key_arg.id)
+        if pidx is None:
+            return
+        if kind == "read":
+            self.summary = dataclasses.replace(
+                self.summary,
+                param_env_read=self.summary.param_env_read | {pidx})
+        else:
+            self.summary = dataclasses.replace(
+                self.summary,
+                param_env_set=self.summary.param_env_set | {pidx})
+
+
+# Parameters named like env keys feed environ accessors even when facts
+# say nothing — `def _env_peak(name): os.environ.get(name)` works because
+# live_params tracking above records the flow, not the name.
+
+
+# ------------------------------------------------------------ rule registry
+DATAFLOW_RULES: dict[str, Callable[[ProjectModel], list[Diagnostic]]] = {}
+
+
+def register_dataflow_rule(rule_id: str):
+    """Add a dataflow rule: ``fn(project) -> list[Diagnostic]`` (mirrors
+    ``lint.register_lint_rule`` / ``register_concurrency_rule``)."""
+    def deco(fn):
+        DATAFLOW_RULES[rule_id] = fn
+        return fn
+    return deco
+
+
+@register_dataflow_rule("TPU501")
+def _rule_donation_after_use(project: ProjectModel) -> list[Diagnostic]:
+    return project.findings_for("TPU501")
+
+
+@register_dataflow_rule("TPU502")
+def _rule_traced_host_escape(project: ProjectModel) -> list[Diagnostic]:
+    return project.findings_for("TPU502")
+
+
+@register_dataflow_rule("TPU504")
+def _rule_shape_dependence(project: ProjectModel) -> list[Diagnostic]:
+    return project.findings_for("TPU504")
+
+
+def collect_env_vars(project: ProjectModel) -> dict[str, dict[str, list]]:
+    """var → {kind → [EnvSite]} over the whole program, declarations
+    included — the raw material for TPU503 and the docs env table."""
+    table: dict[str, dict[str, list]] = {}
+    for site in project.env_sites:
+        table.setdefault(site.var, {}).setdefault(site.kind, []).append(site)
+    return table
+
+
+@register_dataflow_rule("TPU503")
+def _rule_env_contract_drift(project: ProjectModel) -> list[Diagnostic]:
+    out = []
+    table = collect_env_vars(project)
+    for var in sorted(table):
+        kinds = table[var]
+        declared = "declare" in kinds or var in project.env_declared
+        sets, reads = kinds.get("set", []), kinds.get("read", [])
+        if declared or (sets and reads):
+            continue
+        if sets and not reads:
+            s = sets[0]
+            out.append(Diagnostic(
+                "TPU503",
+                f"{var} is set (e.g. {s.module}) but never read anywhere "
+                f"in the program — a renamed or deleted reader; the "
+                f"setter ships dead configuration across the process "
+                f"boundary",
+                path=f"{s.path}:{s.lineno}"))
+        elif reads and not sets:
+            s = reads[0]
+            out.append(Diagnostic(
+                "TPU503",
+                f"{var} is read (e.g. {s.module}) but never set anywhere "
+                f"in the program and not declared as a user-facing knob — "
+                f"either the setter was renamed, or this is an "
+                f"undocumented contract; declare it in config.ENV_KNOBS "
+                f"or set it where the process is spawned",
+                path=f"{s.path}:{s.lineno}"))
+        else:
+            sites = [s for ss in kinds.values() for s in ss]
+            s = sites[0]
+            out.append(Diagnostic(
+                "TPU503",
+                f"{var} is spelled (e.g. {s.module}:{s.lineno}) but never "
+                f"wired into an environment read or write — a dangling "
+                f"constant or a typo'd spelling of another variable",
+                path=f"{s.path}:{s.lineno}"))
+    return out
+
+
+# ------------------------------------------------------------ docs table
+def env_table_markdown(project: Optional[ProjectModel] = None,
+                       repo_root: Optional[str] = None) -> str:
+    """The generated ``DL4J_TPU_*`` env-var table for
+    ``docs/static_analysis.md`` — same can't-drift contract as the rule
+    catalog: the doc embeds this output verbatim and a tier-1 test
+    regenerates and compares."""
+    if project is None:
+        project = build_project_package()
+    table = collect_env_vars(project)
+    if repo_root is None:
+        import deeplearning4j_tpu
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(
+            deeplearning4j_tpu.__file__)))
+
+    def rel_modules(sites: list) -> str:
+        mods = sorted({s.module for s in sites})
+        return ", ".join(f"`{m}`" for m in mods) if mods else "—"
+
+    lines = ["| variable | set by | read by | role |",
+             "|---|---|---|---|"]
+    for var in sorted(set(table) | set(project.env_declared)):
+        kinds = table.get(var, {})
+        desc = project.env_declared.get(var, "")
+        if not desc and "declare" not in kinds:
+            desc = "internal (launcher/supervisor → child contract)"
+        lines.append(
+            f"| `{var}` | {rel_modules(kinds.get('set', []))} "
+            f"| {rel_modules(kinds.get('read', []))} | {desc} |")
+    return "\n".join(lines)
+
+
+# ----------------------------------------------------------------- drivers
+def build_project(paths: Iterable[str]) -> ProjectModel:
+    """Public hook (tests, tooling): the whole-program model."""
+    return ProjectModel(paths)
+
+
+def build_project_package(package_dir: Optional[str] = None) -> ProjectModel:
+    if package_dir is None:
+        import deeplearning4j_tpu
+        package_dir = os.path.dirname(os.path.abspath(
+            deeplearning4j_tpu.__file__))
+    return ProjectModel([package_dir])
+
+
+def analyze_dataflow_paths(paths: Iterable[str],
+                           rules: Optional[dict] = None,
+                           project: Optional[ProjectModel] = None) -> Report:
+    """Run the TPU5xx rules over files/directories as ONE program,
+    honoring suppression pragmas at each finding's anchor line."""
+    report = Report()
+    if project is None:
+        project = ProjectModel(paths)
+    report.context["files_analyzed"] = len(project.graph.files)
+    report.context["call_edges"] = project.graph.resolved_edges()
+    report.context["cross_module_edges"] = \
+        len(project.graph.cross_module_edges())
+    report.context["env_vars"] = len(
+        set(collect_env_vars(project)) | set(project.env_declared))
+    for anchor, reason in project.graph.unparsed:
+        report.add("TPU300", reason, path=anchor,
+                   hint="Fix the --dataflow path (a typo here must not "
+                        "read as a clean gate).")
+    diags: list[Diagnostic] = []
+    for rule_fn in (rules if rules is not None else DATAFLOW_RULES).values():
+        diags.extend(rule_fn(project))
+    # suppressions are per anchor file; pragma problems ride along once
+    by_file: dict[str, list[Diagnostic]] = {}
+    for d in diags:
+        fpath = (d.path or "").rpartition(":")[0] or (d.path or "")
+        by_file.setdefault(fpath, []).append(d)
+    handled: set[str] = set()
+    for path in project.graph.files:
+        try:
+            sf = source_cache.load_source(path)
+        except (OSError, SyntaxError, ValueError):
+            continue
+        handled.add(os.path.abspath(path))
+        kept, suppressed = source_cache.apply_suppressions(
+            by_file.pop(path, []), sf)
+        report.diagnostics.extend(kept)
+        report.suppressed.extend(suppressed)
+        report.diagnostics.extend(
+            source_cache.pragma_diagnostics(sf, display_path=path))
+    for rest in by_file.values():      # anchors outside the analyzed set
+        report.diagnostics.extend(rest)
+    return report
+
+
+def analyze_dataflow_package(package_dir: Optional[str] = None) -> Report:
+    """The ``--dataflow --self`` gate: whole-program TPU5xx analysis of
+    the framework tree."""
+    if package_dir is None:
+        import deeplearning4j_tpu
+        package_dir = os.path.dirname(os.path.abspath(
+            deeplearning4j_tpu.__file__))
+    return analyze_dataflow_paths([package_dir])

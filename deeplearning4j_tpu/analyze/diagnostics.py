@@ -350,8 +350,8 @@ _RULE_LIST = [
         "Suppressions are themselves findings until the reason is "
         "written down.",
         "Write '# tpudl: ok(TPU4xx) — <why this is safe here>'; only "
-        "TPU3xx/TPU4xx findings (which anchor to a source line) can be "
-        "suppressed."),
+        "TPU3xx/TPU4xx/TPU5xx findings (which anchor to a source line) "
+        "can be suppressed."),
     RuleInfo(
         "TPU401", "lock-order-inversion", ERROR,
         "The lock-acquisition graph has a cycle (lock B taken while "
@@ -436,12 +436,66 @@ _RULE_LIST = [
         "future on both paths (set_result on success, set_exception "
         "on failure) — see serve/engine.py's _dispatch for the "
         "pattern."),
+    # ---- whole-program dataflow (interprocedural) ---------------------
+    RuleInfo(
+        "TPU501", "donation-after-use", ERROR,
+        "An argument donated to a donate_argnums jit step (directly, or "
+        "through a callee that forwards its parameter into a donated "
+        "slot) is read again afterwards in a reachable caller frame",
+        "XLA reuses donated input buffers for the step outputs: the "
+        "later read observes freed or overwritten device memory on TPU "
+        "while CPU (which ignores donation) silently returns the old "
+        "values — the worst kind of passes-locally corruption, and "
+        "invisible to per-module lint because the donation and the "
+        "read live in different files.",
+        "Rebind the result over the donated name (params = step(params, "
+        "…)), copy before the call, or reorder the read ahead of the "
+        "donating call."),
+    RuleInfo(
+        "TPU502", "traced-host-escape", ERROR,
+        "A value born inside a jit-compiled callable flows — possibly "
+        "across calls and returns — into print/float/int/.item()/a "
+        "branch test without a block_until_ready/device_get fence",
+        "jax dispatch is async: the escape point forces a hidden "
+        "device→host sync on every call, serializing the pipeline from "
+        "a frame that looks like innocent logging.  TPU301 catches the "
+        "same class inside one jit function; this rule follows the "
+        "value through the call graph to escapes whole modules away.",
+        "Fence explicitly (jax.block_until_ready/device_get/np.asarray) "
+        "where the readback is intended, or keep the value on device."),
+    RuleInfo(
+        "TPU503", "env-contract-drift", ERROR,
+        "A DL4J_TPU_* environment variable is set but never read, read "
+        "but never set (and not declared in config.ENV_KNOBS), or "
+        "spelled without ever being wired into an environ access",
+        "The launcher, supervisor, bootstrap and config communicate "
+        "across process boundaries through DL4J_TPU_* variables — a "
+        "rename on one side is not an error anywhere at runtime, just "
+        "a knob that silently stops arriving (the gang resumes from "
+        "step 0, the watchdog never arms).  Checking the whole program "
+        "as one set of setters and readers makes the contract a "
+        "compile-time fact, and generates the docs env-var table.",
+        "Fix the spelling drift, declare user-facing knobs in "
+        "config.ENV_KNOBS, or delete the dead setter/reader."),
+    RuleInfo(
+        "TPU504", "python-shape-dependence", ERROR,
+        "len()/.shape[i] of a traced batch argument of a jit step flows "
+        "(intra- or interprocedurally) into a jnp.zeros-family or "
+        "reshape shape slot",
+        "The batch's Python size is baked into the compiled program, so "
+        "every distinct batch size compiles a distinct executable — the "
+        "recompile-storm class data.shape_bucketing exists to prevent, "
+        "now reachable through helper calls the per-module rules can't "
+        "see.",
+        "Derive the size from a static bucket constant or a "
+        "static_argnames argument; let shape_bucketing pad the batch."),
 ]
 
 RULES: dict[str, RuleInfo] = {r.id: r for r in _RULE_LIST}
 
 _FAMILY_BY_PREFIX = {"TPU1": "model", "TPU2": "sharding",
-                     "TPU3": "lint", "TPU4": "concurrency"}
+                     "TPU3": "lint", "TPU4": "concurrency",
+                     "TPU5": "dataflow"}
 
 
 def rule_family(rule_id: str) -> str:

@@ -1,0 +1,120 @@
+"""Suppression-debt report: ``analyze --pragmas``.
+
+Every ``# tpudl: ok(...)`` in the tree is a standing claim that a
+finding is safe — a claim that ages: the code around it changes, the
+rule it silences evolves, sometimes the rule ID stops existing
+entirely.  This report inventories the debt so it can be reviewed like
+any other: one row per pragma with the rules it silences, the written
+reason, and the blame age of the line (how long the claim has stood
+unexamined).  Pragmas naming rule IDs that no longer exist in the
+catalog are flagged — they silence nothing and should be deleted (the
+``TPU400`` selfcheck already reds the gate on them; the report makes
+the cleanup list).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Iterable, Optional
+
+from deeplearning4j_tpu.analyze import source as source_cache
+from deeplearning4j_tpu.analyze.diagnostics import RULES, Report
+from deeplearning4j_tpu.analyze.lint import iter_python_files
+
+
+def _blame_age_days(path: str, lineno: int) -> Optional[float]:
+    """Days since the pragma's line was last touched, per ``git blame``
+    (None outside a repo / for uncommitted lines)."""
+    try:
+        out = subprocess.run(
+            ["git", "blame", "-L", f"{lineno},{lineno}", "--porcelain",
+             "--", os.path.basename(path)],
+            cwd=os.path.dirname(os.path.abspath(path)) or ".",
+            capture_output=True, text=True, timeout=10)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if out.returncode != 0:
+        return None
+    committer_time = None
+    for line in out.stdout.splitlines():
+        if line.startswith("committer-time "):
+            committer_time = int(line.split()[1])
+            break
+        if line.startswith("boundary") or line.startswith(
+                "0000000000000000000000000000000000000000"):
+            return None            # uncommitted
+    if committer_time is None:
+        return None
+    import time
+    return max(0.0, (time.time() - committer_time) / 86400.0)
+
+
+def collect_pragmas(paths: Iterable[str],
+                    blame: bool = True) -> list[dict]:
+    """One record per pragma: path, line, rules, stale rule IDs,
+    reason, blame age in days (None when unknown)."""
+    files, _missing = iter_python_files(list(paths))
+    records = []
+    for path in files:
+        try:
+            sf = source_cache.load_source(path)
+        except (OSError, SyntaxError, ValueError):
+            continue
+        for pragma in sf.pragmas:
+            records.append({
+                "path": path,
+                "lineno": pragma.lineno,
+                "rules": list(pragma.rules),
+                "stale_rules": [r for r in pragma.rules if r not in RULES],
+                "reason": pragma.reason,
+                "age_days": (_blame_age_days(path, pragma.lineno)
+                             if blame else None),
+                "raw": pragma.raw,
+            })
+    records.sort(key=lambda r: (r["path"], r["lineno"]))
+    return records
+
+
+def pragma_report(paths: Optional[Iterable[str]] = None,
+                  blame: bool = True) -> Report:
+    """The ``--pragmas`` mode: inventory in ``context`` (JSON output
+    carries it whole), plus the ``TPU400`` findings for pragmas whose
+    rule IDs no longer exist — the debt that silences nothing."""
+    if paths is None:
+        import deeplearning4j_tpu
+        paths = [os.path.dirname(os.path.abspath(
+            deeplearning4j_tpu.__file__))]
+    records = collect_pragmas(paths, blame=blame)
+    report = Report()
+    report.context["pragmas"] = len(records)
+    report.context["pragmas_without_reason"] = sum(
+        1 for r in records if not r["reason"])
+    report.context["pragma_inventory"] = records
+    for rec in records:
+        for rule in rec["stale_rules"]:
+            report.add(
+                "TPU400",
+                f"suppression pragma names {rule!r}, which is no longer "
+                f"in the rule catalog — it silences nothing; delete it "
+                f"(or update the ID if the rule was renumbered)",
+                path=f"{rec['path']}:{rec['lineno']}")
+    return report
+
+
+def render_pragmas_text(records: list[dict]) -> str:
+    """Human layout for the debt review: one row per pragma."""
+    if not records:
+        return "no suppression pragmas in tree"
+    lines = []
+    for rec in records:
+        age = (f"{rec['age_days']:.0f}d" if rec["age_days"] is not None
+               else "?")
+        rules = ",".join(rec["rules"]) or "<none>"
+        reason = rec["reason"] or "<NO REASON — TPU400>"
+        stale = (" [STALE RULE ID: " + ",".join(rec["stale_rules"]) + "]"
+                 if rec["stale_rules"] else "")
+        lines.append(f"{rec['path']}:{rec['lineno']}: ok({rules}) "
+                     f"age={age}{stale}\n    reason: {reason}")
+    lines.append(f"{len(records)} pragma(s)")
+    return "\n".join(lines)

@@ -1,0 +1,144 @@
+"""SARIF 2.1.0 export for ``tpudl.analyze`` reports.
+
+CI systems (GitHub code scanning, Gerrit checks) annotate findings
+inline when handed SARIF; this module maps the one finding-object
+schema every family shares (``Diagnostic.to_dict``) onto the standard:
+
+- each referenced rule becomes a ``tool.driver.rules`` entry (id, slug
+  as name, summary/rationale as descriptions, hint as help),
+- each diagnostic becomes a ``result`` with ``ruleId``, ``level``
+  (error→error, warning→warning, info→note), message, and a physical
+  location parsed from the ``file:line`` anchor,
+- pragma-suppressed findings are carried as results with an
+  ``inSource`` suppression, mirroring the JSON report's ``suppressed``
+  list — CI shows them struck through instead of losing them.
+
+The export is lossless against the JSON schema: ``test_analyze_cli``
+round-trips a report through SARIF and back onto the finding fields.
+"""
+
+from __future__ import annotations
+
+import json
+
+from deeplearning4j_tpu.analyze.diagnostics import (
+    Diagnostic, Report, RULES, rule_family)
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+_LEVEL_BY_SEVERITY = {"error": "error", "warning": "warning", "info": "note"}
+
+
+def _split_anchor(path: str | None) -> tuple[str | None, int | None]:
+    if not path:
+        return None, None
+    base, _, line = path.rpartition(":")
+    if base and line.isdigit():
+        return base, int(line)
+    return path, None
+
+
+def _rule_entry(rule_id: str) -> dict:
+    info = RULES.get(rule_id)
+    if info is None:
+        return {"id": rule_id}
+    entry = {
+        "id": info.id,
+        "name": info.slug,
+        "shortDescription": {"text": info.summary},
+        "fullDescription": {"text": info.rationale},
+        "help": {"text": info.hint},
+        "defaultConfiguration": {
+            "level": _LEVEL_BY_SEVERITY.get(info.severity, "warning")},
+        "properties": {"family": rule_family(info.id)},
+    }
+    return entry
+
+
+def _result(diag: Diagnostic, rule_index: dict[str, int],
+            suppressed: bool) -> dict:
+    uri, line = _split_anchor(diag.path)
+    result: dict = {
+        "ruleId": diag.rule,
+        "level": _LEVEL_BY_SEVERITY.get(diag.effective_severity(), "warning"),
+        "message": {"text": diag.message},
+    }
+    if diag.rule in rule_index:
+        result["ruleIndex"] = rule_index[diag.rule]
+    if uri is not None:
+        location: dict = {
+            "physicalLocation": {"artifactLocation": {"uri": uri}}}
+        if line is not None:
+            location["physicalLocation"]["region"] = {"startLine": line}
+        result["locations"] = [location]
+    hint = diag.effective_hint()
+    if hint:
+        result["properties"] = {"hint": hint,
+                                "family": rule_family(diag.rule)}
+    else:
+        result["properties"] = {"family": rule_family(diag.rule)}
+    if suppressed:
+        result["suppressions"] = [{"kind": "inSource"}]
+    return result
+
+
+def report_to_sarif(report: Report) -> dict:
+    """The report as a SARIF 2.1.0 log dict (one run)."""
+    referenced: list[str] = []
+    for d in list(report.sorted()) + list(report.suppressed):
+        if d.rule not in referenced:
+            referenced.append(d.rule)
+    referenced.sort()
+    rule_index = {rid: i for i, rid in enumerate(referenced)}
+    results = [_result(d, rule_index, suppressed=False)
+               for d in report.sorted()]
+    results += [_result(d, rule_index, suppressed=True)
+                for d in report.suppressed]
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tpudl-analyze",
+                "informationUri":
+                    "docs/static_analysis.md",
+                "rules": [_rule_entry(r) for r in referenced],
+            }},
+            "results": results,
+            "properties": {"context": dict(report.context)},
+        }],
+    }
+
+
+def report_to_sarif_json(report: Report) -> str:
+    return json.dumps(report_to_sarif(report), indent=2, default=str)
+
+
+def sarif_to_findings(doc: dict) -> list[dict]:
+    """The inverse mapping (for the round-trip test and finding diffs):
+    SARIF results back onto the JSON finding schema fields that survive
+    the trip (rule/severity/path/message/hint + suppressed flag)."""
+    level_to_sev = {v: k for k, v in _LEVEL_BY_SEVERITY.items()}
+    out = []
+    for run in doc.get("runs", ()):
+        for result in run.get("results", ()):
+            path = None
+            locs = result.get("locations") or ()
+            if locs:
+                phys = locs[0].get("physicalLocation", {})
+                path = phys.get("artifactLocation", {}).get("uri")
+                line = phys.get("region", {}).get("startLine")
+                if path is not None and line is not None:
+                    path = f"{path}:{line}"
+            out.append({
+                "rule": result.get("ruleId"),
+                "severity": level_to_sev.get(result.get("level"), "warning"),
+                "path": path,
+                "message": result.get("message", {}).get("text"),
+                "hint": result.get("properties", {}).get("hint"),
+                "family": result.get("properties", {}).get("family"),
+                "suppressed": bool(result.get("suppressions")),
+            })
+    return out

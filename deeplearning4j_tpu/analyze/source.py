@@ -16,8 +16,8 @@ Suppression pragma
     # tpudl: ok(TPU402) — writes race only during shutdown, see close()
     # tpudl: ok(TPU404,TPU311) — bounded wait, coordinator is local
 
-A pragma suppresses matching AST-family findings (``TPU3xx``/``TPU4xx``)
-anchored at its own line, or — when the pragma sits on a line of its own
+A pragma suppresses matching AST-family findings
+(``TPU3xx``/``TPU4xx``/``TPU5xx``) anchored at its own line, or — when the pragma sits on a line of its own
 — at the line directly below.  The reason text after the dash is
 MANDATORY: a bare ``# tpudl: ok(TPU402)`` still suppresses, but is
 itself a ``TPU400`` error, so the gate stays red until someone writes
@@ -33,10 +33,12 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import io
 import os
 import re
 import threading
+import time
 import tokenize
 from typing import Any, Optional
 
@@ -47,7 +49,7 @@ _RULE_ID_RE = re.compile(r"^TPU\d{3}$")
 # families a pragma may suppress: the AST rules, which anchor findings
 # to file:line.  Model/graph/sharding findings anchor to layer paths —
 # a line pragma has nothing to attach to there.
-_SUPPRESSIBLE_PREFIXES = ("TPU3", "TPU4")
+_SUPPRESSIBLE_PREFIXES = ("TPU3", "TPU4", "TPU5")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -110,9 +112,9 @@ class SourceFile:
 
 
 # ------------------------------------------------------------------ cache
-_CACHE: dict[str, tuple[tuple, SourceFile]] = {}
+_CACHE: dict[str, tuple[tuple, str, SourceFile]] = {}
 _CACHE_LOCK = threading.Lock()
-CACHE_STATS = {"parses": 0, "hits": 0}
+CACHE_STATS = {"parses": 0, "hits": 0, "hash_verifies": 0}
 
 
 def _stat_key(path: str) -> tuple:
@@ -120,23 +122,54 @@ def _stat_key(path: str) -> tuple:
     return (st.st_mtime_ns, st.st_size)
 
 
+def _digest(text: str) -> str:
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+def _stale_prone(key: tuple) -> bool:
+    """(mtime_ns, size) keys can collide across rewrites when the
+    filesystem's mtime granularity collapses: a same-second rewrite that
+    happens to keep the byte count (the ``--changed`` pre-commit shape —
+    editor save, re-run within one tick) returns a stale AST.  Two
+    signals mark a key untrustworthy: whole-second mtime (coarse
+    filesystem) and an mtime inside the last ~2s (a rewrite may still
+    land on the same tick)."""
+    mtime_ns = key[0]
+    if mtime_ns % 1_000_000_000 == 0:
+        return True
+    return abs(time.time() - mtime_ns / 1e9) < 2.0
+
+
 def load_source(path: str) -> SourceFile:
     """Parse ``path`` once per content version; raises ``OSError`` /
-    ``SyntaxError`` like ``open``+``ast.parse`` would."""
+    ``SyntaxError`` like ``open``+``ast.parse`` would.  Keyed by
+    (mtime_ns, size) with a content-hash fallback when the mtime
+    granularity makes that key unreliable (see :func:`_stale_prone`)."""
     path = os.path.abspath(path)
     key = _stat_key(path)
     with _CACHE_LOCK:
         hit = _CACHE.get(path)
-        if hit is not None and hit[0] == key:
-            CACHE_STATS["hits"] += 1
-            return hit[1]
-    with open(path, encoding="utf-8") as f:
-        text = f.read()
+    if hit is not None and hit[0] == key:
+        if not _stale_prone(key):
+            with _CACHE_LOCK:
+                CACHE_STATS["hits"] += 1
+            return hit[2]
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        with _CACHE_LOCK:
+            CACHE_STATS["hash_verifies"] += 1
+        if _digest(text) == hit[1]:
+            with _CACHE_LOCK:
+                CACHE_STATS["hits"] += 1
+            return hit[2]
+    else:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
     tree = ast.parse(text, filename=path)
     sf = SourceFile(path, text, tree)
     with _CACHE_LOCK:
         CACHE_STATS["parses"] += 1
-        _CACHE[path] = (key, sf)
+        _CACHE[path] = (key, _digest(text), sf)
     return sf
 
 
@@ -149,6 +182,7 @@ def clear_cache() -> None:
     with _CACHE_LOCK:
         _CACHE.clear()
         CACHE_STATS["parses"] = CACHE_STATS["hits"] = 0
+        CACHE_STATS["hash_verifies"] = 0
 
 
 # ------------------------------------------------------- pragma application
@@ -258,8 +292,9 @@ def pragma_diagnostics(sf: SourceFile,
                 out.append(Diagnostic(
                     "TPU400",
                     f"suppression pragma names {rule}, which is not an "
-                    f"AST-family rule — only TPU3xx/TPU4xx findings "
-                    f"anchor to a source line a pragma can excuse",
+                    f"AST-family rule — only TPU3xx/TPU4xx/TPU5xx "
+                    f"findings anchor to a source line a pragma can "
+                    f"excuse",
                     path=anchor))
         if not pragma.reason:
             out.append(Diagnostic(

@@ -137,6 +137,10 @@ class Config:
     costmodel: bool = True
 
     @classmethod
+    def env_var_for(cls, field_name: str) -> str:
+        return _ENV_PREFIX + field_name.upper()
+
+    @classmethod
     def from_env(cls) -> "Config":
         cfg = cls()
         for f in dataclasses.fields(cls):
@@ -151,6 +155,66 @@ class Config:
                 setattr(cfg, f.name, raw)
         return cfg
 
+
+# ----------------------------------------------------------- env contract
+# The static declaration of every USER-FACING ``DL4J_TPU_*`` knob — the
+# variables a person (or a deployment manifest) sets, which the code
+# reads without any in-tree setter.  ``Config.from_env`` reads its
+# fields dynamically (``_ENV_PREFIX + field.upper()``), which no static
+# analysis can see; this table is the statically-checkable face of that
+# contract.  The TPU503 whole-program rule (analyze --dataflow) treats
+# a read-never-set variable as an error UNLESS it is declared here, and
+# the generated env-var table in docs/static_analysis.md is built from
+# the same data — so adding a knob without declaring it reds the gate,
+# and declaring it documents it in the same keystroke.  Internal
+# launcher→child plumbing (DL4J_TPU_FLIGHT_DUMP, _WORKER_ID, …) is
+# deliberately NOT declared: those must have both a setter and a reader
+# in-tree, and TPU503 checks exactly that.
+ENV_KNOBS: dict[str, str] = {
+    # Config dataclass fields (read dynamically by Config.from_env)
+    "DL4J_TPU_DEBUG": "config.debug: sd::Environment-style debug toggle",
+    "DL4J_TPU_VERBOSE": "config.verbose: verbose logging toggle",
+    "DL4J_TPU_NAN_PANIC": "config.nan_panic: raise on NaN step outputs",
+    "DL4J_TPU_INF_PANIC": "config.inf_panic: raise on Inf step outputs",
+    "DL4J_TPU_DEFAULT_SEED": "config.default_seed: global RNG seed",
+    "DL4J_TPU_METRICS_DIR": "config.metrics_dir: jsonl metric stream dir",
+    "DL4J_TPU_PREFETCH_SIZE": "config.prefetch_size: prefetch queue depth",
+    "DL4J_TPU_DEVICE_FEED": "config.device_feed: DeviceFeeder double "
+                            "buffering in Trainer.fit",
+    "DL4J_TPU_SHAPE_BUCKETING": "config.shape_bucketing: pad ragged tail "
+                                "batches to static bucket shapes",
+    "DL4J_TPU_FUSED_CONV": "config.fused_conv: Pallas fused conv+BN "
+                           "bottleneck lowering",
+    "DL4J_TPU_COMPILE_CACHE_DIR": "config.compile_cache_dir: persistent "
+                                  "XLA compilation cache location",
+    "DL4J_TPU_ARTIFACT_STORE": "config.artifact_store: warm compiled "
+                               "programs from checkpoint zips",
+    "DL4J_TPU_ARTIFACT_BAKE": "config.artifact_bake: background "
+                              "AOT-bake of train/eval programs (the "
+                              "supervisor turns it on for gang children)",
+    "DL4J_TPU_PROFILING": "config.profiling: jax.profiler trace around "
+                          "Trainer.fit",
+    "DL4J_TPU_TRACING": "config.tracing: span-based tracing (the "
+                        "launcher also turns it on for gang children)",
+    "DL4J_TPU_TRACE_DIR": "config.trace_dir: span/profiler dump dir",
+    "DL4J_TPU_COSTMODEL": "config.costmodel: roofline MFU/HBM gauges "
+                          "from XLA cost_analysis",
+    # Distributed-init knobs (parallel.launcher env fallbacks)
+    "DL4J_TPU_COORDINATOR": "launcher: coordinator address fallback for "
+                            "jax.distributed.initialize",
+    "DL4J_TPU_NUM_PROCESSES": "launcher: process count fallback",
+    "DL4J_TPU_PROCESS_ID": "launcher: this process's index fallback",
+    # Observability / native knobs with no in-tree setter
+    "DL4J_TPU_UI_HOST": "obs.ui_server: bind address for the metrics UI",
+    "DL4J_TPU_WATCHDOG_GRACE_S": "obs.flight_recorder: extra grace "
+                                 "before a fired watchdog _exits",
+    "DL4J_TPU_PEAK_TFLOPS": "obs.costmodel: device peak TFLOP/s "
+                            "override for MFU",
+    "DL4J_TPU_PEAK_HBM_GBPS": "obs.costmodel: device peak HBM GB/s "
+                              "override",
+    "DL4J_TPU_NATIVE_SANITIZE": "native: pure-Python reference path for "
+                                "the packbits/codec fast paths",
+}
 
 _lock = threading.Lock()
 _config: Config | None = None

@@ -58,7 +58,10 @@ def check_finite(tree: Any, label: str = "output") -> None:
             and jnp.issubdtype(leaf.dtype, jnp.inexact)]
     if not flat:
         return
-    nan_flag, inf_flag = _finite_flags([leaf for _, leaf in flat])
+    # explicit fence: ONE transfer for both flags — bool() on the raw
+    # jit outputs would pay two hidden syncs (TPU502)
+    nan_flag, inf_flag = jax.device_get(
+        _finite_flags([leaf for _, leaf in flat]))
     has_nan = cfg.nan_panic and bool(nan_flag)
     has_inf = cfg.inf_panic and bool(inf_flag)
     if not (has_nan or has_inf):

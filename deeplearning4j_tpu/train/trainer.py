@@ -859,6 +859,14 @@ class Trainer:
         per-step losses exactly (tests/test_resilience.py pins 1e-6)."""
         net = self.net
         epochs_to_run = epochs
+        if resume_from is None:
+            # the supervisor's respawn contract: a verified checkpoint
+            # pointer rides DL4J_TPU_RESUME_FROM into every respawned
+            # gang child — consuming it here makes resume automatic for
+            # any worker fn that calls fit, instead of each one
+            # re-implementing the env read
+            from deeplearning4j_tpu.resilience.supervisor import RESUME_ENV
+            resume_from = os.environ.get(RESUME_ENV) or None
         if resume_from is not None:
             # resume first: it verifies + restores state, then warms
             # the artifact pool, so the first step below dispatches the

@@ -1,0 +1,8 @@
+"""Clean caller: rebinds the donated names to the step's outputs."""
+from steps import train_step
+
+
+def run_epoch(params, opt_state, batches):
+    for batch in batches:
+        params, opt_state = train_step(params, opt_state, batch)
+    return params

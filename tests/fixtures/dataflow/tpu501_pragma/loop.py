@@ -1,0 +1,8 @@
+"""Positive case with the read suppressed in-line."""
+from steps import train_step
+
+
+def run_epoch(params, opt_state, batches):
+    for batch in batches:
+        train_step(params, opt_state, batch)
+    return params["w"].sum()  # tpudl: ok(TPU501) — fixture: post-donation read is the point

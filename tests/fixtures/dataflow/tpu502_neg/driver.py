@@ -1,0 +1,11 @@
+"""Clean caller: the jit output is fenced before it reaches the sink."""
+import jax
+
+from model import forward
+from report import emit
+
+
+def run(x):
+    y = forward(x)
+    y = jax.block_until_ready(y)
+    emit(y)

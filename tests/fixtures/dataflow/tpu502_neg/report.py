@@ -1,0 +1,5 @@
+"""Same host-sink helper as the positive case."""
+
+
+def emit(value):
+    print(value)

@@ -1,0 +1,8 @@
+"""Defect site: a jit output flows into the host-sink helper unfenced."""
+from model import forward
+from report import emit
+
+
+def run(x):
+    y = forward(x)
+    emit(y)

@@ -1,0 +1,5 @@
+"""Detection site: this helper pushes its argument to the host."""
+
+
+def emit(value):
+    print(value)

@@ -1,0 +1,8 @@
+"""Same unfenced flow as the positive case; suppression lives at the sink."""
+from model import forward
+from report import emit
+
+
+def run(x):
+    y = forward(x)
+    emit(y)

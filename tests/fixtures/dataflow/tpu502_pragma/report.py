@@ -1,0 +1,5 @@
+"""Host-sink helper with the sink suppressed in-line."""
+
+
+def emit(value):
+    print(value)  # tpudl: ok(TPU502) — fixture: debug print accepts the sync

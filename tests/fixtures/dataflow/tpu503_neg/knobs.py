@@ -1,0 +1,10 @@
+"""Declared user-facing knob: read-only is fine once declared."""
+import os
+
+FIXTURE_KNOBS: dict[str, str] = {
+    "DL4J_TPU_FIXTURE_DEBUG": "user-set debug toggle; never set by the framework",
+}
+
+
+def debug_enabled():
+    return bool(os.environ.get("DL4J_TPU_FIXTURE_DEBUG"))

@@ -1,0 +1,8 @@
+"""Reader side: imports the setter's constant, so the spelling cannot drift."""
+import os
+
+from writer import GANG_TOKEN_ENV
+
+
+def token():
+    return os.environ.get(GANG_TOKEN_ENV)

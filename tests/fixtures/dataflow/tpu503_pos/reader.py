@@ -1,0 +1,6 @@
+"""Reader side — but the spelling drifted from the setter's."""
+import os
+
+
+def token():
+    return os.environ.get("DL4J_TPU_GANG_TOKEN_ID")

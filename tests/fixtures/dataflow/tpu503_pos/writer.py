@@ -1,0 +1,11 @@
+"""Setter side of the env contract: exports a token for child processes."""
+import os
+import subprocess
+
+GANG_TOKEN_ENV = "DL4J_TPU_GANG_TOKEN"
+
+
+def spawn(cmd):
+    env = dict(os.environ)
+    env[GANG_TOKEN_ENV] = "tok"
+    return subprocess.Popen(cmd, env=env)

@@ -1,0 +1,6 @@
+"""Reader side, with the orphaned read suppressed in-line."""
+import os
+
+
+def token():
+    return os.environ.get("DL4J_TPU_GANG_TOKEN_ID")  # tpudl: ok(TPU503) — fixture: set by the deploy wrapper

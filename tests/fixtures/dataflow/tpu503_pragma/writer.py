@@ -1,0 +1,11 @@
+"""Setter side, with the orphaned set suppressed in-line."""
+import os
+import subprocess
+
+GANG_TOKEN_ENV = "DL4J_TPU_GANG_TOKEN"
+
+
+def spawn(cmd):
+    env = dict(os.environ)
+    env[GANG_TOKEN_ENV] = "tok"  # tpudl: ok(TPU503) — fixture: consumed by an external tool
+    return subprocess.Popen(cmd, env=env)

@@ -1,0 +1,6 @@
+"""Same allocator helper as the positive case."""
+import jax.numpy as jnp
+
+
+def zero_state(n, width):
+    return jnp.zeros((n, width))

@@ -1,0 +1,12 @@
+"""Clean caller: the allocation shape is a bucketed constant, not data-derived."""
+import jax
+
+from alloc import zero_state
+
+BUCKET = 128
+
+
+@jax.jit
+def train_step(params, batch):
+    state = zero_state(BUCKET, 4)
+    return state + batch

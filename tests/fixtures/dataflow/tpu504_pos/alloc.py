@@ -1,0 +1,6 @@
+"""Detection site: allocator helper shapes its output from a Python int."""
+import jax.numpy as jnp
+
+
+def zero_state(n, width):
+    return jnp.zeros((n, width))

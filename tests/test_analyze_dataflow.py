@@ -1,0 +1,264 @@
+"""Tier-1 gate for the whole-program dataflow analyzer (TPU5xx).
+
+Three layers: (a) the framework tree itself must be dataflow-clean —
+the same ``analyze --dataflow --self`` contract the CLI enforces;
+(b) seeded-defect fixture packages under ``tests/fixtures/dataflow/``
+prove each rule fires *interprocedurally* (the defect and the
+detection site live in different modules) and that the negative and
+pragma variants stay quiet; (c) the satellites — SARIF round-trip,
+``--changed`` scoping, pragma-debt report, source-cache content-hash
+fallback — each get a deterministic check.
+"""
+
+import dataclasses
+import json
+import os
+
+import pytest
+
+from deeplearning4j_tpu.analyze import (
+    analyze_dataflow_paths,
+    build_project,
+    collect_pragmas,
+    env_table_markdown,
+    pragma_report,
+    report_to_sarif,
+    sarif_to_findings,
+)
+from deeplearning4j_tpu.analyze.__main__ import (
+    _filter_report_to,
+    changed_files,
+    main as analyze_main,
+)
+from deeplearning4j_tpu.analyze.source import cache_stats, load_source
+from deeplearning4j_tpu.config import Config, ENV_KNOBS
+
+import deeplearning4j_tpu
+
+PACKAGE_DIR = os.path.dirname(os.path.abspath(deeplearning4j_tpu.__file__))
+REPO_ROOT = os.path.dirname(PACKAGE_DIR)
+FIXTURES = os.path.join(REPO_ROOT, "tests", "fixtures", "dataflow")
+
+
+def fixture(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+@pytest.fixture(scope="module")
+def project():
+    """ONE whole-program model of the real tree, shared by every test
+    here — the build walks ~150 files and is the expensive part."""
+    return build_project([PACKAGE_DIR])
+
+
+@pytest.fixture(scope="module")
+def package_report(project):
+    return analyze_dataflow_paths([PACKAGE_DIR], project=project)
+
+
+# ------------------------------------------------------- self-gate + graph
+def test_framework_tree_is_dataflow_clean(package_report):
+    """The acceptance gate: zero unsuppressed TPU5xx on the tree."""
+    tpu5 = [d for d in package_report.diagnostics
+            if d.rule.startswith("TPU5")]
+    assert tpu5 == [], "TPU5xx findings in the tree:\n" + "\n".join(
+        d.render() for d in tpu5)
+    assert package_report.exit_code() == 0
+
+
+def test_package_model_coverage(package_report):
+    ctx = package_report.context
+    assert ctx["files_analyzed"] > 100
+    assert ctx["env_vars"] >= 25
+
+
+def test_callgraph_cross_module_resolution_floor(project):
+    """Resolution-health floor: a resolver regression that hollows the
+    call graph (so interprocedural rules silently see nothing) trips
+    this long before a missed finding would.  The real tree currently
+    resolves ~880 cross-module edges; 500 leaves refactor headroom."""
+    assert len(project.graph.cross_module_edges()) >= 500
+    assert project.graph.resolved_edges() >= 2000
+
+
+def test_dataflow_self_cli_exits_zero():
+    assert analyze_main(["--dataflow", "--self"]) == 0
+
+
+# ------------------------------------------------------------- fixtures
+# (dir, expected rule, detection-site basename, defect-site basename) —
+# detection and defect sites are in DIFFERENT modules by construction.
+POSITIVE_CASES = [
+    ("tpu501_pos", "TPU501", "loop.py", "steps.py"),
+    ("tpu502_pos", "TPU502", "report.py", "driver.py"),
+    ("tpu503_pos", "TPU503", "reader.py", "writer.py"),
+    ("tpu504_pos", "TPU504", "alloc.py", "step.py"),
+]
+
+
+@pytest.mark.parametrize("case, rule, anchor, defect", POSITIVE_CASES)
+def test_fixture_positive_fires_interprocedurally(case, rule, anchor, defect):
+    report = analyze_dataflow_paths([fixture(case)])
+    hits = [d for d in report.diagnostics if d.rule == rule]
+    assert hits, f"{rule} did not fire on {case}"
+    anchored = {os.path.basename((d.path or "").rpartition(":")[0])
+                for d in hits}
+    assert anchor in anchored
+    # the module holding the defect is not the module holding the anchor
+    assert anchor != defect
+    assert report.exit_code() == 1
+
+
+@pytest.mark.parametrize("case", [
+    "tpu501_neg", "tpu502_neg", "tpu503_neg", "tpu504_neg",
+])
+def test_fixture_negative_stays_quiet(case):
+    report = analyze_dataflow_paths([fixture(case)])
+    tpu5 = [d for d in report.diagnostics if d.rule.startswith("TPU5")]
+    assert tpu5 == [], "\n".join(d.render() for d in tpu5)
+
+
+@pytest.mark.parametrize("case, rule", [
+    ("tpu501_pragma", "TPU501"),
+    ("tpu502_pragma", "TPU502"),
+    ("tpu503_pragma", "TPU503"),
+    ("tpu504_pragma", "TPU504"),
+])
+def test_fixture_pragma_suppresses(case, rule):
+    report = analyze_dataflow_paths([fixture(case)])
+    assert [d for d in report.diagnostics if d.rule.startswith("TPU5")] == []
+    assert rule in {d.rule for d in report.suppressed}
+    assert report.exit_code() == 0
+
+
+def test_tpu503_drift_names_both_sides():
+    """The positive case is a spelling drift: the set-never-read and the
+    read-never-set finding must both surface, each naming its variable."""
+    report = analyze_dataflow_paths([fixture("tpu503_pos")])
+    msgs = " ".join(d.message for d in report.diagnostics)
+    assert "DL4J_TPU_GANG_TOKEN" in msgs
+    assert "DL4J_TPU_GANG_TOKEN_ID" in msgs
+    assert len([d for d in report.diagnostics if d.rule == "TPU503"]) == 2
+
+
+# ---------------------------------------------------------------- SARIF
+def test_sarif_round_trip():
+    """report → SARIF 2.1.0 → findings preserves every field the JSON
+    schema carries, including the suppressed flag."""
+    report = analyze_dataflow_paths(
+        [fixture("tpu501_pos"), fixture("tpu502_pragma")])
+    doc = report_to_sarif(report)
+    assert doc["version"] == "2.1.0"
+    json.dumps(doc)  # must be serializable as-is
+
+    back = sarif_to_findings(doc)
+    active = [f for f in back if not f["suppressed"]]
+    suppressed = [f for f in back if f["suppressed"]]
+    expect = json.loads(report.to_json())["diagnostics"]
+    assert [(f["rule"], f["path"], f["message"]) for f in active] == \
+           [(f["rule"], f["path"], f["message"]) for f in expect]
+    assert {f["rule"] for f in suppressed} == {"TPU502"}
+
+    # every referenced rule is described in the driver's rule catalog
+    rules = {r["id"] for r in
+             doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert {f["rule"] for f in back} <= rules
+
+
+def test_sarif_cli(capsys):
+    rc = analyze_main(["--dataflow", fixture("tpu501_pos"),
+                       "--format", "sarif"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["version"] == "2.1.0"
+    assert {r["ruleId"] for r in doc["runs"][0]["results"]} == {"TPU501"}
+
+
+# -------------------------------------------------------------- --changed
+def test_changed_files_lists_existing_python():
+    files = changed_files("HEAD")
+    assert isinstance(files, list)
+    for f in files:
+        assert f.endswith(".py") and os.path.isfile(f)
+
+
+def test_filter_report_scopes_findings():
+    report = analyze_dataflow_paths([fixture("tpu503_pos")])
+    assert len(report.diagnostics) == 2
+    keep = {os.path.abspath(os.path.join(fixture("tpu503_pos"),
+                                         "reader.py"))}
+    _filter_report_to(report, keep)
+    assert [os.path.basename((d.path or "").rpartition(":")[0])
+            for d in report.diagnostics] == ["reader.py"]
+
+
+# --------------------------------------------------------------- pragmas
+def test_collect_pragmas_inventory():
+    recs = collect_pragmas(
+        [os.path.join(fixture("tpu501_pragma"), "loop.py")], blame=False)
+    assert len(recs) == 1
+    assert recs[0]["rules"] == ["TPU501"]
+    assert recs[0]["stale_rules"] == []
+    assert "post-donation read" in recs[0]["reason"]
+
+
+def test_pragma_report_flags_stale_rule_ids(tmp_path):
+    bad = tmp_path / "stale.py"
+    bad.write_text("x = 1  # tpudl: ok(TPU999) — rule retired long ago\n")
+    report = pragma_report([str(bad)], blame=False)
+    assert any(d.rule == "TPU400" and "TPU999" in d.message
+               for d in report.diagnostics)
+
+
+# ------------------------------------------------------------ source cache
+def test_cache_content_hash_fallback(tmp_path):
+    """A same-second, same-size rewrite must not serve the stale AST:
+    the whole-second mtime marks the stat key untrustworthy, so the
+    content hash re-checks and the new text reparses."""
+    p = tmp_path / "mod.py"
+    whole = 1_700_000_000 * 10**9  # whole-second mtime_ns, far from now
+    p.write_text("x = 1\n")
+    os.utime(p, ns=(whole, whole))
+    sf1 = load_source(str(p))
+    p.write_text("x = 2\n")  # identical byte count
+    os.utime(p, ns=(whole, whole))  # identical (mtime_ns, size) key
+    sf2 = load_source(str(p))
+    assert sf2 is not sf1
+    assert sf2.text == "x = 2\n"
+
+
+def test_cache_fast_path_skips_hashing(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text("x = 1\n")
+    ns = 1_700_000_000 * 10**9 + 123_456_789  # sub-second, far from now
+    os.utime(p, ns=(ns, ns))
+    sf1 = load_source(str(p))
+    before = cache_stats()
+    sf2 = load_source(str(p))
+    after = cache_stats()
+    assert sf2 is sf1
+    assert after["hits"] == before["hits"] + 1
+    assert after["hash_verifies"] == before["hash_verifies"]
+
+
+# ------------------------------------------------------------- env table
+def test_every_config_knob_is_declared():
+    """TPU503's declaration registry must cover every Config field —
+    a new field without an ENV_KNOBS entry would surface as a drift
+    finding the moment only one side of the contract exists."""
+    for f in dataclasses.fields(Config):
+        var = Config.env_var_for(f.name)
+        assert var in ENV_KNOBS, f"{var} missing from config.ENV_KNOBS"
+
+
+def test_env_table_embedded_in_docs(project):
+    """docs/static_analysis.md embeds the generated env-var table
+    verbatim — same can't-drift contract as the rule catalog."""
+    with open(os.path.join(REPO_ROOT, "docs", "static_analysis.md")) as f:
+        doc = f.read()
+    table = env_table_markdown(project)
+    assert "DL4J_TPU_COORDINATOR" in table
+    assert table in doc, \
+        "env table drifted — regenerate with " \
+        "python -c 'from deeplearning4j_tpu.analyze import " \
+        "env_table_markdown; print(env_table_markdown())'"

@@ -57,3 +57,10 @@ def test_rule_catalog_documented():
         if rule_id.startswith("TPU4") and rule_id != "TPU400":
             assert f"**{rule_id} {info.slug}.**" in doc, \
                 f"{rule_id} rationale missing from the Concurrency section"
+    # same contract for the dataflow family: one "**TPU5xx slug.**"
+    # rationale block per rule
+    assert "## Whole-program dataflow" in doc
+    for rule_id, info in RULES.items():
+        if rule_id.startswith("TPU5"):
+            assert f"**{rule_id} {info.slug}.**" in doc, \
+                f"{rule_id} rationale missing from the dataflow section"
