@@ -26,6 +26,16 @@ record reports the measured ``mttr_s`` (failure detection → first
 post-restart federated step), ``steps_replayed`` and
 ``recovered: true`` — recovery time as a first-class efficiency number.
 
+Since the elastic-device-pool PR the record also carries an **elastic**
+section (own subprocess, like the mesh sweep): a grow scenario — one
+continuous fit grows dp2→dp4 at an epoch boundary and its post-boundary
+losses are diffed against a fixed-dp4 run (the checkpoint-consistency
+number) — and a borrow/return scenario — a
+:class:`~deeplearning4j_tpu.resilience.arbiter.DevicePoolArbiter` moves
+2 chips from a live dp4 trainer to a live serve router and back under
+threaded client load, reporting whether serve p99 held, the measured
+gang grow-back MTTR, and that zero responses were dropped or garbled.
+
 Prints ONE json line.  Env knobs: ``DL4J_TPU_MULTICHIP_WORKERS`` (4),
 ``DL4J_TPU_MULTICHIP_STEPS`` (16), ``DL4J_TPU_MULTICHIP_PORT`` (24211),
 ``DL4J_TPU_MULTICHIP_RECOVERY_STEPS`` (8).
@@ -277,6 +287,188 @@ def mesh_sweep_main():
     return 0
 
 
+def elastic_main():
+    """The elastic-device-pool record (ISSUE 19).  Two scenarios on the
+    forced 8-device virtual CPU mesh, in-process:
+
+    - **grow**: the SAME model/data/seed run twice — fixed dp4, and
+      dp2 growing to dp4 at an epoch boundary inside one continuous fit
+      (dropout active, width-invariant partitionable RNG).  Reports the
+      max post-boundary per-step loss delta: the checkpoint-consistent
+      reshard makes it ~0.
+    - **arbiter**: a DevicePoolArbiter borrows 2 chips from a live dp4
+      trainer for a live serve router under threaded client load, then
+      returns them; reports serve p99 steady vs during the flips, the
+      gang grow-back MTTR, and zero dropped/garbled responses.
+
+    Prints ONE json line."""
+    import tempfile
+    import threading
+    import time
+
+    import numpy as np
+
+    from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+    from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.resilience.arbiter import (DevicePoolArbiter,
+                                                       TrainerGang)
+    from deeplearning4j_tpu.serve import ModelRegistry, ReplicaRouter
+    from deeplearning4j_tpu.train import Sgd
+    from deeplearning4j_tpu.train.trainer import Trainer
+
+    def mlp(seed=11, dropout=0.8):
+        conf = (NeuralNetConfiguration.builder().seed(seed)
+                .updater(Sgd(0.1)).weight_init("xavier").list()
+                .layer(DenseLayer(n_out=16, activation="relu",
+                                  dropout=dropout))
+                .layer(DenseLayer(n_out=16, activation="tanh",
+                                  dropout=dropout))
+                .layer(OutputLayer(n_out=4, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(8)).build())
+        return MultiLayerNetwork(conf)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 4)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[np.argmax(x @ w, -1)]
+    epochs, boundary = 4, 2
+
+    def run(start, resize_to=None):
+        net = mlp()
+        trainer = Trainer(net, layout=start)
+        losses = []
+
+        class Rec:
+            def iteration_done(self, net, it, ep, loss):
+                losses.append(float(loss))
+
+            def on_epoch_end(self, net, epoch, info):
+                if resize_to is not None and epoch + 1 == boundary:
+                    trainer.request_resize(resize_to)
+
+        trainer.bus.listeners.append(Rec())
+        trainer.fit(ArrayDataSetIterator(x, y, 16, shuffle=False),
+                    epochs=epochs)
+        return losses, trainer
+
+    fixed_losses, _ = run("dp4")
+    elastic_losses, trainer = run("dp2", resize_to=4)
+    cut = boundary * (len(fixed_losses) // epochs)
+    delta = max(abs(a - b) for a, b in
+                zip(elastic_losses[cut:], fixed_losses[cut:]))
+    grow = {
+        "from_width": 2, "to_width": 4, "resize_epoch": boundary,
+        "post_boundary_max_loss_delta": float(f"{delta:.3e}"),
+        "matches_fixed_width": bool(delta <= 1e-6),
+        "final_layout": trainer._layout.describe(),
+        "note": ("one continuous fit grows dp2->dp4 at the epoch "
+                 "boundary; post-boundary per-step losses diffed "
+                 "against a fixed-dp4 run (dropout active)"),
+    }
+
+    # ----- borrow/return under live serve load
+    workdir = tempfile.mkdtemp(prefix="dl4j_tpu_elastic_")
+    snet = mlp(seed=23, dropout=None).init()
+    path = os.path.join(workdir, "serve.zip")
+    snet.save(path)
+    models = ModelRegistry(max_batch=8, max_latency_ms=2, queue_limit=64)
+    models.deploy("m", path)
+    router = ReplicaRouter(models, "m", replicas=2, max_replicas=4)
+    trainer = Trainer(mlp(), layout="dp4")
+    it = ArrayDataSetIterator(x, y, 16, shuffle=False)
+    trainer.fit(it, epochs=1)
+    arb = DevicePoolArbiter(router, TrainerGang(trainer), min_train=2,
+                            chips_per_flip=2, cooldown_s=0.0, serve_chips=2)
+    xs = x[:8]
+    expected = np.asarray(snet.output(xs))
+    stop, errors, lat = threading.Event(), [], []
+
+    def client():
+        while not stop.is_set():
+            t = time.perf_counter()
+            try:
+                out, _ = models.predict_versioned("m", xs, timeout_s=30)
+            except Exception as e:
+                errors.append(repr(e)[:200])
+                return
+            lat.append(time.perf_counter() - t)
+            if not np.allclose(out, expected, rtol=1e-5, atol=1e-6):
+                errors.append("garbled response")
+                return
+
+    def p99(samples):
+        s = sorted(samples) or [0.0]
+        return s[int(0.99 * (len(s) - 1))]
+
+    for _ in range(3):                   # compile + settle the engine
+        models.predict_versioned("m", xs, timeout_s=30)
+    threads = [threading.Thread(target=client) for _ in range(3)]
+    for th in threads:
+        th.start()
+    deadline = time.monotonic() + 5.0    # steady-state sample
+    while len(lat) < 30 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    n0, p99_steady = len(lat), p99(lat)
+    borrowed = arb.borrow()
+    trainer.fit(it, epochs=1)            # shrink lands at the boundary
+    width_during = trainer._layout.spec.total()
+    t_return = time.perf_counter()
+    returned = arb.return_chips()
+    trainer.fit(it, epochs=1)            # ... grow-back too
+    mttr_s = time.perf_counter() - t_return
+    stop.set()
+    for th in threads:
+        th.join(timeout=30)
+    p99_flips = p99(lat[n0:])
+    arbiter = {
+        "borrowed": bool(borrowed), "returned": bool(returned),
+        "width_during_borrow": width_during,
+        "width_restored": trainer._layout.spec.total() == 4,
+        "pool": arb.snapshot(),
+        "served": len(lat),
+        "zero_dropped_or_garbled": not errors,
+        "errors": errors[:3],
+        "serve_p99_ms_steady": round(p99_steady * 1e3, 3),
+        "serve_p99_ms_during_flips": round(p99_flips * 1e3, 3),
+        "p99_held": bool(not errors
+                         and p99_flips <= max(p99_steady * 5, 0.25)),
+        "grow_back_mttr_s": round(mttr_s, 3),
+        "note": ("2 chips borrowed from a live dp4 trainer for the "
+                 "serve router and returned under 3 threaded clients; "
+                 "mttr_s = return_chips() to the gang trained back at "
+                 "dp4 (includes the boundary epoch + reshard)"),
+    }
+    ok = (grow["matches_fixed_width"] and arbiter["width_restored"]
+          and arbiter["zero_dropped_or_garbled"])
+    print(json.dumps({
+        "metric": "elastic_pool", "value": 1.0 if ok else 0.0,
+        "unit": "ok", "grow": grow, "arbiter": arbiter,
+    }))
+    return 0
+
+
+def _run_elastic(timeout_s=420.0):
+    """Run the elastic record in a subprocess with the forced 8-device
+    virtual CPU topology and the width-invariant partitionable RNG (the
+    1e-6 grow contract depends on it)."""
+    import subprocess
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS=flags,
+               JAX_THREEFRY_PARTITIONABLE="1")
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--elastic"],
+        capture_output=True, text=True, timeout=timeout_s, env=env)
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    if lines:
+        return json.loads(lines[-1])
+    return {"error": (proc.stderr or "no output")[-300:]}
+
+
 def _run_mesh_sweep(timeout_s=420.0):
     """Run the sweep in a subprocess with the forced 8-device virtual
     CPU topology (the parent keeps its own device view for the gangs)."""
@@ -368,6 +560,12 @@ def main():
             mesh_sweep = _run_mesh_sweep()
         except Exception as e:
             mesh_sweep = {"error": str(e)[:200]}
+        # the elastic-pool row (own subprocess: needs the forced
+        # 8-device topology AND the partitionable RNG)
+        try:
+            elastic = _run_elastic()
+        except Exception as e:
+            elastic = {"error": str(e)[:200]}
         print(json.dumps({
             "metric": "multichip_scaling_efficiency",
             "value": round(efficiency, 4),
@@ -378,6 +576,7 @@ def main():
             "straggler_skew": round(skew, 4),
             "recovery": recovery,
             "mesh_sweep": mesh_sweep,
+            "elastic": elastic,
             "detail": {
                 "baseline_steps_per_s": round(baseline, 3),
                 "aggregate_steps_per_s": round(aggregate, 3),
@@ -398,4 +597,6 @@ def main():
 if __name__ == "__main__":
     if "--mesh-sweep" in sys.argv:
         sys.exit(mesh_sweep_main())
+    if "--elastic" in sys.argv:
+        sys.exit(elastic_main())
     sys.exit(main())

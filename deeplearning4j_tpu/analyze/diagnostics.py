@@ -339,6 +339,24 @@ _RULE_LIST = [
         "tpudl_*_seconds histogram, or a gauge.set) or hand it to the "
         "buffered cluster router (notify_step) — then delete the raw "
         "float."),
+    RuleInfo(
+        "TPU319", "hardcoded-device-count", ERROR,
+        "Integer literal compared against jax.device_count()/"
+        "len(jax.devices()) in a layout/reshard/arbiter-token function "
+        "(tests exempt — they pin concrete widths on purpose)",
+        "Elastic resizing (resilience.elastic, the DevicePoolArbiter) "
+        "changes the width a gang runs at MID-RUN: a supervisor grow "
+        "relaunches the gang wider, a borrow shrinks it.  Code on the "
+        "resize path that bakes in a device count — 'if "
+        "jax.device_count() == 8' — is correct exactly until the first "
+        "flip, then silently builds the wrong layout or refuses a "
+        "legal resize.  The failure is the worst kind: it only "
+        "reproduces on a fleet whose width just changed.",
+        "Derive the width from what the caller was handed: "
+        "MeshSpec.total() / resize_spec for layouts, the arbiter's "
+        "inventory for chip counts, elastic.configured_width() "
+        "(DL4J_TPU_GANG_WIDTH) inside gang workers — and compare "
+        "against THAT, or take the width as a parameter."),
     # ---- concurrency (AST, whole-repo thread model) -------------------
     RuleInfo(
         "TPU400", "bad-suppression", ERROR,

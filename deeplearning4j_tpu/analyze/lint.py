@@ -30,7 +30,10 @@ atomic fan-out, never a single-engine registry deploy), TPU317
 (hardcoded mesh-axis string outside parallel/mesh.py), TPU318 (ad-hoc
 latency measurement in serving/step-path code — a time delta that
 never reaches a registry histogram/gauge is invisible to SLO burn-rate
-evaluation).
+evaluation), TPU319 (integer literal compared against
+jax.device_count()/len(jax.devices()) in layout/reshard/arbiter-token
+functions — elastic gangs resize at runtime, so widths are derived,
+never assumed).
 Registry-backed rules that ride along in ``lint_package``/``--self``:
 TPU305 (metric names — the former ``obs.check`` lint) and TPU306
 (op-spec catalog integrity).
@@ -1375,6 +1378,72 @@ def _rule_adhoc_latency_measurement(mod: ModuleInfo) -> list[Diagnostic]:
                     f"registry histogram/gauge, so SLO burn-rate "
                     f"evaluation cannot see it; observe() it into the "
                     f"metric family the SLO reads",
+                    path=mod.anchor(node)))
+    return out
+
+
+# functions whose name marks them as layout/reshard/arbiter code — the
+# code that must DERIVE device widths (elastic resizing changes them at
+# runtime), never bake one in
+_TPU319_TOKENS = {"layout", "layouts", "reshard", "resize", "arbiter",
+                  "elastic", "mesh", "gang", "borrow", "width", "pool"}
+_DEVICE_COUNT_FNS = {"device_count", "local_device_count"}
+_DEVICE_LIST_FNS = {"devices", "local_devices"}
+
+
+def _is_device_count_expr(expr: ast.expr) -> bool:
+    """``jax.device_count()`` / ``local_device_count()`` (any receiver
+    or bare from-import) or ``len(jax.devices())`` and friends."""
+    if not isinstance(expr, ast.Call):
+        return False
+    f = expr.func
+    name = (f.attr if isinstance(f, ast.Attribute)
+            else f.id if isinstance(f, ast.Name) else None)
+    if name in _DEVICE_COUNT_FNS:
+        return True
+    if name == "len" and len(expr.args) == 1 \
+            and isinstance(expr.args[0], ast.Call):
+        inner = expr.args[0].func
+        iname = (inner.attr if isinstance(inner, ast.Attribute)
+                 else inner.id if isinstance(inner, ast.Name) else None)
+        return iname in _DEVICE_LIST_FNS
+    return False
+
+
+@register_lint_rule("TPU319")
+def _rule_hardcoded_device_count(mod: ModuleInfo) -> list[Diagnostic]:
+    """An integer literal compared against ``jax.device_count()`` /
+    ``len(jax.devices())`` inside a layout/reshard/arbiter-token
+    function: elastic resizing (resilience.elastic) changes the width a
+    gang runs at MID-RUN, so code on the resize path must derive widths
+    from the spec/inventory it was handed — a baked-in ``== 8`` holds
+    exactly until the first grow or borrow flips it false.  Tests are
+    exempt (they pin concrete widths on purpose)."""
+    norm = mod.path.replace(os.sep, "/")
+    if _is_test_path(norm):
+        return []
+    out = []
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not set(_snake_tokens(fn.name)) & _TPU319_TOKENS:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Compare):
+                continue
+            sides = [node.left] + list(node.comparators)
+            counts = [s for s in sides if _is_device_count_expr(s)]
+            literals = [s for s in sides if isinstance(s, ast.Constant)
+                        and type(s.value) is int]
+            if counts and literals:
+                out.append(Diagnostic(
+                    "TPU319",
+                    f"device count compared against the hardcoded "
+                    f"integer {literals[0].value} in "
+                    f"'{fn.name}' — elastic gangs resize at runtime, "
+                    f"so layout/reshard/arbiter code must derive the "
+                    f"width (MeshSpec.total(), the arbiter inventory, "
+                    f"DL4J_TPU_GANG_WIDTH), never assume it",
                     path=mod.anchor(node)))
     return out
 

@@ -81,10 +81,14 @@ def robust_zscore(window, value: float) -> Optional[float]:
     return abs(value - med) / scale
 
 
-def stragglers(medians: dict, factor: float = 2.0) -> list:
+def stragglers(medians: dict, factor: float = 2.0,
+               min_excess_s: float = 0.02) -> list:
     """Workers whose median step time exceeds ``factor`` x the median of
     their PEERS' medians (leave-one-out, so a straggler's own inflated
-    time can't mask itself in a small gang).  ``medians``: worker →
+    time can't mask itself in a small gang) AND sits at least
+    ``min_excess_s`` above it — on millisecond-scale steps a loaded host
+    scheduler can double an innocent worker's median, and a relative
+    check alone would page on that jitter.  ``medians``: worker →
     median step seconds (None entries ignored).  Needs >= 2 reporting
     workers."""
     valid = {w: float(m) for w, m in medians.items() if m}
@@ -94,7 +98,8 @@ def stragglers(medians: dict, factor: float = 2.0) -> list:
     for worker, m in valid.items():
         peer_med = statistics.median(v for w, v in valid.items()
                                      if w != worker)
-        if peer_med > 0 and m > factor * peer_med:
+        if (peer_med > 0 and m > factor * peer_med
+                and m - peer_med > min_excess_s):
             out.append(worker)
     return sorted(out)
 

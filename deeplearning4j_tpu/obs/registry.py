@@ -812,6 +812,31 @@ def install_standard_metrics(registry: Optional[MetricsRegistry] = None) -> dict
         r.labeled_gauge("tpudl_slo_healthy",
                         "1 while the objective's burn is below every "
                         "window threshold, 0 while breached", ("slo",)),
+        r.labeled_gauge("tpudl_elastic_pool_devices",
+                        "Chips currently assigned to each tenant of the "
+                        "DevicePoolArbiter's inventory (serve/train); "
+                        "the sum is conserved across every flip",
+                        ("owner",)),
+        r.gauge("tpudl_elastic_gang_width",
+                "Current training gang width (workers/devices) after "
+                "the latest elastic grow/shrink"),
+        r.counter("tpudl_elastic_borrows_total",
+                  "Completed arbiter flips moving chips train -> serve "
+                  "under sustained router queue pressure"),
+        r.counter("tpudl_elastic_returns_total",
+                  "Completed arbiter flips returning borrowed chips "
+                  "serve -> train after pressure ebbed"),
+        r.counter("tpudl_elastic_grows_total",
+                  "Committed elastic gang grows (supervisor relaunch or "
+                  "in-process Trainer.resize_mesh at a round boundary)"),
+        r.counter("tpudl_elastic_shrinks_total",
+                  "Committed elastic gang shrinks (arbiter borrows and "
+                  "budget-driven degradation both count here)"),
+        r.histogram("tpudl_elastic_flip_seconds",
+                    "Wall time of one elastic flip: resize decision "
+                    "begun -> resized gang up (supervisor), reshard + "
+                    "step rebuild (in-process), or chip move "
+                    "(arbiter) — the elastic MTTR"),
     ]
     return {m.name: m for m in metrics}
 

@@ -358,6 +358,14 @@ class ClusterStore:
         self._lock = threading.Lock()
         self.straggler_factor = float(straggler_factor)
         self.min_straggler_samples = int(min_straggler_samples)
+        self._gang_width: Optional[int] = None
+
+    def set_gang_width(self, width: int) -> None:
+        """Record the training gang's current width (the supervisor
+        stamps it on every spawn — including elastic grow/shrink
+        relaunches) for the ``/cluster`` dashboard and summary."""
+        with self._lock:
+            self._gang_width = int(width)
 
     def workers(self) -> list[str]:
         with self._lock:
@@ -553,8 +561,10 @@ class ClusterStore:
                 }
             restarts = list(self._restarts)
             annotations = list(self._annotations)
+            gang_width = self._gang_width
         return {"n_workers": len(workers),
                 "straggler_skew": self.straggler_skew(),
+                "gang_width": gang_width,
                 "workers": workers,
                 "restarts": restarts,
                 "annotations": annotations}
@@ -584,6 +594,8 @@ class ClusterStore:
         skew = summary["straggler_skew"]
         refresh = (f"<meta http-equiv='refresh' "
                    f"content='{refresh_seconds}'>" if refresh_seconds else "")
+        gang_width = summary["gang_width"]
+        gw_cell = "—" if gang_width is None else gang_width
         rows = []
         for name, w in summary["workers"].items():
             flag = " &#9888; straggler" if w["straggler"] else ""
@@ -599,7 +611,8 @@ class ClusterStore:
                 f"<td>{w['last_step_ms'] if w['last_step_ms'] is not None else '—'}</td>"
                 f"<td>{w['mfu'] if w['mfu'] is not None else '—'}</td>"
                 f"<td>{w['score'] if w['score'] is not None else '—'}</td>"
-                f"<td>{w['liveness_age_s']}</td></tr>")
+                f"<td>{w['liveness_age_s']}</td>"
+                f"<td>{gw_cell}</td></tr>")
         # restart annotations: gang-recovery history for triage (each
         # annotation pairs with the supervisor incident's flight-dump
         # bundle — see docs/fault_tolerance.md "Gang recovery")
@@ -646,5 +659,6 @@ class ClusterStore:
             "<table><tr><th>worker</th><th>generation</th><th>steps</th>"
             "<th>iteration</th>"
             "<th>median step ms</th><th>last step ms</th><th>MFU</th>"
-            "<th>last score</th><th>liveness age s</th></tr>"
+            "<th>last score</th><th>liveness age s</th>"
+            "<th>gang width</th></tr>"
             + "".join(rows) + "</table>" + notes + "</body></html>")

@@ -521,6 +521,58 @@ class MeshLayout:
                                                activation_bytes))
 
 
+class LayoutResizeError(ValueError):
+    """A target device width is incompatible with a layout's fixed axes.
+
+    Raised by :func:`resize_spec` / :func:`resize_layout` when the
+    requested width is not a positive multiple of the layout's
+    non-data degree (``model·seq·expert·pipe``) — most commonly a
+    pipeline layout whose stage count does not divide the new width.
+    Typed (not a bare ValueError) so elastic callers — the supervisor's
+    gang resize, the device-pool arbiter — can refuse the resize and
+    keep the current width instead of tearing anything down.
+    """
+
+
+def resize_spec(spec: MeshSpec, n_devices: int) -> MeshSpec:
+    """Derive the ``MeshSpec`` for the SAME layout at a new device width.
+
+    Elastic resizing only ever scales the ``data`` axis: model/seq/
+    expert/pipe describe how the MODEL is cut and must survive a grow or
+    shrink unchanged (a dp2xpp2 gang grown to 8 devices becomes
+    dp4xpp2).  The new width must therefore be a positive multiple of
+    the non-data degree; anything else raises :class:`LayoutResizeError`.
+    """
+    fixed = spec.model * spec.seq * spec.expert * spec.pipe
+    if n_devices < fixed or n_devices % fixed:
+        detail = (f"pipeline layouts keep their {spec.pipe} stages across "
+                  f"a resize" if spec.pipe > 1 else
+                  "model/seq/expert axes are fixed across a resize")
+        raise LayoutResizeError(
+            f"cannot resize layout {spec.describe()!r} to {n_devices} "
+            f"device(s): width must be a positive multiple of its "
+            f"non-data degree {fixed} ({detail})")
+    return dataclasses.replace(spec, data=n_devices // fixed)
+
+
+def resize_layout(layout: MeshLayout, n_devices: int,
+                  devices: Optional[Sequence] = None) -> MeshLayout:
+    """Re-derive a :class:`MeshLayout` at a new device width (N→M).
+
+    The elastic-resize primitive ("a device_put onto a new MeshSpec, not
+    per-module surgery"): the returned layout keeps the TP family/rules
+    and scales only the ``data`` axis, so its ``param_sharding_tree`` /
+    ``opt_state_sharding_tree`` are exactly what a from-scratch build at
+    the new width derives — placing an existing params/opt-state tree
+    onto them IS the reshard.  Non-divisible widths (e.g. growing a
+    ``pp3`` layout to 4 devices) raise :class:`LayoutResizeError` before
+    any mesh is built.
+    """
+    spec = resize_spec(layout.spec, n_devices)
+    return MeshLayout(spec, tp_family=layout.tp_family,
+                      tp_rules=layout.tp_rules, devices=devices)
+
+
 def resolve_layout(mesh: Optional[Any] = None, layout: Optional[Any] = None,
                    tp_family: str = "dense",
                    devices: Optional[Sequence] = None) -> Optional[MeshLayout]:

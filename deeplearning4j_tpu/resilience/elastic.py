@@ -1,0 +1,208 @@
+"""Elastic gang resizing — the reversible half of fault tolerance.
+
+PR 8's supervisor could only ever *shrink*: a slot that spent its
+restart budget was dropped and never came back.  This module upgrades
+resizing into a first-class, reversible state machine that the
+supervisor (gang relaunch at a new width) and the
+:class:`~deeplearning4j_tpu.resilience.arbiter.DevicePoolArbiter`
+(serve/train chip flips) both drive:
+
+- :class:`ResizeCoordinator` — thread-safe
+  request → begin → commit/abort lifecycle around one width change at a
+  time.  ``request`` validates eagerly (floor, positive width) at the
+  decision site; ``begin`` claims the pending decision for execution;
+  ``commit`` makes the new width current and stamps the
+  ``tpudl_elastic_*`` metrics; ``abort`` returns to the previous width
+  with nothing torn (the fault-injection contract: a crash mid-flip
+  must leave the inventory exactly as it was).
+- The **env contract** a resized gang child sees:
+  ``DL4J_TPU_GANG_WIDTH`` (the gang's current width — workers derive
+  their data-parallel degree from it instead of hardcoding one) and
+  ``DL4J_TPU_GANG_GROWN`` (set only on the generation a *grow* spawned;
+  ``Trainer.resume_state`` fires the ``gang.grow`` fault site under it,
+  so a kill injected mid-reshard lands inside the grown child and must
+  recover through the normal supervisor respawn path).
+
+Checkpoint consistency is inherited, not reinvented: a resize tears the
+gang down at a round boundary and the new-width gang resumes from the
+newest *verified* checkpoint (``DL4J_TPU_RESUME_FROM`` plumbing, PR 8),
+with params/opt-state resharded by the PR-14 structure-matched
+derivation onto the resized ``MeshSpec`` — so a grow 2→4 matches a
+fixed-4 run to 1e-6 after the boundary (tests/test_elastic.py).
+
+See docs/fault_tolerance.md "Elastic gangs & the chip arbiter".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+# current gang width, handed to EVERY gang child (all generations):
+# workers derive their layout width from this instead of assuming one
+WIDTH_ENV = "DL4J_TPU_GANG_WIDTH"
+# set ONLY on the generation a grow spawned (cleared again for
+# subsequent incident respawns): gates the child-side ``gang.grow``
+# fault site in Trainer.resume_state
+GROWN_ENV = "DL4J_TPU_GANG_GROWN"
+
+
+def configured_width(default: Optional[int] = None) -> Optional[int]:
+    """The gang width the supervisor configured for this process, or
+    ``default`` outside a supervised gang (elastic workers size their
+    layout from this — never from a hardcoded device count)."""
+    raw = os.environ.get(WIDTH_ENV, "").strip()
+    return int(raw) if raw else default
+
+
+def is_grown_child() -> bool:
+    """True inside a gang child spawned by a *grow* resize."""
+    return bool(os.environ.get(GROWN_ENV))
+
+
+@dataclasses.dataclass
+class ResizeDecision:
+    """One width change moving through the coordinator's lifecycle."""
+
+    kind: str                  # "grow" | "shrink"
+    from_width: int
+    to_width: int
+    reason: str = ""
+    seq: int = 0               # monotonic decision number
+    requested_at: float = 0.0  # time.monotonic() at request
+    begun_at: float = 0.0      # time.monotonic() at begin (0 = not begun)
+    outcome: str = ""          # "" in flight | "committed" | "aborted"
+    flip_s: Optional[float] = None   # begin → commit wall time
+
+    def summary(self) -> str:
+        return (f"resize#{self.seq} {self.kind} "
+                f"{self.from_width}→{self.to_width}"
+                + (f" ({self.reason})" if self.reason else "")
+                + (f" [{self.outcome}]" if self.outcome else ""))
+
+
+class ResizeCoordinator:
+    """Thread-safe reversible resize state machine.
+
+    One decision is in motion at a time: ``request`` (any thread — the
+    arbiter's, a signal handler's, a test's) parks a validated decision;
+    the executor (the supervisor's watch loop, or the arbiter's flip
+    body) picks it up with ``begin``, performs the relaunch/reshard, and
+    ends it with ``commit`` (width changes) or ``abort`` (width stays —
+    the reversible guarantee).  A new request replaces an un-begun
+    pending decision (latest wins); requesting while a flip is in
+    flight raises, because two concurrent relaunches would race over
+    the same chips.
+    """
+
+    def __init__(self, width: int, min_width: int = 1,
+                 on_event: Optional[Callable[[ResizeDecision], None]] = None):
+        if int(width) < 1:
+            raise ValueError(f"initial gang width must be >= 1, got {width}")
+        self._width = int(width)
+        self.min_width = max(1, int(min_width))
+        self._on_event = on_event
+        self._lock = threading.Lock()
+        self._pending: Optional[ResizeDecision] = None
+        self._in_flight: Optional[ResizeDecision] = None
+        self._seq = 0
+        self.history: list[ResizeDecision] = []
+
+    # ------------------------------------------------------------ queries
+    @property
+    def width(self) -> int:
+        with self._lock:
+            return self._width
+
+    def pending(self) -> Optional[ResizeDecision]:
+        with self._lock:
+            return self._pending
+
+    def in_flight(self) -> Optional[ResizeDecision]:
+        with self._lock:
+            return self._in_flight
+
+    # ---------------------------------------------------------- lifecycle
+    def request(self, width: int, reason: str = "") -> ResizeDecision:
+        """Park a validated resize for the executor to pick up.
+        Raises ``ValueError`` at the decision site for an impossible
+        width (below the training floor, or not a width at all) —
+        callers like the arbiter refuse the flip and keep the current
+        inventory instead of tearing anything down."""
+        width = int(width)
+        if width < 1:
+            raise ValueError(f"gang width must be >= 1, got {width}")
+        if width < self.min_width:
+            raise ValueError(
+                f"gang width {width} is below the training floor "
+                f"min_width={self.min_width} — the arbiter can never "
+                f"cross it")
+        with self._lock:
+            if self._in_flight is not None:
+                raise ValueError(
+                    f"a resize is already in flight "
+                    f"({self._in_flight.summary()}); commit or abort it "
+                    f"before requesting another")
+            self._seq += 1
+            decision = ResizeDecision(
+                kind="grow" if width > self._width else "shrink",
+                from_width=self._width, to_width=width, reason=reason,
+                seq=self._seq, requested_at=time.monotonic())
+            if width == self._width:
+                # no-op widths never enter the queue; recorded for the
+                # history (the arbiter's hysteresis audit trail)
+                decision.outcome = "noop"
+                self.history.append(decision)
+                return decision
+            self._pending = decision   # latest wins over an un-begun one
+            return decision
+
+    def begin(self) -> Optional[ResizeDecision]:
+        """Claim the pending decision for execution (None when idle)."""
+        with self._lock:
+            decision, self._pending = self._pending, None
+            if decision is not None:
+                decision.begun_at = time.monotonic()
+                self._in_flight = decision
+            return decision
+
+    def commit(self, decision: ResizeDecision) -> None:
+        """The flip landed: the new width is current.  Stamps the
+        ``tpudl_elastic_*`` family and notifies ``on_event``."""
+        with self._lock:
+            self._close(decision, "committed")
+            self._width = decision.to_width
+        from deeplearning4j_tpu.obs.registry import get_registry
+        reg = get_registry()
+        reg.counter("tpudl_elastic_grows_total" if decision.kind == "grow"
+                    else "tpudl_elastic_shrinks_total").inc()
+        reg.gauge("tpudl_elastic_gang_width").set(decision.to_width)
+        if decision.flip_s is not None:
+            reg.histogram("tpudl_elastic_flip_seconds").observe(
+                decision.flip_s)
+        if self._on_event is not None:
+            self._on_event(decision)
+
+    def abort(self, decision: ResizeDecision, reason: str = "") -> None:
+        """The flip failed: width stays exactly where it was (the
+        reversible guarantee — nothing half-resized survives)."""
+        with self._lock:
+            self._close(decision, "aborted")
+            if reason:
+                decision.reason = (decision.reason + "; " + reason
+                                   if decision.reason else reason)
+        if self._on_event is not None:
+            self._on_event(decision)
+
+    def _close(self, decision: ResizeDecision, outcome: str) -> None:
+        # caller holds the lock
+        if self._in_flight is not decision:
+            raise ValueError(
+                f"{decision.summary()} is not the in-flight resize")
+        self._in_flight = None
+        decision.outcome = outcome
+        decision.flip_s = round(time.monotonic() - decision.begun_at, 6)
+        self.history.append(decision)

@@ -2,8 +2,9 @@
 
 A :class:`FaultPlan` is a list of :class:`FaultRule`\\ s, each naming an
 instrumented **site** (``trainer.step``, ``dcn.exchange``,
-``feeder.stage``, ``checkpoint.write``, ``launcher.spawn``), the event
-index at which it fires, and an action:
+``feeder.stage``, ``checkpoint.write``, ``launcher.spawn``, and the
+elastic-pool sites ``gang.grow``, ``arbiter.borrow``,
+``arbiter.return``), the event index at which it fires, and an action:
 
 - ``crash``     — raise :class:`InjectedCrash` (a process-death stand-in;
   **not** retryable, it must propagate out of retry loops the way a

@@ -58,7 +58,7 @@ import time
 from typing import Any, Callable, Optional
 
 from deeplearning4j_tpu.obs import remote as obs_remote
-from deeplearning4j_tpu.resilience import faults
+from deeplearning4j_tpu.resilience import elastic, faults
 from deeplearning4j_tpu.resilience.retry import RetryPolicy
 
 # the resume pointer handed to every respawned worker: the supervisor's
@@ -212,6 +212,39 @@ class ClusterSupervisor:
             # None is the "inherit the environment" spelling
             self.extra_env["DL4J_TPU_ARTIFACT_BAKE"] = \
                 "1" if artifact_bake else "0"
+        # elastic resizing: the reversible grow/shrink state machine.
+        # request_resize (any thread — the arbiter's, a test's) parks a
+        # decision; the watch loop picks it up at its next poll — the
+        # round boundary where the gang relaunches at the new width
+        self._resize = elastic.ResizeCoordinator(
+            width=self.n_processes, min_width=self.min_workers,
+            on_event=self._on_resize_event)
+
+    # ------------------------------------------------------------ elastic
+    @property
+    def width(self) -> int:
+        """Current gang width (tracks resizes and degradation shrinks)."""
+        return self._resize.width
+
+    def request_resize(self, width: int, reason: str = "") -> None:
+        """Ask the running gang to relaunch at ``width`` workers (grow
+        or shrink) at its next round boundary, resuming every slot from
+        the newest verified checkpoint.  Thread-safe; validates eagerly
+        (a width below ``min_workers`` raises here, and the gang keeps
+        running untouched)."""
+        self._resize.request(width, reason=reason)
+
+    def _on_resize_event(self, decision) -> None:
+        if self.cluster_store is None:
+            return
+        try:
+            self.cluster_store.annotate(
+                "resize", decision.summary(), direction=decision.kind,
+                from_width=decision.from_width,
+                to_width=decision.to_width, outcome=decision.outcome,
+                flip_s=decision.flip_s)
+        except Exception:
+            pass
 
     # ------------------------------------------------------------- pieces
     def _latest_checkpoint(self) -> Optional[str]:
@@ -239,14 +272,20 @@ class ClusterSupervisor:
         return None
 
     def _child_env(self, generation: int, slots: list,
-                   resume: Optional[str]) -> Callable[[int], dict]:
+                   resume: Optional[str],
+                   grown: bool = False) -> Callable[[int], dict]:
         """Per-child env hook for the GangHandle: stable worker identity
-        (``w<slot>``), the restart generation, the resume pointer, and —
-        on restarts — a stripped fault plan so the drill that killed
+        (``w<slot>``), the restart generation, the resume pointer, the
+        elastic width contract (``DL4J_TPU_GANG_WIDTH`` always;
+        ``DL4J_TPU_GANG_GROWN`` only on a grow generation, so the
+        ``gang.grow`` site fires in exactly those children), and — on
+        restarts — a stripped fault plan so the drill that killed
         generation N can't re-kill generation N+1 at the same step."""
         def env_for(pid: int) -> dict:
             env = {obs_remote.WORKER_ENV: f"w{slots[pid]}",
-                   GENERATION_ENV: str(generation)}
+                   GENERATION_ENV: str(generation),
+                   elastic.WIDTH_ENV: str(len(slots)),
+                   elastic.GROWN_ENV: "1" if grown else ""}
             if resume is not None and self.checkpoint_dir is not None:
                 env[RESUME_ENV] = self.checkpoint_dir
             if generation > 0 and self.clear_fault_plan_on_restart:
@@ -254,7 +293,8 @@ class ClusterSupervisor:
             return env
         return env_for
 
-    def _spawn(self, generation: int, slots: list, resume: Optional[str]):
+    def _spawn(self, generation: int, slots: list, resume: Optional[str],
+               grown: bool = False):
         from deeplearning4j_tpu.parallel.launcher import GangHandle
         gang_deadline, gang_fires = self.gang_deadline, 1
         if gang_deadline is None:
@@ -272,7 +312,8 @@ class ClusterSupervisor:
             local_devices=self.local_devices, timeout=self.timeout,
             extra_env=self.extra_env, gang_deadline=gang_deadline,
             gang_fires=gang_fires, remote_ui=self.remote_ui,
-            child_env=self._child_env(generation, slots, resume))
+            child_env=self._child_env(generation, slots, resume,
+                                      grown=grown))
 
     @staticmethod
     def _classify(failed: list) -> str:
@@ -330,6 +371,11 @@ class ClusterSupervisor:
             if stalled:
                 return {"failed": [], "stalled_workers": stalled,
                         "reason": "liveness_stall"}
+            if self._resize.pending() is not None:
+                # an elastic resize was requested: surface it like a
+                # failure fact, but run() treats it as a planned round
+                # boundary (graceful teardown, NOT an incident)
+                return {"failed": [], "reason": "resize"}
             time.sleep(self.poll_s)
 
     def _make_incident(self, handle, generation: int, slots: list,
@@ -433,9 +479,24 @@ class ClusterSupervisor:
         generation = 0
         incidents: list = []
         pending: Optional[tuple] = None   # (incident, detection monotonic)
+        resize_flip = None                # in-flight ResizeDecision
+        grown_spawn = False               # next spawn is a grow generation
         while True:
             resume = self._latest_checkpoint()
-            handle = self._spawn(generation, slots, resume)
+            handle = self._spawn(generation, slots, resume,
+                                 grown=grown_spawn)
+            grown_spawn = False
+            if resize_flip is not None:
+                # the new-width gang is up: the flip landed.  commit
+                # stamps grows/shrinks totals, the gang-width gauge and
+                # flip MTTR (decision begin → resized gang spawned)
+                self._resize.commit(resize_flip)
+                resize_flip = None
+            if self.cluster_store is not None:
+                try:
+                    self.cluster_store.set_gang_width(len(slots))
+                except Exception:
+                    pass
             try:
                 if pending is not None:
                     incident, t_detect = pending
@@ -451,6 +512,24 @@ class ClusterSupervisor:
                                      incidents=incidents,
                                      generations=generation + 1,
                                      slots=slots)
+            if failure["reason"] == "resize":
+                # planned round boundary, not an incident: stop the gang
+                # cleanly (SIGTERM-first — checkpoint listeners already
+                # wrote verified zips), then relaunch at the new width
+                # resuming from the newest verified checkpoint.  A
+                # successful GROW resets every slot's restart budget —
+                # the grown gang is a fresh bet, not a tainted one.
+                decision = self._resize.begin()
+                handle.shutdown()
+                if decision is None:
+                    continue
+                slots = list(range(decision.to_width))
+                if decision.kind == "grow":
+                    restarts = {}
+                    grown_spawn = True
+                resize_flip = decision
+                generation += 1
+                continue
             t_detect = time.monotonic()
             incident = self._make_incident(handle, generation, slots,
                                            failure, resume)
@@ -467,6 +546,14 @@ class ClusterSupervisor:
                     + "\n".join(i.summary() for i in incidents), incidents)
             if decision == "shrink":
                 incident.degraded_to = list(slots)
+                # route the budget-driven shrink through the SAME state
+                # machine as elastic resizes: width tracking stays
+                # honest, the shrink is recorded (totals + gauge), and a
+                # later request_resize can grow the gang back — the old
+                # one-way ratchet is gone
+                d = self._resize.request(len(slots), reason="degradation")
+                if d.outcome != "noop":
+                    self._resize.commit(self._resize.begin())
             incident.restarted = True
             reg.counter("tpudl_resilience_gang_restarts_total").inc()
             attempt = max(restarts.get(s, 1) for s in failed_slots)

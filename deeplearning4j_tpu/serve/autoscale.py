@@ -64,9 +64,14 @@ class Autoscaler:
     """Background scaling loop over one :class:`ReplicaRouter`."""
 
     def __init__(self, router: ReplicaRouter,
-                 config: AutoscaleConfig = None):
+                 config: AutoscaleConfig = None, arbiter=None):
         self.router = router
         self.config = config or AutoscaleConfig()
+        # escalation path beyond replicas: when an up-decision hits the
+        # router's max_replicas ceiling and pressure persists, the
+        # DevicePoolArbiter (resilience.arbiter) can move actual chips
+        # from training — the autoscaler just reports what it sees
+        self.arbiter = arbiter
         self._stop = threading.Event()
         # decision state shared between the poll thread and direct
         # step() callers (tests, the bench): guarded by _lock — the
@@ -94,16 +99,24 @@ class Autoscaler:
                 and now - self._last_up >= cfg.up_cooldown_s
             down = not up and fill <= cfg.scale_down_at \
                 and now - self._last_down >= cfg.down_cooldown_s
-        if up and self.router.add_replica():
-            with self._lock:
-                self._last_up = now
-                # a fresh replica changes the denominator — judge the
-                # new size on its own samples
-                self._fills.clear()
+        saturated = False
+        if up:
+            if self.router.add_replica():
+                with self._lock:
+                    self._last_up = now
+                    # a fresh replica changes the denominator — judge
+                    # the new size on its own samples
+                    self._fills.clear()
+            else:
+                # replica scaling is spent (max_replicas) while pressure
+                # persists — the signal the chip arbiter escalates on
+                saturated = True
         elif down and self.router.retire_replica():
             with self._lock:
                 self._last_down = now
                 self._fills.clear()
+        if self.arbiter is not None:
+            self.arbiter.note_pressure(fill, saturated=saturated)
 
     def _run(self) -> None:
         from deeplearning4j_tpu.obs import flight_recorder

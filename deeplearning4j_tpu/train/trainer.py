@@ -315,6 +315,10 @@ class Trainer:
     # layout bookkeeping: param placement tree + one-shot opt placement
     _param_shardings = None
     _opt_placed = False
+    # elastic: a width requested mid-epoch (request_resize), applied by
+    # fit() at the next epoch boundary — the round boundary where the
+    # feeder restarts, so no stale-sharded batch crosses the flip
+    _pending_resize = None
     # which jit program (and how many calls of it) the last fit_batch/
     # tbptt pass ran — the cost model's per-step MFU denominator pairing
     _last_step_fn = None
@@ -423,6 +427,87 @@ class Trainer:
         get_registry().gauge("tpudl_parallel_mesh_devices").set(
             int(layout.data))
         self._layout_placed = True
+
+    # ------------------------------------------------------------- elastic
+    def request_resize(self, n_devices: int) -> None:
+        """Ask for an elastic resize at the NEXT epoch (round) boundary.
+
+        Validates eagerly — an impossible width (no layout on this
+        trainer, or a width the layout's fixed axes don't divide) raises
+        here, at the decision site, not an epoch later inside fit().
+        The flip itself happens in :meth:`resize_mesh`, which fit()
+        calls between epochs so no batch sharded for the old width ever
+        meets the new step."""
+        from deeplearning4j_tpu.parallel import mesh as mesh_mod
+        if self._layout is None:
+            raise ValueError(
+                "request_resize needs a mesh/layout-configured Trainer "
+                "(the single-device path has no width to change)")
+        mesh_mod.resize_spec(self._layout.spec, int(n_devices))  # validate
+        self._pending_resize = int(n_devices)
+
+    def resize_mesh(self, n_devices: int) -> bool:
+        """Reshard this trainer onto the SAME layout at a new device
+        width (grow or shrink), checkpoint-consistently: the new
+        ``MeshLayout`` is derived first (a non-divisible width raises
+        :class:`parallel.mesh.LayoutResizeError` before anything
+        mutates), then params/opt-state are device_put onto the new
+        layout's structure-matched sharding trees — the PR-14 derivation,
+        so post-flip state is bit-identical to a from-scratch build at
+        the new width and the 1e-6 loss contract holds across the
+        boundary.  Returns False when the width is already current.
+
+        The ``gang.grow`` fault site fires BEFORE any state is touched:
+        an injected crash/kill mid-reshard leaves the old layout fully
+        consistent (no torn placement), which is what the supervisor
+        drill in tests/test_elastic.py pins."""
+        from deeplearning4j_tpu.parallel import mesh as mesh_mod
+        n_devices = int(n_devices)
+        self._pending_resize = None
+        if self._layout is None:
+            raise ValueError(
+                "resize_mesh needs a mesh/layout-configured Trainer")
+        old_width = self._layout.spec.total()
+        if n_devices == old_width:
+            return False
+        # derive-then-commit: a typed LayoutResizeError escapes here
+        # with the trainer untouched
+        new_layout = mesh_mod.resize_layout(self._layout, n_devices)
+        grow = n_devices > old_width
+        if grow:
+            faults.fire("gang.grow")
+        t0 = time.perf_counter()
+        self._layout = new_layout
+        # every derived artifact of the old width is stale: placement,
+        # sharding trees, compiled steps and their bake bookkeeping
+        self._layout_placed = False
+        self._opt_placed = False
+        self._param_shardings = None
+        self._opt_state_shardings = None
+        self._step = None
+        self._stats_step = None
+        self._tbptt_step = None
+        self._eval_loss_fn = None
+        self._bake_args = None
+        self._tbptt_bake_args = None
+        self._bake_scheduled = False
+        # eager re-place + step rebuild: the flip's full cost lands here
+        # (where flip MTTR is measured), not on the first post-flip step
+        self._ensure_ready()
+        flip_s = time.perf_counter() - t0
+        reg = get_registry()
+        reg.counter("tpudl_elastic_grows_total" if grow
+                    else "tpudl_elastic_shrinks_total").inc()
+        reg.gauge("tpudl_elastic_gang_width").set(n_devices)
+        reg.histogram("tpudl_elastic_flip_seconds").observe(flip_s)
+        flight_recorder.record(
+            "elastic_resize", direction="grow" if grow else "shrink",
+            from_width=old_width, to_width=n_devices,
+            layout=new_layout.spec.describe(), flip_s=flip_s)
+        obs_remote.notify_event(
+            "elastic_resize", direction="grow" if grow else "shrink",
+            from_width=old_width, to_width=n_devices)
+        return True
 
     def _prepare_batch(self, batch):
         """Hook: with an active layout the batch shards its leading dim
@@ -803,6 +888,13 @@ class Trainer:
         self._ensure_ready()
         state = restore_into(self.net, path, tx=self.tx,
                              verify=not verified)
+        # a gang child respawned as part of a GROW resize announces the
+        # reshard here — the instrumentation point where an injected
+        # kill proves a torn mid-grow death leaves the checkpoint intact
+        # and recovers through the normal supervisor respawn path
+        from deeplearning4j_tpu.resilience import elastic as _elastic
+        if os.environ.get(_elastic.GROWN_ENV):
+            faults.fire("gang.grow")
         # warm the compiled-artifact pool — a respawned process
         # (supervisor, online loop) then takes its first step with zero
         # JIT instead of recompiling the world.  Strictly AFTER the
@@ -896,6 +988,11 @@ class Trainer:
             with tracing.span("fit", epochs=epochs, **attrs):
                 self.bus.dispatch("on_fit_start", net)
                 for _ in range(epochs_to_run):
+                    if self._pending_resize is not None:
+                        # elastic round boundary: the feeder restarts
+                        # below, so nothing sharded for the old width
+                        # survives into the resized epoch
+                        self.resize_mesh(self._pending_resize)
                     with tracing.span("epoch", epoch=net.epoch):
                         self.bus.dispatch("on_epoch_start", net, net.epoch)
                         epoch_t0 = time.perf_counter()

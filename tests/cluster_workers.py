@@ -413,6 +413,84 @@ def repeatedly_dying_worker(pid, n, die_pid=None, kill_at=2, steps=60):
     return {"pid": pid, "steps": steps}
 
 
+def run_elastic_reference(epochs=4):
+    """The uninterrupted single-device run an ELASTIC gang must match to
+    1e-6 — same conf/data/seed as elastic_train_worker (every worker in
+    that gang runs this same trajectory, just laid out dp<width>)."""
+    from deeplearning4j_tpu.data.iterators import (ListDataSetIterator,
+                                                   ResumableIterator)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.obs.listeners import CollectScoresListener
+    from deeplearning4j_tpu.train.trainer import Trainer
+    from deeplearning4j_tpu.utils.pytree import flat_param_vector
+    net = MultiLayerNetwork(_supervised_conf(77)).init()
+    scores = CollectScoresListener()
+    Trainer(net, listeners=[scores]).fit(
+        ResumableIterator(ListDataSetIterator(supervised_batches(0))),
+        epochs=epochs)
+    return scores.scores, np.asarray(flat_param_vector(net.params_))
+
+
+def elastic_train_worker(pid, n, workdir=None, epochs=4, kill_on_grow=False):
+    """THE elastic-gang acceptance worker: a deterministic fit (dropout
+    active) laid out ``dp<width>`` over this process's local virtual
+    devices, where the width comes from the supervisor's elastic env
+    contract (``DL4J_TPU_GANG_WIDTH``) — never hardcoded.  Every worker
+    runs the SAME trajectory (same conf/data/seed); slot w0 checkpoints
+    every iteration into a SHARED directory, so when the supervisor
+    relaunches the gang at a new width, every slot — including brand-new
+    ones — resumes from the newest verified checkpoint with params/
+    opt-state resharded onto the new-width layout.  PR-14 width
+    invariance then makes the post-boundary losses the 1e-6 pin against
+    the fixed-width reference.
+
+    ``kill_on_grow``: in a GROW generation (``DL4J_TPU_GANG_GROWN``),
+    the new slot w2 installs a ``gang.grow@0:kill`` plan — Trainer fires
+    that site right after restoring the checkpoint, so the death lands
+    mid-reshard and recovery must ride the normal respawn path."""
+    import jax
+    from deeplearning4j_tpu.data.iterators import (ListDataSetIterator,
+                                                   ResumableIterator)
+    from deeplearning4j_tpu.io.checkpoint import CheckpointListener
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.obs import remote as obs_remote
+    from deeplearning4j_tpu.obs.listeners import CollectScoresListener
+    from deeplearning4j_tpu.parallel import mesh as mesh_mod
+    from deeplearning4j_tpu.resilience import elastic, faults, supervisor
+    from deeplearning4j_tpu.train.trainer import Trainer
+    from deeplearning4j_tpu.utils.pytree import flat_param_vector
+
+    width = elastic.configured_width(default=n)
+    slot = os.environ.get(obs_remote.WORKER_ENV, f"w{pid}")
+    if kill_on_grow and elastic.is_grown_child() and slot == "w2":
+        faults.install_fault_plan(
+            faults.FaultPlan.parse("gang.grow@0:kill"))
+    # local devices only: CPU loopback has no cross-process collectives
+    # (the established MultiSliceTrainer translation)
+    layout = mesh_mod.resolve_layout(
+        layout=f"dp{width}", devices=jax.local_devices()[:width])
+    net = MultiLayerNetwork(_supervised_conf(77)).init()
+    iterator = ResumableIterator(ListDataSetIterator(supervised_batches(0)))
+    scores = CollectScoresListener()
+    listeners = [scores]
+    ckpt_dir = os.path.join(workdir, "shared")
+    if slot == "w0":
+        listeners.append(CheckpointListener(
+            ckpt_dir, save_every_n_iterations=1, keep_last=3,
+            iterator=iterator))
+    resume = os.environ.get(supervisor.RESUME_ENV)
+    Trainer(net, listeners=listeners, layout=layout).fit(
+        iterator, epochs=epochs,
+        resume_from=(ckpt_dir if resume else None))
+    return {"pid": pid, "slot": slot, "width": width,
+            "generation": int(os.environ.get(supervisor.GENERATION_ENV,
+                                             "0")),
+            "grown": elastic.is_grown_child(),
+            "losses": list(scores.scores),
+            "end_iteration": net.iteration,
+            "params": np.asarray(flat_param_vector(net.params_))}
+
+
 def slot_gated_dying_worker(pid, n, steps=6, workdir=None):
     """Shrink-degradation rig: the worker whose STABLE slot id (the
     supervisor-assigned DL4J_TPU_WORKER_ID, not the process index) is

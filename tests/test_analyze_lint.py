@@ -1075,3 +1075,74 @@ def test_obs_measurement_modules_are_exempt_from_tpu318(tmp_path):
             return out
         """, name="obs/probe.py")
     assert report.by_rule("TPU318") == []
+
+
+# ------------------------------------------------------------ TPU319
+def test_hardcoded_device_count_in_layout_code_flagged(tmp_path):
+    """An integer literal compared against the device count inside
+    layout/reshard/arbiter-token functions: true exactly until the
+    first elastic grow/borrow changes the width."""
+    report = _lint_source(tmp_path, """
+        import jax
+
+        def build_layout(spec):
+            if jax.device_count() == 8:
+                return spec
+
+        def reshard_params(params):
+            assert len(jax.devices()) >= 4
+            return params
+
+        def arbiter_flip(pool):
+            return 2 < jax.local_device_count()
+        """)
+    hits = report.by_rule("TPU319")
+    assert len(hits) == 3
+    assert "build_layout" in hits[0].message
+    assert "derive" in hits[0].message
+    assert report.exit_code() == 1
+
+
+def test_derived_widths_and_out_of_scope_functions_are_fine(tmp_path):
+    """Widths derived from the spec/inventory never flag; device-count
+    comparisons outside layout/reshard/arbiter functions are out of
+    scope; comparing two non-literal expressions is fine."""
+    report = _lint_source(tmp_path, """
+        import jax
+
+        def build_layout(spec):
+            if jax.device_count() >= spec.total():
+                return spec
+
+        def resize_gang(widths):
+            n = jax.device_count()
+            return [w for w in widths if w <= n or n > min(widths)]
+
+        def print_banner():
+            if jax.device_count() == 1:
+                print("single device")
+        """)
+    assert report.by_rule("TPU319") == []
+    assert report.exit_code() == 0
+
+
+def test_tpu319_test_paths_exempt_and_pragma_honored(tmp_path):
+    """Tests pin concrete widths on purpose (exempt by path); elsewhere
+    a reasoned suppression pragma is honored."""
+    (tmp_path / "tests").mkdir(exist_ok=True)
+    report = _lint_source(tmp_path, """
+        import jax
+
+        def layout_case():
+            assert jax.device_count() == 8
+        """, name="tests/test_widths.py")
+    assert report.by_rule("TPU319") == []
+    report = _lint_source(tmp_path, """
+        import jax
+
+        def describe_mesh():
+            single = jax.device_count() == 1  # tpudl: ok(TPU319) — banner text only
+            return "single" if single else "multi"
+        """)
+    assert report.by_rule("TPU319") == []
+    assert report.suppressed

@@ -1,0 +1,668 @@
+"""Elastic device pool (ISSUE 19): checkpoint-consistent gang
+grow/shrink + the serve/train chip arbiter.
+
+Acceptance pins:
+
+- N→M resharding in isolation: ``resize_layout`` derivations (grow
+  dp2→4, shrink 4→2) produce exactly the sharding trees a from-scratch
+  build at the new width derives; pipeline layouts refuse non-divisible
+  widths with a typed :class:`~...parallel.mesh.LayoutResizeError`;
+- the 1e-6 contract across a live resize: a Trainer that grows dp2→dp4
+  (and shrinks dp4→dp2) at an epoch boundary mid-``fit`` matches a
+  fixed-width run's per-step losses AND final params to 1e-6 with
+  dropout active;
+- fault sites: a crash injected at ``gang.grow`` mid-reshard leaves the
+  old layout fully intact (no torn placement) and the same grow
+  succeeds afterwards; crashes at ``arbiter.borrow``/``arbiter.return``
+  abort the flip with the chip inventory exactly conserved;
+- the arbiter: borrow/return cycle under live serve load with zero
+  dropped/garbled responses and the gang restored to its original
+  width; hysteresis + cooldown + the ``min_train`` floor;
+- @slow: a supervised 2-worker gang grows to 4 at a round boundary
+  (relaunch + checkpoint reshard) and its post-boundary losses match
+  the uninterrupted reference to 1e-6; a ``gang.grow@0:kill`` injected
+  into the grown child recovers through the normal respawn path.
+"""
+
+import functools
+import os
+import sys
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+import cluster_workers  # noqa: E402
+
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator  # noqa: E402
+from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration  # noqa: E402
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer  # noqa: E402
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: E402
+from deeplearning4j_tpu.obs.registry import (MetricsRegistry, get_registry,  # noqa: E402
+                                             set_registry)
+from deeplearning4j_tpu.obs.remote import ClusterStore  # noqa: E402
+from deeplearning4j_tpu.parallel import mesh as mesh_mod  # noqa: E402
+from deeplearning4j_tpu.parallel.mesh import (AXIS_MODEL, LayoutResizeError,  # noqa: E402
+                                              MeshSpec)
+from deeplearning4j_tpu.resilience import elastic, faults  # noqa: E402
+from deeplearning4j_tpu.resilience.arbiter import (DevicePoolArbiter,  # noqa: E402
+                                                   TrainerGang)
+from deeplearning4j_tpu.resilience.elastic import ResizeCoordinator  # noqa: E402
+from deeplearning4j_tpu.resilience.retry import RetryPolicy  # noqa: E402
+from deeplearning4j_tpu.resilience.supervisor import ClusterSupervisor  # noqa: E402
+from deeplearning4j_tpu.serve import (AutoscaleConfig, Autoscaler,  # noqa: E402
+                                      ModelRegistry, ReplicaRouter)
+from deeplearning4j_tpu.train import Sgd  # noqa: E402
+from deeplearning4j_tpu.train.trainer import Trainer  # noqa: E402
+
+_ENV = {"PYTHONPATH": os.path.dirname(os.path.abspath(__file__))
+        + os.pathsep + os.environ.get("PYTHONPATH", "")}
+
+
+@pytest.fixture
+def registry():
+    prev = set_registry(MetricsRegistry())
+    yield get_registry()
+    set_registry(prev)
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_fault_plan():
+    faults.clear_fault_plan()
+    yield
+    faults.clear_fault_plan()
+
+
+def _mlp(seed=11, dropout=True):
+    drop = 0.8 if dropout else None
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=16, activation="relu", dropout=drop))
+            .layer(DenseLayer(n_out=16, activation="tanh", dropout=drop))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    return MultiLayerNetwork(conf)
+
+
+def _data(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 4)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[np.argmax(x @ w, -1)]
+    return x, y
+
+
+def _elastic_run(start, resize_to=None, boundary=2, epochs=4):
+    """One continuous fit (dropout active) that optionally requests an
+    elastic resize at an epoch boundary mid-run.  Returns (per-step
+    losses, flat final params, the trainer)."""
+    x, y = _data()
+    net = _mlp()
+    trainer = Trainer(net, layout=start)
+    losses = []
+
+    class Rec:
+        def iteration_done(self, net, it, ep, loss):
+            losses.append(float(loss))
+
+        def on_epoch_end(self, net, epoch, info):
+            if resize_to is not None and epoch + 1 == boundary:
+                trainer.request_resize(resize_to)
+
+    trainer.bus.listeners.append(Rec())
+    trainer.fit(ArrayDataSetIterator(x, y, 16, shuffle=False), epochs=epochs)
+    return losses, np.asarray(net.params()), trainer
+
+
+# ===================================== N→M resharding, in isolation
+def test_resize_spec_scales_only_the_data_axis():
+    assert mesh_mod.resize_spec(MeshSpec.parse("dp2"), 4).describe() == "dp4"
+    assert mesh_mod.resize_spec(MeshSpec.parse("dp4"), 2).describe() == "dp2"
+    # non-data axes describe how the MODEL is cut: they survive a resize
+    assert mesh_mod.resize_spec(MeshSpec.parse("dp2xtp2"), 8).describe() \
+        == "dp4xtp2"
+    assert mesh_mod.resize_spec(MeshSpec.parse("dp4xpp2"), 4).describe() \
+        == "dp2xpp2"
+
+
+def test_resize_refuses_non_divisible_widths_with_typed_error():
+    """A pp3 layout cannot live on 4 devices; the refusal is TYPED so
+    elastic callers keep the current width instead of tearing down."""
+    with pytest.raises(LayoutResizeError, match="pp3"):
+        mesh_mod.resize_spec(MeshSpec.parse("pp3"), 4)
+    with pytest.raises(LayoutResizeError, match="non-data degree"):
+        mesh_mod.resize_spec(MeshSpec.parse("dp2xtp2"), 5)
+    with pytest.raises(LayoutResizeError):
+        mesh_mod.resize_spec(MeshSpec.parse("dp4"), 0)
+    # LayoutResizeError IS a ValueError: pre-elastic callers that catch
+    # ValueError keep working
+    assert issubclass(LayoutResizeError, ValueError)
+    # ... and the same eager validation runs at Trainer.request_resize,
+    # the decision site — not an epoch later inside fit()
+    trainer = Trainer(_mlp(), layout="dp2xtp2")
+    with pytest.raises(LayoutResizeError):
+        trainer.request_resize(5)
+    with pytest.raises(ValueError, match="layout"):
+        Trainer(_mlp()).request_resize(2)   # single-device: no width
+
+
+def test_resized_layout_matches_from_scratch_derivation():
+    """The reshard primitive: resize_layout(dp2 → 4) derives exactly the
+    param/opt-state sharding trees a from-scratch dp4 build derives —
+    placing a checkpoint onto them IS the reshard."""
+    net = _mlp().init()
+    params = net.params_
+    leaves = functools.partial(jax.tree_util.tree_leaves)
+
+    base = mesh_mod.resolve_layout(layout="dp2")
+    grown = mesh_mod.resize_layout(base, 4)
+    scratch = mesh_mod.resolve_layout(layout="dp4")
+    assert grown.describe() == "dp4"
+    assert grown.spec.sizes() == scratch.spec.sizes()
+    assert grown.cache_signature() == scratch.cache_signature()
+    assert leaves(grown.param_sharding_tree(params)) \
+        == leaves(scratch.param_sharding_tree(params))
+    opt_state = {"mu": params, "nu": params, "count": np.zeros(())}
+    assert leaves(grown.opt_state_sharding_tree(opt_state, params)) \
+        == leaves(scratch.opt_state_sharding_tree(opt_state, params))
+    # placed values: numerically identical to the from-scratch placement
+    for a, b in zip(leaves(grown.shard_params(params)),
+                    leaves(scratch.shard_params(params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # shrink 4→2 is the same derivation in reverse
+    shrunk = mesh_mod.resize_layout(scratch, 2)
+    again = mesh_mod.resolve_layout(layout="dp2")
+    assert shrunk.describe() == "dp2"
+    assert leaves(shrunk.param_sharding_tree(params)) \
+        == leaves(again.param_sharding_tree(params))
+
+    # TP rules ride along: dp2xtp2 grown to 8 devices keeps its
+    # model-axis kernel sharding
+    tp8 = mesh_mod.resize_layout(mesh_mod.resolve_layout(layout="dp2xtp2"), 8)
+    assert tp8.describe() == "dp4xtp2"
+    specs = jax.tree_util.tree_leaves(
+        tp8.param_spec_tree(params), is_leaf=lambda s: isinstance(s, P))
+    assert any(s == P(None, AXIS_MODEL) for s in specs)
+
+
+# =============================== the 1e-6 contract across a live flip
+def test_grow_mid_run_matches_fixed_width_run(registry):
+    """THE tentpole pin: dp2 grows to dp4 at an epoch boundary inside
+    one continuous fit; losses and final params match a fixed-dp4 run
+    to 1e-6 with dropout ACTIVE (the RNG trajectory is width-invariant,
+    so the reshard — not luck — is what keeps the runs identical)."""
+    fixed_losses, fixed_params, _ = _elastic_run("dp4")
+    losses, params, trainer = _elastic_run("dp2", resize_to=4)
+    assert trainer._layout.spec.describe() == "dp4"
+    assert len(losses) == len(fixed_losses)
+    np.testing.assert_allclose(losses, fixed_losses, rtol=0, atol=1e-6)
+    np.testing.assert_allclose(params, fixed_params, rtol=0, atol=1e-6)
+    assert registry.counter("tpudl_elastic_grows_total").value == 1
+    assert registry.gauge("tpudl_elastic_gang_width").value == 4
+
+
+def test_shrink_mid_run_matches_fixed_width_run(registry):
+    """The reverse direction: dp4 shrinks to dp2 mid-run, same 1e-6
+    contract — shrink is no longer a one-way degradation ratchet."""
+    fixed_losses, fixed_params, _ = _elastic_run("dp2")
+    losses, params, trainer = _elastic_run("dp4", resize_to=2)
+    assert trainer._layout.spec.describe() == "dp2"
+    np.testing.assert_allclose(losses, fixed_losses, rtol=0, atol=1e-6)
+    np.testing.assert_allclose(params, fixed_params, rtol=0, atol=1e-6)
+    assert registry.counter("tpudl_elastic_shrinks_total").value == 1
+    assert registry.gauge("tpudl_elastic_gang_width").value == 2
+
+
+def test_crash_injected_mid_grow_leaves_old_layout_intact(registry):
+    """The ``gang.grow`` fault site fires before ANY state mutates: an
+    injected crash mid-reshard leaves the dp2 trainer fully consistent
+    (no torn placement), still trainable, and the same grow succeeds
+    once the fault is gone."""
+    x, y = _data()
+    trainer = Trainer(_mlp(), layout="dp2")
+    it = ArrayDataSetIterator(x, y, 16, shuffle=False)
+    trainer.fit(it, epochs=1)
+    with faults.inject("gang.grow@0:crash"):
+        with pytest.raises(faults.InjectedCrash):
+            trainer.resize_mesh(4)
+    assert trainer._layout.spec.total() == 2
+    assert trainer._layout_placed          # nothing was torn down
+    trainer.fit(it, epochs=1)              # still trainable at dp2
+    assert trainer._layout.spec.total() == 2
+    assert trainer.resize_mesh(4) is True  # the grow lands afterwards
+    assert trainer._layout.spec.total() == 4
+    assert registry.counter("tpudl_elastic_grows_total").value == 1
+
+
+# =================================================== ResizeCoordinator
+def test_resize_coordinator_lifecycle(registry):
+    events = []
+    rc = ResizeCoordinator(width=2, min_width=1, on_event=events.append)
+    with pytest.raises(ValueError):
+        rc.request(0)
+    with pytest.raises(ValueError, match="training floor"):
+        ResizeCoordinator(width=4, min_width=2).request(1)
+
+    d1 = rc.request(4, reason="spike")
+    assert d1.kind == "grow" and rc.pending() is d1 and rc.width == 2
+    d2 = rc.request(3)                      # latest wins over un-begun
+    assert rc.pending() is d2
+    begun = rc.begin()
+    assert begun is d2 and rc.in_flight() is d2 and rc.pending() is None
+    with pytest.raises(ValueError, match="in flight"):
+        rc.request(4)                       # one flip at a time
+    rc.commit(begun)
+    assert rc.width == 3 and begun.outcome == "committed"
+    assert begun.flip_s is not None and events[-1] is begun
+    assert registry.counter("tpudl_elastic_grows_total").value == 1
+    assert registry.gauge("tpudl_elastic_gang_width").value == 3
+
+    noop = rc.request(3)                    # recorded, never queued
+    assert noop.outcome == "noop" and rc.pending() is None
+
+    rc.request(2)
+    d3 = rc.begin()
+    rc.abort(d3, reason="relaunch failed")
+    assert rc.width == 3 and d3.outcome == "aborted"   # reversible
+    with pytest.raises(ValueError):
+        rc.commit(d3)                       # not in flight anymore
+    assert [d.outcome for d in rc.history] == ["committed", "noop",
+                                               "aborted"]
+
+
+def test_elastic_env_contract(monkeypatch):
+    monkeypatch.delenv(elastic.WIDTH_ENV, raising=False)
+    monkeypatch.delenv(elastic.GROWN_ENV, raising=False)
+    assert elastic.configured_width() is None
+    assert elastic.configured_width(default=3) == 3
+    assert not elastic.is_grown_child()
+    monkeypatch.setenv(elastic.WIDTH_ENV, "4")
+    monkeypatch.setenv(elastic.GROWN_ENV, "1")
+    assert elastic.configured_width() == 4
+    assert elastic.is_grown_child()
+
+
+def test_supervisor_resize_request_and_child_env(tmp_path):
+    sup = ClusterSupervisor(cluster_workers.trivial_worker, n_processes=2,
+                            min_workers=2, checkpoint_dir=str(tmp_path))
+    assert sup.width == 2
+    with pytest.raises(ValueError, match="training floor"):
+        sup.request_resize(1)               # the floor is eager
+    sup.request_resize(4, reason="test")
+    assert sup._resize.pending().to_width == 4
+    # grow generations carry the elastic env contract to every child
+    env = sup._child_env(1, [0, 1, 2, 3], None, grown=True)(2)
+    assert env[elastic.WIDTH_ENV] == "4"
+    assert env[elastic.GROWN_ENV] == "1"
+    assert env["DL4J_TPU_WORKER_ID"] == "w2"
+    env = sup._child_env(0, [0, 1], None)(0)
+    assert env[elastic.WIDTH_ENV] == "2"
+    assert env[elastic.GROWN_ENV] == ""
+
+
+def test_cluster_store_gang_width_and_resize_annotations():
+    store = ClusterStore()
+    assert store.summary()["gang_width"] is None
+    assert "gang width" in store.render_html()
+    store.set_gang_width(4)
+    store.annotate("resize", "resize#1 grow 2→4 [committed]",
+                   direction="grow", from_width=2, to_width=4,
+                   outcome="committed")
+    summary = store.summary()
+    assert summary["gang_width"] == 4
+    notes = [a for a in summary["annotations"] if a["kind"] == "resize"]
+    assert notes and notes[0]["to_width"] == 4
+    assert "[resize]" in store.render_html()
+
+
+# ================================================== DevicePoolArbiter
+class _FakeGang:
+    """Minimal gang side: width + request_resize, applied immediately."""
+
+    def __init__(self, width):
+        self._width = width
+        self.requests = []
+
+    @property
+    def width(self):
+        return self._width
+
+    def request_resize(self, width, reason=""):
+        self.requests.append((int(width), reason))
+        self._width = int(width)
+
+
+def _routed(tmp_path, replicas=2, max_replicas=4):
+    net = _mlp(dropout=False).init()
+    path = str(tmp_path / "serve.zip")
+    net.save(path)
+    models = ModelRegistry(max_batch=8, max_latency_ms=2, queue_limit=64)
+    models.deploy("m", path)
+    router = ReplicaRouter(models, "m", replicas=replicas,
+                           max_replicas=max_replicas)
+    return models, router, net
+
+
+def test_arbiter_borrow_return_cycle_conserves_inventory(tmp_path, registry):
+    models, router, _ = _routed(tmp_path)
+    gang = _FakeGang(4)
+    arb = DevicePoolArbiter(router, gang, min_train=2, chips_per_flip=2,
+                            cooldown_s=0.0, serve_chips=2)
+    assert arb.total() == 6
+    assert arb.borrow() is True
+    assert arb.snapshot() == {"serve": 4, "train": 2, "borrowed": 2,
+                              "total": 6}
+    assert gang.width == 2
+    assert router.replicas == 4 and router.max_replicas == 6
+    # the training floor: the next borrow would cross min_train → refused
+    # at the decision site, nothing torn down
+    assert arb.borrow() is False
+    assert arb.snapshot()["train"] == 2
+    assert arb.return_chips() is True
+    assert arb.snapshot() == {"serve": 2, "train": 4, "borrowed": 0,
+                              "total": 6}
+    assert gang.width == 4
+    assert router.replicas == 2 and router.max_replicas == 4
+    assert registry.counter("tpudl_elastic_borrows_total").value == 1
+    assert registry.counter("tpudl_elastic_returns_total").value == 1
+    gauge = registry.labeled_gauge("tpudl_elastic_pool_devices",
+                                   label_names=("owner",))
+    assert gauge.labeled_value(owner="train") == 4
+    assert gauge.labeled_value(owner="serve") == 2
+
+
+def test_arbiter_crash_mid_flip_never_leaks_a_device(tmp_path, registry):
+    """Crashes at the ``arbiter.borrow``/``arbiter.return`` sites fire
+    at the worst instant (between the gang request and the serve-side
+    mutation); the flip aborts with serve + train chip counts, router
+    capacity AND the gang width exactly as they were."""
+    models, router, _ = _routed(tmp_path)
+    gang = _FakeGang(4)
+    arb = DevicePoolArbiter(router, gang, min_train=1, chips_per_flip=2,
+                            cooldown_s=0.0, serve_chips=2)
+    before = arb.snapshot()
+    with faults.inject("arbiter.borrow@0:crash"):
+        assert arb.borrow() is False
+    assert arb.snapshot() == before
+    assert router.replicas == 2 and router.max_replicas == 4
+    assert gang.width == 4                   # rolled back
+    assert gang.requests[-1] == (4, "arbiter rollback")
+
+    assert arb.borrow() is True              # the pool is healthy
+    borrowed = arb.snapshot()
+    with faults.inject("arbiter.return@0:crash"):
+        assert arb.return_chips() is False
+    assert arb.snapshot() == borrowed
+    assert router.replicas == 4 and router.max_replicas == 6
+    assert gang.width == 2
+    assert arb.return_chips() is True
+    assert arb.snapshot() == before
+
+
+def test_arbiter_retries_transient_faults(tmp_path, registry):
+    """A transient InjectedFault at the borrow site is retried under
+    resilience.retry backoff — the flip still lands."""
+    models, router, _ = _routed(tmp_path)
+    gang = _FakeGang(4)
+    arb = DevicePoolArbiter(router, gang, min_train=1, chips_per_flip=1,
+                            cooldown_s=0.0, serve_chips=2,
+                            policy=RetryPolicy(max_attempts=3,
+                                               base_delay_s=0.0, jitter=0.0))
+    with faults.inject("arbiter.borrow@0:error"):
+        assert arb.borrow() is True
+    assert arb.snapshot() == {"serve": 3, "train": 3, "borrowed": 1,
+                              "total": 6}
+
+
+def test_arbiter_hysteresis_and_cooldown(tmp_path, registry):
+    models, router, _ = _routed(tmp_path)
+    gang = _FakeGang(4)
+    arb = DevicePoolArbiter(router, gang, min_train=1, chips_per_flip=1,
+                            high_water=0.5, low_water=0.05,
+                            sustain_polls=3, cooldown_s=0.0, serve_chips=2)
+    # a borrow needs sustain_polls CONSECUTIVE saturated-high polls; a
+    # mid-band sample resets the streak
+    assert arb.note_pressure(0.9, saturated=True) is None
+    assert arb.note_pressure(0.9, saturated=True) is None
+    assert arb.note_pressure(0.3) is None                # streak reset
+    assert arb.note_pressure(0.9, saturated=True) is None
+    assert arb.note_pressure(0.9) is None                # not saturated
+    assert arb.note_pressure(0.9, saturated=True) is None
+    assert arb.note_pressure(0.9, saturated=True) is None
+    assert arb.note_pressure(0.9, saturated=True) == "borrow"
+    assert arb.borrowed == 1
+    # pressure ebbs: the return needs its own sustained calm window
+    assert arb.note_pressure(0.0) is None
+    assert arb.note_pressure(0.0) is None
+    assert arb.note_pressure(0.0) == "return"
+    assert arb.borrowed == 0 and gang.width == 4
+
+    # cooldown separates any two flips
+    arb2 = DevicePoolArbiter(router, _FakeGang(4), min_train=1,
+                             sustain_polls=1, cooldown_s=3600.0,
+                             serve_chips=2)
+    assert arb2.borrow() is True
+    for _ in range(5):
+        assert arb2.note_pressure(0.0) is None   # cooldown gates it
+    assert arb2.borrowed == 1
+
+
+def test_autoscaler_escalates_to_arbiter_on_saturation():
+    """The escalation signal: an up-decision that hits max_replicas
+    while pressure persists reports ``saturated=True`` to the arbiter —
+    replica scaling is spent, only chips will help."""
+
+    class _StubRouter:
+        name = "m"
+        fill = 0.9
+
+        def heal(self):
+            pass
+
+        def queue_fill(self):
+            return self.fill
+
+        def add_replica(self):
+            return False                     # max_replicas spent
+
+        def retire_replica(self):
+            return False
+
+    class _Recorder:
+        def __init__(self):
+            self.calls = []
+
+        def note_pressure(self, fill, saturated=False):
+            self.calls.append((fill, saturated))
+
+    router, rec = _StubRouter(), _Recorder()
+    auto = Autoscaler(router, AutoscaleConfig(poll_s=30.0,
+                                              up_cooldown_s=0.0, window=1),
+                      arbiter=rec)
+    try:
+        auto.step()
+        assert (0.9, True) in rec.calls
+        router.fill = 0.0                    # pressure gone
+        auto.step()
+        assert rec.calls[-1] == (0.0, False)
+    finally:
+        auto.close()
+
+
+def test_trainer_gang_requires_a_layout():
+    with pytest.raises(ValueError, match="layout"):
+        TrainerGang(Trainer(_mlp()))
+
+
+def test_borrow_return_under_live_serve_load(tmp_path, registry):
+    """The acceptance cycle: sustained pressure borrows 2 training chips
+    (the dp4 gang shrinks to dp2 at its next round boundary, serve
+    replicas rise), pressure ebbs, the chips return and the gang grows
+    back to dp4 — all while live serve traffic sees zero dropped or
+    garbled responses."""
+    models, router, snet = _routed(tmp_path)
+    x, y = _data()
+    trainer = Trainer(_mlp(), layout="dp4")
+    it = ArrayDataSetIterator(x, y, 16, shuffle=False)
+    trainer.fit(it, epochs=1)
+    arb = DevicePoolArbiter(router, TrainerGang(trainer), min_train=2,
+                            chips_per_flip=2, cooldown_s=0.0, serve_chips=2)
+    xs = x[:8]
+    expected = np.asarray(snet.output(xs))
+    stop, errors, served = threading.Event(), [], [0]
+
+    def client():
+        while not stop.is_set():
+            try:
+                out, _ = models.predict_versioned("m", xs, timeout_s=30)
+                np.testing.assert_allclose(out, expected, rtol=1e-5,
+                                           atol=1e-6)
+                served[0] += 1
+            except Exception as e:           # noqa: BLE001 — the assertion
+                errors.append(repr(e))
+                return
+    threads = [threading.Thread(target=client) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        assert arb.borrow() is True
+        trainer.fit(it, epochs=1)            # shrink applies at the boundary
+        assert trainer._layout.spec.total() == 2
+        assert arb.return_chips() is True
+        trainer.fit(it, epochs=1)            # ... and the grow-back too
+        assert trainer._layout.spec.total() == 4
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    assert not errors, errors[:3]
+    assert served[0] > 0
+    assert arb.snapshot() == {"serve": 2, "train": 4, "borrowed": 0,
+                              "total": 6}
+    assert router.replicas == 2 and router.max_replicas == 4
+
+
+# ================================= supervised gang grow/shrink (e2e)
+def _drive_resize(sup, to_width, reason):
+    """Run ``sup`` on a thread; once the gang has produced a verified
+    checkpoint, request the resize from the main thread (the arbiter's
+    seat).  Returns the completed SupervisedRun."""
+    result = {}
+
+    def run():
+        try:
+            result["run"] = sup.run()
+        except BaseException as e:           # surfaced by the caller
+            result["error"] = e
+    thread = threading.Thread(target=run)
+    thread.start()
+    deadline = time.monotonic() + 150.0
+    while time.monotonic() < deadline and sup._latest_checkpoint() is None \
+            and thread.is_alive():
+        time.sleep(0.02)
+    assert sup._latest_checkpoint() is not None, \
+        f"no verified checkpoint appeared: {result.get('error')}"
+    sup.request_resize(to_width, reason=reason)
+    thread.join(timeout=300.0)
+    assert not thread.is_alive(), "supervised run did not finish"
+    if "error" in result:
+        raise result["error"]
+    return result["run"]
+
+
+@pytest.mark.slow
+def test_supervised_gang_grows_2_to_4_and_matches_reference(tmp_path,
+                                                            registry):
+    """THE elastic acceptance e2e: a supervised 2-worker gang is asked
+    to grow mid-run; the supervisor tears it down at the round boundary,
+    relaunches 4 workers that resume from the shared verified checkpoint
+    with params/opt-state resharded onto the dp4 layout, and every
+    worker's post-boundary losses + final params match the uninterrupted
+    reference to 1e-6 (dropout active)."""
+    ref_losses, ref_params = cluster_workers.run_elastic_reference(epochs=4)
+    fn = functools.partial(cluster_workers.elastic_train_worker,
+                           workdir=str(tmp_path), epochs=4)
+    from deeplearning4j_tpu.obs.ui_server import UIServer
+    server = UIServer(port=0)
+    try:
+        sup = ClusterSupervisor(
+            fn, n_processes=2, checkpoint_dir=str(tmp_path),
+            max_restarts=2, min_workers=1, port=25611, timeout=240.0,
+            local_devices=4, remote_ui=server.url,
+            cluster_store=server.cluster, extra_env=_ENV,
+            backoff=RetryPolicy(max_attempts=3, base_delay_s=0.0,
+                                jitter=0.0))
+        run = _drive_resize(sup, 4, reason="test grow")
+
+        # a planned resize is a round boundary, NOT an incident
+        assert run.incidents == []
+        assert run.slots == [0, 1, 2, 3]
+        assert run.generations >= 2
+        assert sup.width == 4
+        results = {r["pid"]: r for r in run.results}
+        assert sorted(results) == [0, 1, 2, 3]
+        for r in results.values():
+            assert r["width"] == 4 and r["grown"]
+            start = r["end_iteration"] - len(r["losses"])
+            # resumed post-boundary tail, not a from-scratch replay
+            assert 0 < start and len(r["losses"]) < len(ref_losses)
+            np.testing.assert_allclose(r["losses"], ref_losses[start:],
+                                       atol=1e-6)
+            np.testing.assert_allclose(r["params"], ref_params, atol=1e-6)
+        # the flip was committed and annotated
+        assert registry.counter("tpudl_elastic_grows_total").value == 1
+        assert registry.gauge("tpudl_elastic_gang_width").value == 4
+        summary = server.cluster.summary()
+        assert summary["gang_width"] == 4
+        kinds = [a["kind"] for a in summary["annotations"]]
+        assert "resize" in kinds
+    finally:
+        server.stop()
+
+
+@pytest.mark.slow
+def test_kill_injected_at_gang_grow_recovers_via_respawn(tmp_path,
+                                                         registry):
+    """Chaos on the grow path: the NEW worker slot SIGKILLs itself at
+    the ``gang.grow`` site (right after restoring the checkpoint —
+    mid-reshard).  The supervisor treats it like any worker death:
+    respawn at the grown width from the still-intact verified
+    checkpoint; the run completes at width 4 with the reference params,
+    proving no torn checkpoint and no leaked worker slot."""
+    ref_losses, ref_params = cluster_workers.run_elastic_reference(epochs=4)
+    fn = functools.partial(cluster_workers.elastic_train_worker,
+                           workdir=str(tmp_path), epochs=4,
+                           kill_on_grow=True)
+    sup = ClusterSupervisor(
+        fn, n_processes=2, checkpoint_dir=str(tmp_path),
+        max_restarts=2, min_workers=1, port=25811, timeout=240.0,
+        local_devices=4, extra_env=_ENV,
+        backoff=RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter=0.0))
+    run = _drive_resize(sup, 4, reason="test grow under chaos")
+
+    assert len(run.incidents) == 1
+    incident = run.incidents[0]
+    assert incident.reason == "killed"
+    assert any(slot == 2 and rc is not None and rc < 0
+               for slot, rc in incident.exits)
+    assert incident.restarted
+    # no leaked slot: the gang ends at exactly the requested width
+    assert run.slots == [0, 1, 2, 3]
+    assert sup.width == 4
+    results = {r["pid"]: r for r in run.results}
+    assert sorted(results) == [0, 1, 2, 3]
+    for r in results.values():
+        assert r["width"] == 4
+        start = r["end_iteration"] - len(r["losses"])
+        np.testing.assert_allclose(r["losses"], ref_losses[start:],
+                                   atol=1e-6)
+        np.testing.assert_allclose(r["params"], ref_params, atol=1e-6)
+    assert registry.counter("tpudl_elastic_grows_total").value == 1
+    assert registry.counter(
+        "tpudl_resilience_gang_restarts_total").value == 1

@@ -244,3 +244,13 @@ def test_stragglers_helper():
     assert stragglers({"a": 0.01}, factor=2.0) == []       # need >= 2
     assert stragglers({"a": 0.01, "b": None, "c": 0.05}, factor=2.0) \
         == ["c"]
+    # absolute-excess jitter guard: a worker whose millisecond median
+    # doubled under host scheduler noise is NOT a straggler (relative
+    # ratio alone would flag w1 here — observed flake on a loaded box)
+    assert stragglers({"w0": 0.252, "w1": 0.0151, "w2": 0.0069,
+                       "w3": 0.0071}, factor=2.0) == ["w0"]
+    # ... but the guard yields once the excess clears min_excess_s
+    assert stragglers({"a": 0.01, "b": 0.011, "c": 0.04},
+                      factor=2.0, min_excess_s=0.02) == ["c"]
+    assert stragglers({"a": 0.01, "b": 0.011, "c": 0.04},
+                      factor=2.0, min_excess_s=0.05) == []

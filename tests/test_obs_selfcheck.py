@@ -99,7 +99,16 @@ def test_bench_skip_path_runs_cpu_records_and_exits_zero(monkeypatch,
                                  "collective_bytes_per_step": 506928},
                          "dp2xpp2": {"steps_per_s": 90.0,
                                      "arith_intensity": 1.5,
-                                     "collective_bytes_per_step": 806976}}}})
+                                     "collective_bytes_per_step": 806976}}},
+                 "elastic": {
+                     "metric": "elastic_pool", "value": 1.0,
+                     "grow": {"from_width": 2, "to_width": 4,
+                              "post_boundary_max_loss_delta": 0.0,
+                              "matches_fixed_width": True},
+                     "arbiter": {"p99_held": True,
+                                 "grow_back_mttr_s": 0.04,
+                                 "zero_dropped_or_garbled": True,
+                                 "width_restored": True}}})
     monkeypatch.setattr(
         bench, "bench_online",
         lambda: {"metric": "online_feedback_to_deploy_seconds",
@@ -148,6 +157,16 @@ def test_bench_skip_path_runs_cpu_records_and_exits_zero(monkeypatch,
         assert row["steps_per_s"] > 0
         assert row["collective_bytes_per_step"] > 0
         assert "arith_intensity" in row
+    # ... and the ISSUE-19 elastic-pool row rides the same record on
+    # both paths: the grow 1e-6 contract and the borrow/return cycle
+    # (serve p99 held, gang grown back) are CPU-measurable evidence
+    elastic = multichip["elastic"]
+    assert elastic["grow"]["matches_fixed_width"] is True
+    assert elastic["grow"]["post_boundary_max_loss_delta"] <= 1e-6
+    assert elastic["arbiter"]["p99_held"] is True
+    assert elastic["arbiter"]["zero_dropped_or_garbled"] is True
+    assert elastic["arbiter"]["width_restored"] is True
+    assert elastic["arbiter"]["grow_back_mttr_s"] is not None
     # ... and so does the continual-learning loop row: feedback→deploy
     # latency, gate eval seconds and rollback MTTR are CPU-measurable
     online = record["detail"]["online"]
